@@ -1,0 +1,24 @@
+"""sketch_rnn_tpu — a TPU-native sketch-rnn framework.
+
+A ground-up JAX/XLA re-design of the capability surface of the reference
+(ByzanTine/sketch-rnn; see SURVEY.md — the reference mount was empty when
+surveyed, so citations are to SURVEY.md sections and BASELINE.json):
+
+- stroke-5 QuickDraw data pipeline (SURVEY §2 component 1)
+- LSTM / LayerNorm-LSTM / HyperLSTM cells as pure ``lax.scan`` step
+  functions (components 2-5; the cuDNN fused path becomes XLA-fused scan)
+- seq2seq VAE: bi-LSTM encoder, reparameterized latent, autoregressive
+  decoder, 20-component bivariate-GMM + pen mixture-density head
+  (components 6-10)
+- single-jit training step with optax, KL annealing, gradient clipping,
+  data-parallel over a ``jax.sharding.Mesh`` with ICI collectives in
+  place of NCCL (components 11, 18)
+- fully on-device autoregressive sampling via ``lax.while_loop``
+  (component 15)
+"""
+
+from sketch_rnn_tpu.config import HParams, get_default_hparams
+
+__version__ = "0.1.0"
+
+__all__ = ["HParams", "get_default_hparams", "__version__"]

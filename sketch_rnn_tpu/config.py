@@ -1,0 +1,150 @@
+"""Hyperparameter / config system.
+
+TPU-native equivalent of the reference's ``get_default_hparams()`` +
+``tf.app.flags`` hparams-string override machinery (SURVEY.md §2 component 14,
+§5 "Config / flag system"; reference unreadable — canonical defaults follow
+the sketch-rnn paper, arXiv:1704.03477, and BASELINE.json's fixed values:
+enc_rnn_size=256, dec_rnn_size=512, z_size=128, num_mixture=20).
+
+Design: a frozen dataclass (hashable, so it can ride as a static argument
+through ``jax.jit``) plus a ``parse()`` string-override path mirroring the
+reference's ``--hparams=key=value,key=value`` CLI contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Tuple
+
+CELL_TYPES = ("lstm", "layer_norm", "hyper")
+
+
+@dataclasses.dataclass(frozen=True)
+class HParams:
+    """All knobs for data, model, loss, optimizer and parallelism."""
+
+    # --- data (SURVEY §2 component 1) ---
+    data_dir: str = ""
+    data_set: Tuple[str, ...] = ("cat.npz",)
+    max_seq_len: int = 250
+    batch_size: int = 100
+    random_scale_factor: float = 0.15  # stroke augmentation scale jitter
+    augment_stroke_prob: float = 0.10  # prob of dropping a point (train only)
+
+    # --- model (components 2-10) ---
+    conditional: bool = True           # seq2seq VAE vs decoder-only
+    enc_model: str = "lstm"            # encoder cell: lstm | layer_norm | hyper
+    dec_model: str = "lstm"            # decoder cell: lstm | layer_norm | hyper
+    enc_rnn_size: int = 256            # per-direction encoder width
+    dec_rnn_size: int = 512
+    z_size: int = 128
+    num_mixture: int = 20
+    # HyperLSTM sub-network (component 4)
+    hyper_rnn_size: int = 256
+    hyper_embed_size: int = 32
+    # class-conditional decoding (BASELINE configs 4-5; flagged UNVERIFIED in
+    # SURVEY §3.5 — implemented as an optional learned class embedding
+    # concatenated to the decoder input)
+    num_classes: int = 0
+    class_embed_size: int = 64
+
+    # --- regularization ---
+    use_recurrent_dropout: bool = True
+    recurrent_dropout_keep: float = 0.90
+    use_input_dropout: bool = False
+    input_dropout_keep: float = 0.90
+    use_output_dropout: bool = False
+    output_dropout_keep: float = 0.90
+
+    # --- VAE loss (component 10) ---
+    kl_weight: float = 0.5
+    kl_weight_start: float = 0.01
+    kl_decay_rate: float = 0.99995
+    kl_tolerance: float = 0.20
+
+    # --- optimizer (component 11) ---
+    learning_rate: float = 1e-3
+    decay_rate: float = 0.9999
+    min_learning_rate: float = 1e-5
+    grad_clip: float = 1.0
+
+    # --- training loop (component 12) ---
+    num_steps: int = 100000
+    save_every: int = 500
+    eval_every: int = 500
+    log_every: int = 20
+
+    # --- TPU / parallelism (component 18) ---
+    compute_dtype: str = "float32"     # "bfloat16" for MXU-friendly matmuls
+    mesh_shape: Tuple[int, ...] = (-1,)  # -1 = all devices on the data axis
+    mesh_axes: Tuple[str, ...] = ("data",)
+
+    def __post_init__(self):
+        if self.enc_model not in CELL_TYPES or self.dec_model not in CELL_TYPES:
+            raise ValueError(
+                f"cell types must be one of {CELL_TYPES}, got "
+                f"enc={self.enc_model!r} dec={self.dec_model!r}")
+        if self.batch_size <= 0 or self.max_seq_len <= 0:
+            raise ValueError("batch_size and max_seq_len must be positive")
+
+    # -- overrides ---------------------------------------------------------
+
+    def replace(self, **kw: Any) -> "HParams":
+        return dataclasses.replace(self, **kw)
+
+    def parse(self, spec: str) -> "HParams":
+        """Apply a reference-style ``key=value,key=value`` override string."""
+        if not spec:
+            return self
+        fields = {f.name: f for f in dataclasses.fields(self)}
+        out: dict = {}
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if "=" not in item:
+                raise ValueError(f"bad hparam override {item!r} (want key=value)")
+            key, val = item.split("=", 1)
+            key = key.strip()
+            if key not in fields:
+                raise ValueError(f"unknown hparam {key!r}")
+            out[key] = _coerce(val.strip(), self.__getattribute__(key))
+        return self.replace(**out)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "HParams":
+        raw = json.loads(text)
+        for k, v in raw.items():
+            if isinstance(v, list):
+                raw[k] = tuple(v)
+        return cls(**raw)
+
+
+def _coerce(val: str, like: Any) -> Any:
+    """Coerce a string override to the type of the current field value."""
+    if isinstance(like, bool):  # before int: bool is an int subclass
+        low = val.lower()
+        if low in ("1", "true", "t", "yes"):
+            return True
+        if low in ("0", "false", "f", "no"):
+            return False
+        raise ValueError(f"bad bool {val!r}")
+    if isinstance(like, int):
+        return int(val)
+    if isinstance(like, float):
+        return float(val)
+    if isinstance(like, tuple):
+        items = [s for s in val.split(";") if s]
+        if like and isinstance(like[0], int):
+            return tuple(int(s) for s in items)
+        return tuple(items)
+    return val
+
+
+def get_default_hparams() -> HParams:
+    """Reference-parity defaults (SURVEY §5 'Config / flag system')."""
+    return HParams()

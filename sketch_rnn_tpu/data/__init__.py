@@ -1,0 +1,27 @@
+from sketch_rnn_tpu.data.strokes import (
+    augment_strokes,
+    calculate_normalizing_scale_factor,
+    normalize_strokes,
+    random_scale,
+    strokes_to_lines,
+    to_big_strokes,
+    to_normal_strokes,
+)
+from sketch_rnn_tpu.data.loader import (
+    DataLoader,
+    load_dataset,
+    make_synthetic_strokes,
+)
+
+__all__ = [
+    "DataLoader",
+    "augment_strokes",
+    "calculate_normalizing_scale_factor",
+    "load_dataset",
+    "make_synthetic_strokes",
+    "normalize_strokes",
+    "random_scale",
+    "strokes_to_lines",
+    "to_big_strokes",
+    "to_normal_strokes",
+]

@@ -1,0 +1,258 @@
+"""QuickDraw dataset loading and batching (host-side numpy).
+
+TPU-native equivalent of the reference's ``DataLoader`` / ``load_dataset``
+(SURVEY.md §2 component 1, §3.5; reference unreadable — semantics per the
+canonical pipeline described there):
+
+- read per-category ``.npz`` files with ``train``/``valid``/``test`` arrays
+  of stroke-3 int16 sequences,
+- drop sequences longer than ``max_seq_len``, clamp extreme offsets,
+- normalize offsets by the *train* split's std (the scale factor is part of
+  the model contract and is checkpointed),
+- pad to ``max_seq_len`` in stroke-5 with a prepended start token,
+- random-scale + point-dropout augmentation at train time.
+
+QuickDraw data is not present in this environment (SURVEY §7 'Data
+availability'), so ``make_synthetic_strokes`` provides a deterministic
+synthetic sketch distribution behind the same interface; the real-data path
+is exercised by tests that write tiny ``.npz`` files.
+
+Batches stay host-side numpy; the trainer moves them onto the device mesh
+with a single sharded transfer per step (SURVEY §3.1 boundary notes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data import strokes as S
+
+
+def _purify(stroke3_list, max_seq_len: int, limit: float = 1000.0):
+    """Drop too-long sequences; clamp absurd offsets to ±limit."""
+    out = []
+    for s in stroke3_list:
+        if len(s) == 0 or len(s) > max_seq_len:
+            continue
+        s = np.array(s, dtype=np.float32)
+        s[:, 0:2] = np.clip(s[:, 0:2], -limit, limit)
+        out.append(s)
+    return out
+
+
+class DataLoader:
+    """Pads, normalizes, augments and batches stroke-3 sequences.
+
+    ``random_batch``/``get_batch`` return a dict:
+
+    - ``"strokes"``: ``[B, max_seq_len + 1, 5]`` float32 stroke-5 with the
+      start token ``(0, 0, 1, 0, 0)`` at t=0,
+    - ``"seq_len"``: ``[B]`` int32 true lengths (excluding start token),
+    - ``"labels"``: ``[B]`` int32 class ids (zeros when unlabeled).
+    """
+
+    def __init__(self,
+                 stroke3_list: Sequence[np.ndarray],
+                 hps: HParams,
+                 labels: Optional[np.ndarray] = None,
+                 augment: bool = False,
+                 seed: int = 0):
+        self.hps = hps
+        self.strokes: List[np.ndarray] = [np.array(s, np.float32)
+                                          for s in stroke3_list]
+        if labels is None:
+            labels = np.zeros((len(self.strokes),), dtype=np.int32)
+        self.labels = np.asarray(labels, dtype=np.int32)
+        assert len(self.labels) == len(self.strokes)
+        self.augment = augment
+        self.rng = np.random.default_rng(seed)
+        self.num_batches = len(self.strokes) // hps.batch_size
+
+    def __len__(self) -> int:
+        return len(self.strokes)
+
+    # -- normalization -----------------------------------------------------
+
+    def calculate_normalizing_scale_factor(self) -> float:
+        return S.calculate_normalizing_scale_factor(self.strokes)
+
+    def normalize(self, scale_factor: float) -> None:
+        self.strokes = S.normalize_strokes(self.strokes, scale_factor)
+
+    # -- batching ----------------------------------------------------------
+
+    def _pad_batch(self, batch: Sequence[np.ndarray]) -> np.ndarray:
+        nmax = self.hps.max_seq_len
+        out = np.zeros((len(batch), nmax + 1, 5), dtype=np.float32)
+        for i, s in enumerate(batch):
+            big = S.to_big_strokes(s, nmax)      # [nmax, 5]
+            out[i, 1:, :] = big
+            out[i, 0, :] = [0, 0, 1, 0, 0]       # start token
+        return out
+
+    def _assemble(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        raw = []
+        for i in idx:
+            s = self.strokes[i]
+            if self.augment:
+                s = S.random_scale(s, self.hps.random_scale_factor, self.rng)
+                s = S.augment_strokes(s, self.hps.augment_stroke_prob, self.rng)
+            raw.append(s)
+        return {
+            "strokes": self._pad_batch(raw),
+            "seq_len": np.array([len(s) for s in raw], dtype=np.int32),
+            "labels": self.labels[idx],
+        }
+
+    def random_batch(self) -> Dict[str, np.ndarray]:
+        idx = self.rng.choice(len(self.strokes), self.hps.batch_size,
+                              replace=len(self.strokes) < self.hps.batch_size)
+        return self._assemble(idx)
+
+    def get_batch(self, batch_index: int) -> Dict[str, np.ndarray]:
+        if not 0 <= batch_index < self.num_batches:
+            raise IndexError(f"batch {batch_index} of {self.num_batches}")
+        lo = batch_index * self.hps.batch_size
+        idx = np.arange(lo, lo + self.hps.batch_size)
+        return self._assemble(idx)
+
+
+# -- dataset assembly ------------------------------------------------------
+
+
+def load_dataset(hps: HParams,
+                 data_dir: Optional[str] = None,
+                 host_id: int = 0,
+                 num_hosts: int = 1,
+                 ) -> Tuple[DataLoader, DataLoader, DataLoader, float]:
+    """Read category ``.npz`` files and build train/valid/test loaders.
+
+    Multi-category configs (BASELINE configs 4-5) pool the categories and
+    attach the category index as the class label. ``host_id``/``num_hosts``
+    stripe the training examples across hosts for multi-host data
+    parallelism (each host feeds its own slice of the global batch).
+
+    Returns ``(train, valid, test, scale_factor)``; every split is
+    normalized by the train split's scale factor (SURVEY §3.5).
+    """
+    data_dir = data_dir or hps.data_dir
+    splits = {"train": ([], []), "valid": ([], []), "test": ([], [])}
+    for label, name in enumerate(hps.data_set):
+        path = os.path.join(data_dir, name)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"{path} not found; QuickDraw .npz files are required "
+                f"(or use make_synthetic_strokes for a synthetic corpus)")
+        with np.load(path, allow_pickle=True, encoding="latin1") as npz:
+            for split in splits:
+                seqs = _purify(list(npz[split]), hps.max_seq_len)
+                splits[split][0].extend(seqs)
+                splits[split][1].extend([label] * len(seqs))
+
+    _SEEDS = {"train": 1, "valid": 2, "test": 3}  # fixed: runs must be reproducible
+
+    def build(split: str, augment: bool, shard: bool) -> DataLoader:
+        seqs, labels = splits[split]
+        if not seqs:
+            raise ValueError(
+                f"{split} split is empty after filtering to "
+                f"max_seq_len={hps.max_seq_len}; raise max_seq_len or check "
+                f"the data files {hps.data_set}")
+        if shard and num_hosts > 1:
+            seqs = seqs[host_id::num_hosts]
+            labels = labels[host_id::num_hosts]
+        return DataLoader(seqs, hps, labels=np.array(labels, np.int32),
+                          augment=augment, seed=_SEEDS[split] + 7919 * host_id)
+
+    # Scale factor comes from the FULL train split, before host sharding:
+    # every host must normalize identically (it is part of the model contract
+    # and is checkpointed — SURVEY §5 'Checkpoint / resume').
+    if not splits["train"][0]:
+        raise ValueError(
+            f"train split is empty after filtering to "
+            f"max_seq_len={hps.max_seq_len}; raise max_seq_len or check "
+            f"the data files {hps.data_set}")
+    scale = S.calculate_normalizing_scale_factor(splits["train"][0])
+    train = build("train", augment=True, shard=True)
+    valid = build("valid", augment=False, shard=False)
+    test = build("test", augment=False, shard=False)
+    for dl in (train, valid, test):
+        dl.normalize(scale)
+    return train, valid, test, scale
+
+
+# -- synthetic corpus ------------------------------------------------------
+
+
+def make_synthetic_strokes(num: int,
+                           num_classes: int = 1,
+                           min_len: int = 24,
+                           max_len: int = 96,
+                           seed: int = 0,
+                           fixed_class: Optional[int] = None,
+                           ) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Deterministic synthetic sketch corpus (SURVEY §7 'Data availability').
+
+    Each class is a distinct parametric figure (ellipses / zigzags / spirals
+    with class-dependent frequency), drawn as 1-3 pen strokes with noise, so
+    models can measurably overfit and class-conditioning is learnable.
+
+    Returns ``(stroke3_list, labels)``.
+    """
+    rng = np.random.default_rng(seed)
+    out: List[np.ndarray] = []
+    if fixed_class is not None:
+        labels = np.full((num,), fixed_class, dtype=np.int32)
+    else:
+        labels = rng.integers(0, num_classes, size=num).astype(np.int32)
+    for i in range(num):
+        c = int(labels[i])
+        n = int(rng.integers(min_len, max_len + 1))
+        t = np.linspace(0.0, 2.0 * np.pi, n)
+        freq = 1.0 + c % 3
+        radius = 1.0 + 0.5 * ((c // 3) % 3)
+        phase = rng.random() * 2 * np.pi
+        if c % 2 == 0:  # loopy figure
+            x = radius * np.cos(freq * t + phase)
+            y = radius * np.sin(t + phase) * (0.5 + 0.5 * (c % 5) / 4)
+        else:           # zigzag figure
+            x = t / np.pi - 1.0
+            y = radius * np.sign(np.sin(freq * t + phase)) * (t / (2 * np.pi))
+        x = x + rng.normal(0, 0.02, n)
+        y = y + rng.normal(0, 0.02, n)
+        dx = np.diff(x, prepend=x[0]).astype(np.float32)
+        dy = np.diff(y, prepend=y[0]).astype(np.float32)
+        pen = np.zeros(n, dtype=np.float32)
+        lift_pool = np.arange(4, n - 2)
+        n_strokes = int(rng.integers(1, 2 + min(2, len(lift_pool))))
+        lifts = rng.choice(lift_pool, size=n_strokes - 1,
+                           replace=False) if n_strokes > 1 else []
+        for j in lifts:
+            pen[j] = 1.0
+        pen[-1] = 1.0
+        out.append(np.stack([dx, dy, pen], axis=1))
+    return out, labels
+
+
+def write_synthetic_npz(path: str, num_train: int = 200, num_valid: int = 50,
+                        num_test: int = 50, class_id: int = 0,
+                        seed: int = 0, **kw) -> None:
+    """Write a synthetic corpus as a QuickDraw-shaped ``.npz`` file.
+
+    QuickDraw ``.npz`` files are single-category (one file per class; the
+    class label of a pooled dataset is the file's index in
+    ``hps.data_set``, matching ``load_dataset``). ``class_id`` selects which
+    synthetic figure family this file draws, so multi-file corpora have
+    visually distinct classes.
+    """
+    sets = {}
+    for split, n, s in (("train", num_train, seed), ("valid", num_valid,
+                        seed + 1), ("test", num_test, seed + 2)):
+        seqs, _ = make_synthetic_strokes(n, fixed_class=class_id,
+                                         seed=s, **kw)
+        sets[split] = np.array(seqs, dtype=object)
+    np.savez_compressed(path, **sets)

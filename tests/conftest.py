@@ -1,0 +1,15 @@
+"""Test harness setup: force an 8-device CPU platform BEFORE jax imports.
+
+This is the multi-chip-without-cluster mechanism from SURVEY.md §4: all
+sharding/DP tests run on 8 virtual CPU devices so the full mesh path is
+exercised without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

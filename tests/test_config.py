@@ -1,0 +1,44 @@
+import pytest
+
+from sketch_rnn_tpu.config import HParams, get_default_hparams
+
+
+def test_defaults_match_baseline_fixed_values():
+    hps = get_default_hparams()
+    # fixed by BASELINE.json
+    assert hps.enc_rnn_size == 256
+    assert hps.dec_rnn_size == 512
+    assert hps.z_size == 128
+    assert hps.num_mixture == 20
+    # canonical (SURVEY §5)
+    assert hps.batch_size == 100
+    assert hps.max_seq_len == 250
+    assert hps.grad_clip == 1.0
+
+
+def test_parse_overrides():
+    hps = get_default_hparams().parse(
+        "dec_rnn_size=64, kl_weight=0.25,conditional=false,"
+        "data_set=a.npz;b.npz,dec_model=hyper")
+    assert hps.dec_rnn_size == 64
+    assert hps.kl_weight == 0.25
+    assert hps.conditional is False
+    assert hps.data_set == ("a.npz", "b.npz")
+    assert hps.dec_model == "hyper"
+
+
+def test_parse_rejects_unknown_and_bad_cells():
+    with pytest.raises(ValueError):
+        get_default_hparams().parse("nonexistent=3")
+    with pytest.raises(ValueError):
+        get_default_hparams().replace(dec_model="gru")
+
+
+def test_json_roundtrip():
+    hps = get_default_hparams().replace(num_classes=75, dec_model="layer_norm")
+    again = HParams.from_json(hps.to_json())
+    assert again == hps
+
+
+def test_hashable_for_jit_static_args():
+    assert hash(get_default_hparams()) == hash(get_default_hparams())

@@ -1,0 +1,96 @@
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import get_default_hparams
+from sketch_rnn_tpu.data import DataLoader, load_dataset, make_synthetic_strokes
+from sketch_rnn_tpu.data.loader import write_synthetic_npz
+
+
+@pytest.fixture
+def hps():
+    return get_default_hparams().replace(
+        batch_size=8, max_seq_len=100, data_set=("synth.npz",))
+
+
+def test_synthetic_generator_shapes():
+    seqs, labels = make_synthetic_strokes(20, num_classes=4, seed=1)
+    assert len(seqs) == 20 and labels.shape == (20,)
+    assert set(np.unique(labels)).issubset(set(range(4)))
+    for s in seqs:
+        assert s.ndim == 2 and s.shape[1] == 3
+        assert s[-1, 2] == 1.0  # sketch ends with a pen lift
+
+
+def test_synthetic_generator_deterministic():
+    a, la = make_synthetic_strokes(5, seed=7)
+    b, lb = make_synthetic_strokes(5, seed=7)
+    np.testing.assert_array_equal(la, lb)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_loader_batch_contract(hps):
+    seqs, labels = make_synthetic_strokes(32, num_classes=3, max_len=90)
+    dl = DataLoader(seqs, hps, labels=labels, augment=False)
+    batch = dl.random_batch()
+    st = batch["strokes"]
+    assert st.shape == (8, hps.max_seq_len + 1, 5)
+    assert st.dtype == np.float32
+    # start token at t=0
+    np.testing.assert_array_equal(st[:, 0, :],
+                                  np.tile([0, 0, 1, 0, 0], (8, 1)))
+    # one-hot pen states everywhere
+    np.testing.assert_allclose(st[:, :, 2:].sum(-1), 1.0)
+    # seq_len matches the first end-of-sketch row (offset by start token)
+    for i in range(8):
+        n = batch["seq_len"][i]
+        assert st[i, n, 4] == 0.0 or n == 0
+        assert np.all(st[i, n + 1:, 4] == 1.0)
+    assert batch["labels"].shape == (8,)
+
+
+def test_get_batch_covers_dataset_in_order(hps):
+    seqs, labels = make_synthetic_strokes(24, num_classes=2)
+    dl = DataLoader(seqs, hps, labels=labels)
+    assert dl.num_batches == 3
+    b0 = dl.get_batch(0)
+    np.testing.assert_array_equal(b0["labels"], labels[:8])
+    with pytest.raises(IndexError):
+        dl.get_batch(3)
+
+
+def test_load_dataset_end_to_end(tmp_path, hps):
+    write_synthetic_npz(str(tmp_path / "synth.npz"), num_train=40,
+                        num_valid=10, num_test=10, max_len=90)
+    train, valid, test, scale = load_dataset(hps, data_dir=str(tmp_path))
+    assert scale > 0
+    # train split normalized to unit offset std
+    np.testing.assert_allclose(
+        train.calculate_normalizing_scale_factor(), 1.0, rtol=1e-5)
+    assert len(train) == 40 and len(valid) == 10 and len(test) == 10
+    assert train.augment and not valid.augment
+
+
+def test_load_dataset_multi_category_labels(tmp_path):
+    hps = get_default_hparams().replace(
+        batch_size=4, max_seq_len=100, data_set=("a.npz", "b.npz"))
+    for name in ("a.npz", "b.npz"):
+        write_synthetic_npz(str(tmp_path / name), num_train=10, num_valid=4,
+                            num_test=4, max_len=90)
+    train, _, _, _ = load_dataset(hps, data_dir=str(tmp_path))
+    assert set(np.unique(train.labels)) == {0, 1}
+
+
+def test_load_dataset_host_sharding(tmp_path, hps):
+    write_synthetic_npz(str(tmp_path / "synth.npz"), num_train=40,
+                        num_valid=10, num_test=10, max_len=90)
+    t0, _, _, _ = load_dataset(hps, data_dir=str(tmp_path),
+                               host_id=0, num_hosts=2)
+    t1, _, _, _ = load_dataset(hps, data_dir=str(tmp_path),
+                               host_id=1, num_hosts=2)
+    assert len(t0) == 20 and len(t1) == 20
+
+
+def test_missing_file_raises(hps, tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_dataset(hps, data_dir=str(tmp_path))

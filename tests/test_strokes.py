@@ -1,0 +1,75 @@
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.data import strokes as S
+
+
+def _sketch(n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    s = rng.normal(size=(n, 3)).astype(np.float32)
+    s[:, 2] = 0
+    s[4, 2] = 1
+    s[-1, 2] = 1
+    return s
+
+
+def test_stroke5_roundtrip():
+    s3 = _sketch(12)
+    big = S.to_big_strokes(s3, max_len=20)
+    assert big.shape == (20, 5)
+    # one-hot pen state everywhere
+    assert np.allclose(big[:, 2:].sum(axis=1), 1.0)
+    # padding marked end-of-sketch
+    assert np.all(big[12:, 4] == 1.0)
+    back = S.to_normal_strokes(big)
+    np.testing.assert_allclose(back, s3, rtol=1e-6)
+
+
+def test_to_big_strokes_rejects_overflow():
+    with pytest.raises(ValueError):
+        S.to_big_strokes(_sketch(30), max_len=20)
+
+
+def test_scale_factor_and_normalize():
+    seqs = [_sketch(10, i) for i in range(5)]
+    f = S.calculate_normalizing_scale_factor(seqs)
+    normed = S.normalize_strokes(seqs, f)
+    assert f > 0
+    np.testing.assert_allclose(
+        S.calculate_normalizing_scale_factor(normed), 1.0, rtol=1e-5)
+    # pen states untouched
+    np.testing.assert_array_equal(normed[0][:, 2], seqs[0][:, 2])
+
+
+def test_random_scale_bounds():
+    s = _sketch(50)
+    rng = np.random.default_rng(0)
+    out = S.random_scale(s, 0.15, rng)
+    ratio_x = out[:, 0] / s[:, 0]
+    assert np.all(np.abs(ratio_x - ratio_x[0]) < 1e-5)  # single factor per axis
+    assert 0.85 <= ratio_x[0] <= 1.15
+    np.testing.assert_array_equal(out[:, 2], s[:, 2])
+
+
+def test_augment_preserves_total_displacement():
+    s = _sketch(60, 3)
+    rng = np.random.default_rng(1)
+    out = S.augment_strokes(s, prob=0.5, rng=rng)
+    assert len(out) < len(s)  # something was merged at prob=0.5, n=60
+    np.testing.assert_allclose(out[:, 0:2].sum(0), s[:, 0:2].sum(0), atol=1e-4)
+    # pen-lift structure preserved
+    assert out[:, 2].sum() == s[:, 2].sum()
+
+
+def test_augment_prob_zero_identity():
+    s = _sketch(30, 4)
+    out = S.augment_strokes(s, prob=0.0, rng=np.random.default_rng(0))
+    np.testing.assert_array_equal(out, s)
+
+
+def test_strokes_to_lines():
+    s = np.array([[1, 0, 0], [1, 0, 1], [0, 1, 0], [0, 1, 1]], np.float32)
+    lines = S.strokes_to_lines(s)
+    assert len(lines) == 2
+    assert lines[0] == [(1.0, 0.0), (2.0, 0.0)]
+    assert lines[1] == [(2.0, 1.0), (2.0, 2.0)]
