@@ -87,6 +87,10 @@ class HParams:
                 f"enc={self.enc_model!r} dec={self.dec_model!r}")
         if self.batch_size <= 0 or self.max_seq_len <= 0:
             raise ValueError("batch_size and max_seq_len must be positive")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.compute_dtype!r}")
 
     # -- overrides ---------------------------------------------------------
 

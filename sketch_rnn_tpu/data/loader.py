@@ -47,6 +47,10 @@ def _purify(stroke3_list, max_seq_len: int, limit: float = 1000.0):
 class DataLoader:
     """Pads, normalizes, augments and batches stroke-3 sequences.
 
+    The loader takes ownership of float32 input arrays (they are not
+    copied, and ``normalize`` scales them in place); pass copies if the
+    caller needs the originals intact.
+
     ``random_batch``/``get_batch`` return a dict:
 
     - ``"strokes"``: ``[B, max_seq_len + 1, 5]`` float32 stroke-5 with the
@@ -62,7 +66,7 @@ class DataLoader:
                  augment: bool = False,
                  seed: int = 0):
         self.hps = hps
-        self.strokes: List[np.ndarray] = [np.array(s, np.float32)
+        self.strokes: List[np.ndarray] = [np.asarray(s, np.float32)
                                           for s in stroke3_list]
         if labels is None:
             labels = np.zeros((len(self.strokes),), dtype=np.int32)
@@ -81,7 +85,10 @@ class DataLoader:
         return S.calculate_normalizing_scale_factor(self.strokes)
 
     def normalize(self, scale_factor: float) -> None:
-        self.strokes = S.normalize_strokes(self.strokes, scale_factor)
+        # in place: the loader owns its arrays (see class docstring — float32
+        # inputs are adopted without copying)
+        for s in self.strokes:
+            s[:, 0:2] /= scale_factor
 
     # -- batching ----------------------------------------------------------
 
@@ -168,16 +175,11 @@ def load_dataset(hps: HParams,
         return DataLoader(seqs, hps, labels=np.array(labels, np.int32),
                           augment=augment, seed=_SEEDS[split] + 7919 * host_id)
 
-    # Scale factor comes from the FULL train split, before host sharding:
-    # every host must normalize identically (it is part of the model contract
-    # and is checkpointed — SURVEY §5 'Checkpoint / resume').
-    if not splits["train"][0]:
-        raise ValueError(
-            f"train split is empty after filtering to "
-            f"max_seq_len={hps.max_seq_len}; raise max_seq_len or check "
-            f"the data files {hps.data_set}")
-    scale = S.calculate_normalizing_scale_factor(splits["train"][0])
     train = build("train", augment=True, shard=True)
+    # Scale factor comes from the FULL train split (pre-shard): every host
+    # must normalize identically (it is part of the model contract and is
+    # checkpointed — SURVEY §5 'Checkpoint / resume').
+    scale = S.calculate_normalizing_scale_factor(splits["train"][0])
     valid = build("valid", augment=False, shard=False)
     test = build("test", augment=False, shard=False)
     for dl in (train, valid, test):

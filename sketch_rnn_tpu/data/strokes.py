@@ -52,10 +52,6 @@ def to_normal_strokes(big: np.ndarray) -> np.ndarray:
     return out
 
 
-def get_seq_len(stroke3_list) -> np.ndarray:
-    return np.array([len(s) for s in stroke3_list], dtype=np.int32)
-
-
 def calculate_normalizing_scale_factor(stroke3_list) -> float:
     """Std of all (dx, dy) offsets pooled over the training split.
 

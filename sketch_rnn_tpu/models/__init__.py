@@ -1,0 +1,3 @@
+from sketch_rnn_tpu.models.vae import SketchRNN
+
+__all__ = ["SketchRNN"]

@@ -1,0 +1,234 @@
+"""The sketch-rnn seq2seq VAE as one pure, jittable loss function.
+
+TPU-native equivalent of the reference's ``Model`` class (SURVEY.md §2
+components 6-10 and §3.2 forward pass; reference unreadable — architecture
+per the sketch-rnn paper, arXiv:1704.03477 §3):
+
+- bidirectional encoder over the stroke sequence; final fwd/bwd hidden
+  states -> dense mu and sigma-hat heads,
+- z = mu + exp(sigma_hat / 2) * eps with explicit PRNG keys,
+- decoder initial carry = tanh(W z) covering the *full* cell carry
+  (including the HyperLSTM's auxiliary state, as in the reference),
+- teacher-forced decoder over [S_{t-1}; z (; class embedding)],
+- 6M+3 projection -> MDN head -> masked GMM NLL + pen CE + annealed KL.
+
+Unlike the reference's graph/session design (separate train and eval
+graphs with shared weights, SURVEY §3.4), the model here is a set of pure
+functions: ``train=True/False`` is a static argument and XLA compiles the
+two variants; there is nothing to share because parameters are explicit.
+
+Class-conditional decoding (BASELINE configs 4-5; UNVERIFIED in the
+reference per SURVEY §3.5) is an optional learned embedding of the class
+id concatenated to every decoder input step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.ops import linear as L
+from sketch_rnn_tpu.ops import mdn
+from sketch_rnn_tpu.ops.cells import make_cell
+from sketch_rnn_tpu.ops.rnn import bidirectional_rnn, make_dropout_masks, run_rnn
+
+Params = Dict[str, Any]
+
+
+def _dtype(hps: HParams):
+    return {"float32": None, "bfloat16": jnp.bfloat16}[hps.compute_dtype]
+
+
+class SketchRNN:
+    """Static model definition; parameters are explicit pytrees."""
+
+    def __init__(self, hps: HParams):
+        self.hps = hps
+        cd = _dtype(hps)
+        if hps.conditional:
+            self.enc_fwd = make_cell(hps.enc_model, hps.enc_rnn_size,
+                                     hps.hyper_rnn_size, hps.hyper_embed_size,
+                                     compute_dtype=cd)
+            self.enc_bwd = make_cell(hps.enc_model, hps.enc_rnn_size,
+                                     hps.hyper_rnn_size, hps.hyper_embed_size,
+                                     compute_dtype=cd)
+        self.dec = make_cell(hps.dec_model, hps.dec_rnn_size,
+                             hps.hyper_rnn_size, hps.hyper_embed_size,
+                             compute_dtype=cd)
+        self.out_dim = 6 * hps.num_mixture + 3
+
+    # -- parameters --------------------------------------------------------
+
+    def init_params(self, key: jax.Array) -> Params:
+        hps = self.hps
+        keys = jax.random.split(key, 10)
+        dec_in = self.decoder_input_size
+        params: Params = {
+            "dec": self.dec.init_params(keys[0], dec_in),
+            "out_w": L.xavier_uniform(keys[1], (hps.dec_rnn_size,
+                                                self.out_dim)),
+            "out_b": jnp.zeros((self.out_dim,), jnp.float32),
+        }
+        if hps.conditional:
+            params.update({
+                "enc_fwd": self.enc_fwd.init_params(keys[2], 5),
+                "enc_bwd": self.enc_bwd.init_params(keys[3], 5),
+                "mu_w": L.xavier_uniform(keys[4], (2 * hps.enc_rnn_size,
+                                                   hps.z_size)),
+                "mu_b": jnp.zeros((hps.z_size,), jnp.float32),
+                "presig_w": L.xavier_uniform(keys[5], (2 * hps.enc_rnn_size,
+                                                       hps.z_size)),
+                "presig_b": jnp.zeros((hps.z_size,), jnp.float32),
+                "dec_init_w": L.xavier_uniform(keys[6], (hps.z_size,
+                                                         self.dec.carry_size)),
+                "dec_init_b": jnp.zeros((self.dec.carry_size,), jnp.float32),
+            })
+        if hps.num_classes > 0:
+            params["class_embed"] = L.normal_init(
+                keys[7], (hps.num_classes, hps.class_embed_size), 0.05)
+        return params
+
+    @property
+    def decoder_input_size(self) -> int:
+        hps = self.hps
+        size = 5
+        if hps.conditional:
+            size += hps.z_size
+        if hps.num_classes > 0:
+            size += hps.class_embed_size
+        return size
+
+    # -- submodules --------------------------------------------------------
+
+    def encode(self, params: Params, x_tm: jax.Array, seq_len: jax.Array,
+               key: Optional[jax.Array] = None, train: bool = False
+               ) -> Tuple[jax.Array, jax.Array]:
+        """Time-major strokes ``[T, B, 5]`` -> (mu, presig), each [B, Nz]."""
+        hps = self.hps
+        masks_f = masks_b = None
+        if train and hps.use_recurrent_dropout and key is not None:
+            kf, kb = jax.random.split(key)
+            t, b = x_tm.shape[0], x_tm.shape[1]
+            masks_f = make_dropout_masks(kf, hps.recurrent_dropout_keep,
+                                         t, b, hps.enc_rnn_size)
+            masks_b = make_dropout_masks(kb, hps.recurrent_dropout_keep,
+                                         t, b, hps.enc_rnn_size)
+        h_final, _ = bidirectional_rnn(
+            self.enc_fwd, self.enc_bwd, params["enc_fwd"], params["enc_bwd"],
+            x_tm, seq_len=seq_len,
+            rdrop_masks_fwd=masks_f, rdrop_masks_bwd=masks_b)
+        mu = L.matmul(h_final, params["mu_w"], _dtype(hps)) + params["mu_b"]
+        presig = L.matmul(h_final, params["presig_w"], _dtype(hps)) \
+            + params["presig_b"]
+        return mu, presig
+
+    def sample_z(self, mu: jax.Array, presig: jax.Array, key: jax.Array
+                 ) -> jax.Array:
+        eps = jax.random.normal(key, mu.shape, jnp.float32)
+        return mu + jnp.exp(presig / 2.0) * eps
+
+    def decoder_initial_carry(self, params: Params,
+                              z: Optional[jax.Array], batch_size: int):
+        if z is None:
+            return self.dec.initial_carry(batch_size)
+        flat = jnp.tanh(
+            L.matmul(z, params["dec_init_w"], _dtype(self.hps))
+            + params["dec_init_b"])
+        return self.dec.unflatten_carry(flat)
+
+    def _decoder_inputs(self, params: Params, x_in_tm: jax.Array,
+                        z: Optional[jax.Array],
+                        labels: Optional[jax.Array]) -> jax.Array:
+        t = x_in_tm.shape[0]
+        parts = [x_in_tm]
+        if z is not None:
+            parts.append(jnp.broadcast_to(z[None], (t, *z.shape)))
+        if self.hps.num_classes > 0:
+            if labels is None:
+                raise ValueError("num_classes > 0 requires batch labels")
+            emb = params["class_embed"][labels]           # [B, E]
+            parts.append(jnp.broadcast_to(emb[None], (t, *emb.shape)))
+        return jnp.concatenate(parts, axis=-1)
+
+    def decode(self, params: Params, x_in_tm: jax.Array,
+               z: Optional[jax.Array], labels: Optional[jax.Array] = None,
+               key: Optional[jax.Array] = None, train: bool = False
+               ) -> jax.Array:
+        """Teacher-forced decoder -> raw MDN projections ``[T, B, 6M+3]``."""
+        hps = self.hps
+        t, b = x_in_tm.shape[0], x_in_tm.shape[1]
+        inputs = self._decoder_inputs(params, x_in_tm, z, labels)
+        rmasks = None
+        if train and key is not None:
+            krec, kin, kout = jax.random.split(key, 3)
+            if hps.use_recurrent_dropout:
+                rmasks = make_dropout_masks(krec, hps.recurrent_dropout_keep,
+                                            t, b, hps.dec_rnn_size)
+            if hps.use_input_dropout:
+                keep = hps.input_dropout_keep
+                mask = jax.random.bernoulli(kin, keep, inputs.shape)
+                inputs = inputs * mask / keep
+        carry0 = self.decoder_initial_carry(params, z, b)
+        _, hs = run_rnn(self.dec, params["dec"], inputs, carry0,
+                        rdrop_masks=rmasks)
+        if train and key is not None and hps.use_output_dropout:
+            keep = hps.output_dropout_keep
+            mask = jax.random.bernoulli(kout, keep, hs.shape)
+            hs = hs * mask / keep
+        return L.matmul(hs, params["out_w"], _dtype(hps)) + params["out_b"]
+
+    # -- loss --------------------------------------------------------------
+
+    def loss(self, params: Params, batch: Dict[str, jax.Array],
+             key: jax.Array, kl_weight: jax.Array, train: bool = True
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Full VAE loss on a loader batch; one fused XLA computation.
+
+        ``batch["strokes"]`` is ``[B, Nmax+1, 5]`` (start token at t=0);
+        ``kl_weight`` is the *annealed* weight (schedule computed outside,
+        so the jitted graph is step-agnostic). Returns (total, metrics).
+        """
+        hps = self.hps
+        strokes = jnp.transpose(batch["strokes"], (1, 0, 2))  # [T+1, B, 5]
+        x_in = strokes[:-1]
+        x_target = strokes[1:]
+        seq_len = batch["seq_len"]
+        labels = batch.get("labels") if hps.num_classes > 0 else None
+
+        kenc, kz, kdec = jax.random.split(key, 3)
+        z = None
+        if hps.conditional:
+            mu, presig = self.encode(params, x_target, seq_len,
+                                     key=kenc, train=train)
+            z = self.sample_z(mu, presig, kz)
+            kl_raw = mdn.kl_loss(mu, presig)
+        else:
+            kl_raw = jnp.float32(0.0)
+
+        raw = self.decode(params, x_in, z, labels, key=kdec, train=train)
+        mp = mdn.get_mixture_params(raw, hps.num_mixture)
+        # canonical asymmetry: pen CE unmasked in training, masked in eval
+        offset_nll, pen_ce = mdn.reconstruction_loss(
+            mp, x_target, hps.max_seq_len, mask_pen=not train)
+        r_cost = offset_nll + pen_ce
+        if hps.conditional:
+            kl_floored = mdn.kl_cost_with_floor(kl_raw, hps.kl_tolerance)
+            total = r_cost + kl_weight * kl_floored
+        else:
+            # no latent -> no KL term at all (reference parity: the floor
+            # must not inject a kl_tolerance constant into the loss)
+            kl_floored = jnp.float32(0.0)
+            total = r_cost
+        metrics = {
+            "loss": total,
+            "recon": r_cost,
+            "offset_nll": offset_nll,
+            "pen_ce": pen_ce,
+            "kl": kl_floored,
+            "kl_raw": kl_raw,
+            "kl_weight": jnp.asarray(kl_weight, jnp.float32),
+        }
+        return total, metrics

@@ -1,0 +1,17 @@
+from sketch_rnn_tpu.ops.cells import (
+    HyperLSTMCell,
+    LayerNormLSTMCell,
+    LSTMCell,
+    make_cell,
+)
+from sketch_rnn_tpu.ops.rnn import bidirectional_rnn, make_dropout_masks, run_rnn
+
+__all__ = [
+    "HyperLSTMCell",
+    "LSTMCell",
+    "LayerNormLSTMCell",
+    "bidirectional_rnn",
+    "make_cell",
+    "make_dropout_masks",
+    "run_rnn",
+]

@@ -1,0 +1,291 @@
+"""RNN cells as pure ``(params, carry, x) -> (carry, h)`` step functions.
+
+TPU-native equivalents of the reference's cell library (SURVEY.md §2
+components 2-4: ``LSTMCell``, ``LayerNormLSTMCell``, ``HyperLSTMCell``;
+reference unreadable — semantics follow the canonical sketch-rnn cells and
+the HyperNetworks paper, arXiv:1609.09106). The reference's cuDNN fused
+path (component 5) is replaced by XLA fusion: each step is a single fused
+``[x; h] @ W`` matmul (MXU-shaped) and the time loop is ``lax.scan`` in
+:mod:`sketch_rnn_tpu.ops.rnn`.
+
+Conventions:
+
+- Cell objects hold only *static* configuration (sizes, flags); parameters
+  are explicit pytrees from ``init_params`` so cells compose with ``jit``,
+  ``grad``, ``scan`` and sharding transparently.
+- Gate order in all fused weight matrices is ``(i, g, f, o)``.
+- Recurrent dropout is *inverted* dropout on the candidate ``g``; masks are
+  precomputed per step outside the scan (``ops.rnn.make_dropout_masks``) so
+  the step stays a pure function of its inputs.
+- ``compute_dtype`` (e.g. bfloat16) applies to matmul operands only;
+  carries, gates and layer-norm statistics stay float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sketch_rnn_tpu.ops import linear as L
+
+Carry = Any
+Params = Dict[str, Any]
+
+
+def _split_gates(pre: jax.Array) -> Tuple[jax.Array, ...]:
+    return tuple(jnp.split(pre, 4, axis=-1))
+
+
+class LSTMCell:
+    """Vanilla LSTM with orthogonal recurrent init and forget-gate bias.
+
+    New-framework equivalent of SURVEY §2 component 2.
+    """
+
+    def __init__(self, hidden_size: int, forget_bias: float = 1.0,
+                 compute_dtype=None):
+        self.hidden_size = hidden_size
+        self.forget_bias = forget_bias
+        self.compute_dtype = compute_dtype
+
+    def init_params(self, key: jax.Array, input_size: int) -> Params:
+        kx, kh = jax.random.split(key)
+        h = self.hidden_size
+        return {
+            "wx": L.xavier_uniform(kx, (input_size, 4 * h)),
+            "wh": L.orthogonal(kh, (h, 4 * h)),
+            "b": jnp.zeros((4 * h,), jnp.float32),
+        }
+
+    def initial_carry(self, batch_size: int) -> Carry:
+        z = jnp.zeros((batch_size, self.hidden_size), jnp.float32)
+        return (z, z)
+
+    @property
+    def carry_size(self) -> int:
+        """Flat width of the carry, for z -> initial-state projections."""
+        return 2 * self.hidden_size
+
+    def unflatten_carry(self, flat: jax.Array) -> Carry:
+        c, h = jnp.split(flat, 2, axis=-1)
+        return (c, h)
+
+    def __call__(self, params: Params, carry: Carry, x: jax.Array,
+                 rdrop_mask: Optional[jax.Array] = None
+                 ) -> Tuple[Carry, jax.Array]:
+        c, h = carry
+        pre = (L.matmul(x, params["wx"], self.compute_dtype)
+               + L.matmul(h, params["wh"], self.compute_dtype)
+               + params["b"])
+        i, g, f, o = _split_gates(pre)
+        g = jnp.tanh(g)
+        if rdrop_mask is not None:
+            g = g * rdrop_mask
+        new_c = c * jax.nn.sigmoid(f + self.forget_bias) \
+            + jax.nn.sigmoid(i) * g
+        new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+        return (new_c, new_h), new_h
+
+
+class LayerNormLSTMCell:
+    """LSTM with per-gate layer norm and a norm on the cell state.
+
+    New-framework equivalent of SURVEY §2 component 3. Gate pre-activations
+    are normalized per gate (four gamma/beta pairs); the new cell state is
+    normalized before the output tanh. Linear layers carry no bias — the
+    layer-norm betas take that role.
+    """
+
+    def __init__(self, hidden_size: int, forget_bias: float = 1.0,
+                 compute_dtype=None):
+        self.hidden_size = hidden_size
+        self.forget_bias = forget_bias
+        self.compute_dtype = compute_dtype
+
+    def init_params(self, key: jax.Array, input_size: int) -> Params:
+        kx, kh = jax.random.split(key)
+        h = self.hidden_size
+        return {
+            "wx": L.xavier_uniform(kx, (input_size, 4 * h)),
+            "wh": L.orthogonal(kh, (h, 4 * h)),
+            "ln_gamma": jnp.ones((4, h), jnp.float32),
+            "ln_beta": jnp.zeros((4, h), jnp.float32),
+            "lnc_gamma": jnp.ones((h,), jnp.float32),
+            "lnc_beta": jnp.zeros((h,), jnp.float32),
+        }
+
+    def initial_carry(self, batch_size: int) -> Carry:
+        z = jnp.zeros((batch_size, self.hidden_size), jnp.float32)
+        return (z, z)
+
+    @property
+    def carry_size(self) -> int:
+        return 2 * self.hidden_size
+
+    def unflatten_carry(self, flat: jax.Array) -> Carry:
+        c, h = jnp.split(flat, 2, axis=-1)
+        return (c, h)
+
+    def __call__(self, params: Params, carry: Carry, x: jax.Array,
+                 rdrop_mask: Optional[jax.Array] = None
+                 ) -> Tuple[Carry, jax.Array]:
+        c, h = carry
+        pre = (L.matmul(x, params["wx"], self.compute_dtype)
+               + L.matmul(h, params["wh"], self.compute_dtype))
+        gates = []
+        for j, gate in enumerate(_split_gates(pre)):
+            gates.append(L.layer_norm(gate, params["ln_gamma"][j],
+                                      params["ln_beta"][j]))
+        i, g, f, o = gates
+        g = jnp.tanh(g)
+        if rdrop_mask is not None:
+            g = g * rdrop_mask
+        new_c = c * jax.nn.sigmoid(f + self.forget_bias) \
+            + jax.nn.sigmoid(i) * g
+        normed_c = L.layer_norm(new_c, params["lnc_gamma"], params["lnc_beta"])
+        new_h = jnp.tanh(normed_c) * jax.nn.sigmoid(o)
+        return (new_c, new_h), new_h
+
+
+class HyperLSTMCell:
+    """HyperNetwork-modulated LSTM (SURVEY §2 component 4, the hard cell).
+
+    A small auxiliary LSTM observes ``[x; h]`` and emits, per step and per
+    gate, multiplicative scaling vectors for the input path and the
+    recurrent path plus a dynamic bias (arXiv:1609.09106 §4). The main
+    gates are layer-normalized.
+
+    The 4x3 hyper projections are fused into three batched einsums so the
+    per-step work is a few large MXU matmuls rather than 12 small ones.
+
+    Init scheme (HyperNetworks paper): the ``hyper_h -> embedding``
+    projections start at weight 0 / bias 1 and the ``embedding -> scale``
+    projections at the constant ``0.1 / embed_size``, so every scale vector
+    starts at exactly 0.1 and layer norm restores the magnitude; dynamic
+    biases start at 0.
+    """
+
+    def __init__(self, hidden_size: int, hyper_size: int = 256,
+                 embed_size: int = 32, forget_bias: float = 1.0,
+                 use_layer_norm: bool = True, compute_dtype=None):
+        self.hidden_size = hidden_size
+        self.hyper_size = hyper_size
+        self.embed_size = embed_size
+        self.forget_bias = forget_bias
+        self.use_layer_norm = use_layer_norm
+        self.compute_dtype = compute_dtype
+        self._hyper_cell = LSTMCell(hyper_size, forget_bias,
+                                    compute_dtype=compute_dtype)
+
+    def init_params(self, key: jax.Array, input_size: int) -> Params:
+        h, hh, e = self.hidden_size, self.hyper_size, self.embed_size
+        keys = jax.random.split(key, 5)
+        params: Params = {
+            "wx": L.xavier_uniform(keys[0], (input_size, 4 * h)),
+            "wh": L.orthogonal(keys[1], (h, 4 * h)),
+            "b": jnp.zeros((4 * h,), jnp.float32),
+            # hyper_h -> per-gate embeddings, fused over {x-path, h-path}:
+            # weight 0, bias 1 => embeddings start at exactly 1.
+            "w_hz_x": jnp.zeros((hh, 4 * e), jnp.float32),
+            "b_hz_x": jnp.ones((4 * e,), jnp.float32),
+            "w_hz_h": jnp.zeros((hh, 4 * e), jnp.float32),
+            "b_hz_h": jnp.ones((4 * e,), jnp.float32),
+            # bias path: small random hyper_h -> embedding, zero -> bias.
+            "w_hz_b": L.normal_init(keys[2], (hh, 4 * e), 0.01),
+            # embedding -> scale vectors: constant 0.1/e => scales start 0.1.
+            "w_zd_x": jnp.full((4, e, h), 0.1 / e, jnp.float32),
+            "w_zd_h": jnp.full((4, e, h), 0.1 / e, jnp.float32),
+            "w_zd_b": jnp.zeros((4, e, h), jnp.float32),
+            "hyper": self._hyper_cell.init_params(
+                keys[3], input_size + h),
+        }
+        if self.use_layer_norm:
+            params.update({
+                "ln_gamma": jnp.ones((4, h), jnp.float32),
+                "ln_beta": jnp.zeros((4, h), jnp.float32),
+                "lnc_gamma": jnp.ones((h,), jnp.float32),
+                "lnc_beta": jnp.zeros((h,), jnp.float32),
+            })
+        return params
+
+    def initial_carry(self, batch_size: int) -> Carry:
+        z = jnp.zeros((batch_size, self.hidden_size), jnp.float32)
+        return ((z, z), self._hyper_cell.initial_carry(batch_size))
+
+    @property
+    def carry_size(self) -> int:
+        # main (c, h) plus the hyper LSTM's (c, h), as in the reference's
+        # z -> full-state initial-state projection (SURVEY §3.2)
+        return 2 * self.hidden_size + 2 * self.hyper_size
+
+    def unflatten_carry(self, flat: jax.Array) -> Carry:
+        h = self.hidden_size
+        c, hh = flat[..., :h], flat[..., h:2 * h]
+        hc, hhh = (flat[..., 2 * h:2 * h + self.hyper_size],
+                   flat[..., 2 * h + self.hyper_size:])
+        return ((c, hh), (hc, hhh))
+
+    def _scales(self, params: Params, hyper_h: jax.Array, path: str
+                ) -> jax.Array:
+        """hyper_h -> [B, 4, H] scaling (or bias) vectors for one path."""
+        e = self.embed_size
+        z = L.matmul(hyper_h, params[f"w_hz_{path}"], self.compute_dtype)
+        if path != "b":
+            z = z + params[f"b_hz_{path}"]
+        z = z.reshape(z.shape[0], 4, e)
+        return jnp.einsum("bje,jeh->bjh", z, params[f"w_zd_{path}"],
+                          preferred_element_type=jnp.float32)
+
+    def __call__(self, params: Params, carry: Carry, x: jax.Array,
+                 rdrop_mask: Optional[jax.Array] = None
+                 ) -> Tuple[Carry, jax.Array]:
+        (c, h), hyper_carry = carry
+        hyper_in = jnp.concatenate([x, h], axis=-1)
+        hyper_carry, hyper_h = self._hyper_cell(params["hyper"], hyper_carry,
+                                                hyper_in)
+        xh = L.matmul(x, params["wx"], self.compute_dtype)
+        hhp = L.matmul(h, params["wh"], self.compute_dtype)
+        b4 = params["b"].reshape(4, self.hidden_size)
+        sx = self._scales(params, hyper_h, "x")
+        sh = self._scales(params, hyper_h, "h")
+        sb = self._scales(params, hyper_h, "b")
+        xh = xh.reshape(xh.shape[0], 4, self.hidden_size)
+        hhp = hhp.reshape(hhp.shape[0], 4, self.hidden_size)
+        pre = sx * xh + sh * hhp + sb + b4
+        if self.use_layer_norm:
+            gates = [L.layer_norm(pre[:, j], params["ln_gamma"][j],
+                                  params["ln_beta"][j]) for j in range(4)]
+        else:
+            gates = [pre[:, j] for j in range(4)]
+        i, g, f, o = gates
+        g = jnp.tanh(g)
+        if rdrop_mask is not None:
+            g = g * rdrop_mask
+        new_c = c * jax.nn.sigmoid(f + self.forget_bias) \
+            + jax.nn.sigmoid(i) * g
+        if self.use_layer_norm:
+            out_c = L.layer_norm(new_c, params["lnc_gamma"],
+                                 params["lnc_beta"])
+        else:
+            out_c = new_c
+        new_h = jnp.tanh(out_c) * jax.nn.sigmoid(o)
+        return ((new_c, new_h), hyper_carry), new_h
+
+
+def make_cell(kind: str, hidden_size: int, hyper_size: int = 256,
+              hyper_embed_size: int = 32, compute_dtype=None):
+    """Factory mapping the reference's cell-choice hparam to a cell object.
+
+    ``kind`` ∈ {"lstm", "layer_norm", "hyper"} (SURVEY §5 'Config').
+    """
+    if kind == "lstm":
+        return LSTMCell(hidden_size, compute_dtype=compute_dtype)
+    if kind == "layer_norm":
+        return LayerNormLSTMCell(hidden_size, compute_dtype=compute_dtype)
+    if kind == "hyper":
+        return HyperLSTMCell(hidden_size, hyper_size=hyper_size,
+                             embed_size=hyper_embed_size,
+                             compute_dtype=compute_dtype)
+    raise ValueError(f"unknown cell kind {kind!r}")

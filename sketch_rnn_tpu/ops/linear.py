@@ -1,0 +1,58 @@
+"""Parameter initializers and a tiny dense helper.
+
+TPU-native equivalent of the reference's ``super_linear`` / orthogonal-init
+helpers (SURVEY.md §2 components 2-4; reference unreadable — init schemes per
+the canonical sketch-rnn cells and the HyperNetworks paper, arXiv:1609.09106).
+
+All matmuls route through :func:`matmul`, which casts operands to a compute
+dtype (bfloat16 on TPU for MXU throughput) while accumulating in float32
+via ``preferred_element_type`` — the standard mixed-precision contract on
+the MXU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def orthogonal(key: jax.Array, shape, gain: float = 1.0,
+               dtype=jnp.float32) -> jax.Array:
+    """Orthogonal init (used for recurrent weights, as in the reference)."""
+    if len(shape) < 2:
+        raise ValueError("orthogonal init needs >=2 dims")
+    rows, cols = int(np.prod(shape[:-1])), shape[-1]
+    n = max(rows, cols)
+    a = jax.random.normal(key, (n, n), jnp.float32)
+    q, r = jnp.linalg.qr(a)
+    q = q * jnp.sign(jnp.diag(r))  # make distribution uniform over O(n)
+    return (gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+def xavier_uniform(key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+    fan_in, fan_out = shape[0], shape[-1]
+    lim = float(np.sqrt(6.0 / (fan_in + fan_out)))
+    return jax.random.uniform(key, shape, dtype, -lim, lim)
+
+
+def normal_init(key: jax.Array, shape, stddev: float,
+                dtype=jnp.float32) -> jax.Array:
+    return stddev * jax.random.normal(key, shape, dtype)
+
+
+def matmul(x: jax.Array, w: jax.Array, compute_dtype=None) -> jax.Array:
+    """``x @ w`` with optional low-precision operands, f32 accumulation."""
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        w = w.astype(compute_dtype)
+    return jnp.matmul(x, w, preferred_element_type=jnp.float32)
+
+
+def layer_norm(x: jax.Array, gamma: jax.Array, beta: jax.Array,
+               eps: float = 1e-6) -> jax.Array:
+    """Layer norm over the trailing axis (float32 statistics)."""
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta

@@ -1,0 +1,108 @@
+"""Mixture-density-network head and VAE losses (pure jnp).
+
+TPU-native equivalent of the reference's ``get_mixture_coef`` /
+``get_lossfunc`` / ``tf_2d_normal`` + KL terms (SURVEY.md §2 components 9
+and 10; reference unreadable — semantics per the sketch-rnn paper,
+arXiv:1704.03477 §3.2-3.3, and the canonical loss subtleties recorded in
+SURVEY §7 'Hard parts'):
+
+- the bivariate-GMM NLL is computed with a fused ``logsumexp`` over
+  components (numerically stabler than the reference's pdf-then-log with
+  an epsilon; identical up to the epsilon),
+- the GMM term is masked to each sequence's true length via
+  ``fs = 1 - p3(target)``; the pen-state cross-entropy is *unmasked* to
+  Nmax during training and masked during eval — that asymmetry is the
+  canonical behavior and is controlled by ``mask_pen``,
+- both terms are normalized by ``max_seq_len * batch`` regardless of mask,
+- KL has the reference's ``kl_tolerance`` floor (free bits).
+
+Everything here is elementwise/reduction math that XLA fuses straight into
+the surrounding graph (SURVEY §2: "fuse into a single XLA graph").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+LOG_2PI = 1.8378770664093453  # log(2*pi)
+
+
+class MixtureParams(NamedTuple):
+    """Per-step GMM + pen parameters; leading dims are arbitrary."""
+
+    log_pi: jax.Array   # [..., M] log mixture weights (normalized)
+    mu1: jax.Array      # [..., M]
+    mu2: jax.Array      # [..., M]
+    log_s1: jax.Array   # [..., M] log std of dx
+    log_s2: jax.Array   # [..., M] log std of dy
+    rho: jax.Array      # [..., M] correlation in (-1, 1)
+    pen_logits: jax.Array  # [..., 3]
+
+
+def get_mixture_params(raw: jax.Array, num_mixture: int) -> MixtureParams:
+    """Split a ``[..., 6M+3]`` projection into normalized GMM parameters."""
+    m = num_mixture
+    if raw.shape[-1] != 6 * m + 3:
+        raise ValueError(f"expected trailing dim {6 * m + 3}, got {raw.shape}")
+    pen_logits = raw[..., :3]
+    body = raw[..., 3:].reshape(*raw.shape[:-1], 6, m)
+    logits, mu1, mu2, ls1, ls2, rho_raw = (body[..., j, :] for j in range(6))
+    return MixtureParams(
+        log_pi=jax.nn.log_softmax(logits, axis=-1),
+        mu1=mu1,
+        mu2=mu2,
+        log_s1=ls1,
+        log_s2=ls2,
+        rho=jnp.tanh(rho_raw),
+        pen_logits=pen_logits,
+    )
+
+
+def bivariate_normal_logpdf(dx: jax.Array, dy: jax.Array,
+                            mp: MixtureParams) -> jax.Array:
+    """Log pdf of (dx, dy) under each component; returns ``[..., M]``."""
+    zx = (dx[..., None] - mp.mu1) * jnp.exp(-mp.log_s1)
+    zy = (dy[..., None] - mp.mu2) * jnp.exp(-mp.log_s2)
+    one_m_r2 = jnp.clip(1.0 - jnp.square(mp.rho), 1e-6, 1.0)
+    z = zx * zx + zy * zy - 2.0 * mp.rho * zx * zy
+    return (-z / (2.0 * one_m_r2)
+            - 0.5 * jnp.log(one_m_r2) - mp.log_s1 - mp.log_s2 - LOG_2PI)
+
+
+def gmm_nll(dx: jax.Array, dy: jax.Array, mp: MixtureParams) -> jax.Array:
+    """Negative log-likelihood of offsets under the mixture, per step."""
+    comp = mp.log_pi + bivariate_normal_logpdf(dx, dy, mp)
+    return -jax.nn.logsumexp(comp, axis=-1)
+
+
+def reconstruction_loss(mp: MixtureParams, target: jax.Array,
+                        max_seq_len: int, mask_pen: bool = False
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Offset-GMM NLL + pen-state CE, canonical masking and normalization.
+
+    ``target`` is time-major stroke-5 ``[T, B, 5]`` (the sequence shifted
+    one step ahead of the decoder input). Returns scalars
+    ``(offset_nll, pen_ce)``, each already divided by ``max_seq_len * B``.
+    """
+    t, b = target.shape[0], target.shape[1]
+    dx, dy, pen = target[..., 0], target[..., 1], target[..., 2:5]
+    fs = 1.0 - pen[..., 2]  # 0 from the first end-of-sketch row onward
+    nll = gmm_nll(dx, dy, mp) * fs
+    pen_ce = -jnp.sum(pen * jax.nn.log_softmax(mp.pen_logits, -1), axis=-1)
+    if mask_pen:
+        pen_ce = pen_ce * fs
+    denom = float(max_seq_len * b)
+    return jnp.sum(nll) / denom, jnp.sum(pen_ce) / denom
+
+
+def kl_loss(mu: jax.Array, presig: jax.Array) -> jax.Array:
+    """KL(q(z|x) || N(0, I)), mean over batch and latent dims."""
+    return -0.5 * jnp.mean(1.0 + presig - jnp.square(mu) - jnp.exp(presig))
+
+
+def kl_cost_with_floor(kl: jax.Array, kl_tolerance: float) -> jax.Array:
+    """The reference's free-bits floor: cost saturates at kl_tolerance."""
+    return jnp.maximum(kl, kl_tolerance)

@@ -13,3 +13,10 @@ if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The machine's site customization (PYTHONPATH=.axon_site) force-resets
+# JAX_PLATFORMS to the axon TPU plugin at jax import; the config update wins
+# over that, pinning tests to the 8-device virtual CPU platform.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

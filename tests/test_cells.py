@@ -1,0 +1,229 @@
+"""Golden-value tests: each cell vs an independent numpy reference
+(SURVEY.md §4 test strategy)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sketch_rnn_tpu.ops import (
+    HyperLSTMCell, LSTMCell, LayerNormLSTMCell, bidirectional_rnn,
+    make_cell, make_dropout_masks, run_rnn)
+from sketch_rnn_tpu.ops.rnn import final_hidden
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_layer_norm(x, gamma, beta, eps=1e-6):
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return (x - mean) / np.sqrt(var + eps) * gamma + beta
+
+
+def np_lstm_step(p, c, h, x, forget_bias=1.0, mask=None):
+    pre = x @ p["wx"] + h @ p["wh"] + p["b"]
+    i, g, f, o = np.split(pre, 4, axis=-1)
+    g = np.tanh(g)
+    if mask is not None:
+        g = g * mask
+    new_c = c * sigmoid(f + forget_bias) + sigmoid(i) * g
+    new_h = np.tanh(new_c) * sigmoid(o)
+    return new_c, new_h
+
+
+def np_ln_lstm_step(p, c, h, x, forget_bias=1.0):
+    pre = x @ p["wx"] + h @ p["wh"]
+    chunks = np.split(pre, 4, axis=-1)
+    gates = [np_layer_norm(chunks[j], p["ln_gamma"][j], p["ln_beta"][j])
+             for j in range(4)]
+    i, g, f, o = gates
+    new_c = c * sigmoid(f + forget_bias) + sigmoid(i) * np.tanh(g)
+    normed = np_layer_norm(new_c, p["lnc_gamma"], p["lnc_beta"])
+    new_h = np.tanh(normed) * sigmoid(o)
+    return new_c, new_h
+
+
+def np_hyper_scales(p, hyper_h, path, e):
+    z = hyper_h @ p[f"w_hz_{path}"]
+    if path != "b":
+        z = z + p[f"b_hz_{path}"]
+    z = z.reshape(z.shape[0], 4, e)
+    return np.einsum("bje,jeh->bjh", z, p[f"w_zd_{path}"])
+
+
+def np_hyper_step(p, carry, x, e, forget_bias=1.0):
+    (c, h), (hc, hh_state) = carry
+    hyper_in = np.concatenate([x, h], -1)
+    hc, hh_state = np_lstm_step(p["hyper"], hc, hh_state, hyper_in,
+                                forget_bias)
+    hyper_h = hh_state
+    hdim = c.shape[-1]
+    xh = (x @ p["wx"]).reshape(x.shape[0], 4, hdim)
+    hhp = (h @ p["wh"]).reshape(x.shape[0], 4, hdim)
+    b4 = p["b"].reshape(4, hdim)
+    pre = (np_hyper_scales(p, hyper_h, "x", e) * xh
+           + np_hyper_scales(p, hyper_h, "h", e) * hhp
+           + np_hyper_scales(p, hyper_h, "b", e) + b4)
+    gates = [np_layer_norm(pre[:, j], p["ln_gamma"][j], p["ln_beta"][j])
+             for j in range(4)]
+    i, g, f, o = gates
+    new_c = c * sigmoid(f + forget_bias) + sigmoid(i) * np.tanh(g)
+    normed = np_layer_norm(new_c, p["lnc_gamma"], p["lnc_beta"])
+    new_h = np.tanh(normed) * sigmoid(o)
+    return ((new_c, new_h), (hc, hh_state)), new_h
+
+
+def _np_params(params):
+    return jax.tree.map(np.asarray, params)
+
+
+B, T, D, H = 3, 6, 5, 8
+
+
+@pytest.fixture
+def xs():
+    return np.random.default_rng(0).normal(size=(T, B, D)).astype(np.float32)
+
+
+def test_lstm_matches_numpy(xs):
+    cell = LSTMCell(H)
+    params = cell.init_params(jax.random.key(1), D)
+    _, hs = run_rnn(cell, params, jnp.asarray(xs))
+    p = _np_params(params)
+    c = h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        c, h = np_lstm_step(p, c, h, xs[t])
+        np.testing.assert_allclose(np.asarray(hs[t]), h, atol=1e-5)
+
+
+def test_layer_norm_lstm_matches_numpy(xs):
+    cell = LayerNormLSTMCell(H)
+    params = cell.init_params(jax.random.key(2), D)
+    _, hs = run_rnn(cell, params, jnp.asarray(xs))
+    p = _np_params(params)
+    c = h = np.zeros((B, H), np.float32)
+    for t in range(T):
+        c, h = np_ln_lstm_step(p, c, h, xs[t])
+        np.testing.assert_allclose(np.asarray(hs[t]), h, atol=1e-5)
+
+
+def test_hyper_lstm_matches_numpy(xs):
+    cell = HyperLSTMCell(H, hyper_size=7, embed_size=4)
+    params = cell.init_params(jax.random.key(3), D)
+    # perturb the zero-init hyper projections so the test is non-trivial
+    rng = np.random.default_rng(5)
+    params = jax.tree.map(
+        lambda a: jnp.asarray(np.asarray(a)
+                              + 0.05 * rng.normal(size=a.shape)), params)
+    _, hs = run_rnn(cell, params, jnp.asarray(xs))
+    p = _np_params(params)
+    z = np.zeros((B, H), np.float32)
+    zh = np.zeros((B, 7), np.float32)
+    carry = ((z, z), (zh, zh))
+    for t in range(T):
+        carry, h = np_hyper_step(p, carry, xs[t], e=4)
+        np.testing.assert_allclose(np.asarray(hs[t]), h, atol=2e-5)
+
+
+def test_hyper_init_scales_start_at_point_one():
+    cell = HyperLSTMCell(H, hyper_size=7, embed_size=4)
+    params = cell.init_params(jax.random.key(0), D)
+    hyper_h = jnp.ones((B, 7))
+    sx = cell._scales(params, hyper_h, "x")
+    np.testing.assert_allclose(np.asarray(sx), 0.1, atol=1e-6)
+    sb = cell._scales(params, hyper_h, "b")
+    np.testing.assert_allclose(np.asarray(sb), 0.0, atol=1e-6)
+
+
+def test_recurrent_dropout_masks(xs):
+    masks = make_dropout_masks(jax.random.key(0), 0.9, T, B, H)
+    assert masks.shape == (T, B, H)
+    m = np.asarray(masks)
+    assert np.all(np.isclose(m, 0.0) | np.isclose(m, 1 / 0.9))
+    assert 0.0 < m.mean() < 1 / 0.9  # both values actually occur
+    # masked run differs from unmasked but stays finite
+    cell = LSTMCell(H)
+    params = cell.init_params(jax.random.key(1), D)
+    _, hs_drop = run_rnn(cell, params, jnp.asarray(xs), rdrop_masks=masks)
+    _, hs_plain = run_rnn(cell, params, jnp.asarray(xs))
+    assert np.all(np.isfinite(np.asarray(hs_drop)))
+    assert not np.allclose(np.asarray(hs_drop), np.asarray(hs_plain))
+
+
+def test_reverse_scan_order():
+    cell = LSTMCell(H)
+    params = cell.init_params(jax.random.key(1), D)
+    xs = np.random.default_rng(2).normal(size=(T, B, D)).astype(np.float32)
+    _, hs_rev = run_rnn(cell, params, jnp.asarray(xs), reverse=True)
+    _, hs_flip = run_rnn(cell, params, jnp.asarray(xs[::-1].copy()))
+    # reverse=True == scanning the flipped sequence, with outputs flipped back
+    np.testing.assert_allclose(np.asarray(hs_rev), np.asarray(hs_flip)[::-1],
+                               atol=1e-6)
+
+
+def test_bidirectional_final_state_respects_seq_len():
+    cell_f, cell_b = LSTMCell(H), LSTMCell(H)
+    pf = cell_f.init_params(jax.random.key(1), D)
+    pb = cell_b.init_params(jax.random.key(2), D)
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(T, B, D)).astype(np.float32)
+    lens = np.array([3, 6, 1], np.int32)
+    for i, n in enumerate(lens):
+        xs[n:, i] = 0.0  # zero padding after true length
+    h_final, hs = bidirectional_rnn(cell_f, cell_b, pf, pb, jnp.asarray(xs),
+                                    seq_len=jnp.asarray(lens))
+    assert h_final.shape == (B, 2 * H)
+    assert hs.shape == (T, B, 2 * H)
+    # per-example check against single-sequence scans over the valid prefix
+    for i, n in enumerate(lens):
+        seq = jnp.asarray(xs[:n, i:i + 1])
+        fc, _ = run_rnn(cell_f, pf, seq)
+        bc, _ = run_rnn(cell_b, pb, seq, reverse=True)
+        np.testing.assert_allclose(np.asarray(h_final[i, :H]),
+                                   np.asarray(final_hidden(cell_f, fc))[0],
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h_final[i, H:]),
+                                   np.asarray(final_hidden(cell_b, bc))[0],
+                                   atol=1e-5)
+
+
+def test_make_cell_factory():
+    assert isinstance(make_cell("lstm", 8), LSTMCell)
+    assert isinstance(make_cell("layer_norm", 8), LayerNormLSTMCell)
+    hyper = make_cell("hyper", 8, hyper_size=16, hyper_embed_size=4)
+    assert isinstance(hyper, HyperLSTMCell)
+    assert hyper.hyper_size == 16 and hyper.embed_size == 4
+    with pytest.raises(ValueError):
+        make_cell("gru", 8)
+
+
+def test_cells_differentiable_and_jittable():
+    for kind in ("lstm", "layer_norm", "hyper"):
+        cell = make_cell(kind, H, hyper_size=7, hyper_embed_size=4)
+        params = cell.init_params(jax.random.key(0), D)
+        xs = jnp.asarray(
+            np.random.default_rng(1).normal(size=(T, B, D)), jnp.float32)
+
+        @jax.jit
+        def loss(p, xs=xs, cell=cell):
+            _, hs = run_rnn(cell, p, xs)
+            return jnp.sum(hs ** 2)
+
+        g = jax.grad(loss)(params)
+        flat = jax.tree.leaves(jax.tree.map(lambda a: np.all(np.isfinite(a)),
+                                            g))
+        assert all(flat), kind
+
+
+def test_bf16_compute_close_to_f32():
+    cell32 = LSTMCell(H)
+    cell16 = LSTMCell(H, compute_dtype=jnp.bfloat16)
+    params = cell32.init_params(jax.random.key(4), D)
+    xs = jnp.asarray(
+        np.random.default_rng(7).normal(size=(T, B, D)), jnp.float32)
+    _, h32 = run_rnn(cell32, params, xs)
+    _, h16 = run_rnn(cell16, params, xs)
+    assert h16.dtype == jnp.float32  # f32 accumulate/carry contract
+    np.testing.assert_allclose(np.asarray(h32), np.asarray(h16), atol=0.05)

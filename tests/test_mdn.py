@@ -1,0 +1,159 @@
+"""Closed-form MDN math tests (SURVEY.md §4: hand-built mixtures)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sketch_rnn_tpu.ops import mdn
+
+
+def _raw_from(mixture, num_mixture):
+    """Build a raw [.., 6M+3] projection realizing the given parameters."""
+    logits, mu1, mu2, s1, s2, rho, pen = mixture
+    body = np.stack([logits, mu1, mu2, np.log(s1), np.log(s2),
+                     np.arctanh(rho)], axis=-2)  # [..., 6, M]
+    flat = body.reshape(*body.shape[:-2], 6 * num_mixture)
+    return jnp.asarray(np.concatenate([pen, flat], axis=-1), jnp.float32)
+
+
+def test_get_mixture_params_shapes_and_normalization():
+    m = 4
+    raw = jnp.asarray(np.random.default_rng(0).normal(size=(7, 3, 6 * m + 3)),
+                      jnp.float32)
+    mp = mdn.get_mixture_params(raw, m)
+    assert mp.log_pi.shape == (7, 3, m)
+    np.testing.assert_allclose(np.exp(np.asarray(mp.log_pi)).sum(-1), 1.0,
+                               rtol=1e-5)
+    assert np.all(np.abs(np.asarray(mp.rho)) < 1.0)
+    with pytest.raises(ValueError):
+        mdn.get_mixture_params(raw, m + 1)
+
+
+def test_single_gaussian_closed_form():
+    # one dominant component, rho=0: NLL = log(2*pi*s1*s2) + z/2
+    m = 3
+    logits = np.array([50.0, 0.0, 0.0])  # all weight on comp 0
+    mu1 = np.array([0.5, 9.0, 9.0])
+    mu2 = np.array([-0.25, 9.0, 9.0])
+    s1 = np.array([2.0, 1.0, 1.0])
+    s2 = np.array([0.5, 1.0, 1.0])
+    rho = np.zeros(3)
+    pen = np.zeros(3)
+    raw = _raw_from((logits, mu1, mu2, s1, s2, rho, pen), m)
+    mp = mdn.get_mixture_params(raw, m)
+    dx, dy = jnp.float32(1.5), jnp.float32(0.25)
+    nll = float(mdn.gmm_nll(dx, dy, mp))
+    zx = (1.5 - 0.5) / 2.0
+    zy = (0.25 + 0.25) / 0.5
+    expected = np.log(2 * np.pi * 2.0 * 0.5) + 0.5 * (zx**2 + zy**2)
+    np.testing.assert_allclose(nll, expected, rtol=1e-5)
+
+
+def test_correlated_gaussian_matches_numpy_density():
+    m = 1
+    rho_val = 0.7
+    raw = _raw_from((np.zeros(1), np.array([0.3]), np.array([-0.2]),
+                     np.array([1.5]), np.array([0.8]), np.array([rho_val]),
+                     np.zeros(3)), m)
+    mp = mdn.get_mixture_params(raw, m)
+    dx, dy = 0.9, 0.1
+    logpdf = float(mdn.bivariate_normal_logpdf(
+        jnp.float32(dx), jnp.float32(dy), mp)[..., 0])
+    # numpy reference via covariance matrix
+    cov = np.array([[1.5**2, rho_val * 1.5 * 0.8],
+                    [rho_val * 1.5 * 0.8, 0.8**2]])
+    diff = np.array([dx - 0.3, dy + 0.2])
+    expected = (-0.5 * diff @ np.linalg.inv(cov) @ diff
+                - 0.5 * np.log((2 * np.pi) ** 2 * np.linalg.det(cov)))
+    np.testing.assert_allclose(logpdf, expected, rtol=1e-5)
+
+
+def test_mixture_weighting():
+    # two equal components at different means: pdf = average of the two
+    m = 2
+    raw = _raw_from((np.zeros(2), np.array([0.0, 2.0]), np.zeros(2),
+                     np.ones(2), np.ones(2), np.zeros(2), np.zeros(3)), m)
+    mp = mdn.get_mixture_params(raw, m)
+    nll = float(mdn.gmm_nll(jnp.float32(1.0), jnp.float32(0.0), mp))
+
+    def pdf(mu):
+        return np.exp(-0.5 * (1.0 - mu) ** 2) / (2 * np.pi)
+
+    np.testing.assert_allclose(np.exp(-nll), 0.5 * pdf(0) + 0.5 * pdf(2),
+                               rtol=1e-5)
+
+
+def _target_with_len(t, b, n_valid):
+    """stroke-5 target whose sequences end (p3=1) after n_valid steps."""
+    rng = np.random.default_rng(0)
+    tgt = np.zeros((t, b, 5), np.float32)
+    tgt[:, :, 0:2] = rng.normal(size=(t, b, 2))
+    tgt[:, :, 2] = 1.0
+    for i in range(b):
+        tgt[n_valid:, i, 2] = 0.0
+        tgt[n_valid:, i, 0:2] = 0.0
+        tgt[n_valid:, i, 4] = 1.0
+    return tgt
+
+
+def test_reconstruction_masking_semantics():
+    t, b, m = 10, 2, 3
+    rng = np.random.default_rng(1)
+    raw = jnp.asarray(rng.normal(size=(t, b, 6 * m + 3)), jnp.float32)
+    mp = mdn.get_mixture_params(raw, m)
+    tgt_full = jnp.asarray(_target_with_len(t, b, t))
+    tgt_short = jnp.asarray(_target_with_len(t, b, 4))
+
+    off_full, _ = mdn.reconstruction_loss(mp, tgt_full, t)
+    off_short, _ = mdn.reconstruction_loss(mp, tgt_short, t)
+    # masked-out steps contribute nothing -> shorter sequences, smaller sum
+    assert float(off_short) < float(off_full)
+
+    # offset term only counts the first 4 steps: recompute by truncation
+    mp4 = mdn.get_mixture_params(raw[:4], m)
+    off_manual, _ = mdn.reconstruction_loss(mp4, tgt_short[:4], t)
+    np.testing.assert_allclose(float(off_short), float(off_manual), rtol=1e-5)
+
+    # pen CE: unmasked by default (train), masked when mask_pen=True (eval)
+    _, pen_train = mdn.reconstruction_loss(mp, tgt_short, t, mask_pen=False)
+    _, pen_eval = mdn.reconstruction_loss(mp, tgt_short, t, mask_pen=True)
+    assert float(pen_eval) < float(pen_train)
+
+
+def test_normalization_is_by_max_seq_len():
+    t, b, m = 8, 3, 2
+    raw = jnp.asarray(np.random.default_rng(2).normal(size=(t, b, 6 * m + 3)),
+                      jnp.float32)
+    mp = mdn.get_mixture_params(raw, m)
+    tgt = jnp.asarray(_target_with_len(t, b, t))
+    off_a, pen_a = mdn.reconstruction_loss(mp, tgt, max_seq_len=t)
+    off_b, pen_b = mdn.reconstruction_loss(mp, tgt, max_seq_len=2 * t)
+    np.testing.assert_allclose(float(off_a) / 2, float(off_b), rtol=1e-6)
+    np.testing.assert_allclose(float(pen_a) / 2, float(pen_b), rtol=1e-6)
+
+
+def test_kl_loss_closed_form():
+    # q == prior -> 0
+    z = jnp.zeros((4, 8))
+    assert float(mdn.kl_loss(z, z)) == 0.0
+    # known case: mu=1, presig=0 -> 0.5 * mean(mu^2) = 0.5
+    np.testing.assert_allclose(float(mdn.kl_loss(jnp.ones((4, 8)), z)), 0.5,
+                               rtol=1e-6)
+    # floor
+    assert float(mdn.kl_cost_with_floor(jnp.float32(0.01), 0.2)) == \
+        pytest.approx(0.2)
+    assert float(mdn.kl_cost_with_floor(jnp.float32(0.5), 0.2)) == \
+        pytest.approx(0.5)
+
+
+def test_gmm_nll_gradients_finite_at_extremes():
+    m = 2
+    raw = jnp.zeros((6 * m + 3,))
+
+    def f(raw):
+        mp = mdn.get_mixture_params(raw, m)
+        return mdn.gmm_nll(jnp.float32(100.0), jnp.float32(-100.0), mp)
+
+    g = jax.grad(f)(raw)
+    assert np.all(np.isfinite(np.asarray(g)))

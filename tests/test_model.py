@@ -1,0 +1,129 @@
+"""SketchRNN model tests: shapes, jit, grads, conditioning modes."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sketch_rnn_tpu.config import get_default_hparams
+from sketch_rnn_tpu.data import DataLoader, make_synthetic_strokes
+from sketch_rnn_tpu.models import SketchRNN
+
+
+def tiny_hps(**kw):
+    base = dict(batch_size=4, max_seq_len=32, enc_rnn_size=16,
+                dec_rnn_size=24, z_size=8, num_mixture=3,
+                hyper_rnn_size=12, hyper_embed_size=4)
+    base.update(kw)
+    return get_default_hparams().replace(**base)
+
+
+def make_batch(hps, num_classes=1, seed=0):
+    seqs, labels = make_synthetic_strokes(
+        max(8, hps.batch_size), num_classes=num_classes, min_len=8,
+        max_len=hps.max_seq_len - 2, seed=seed)
+    dl = DataLoader(seqs, hps, labels=labels)
+    b = dl.random_batch()
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+def finite(tree):
+    return all(jax.tree.leaves(
+        jax.tree.map(lambda a: bool(np.all(np.isfinite(a))), tree)))
+
+
+@pytest.mark.parametrize("dec_model", ["lstm", "layer_norm", "hyper"])
+def test_loss_and_grads_all_cells(dec_model):
+    hps = tiny_hps(dec_model=dec_model)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    batch = make_batch(hps)
+
+    @jax.jit
+    def loss_fn(p, batch, key):
+        return model.loss(p, batch, key, kl_weight=jnp.float32(0.5))
+
+    (total, metrics), grads = jax.value_and_grad(
+        lambda p: loss_fn(p, batch, jax.random.key(1)), has_aux=True)(params)
+    assert np.isfinite(float(total))
+    assert finite(grads)
+    assert float(metrics["kl_raw"]) >= 0.0
+    assert float(metrics["recon"]) == pytest.approx(
+        float(metrics["offset_nll"]) + float(metrics["pen_ce"]), rel=1e-5)
+
+
+def test_unconditional_mode_has_no_encoder():
+    hps = tiny_hps(conditional=False)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    assert "enc_fwd" not in params and "dec_init_w" not in params
+    total, metrics = model.loss(params, make_batch(hps), jax.random.key(1),
+                                kl_weight=jnp.float32(0.5))
+    assert float(metrics["kl_raw"]) == 0.0
+    assert float(metrics["kl"]) == 0.0
+    # no latent -> loss is pure reconstruction (no kl_tolerance constant)
+    np.testing.assert_allclose(float(total), float(metrics["recon"]),
+                               rtol=1e-5)
+
+
+def test_eval_is_deterministic_train_is_not():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    batch = make_batch(hps)
+    e1, _ = model.loss(params, batch, jax.random.key(5), jnp.float32(1.0),
+                       train=False)
+    e2, _ = model.loss(params, batch, jax.random.key(5), jnp.float32(1.0),
+                       train=False)
+    np.testing.assert_allclose(float(e1), float(e2), rtol=1e-6)
+    t1, _ = model.loss(params, batch, jax.random.key(5), jnp.float32(1.0),
+                       train=True)
+    t2, _ = model.loss(params, batch, jax.random.key(6), jnp.float32(1.0),
+                       train=True)
+    assert float(t1) != float(t2)  # dropout + z noise differ across keys
+
+
+def test_class_conditional_embedding_used():
+    hps = tiny_hps(num_classes=3)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    assert params["class_embed"].shape == (3, hps.class_embed_size)
+    batch = make_batch(hps, num_classes=3)
+    l0, _ = model.loss(params, batch, jax.random.key(1), jnp.float32(0.5),
+                       train=False)
+    batch2 = dict(batch)
+    batch2["labels"] = (batch["labels"] + 1) % 3
+    l1, _ = model.loss(params, batch2, jax.random.key(1), jnp.float32(0.5),
+                       train=False)
+    assert float(l0) != float(l1)
+
+
+def test_encoder_ignores_padding():
+    """Changing strokes after seq_len must not change mu/presig."""
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    batch = make_batch(hps)
+    x = jnp.transpose(batch["strokes"], (1, 0, 2))[1:]
+    mu1, ps1 = model.encode(params, x, batch["seq_len"])
+    x_messed = np.asarray(x).copy()
+    for i in range(x.shape[1]):
+        n = int(batch["seq_len"][i])
+        x_messed[n:, i, 0:2] = 99.0  # scribble on the padding
+    mu2, ps2 = model.encode(params, jnp.asarray(x_messed), batch["seq_len"])
+    np.testing.assert_allclose(np.asarray(mu1), np.asarray(mu2), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ps1), np.asarray(ps2), atol=1e-5)
+
+
+def test_decoder_initial_carry_from_z():
+    hps = tiny_hps(dec_model="hyper")
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    z = jnp.ones((4, hps.z_size))
+    carry = model.decoder_initial_carry(params, z, 4)
+    (c, h), (hc, hh) = carry
+    assert c.shape == (4, hps.dec_rnn_size)
+    assert hc.shape == (4, hps.hyper_rnn_size)
+    # distinct z -> distinct initial state
+    carry2 = model.decoder_initial_carry(params, 2.0 * z, 4)
+    assert not np.allclose(np.asarray(carry[0][0]), np.asarray(carry2[0][0]))
