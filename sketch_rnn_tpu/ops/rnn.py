@@ -101,13 +101,11 @@ def bidirectional_rnn(cell_fwd, cell_bwd, params_fwd, params_bwd,
         rev_idx = jnp.where(idx < seq_len[None, :],
                             seq_len[None, :] - 1 - idx, idx)  # [T, B]
         xs_rev = jnp.take_along_axis(xs, rev_idx[:, :, None], axis=0)
-        masks_b = None
-        if rdrop_masks_bwd is not None:
-            masks_b = rdrop_masks_bwd
         _, hs_f = run_rnn(cell_fwd, params_fwd, xs,
                           rdrop_masks=rdrop_masks_fwd)
+        # dropout masks are i.i.d. per step, so they need no matching reversal
         _, hs_b_rev = run_rnn(cell_bwd, params_bwd, xs_rev,
-                              rdrop_masks=masks_b)
+                              rdrop_masks=rdrop_masks_bwd)
         # forward state at the last valid step
         last = jnp.clip(seq_len - 1, 0, t - 1)            # [B]
         h_f = jnp.take_along_axis(

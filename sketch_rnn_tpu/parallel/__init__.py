@@ -1,0 +1,21 @@
+"""Device-mesh and sharding utilities (SURVEY.md §2 component 18).
+
+The reference scales with NCCL gradient allreduce; the TPU-native
+equivalent is a ``jax.sharding.Mesh`` with the batch sharded over a
+``data`` axis — XLA inserts the gradient all-reduce over ICI when the
+replicated parameters are updated from sharded-batch gradients.
+"""
+
+from sketch_rnn_tpu.parallel.mesh import (
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "shard_batch",
+]

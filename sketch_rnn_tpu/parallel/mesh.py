@@ -1,0 +1,87 @@
+"""Mesh construction and batch sharding.
+
+TPU-native replacement for the reference's NCCL data-parallel layer
+(SURVEY.md §2 component 18, §5 "Distributed communication backend";
+reference unreadable — semantics per BASELINE.json's "pmap'd data-parallel
+loop with gradient allreduce over ICI instead of NCCL").
+
+Design: the modern ``jit``-with-``NamedSharding`` idiom rather than a
+literal ``pmap`` translation. Parameters are replicated over the mesh, the
+batch is sharded along the ``data`` axis, and the gradient all-reduce is
+inserted by the XLA SPMD partitioner and rides ICI — there is no explicit
+collective in user code, which is exactly the "let XLA insert collectives"
+recipe. The mesh keeps extra named axes (``hps.mesh_shape``/``mesh_axes``)
+open for model-parallel sharding later without changing the step API.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sketch_rnn_tpu.config import HParams
+
+DATA_AXIS = "data"
+
+
+def make_mesh(hps: Optional[HParams] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the device mesh from ``hps.mesh_shape`` / ``hps.mesh_axes``.
+
+    A ``-1`` entry in ``mesh_shape`` absorbs all remaining devices (the
+    default ``(-1,)`` over ``("data",)`` is pure data parallelism across
+    every chip).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    shape = list(hps.mesh_shape) if hps is not None else [-1]
+    axes = tuple(hps.mesh_axes) if hps is not None else (DATA_AXIS,)
+    if len(shape) != len(axes):
+        raise ValueError(f"mesh_shape {shape} and mesh_axes {axes} "
+                         f"must have equal length")
+    n = len(devices)
+    if shape.count(-1) > 1:
+        raise ValueError("at most one -1 in mesh_shape")
+    fixed = int(np.prod([s for s in shape if s != -1])) if shape else 1
+    if -1 in shape:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by fixed mesh "
+                             f"dims {fixed}")
+        shape[shape.index(-1)] = n // fixed
+    if int(np.prod(shape)) != n:
+        raise ValueError(f"mesh_shape {shape} != device count {n}")
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, axes)
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for host batches: leading (batch) dim split over ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated sharding (parameters, PRNG keys, scalars)."""
+    return NamedSharding(mesh, P())
+
+
+def check_batch_divisible(batch_size: int, mesh: Mesh,
+                          axis: str = DATA_AXIS) -> None:
+    n = mesh.shape[axis]
+    if batch_size % n != 0:
+        raise ValueError(
+            f"batch_size={batch_size} must be divisible by the {axis!r} "
+            f"mesh axis size {n} (global batch is split across devices)")
+
+
+def shard_batch(batch: Dict[str, Any], mesh: Mesh,
+                axis: str = DATA_AXIS) -> Dict[str, jax.Array]:
+    """Move a host numpy batch onto the mesh, split along ``axis``.
+
+    One sharded transfer per step — the only host→device boundary in the
+    training loop (SURVEY §3.1 boundary notes).
+    """
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch)

@@ -1,0 +1,29 @@
+"""Training subsystem: schedules, optimizer, jitted step, checkpoint, loop.
+
+SURVEY.md §2 components 11-14 and §5 auxiliary subsystems.
+"""
+
+from sketch_rnn_tpu.train.schedules import kl_weight_schedule, lr_schedule
+from sketch_rnn_tpu.train.state import TrainState, make_optimizer, make_train_state
+from sketch_rnn_tpu.train.step import make_eval_step, make_train_step
+from sketch_rnn_tpu.train.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from sketch_rnn_tpu.train.loop import evaluate, train
+
+__all__ = [
+    "lr_schedule",
+    "kl_weight_schedule",
+    "TrainState",
+    "make_optimizer",
+    "make_train_state",
+    "make_train_step",
+    "make_eval_step",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_checkpoint",
+    "train",
+    "evaluate",
+]

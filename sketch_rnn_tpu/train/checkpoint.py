@@ -1,0 +1,92 @@
+"""Checkpoint save / restore (full training state + model contract).
+
+TPU-native equivalent of the reference's TF-Saver checkpointing
+(SURVEY.md §2 component 13, §5 "Checkpoint / resume"): the FULL pytree is
+saved — parameters, optimizer state, step, AND the data-normalization
+scale factor, which is part of the model contract (a model restored
+without its scale factor decodes garbage).
+
+Format: flax msgpack bytes for the state pytree plus a JSON sidecar with
+step / scale factor / hparams, named ``ckpt_<step>.msgpack`` +
+``ckpt_<step>.json``. Restore-from-latest scans the directory, matching
+the reference's resume-from-latest flag. Writes go via a temp file +
+rename so a crash mid-save never corrupts the latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+from flax import serialization
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.train.state import TrainState
+
+_CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
+
+
+def _paths(ckpt_dir: str, step: int) -> Tuple[str, str]:
+    base = os.path.join(ckpt_dir, f"ckpt_{step:08d}")
+    return base + ".msgpack", base + ".json"
+
+
+def save_checkpoint(ckpt_dir: str, state: TrainState, scale_factor: float,
+                    hps: HParams, keep: int = 3) -> str:
+    """Write the state; prune to the ``keep`` most recent. Returns path."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    state = jax.device_get(state)
+    step = int(state.step)
+    data_path, meta_path = _paths(ckpt_dir, step)
+    tmp = data_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(state))
+    os.replace(tmp, data_path)
+    meta = {"step": step, "scale_factor": float(scale_factor),
+            "hps": json.loads(hps.to_json())}
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=2)
+    os.replace(tmp, meta_path)
+    _prune(ckpt_dir, keep)
+    return data_path
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[int]:
+    """Highest checkpointed step in ``ckpt_dir``, or None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [int(m.group(1)) for name in os.listdir(ckpt_dir)
+             if (m := _CKPT_RE.match(name))]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(ckpt_dir: str, target: TrainState,
+                       step: Optional[int] = None
+                       ) -> Tuple[TrainState, float, dict]:
+    """Restore ``(state, scale_factor, meta)``; ``target`` fixes the pytree
+    structure (build it with ``make_train_state`` from the same hparams)."""
+    if step is None:
+        step = latest_checkpoint(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
+    data_path, meta_path = _paths(ckpt_dir, step)
+    with open(data_path, "rb") as f:
+        state = serialization.from_bytes(target, f.read())
+    with open(meta_path) as f:
+        meta = json.load(f)
+    return state, float(meta["scale_factor"]), meta
+
+
+def _prune(ckpt_dir: str, keep: int) -> None:
+    steps = sorted(int(m.group(1)) for name in os.listdir(ckpt_dir)
+                   if (m := _CKPT_RE.match(name)))
+    for s in steps[:-keep] if keep > 0 else []:
+        for p in _paths(ckpt_dir, s):
+            try:
+                os.remove(p)
+            except OSError:
+                pass

@@ -1,0 +1,124 @@
+"""Host training loop and eval sweep.
+
+TPU-native equivalent of the reference's ``train()`` / ``evaluate_model()``
+(SURVEY.md §2 component 12, §3.1/§3.4): a thin host loop around ONE jitted
+step — per iteration the host only assembles a numpy batch, transfers it
+sharded onto the mesh, and (every ``log_every`` steps) fetches scalar
+metrics. Everything else (fwd, bwd, all-reduce, Adam, schedules) runs on
+device. Eval sweeps the whole valid/test split with the dropout-off step
+and averages, which is the recon-NLL/KL parity surface.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data.loader import DataLoader
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+from sketch_rnn_tpu.train.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from sketch_rnn_tpu.train.metrics import MetricsWriter
+from sketch_rnn_tpu.train.state import TrainState, make_train_state
+from sketch_rnn_tpu.train.step import make_eval_step, make_train_step
+
+
+def evaluate(model: SketchRNN, params, loader: DataLoader, eval_step,
+             mesh=None, key: Optional[jax.Array] = None
+             ) -> Dict[str, float]:
+    """Average eval metrics over every full batch of ``loader``."""
+    if key is None:
+        key = jax.random.key(0)
+    totals: Dict[str, float] = {}
+    n = max(loader.num_batches, 1)
+    for i in range(loader.num_batches):
+        batch = loader.get_batch(i)
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        # eval is deterministic (no dropout, z uses the key) — a fixed
+        # fold-in per batch keeps the sweep reproducible
+        metrics = eval_step(params, batch, jax.random.fold_in(key, i))
+        for k, v in metrics.items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+    return {k: v / n for k, v in totals.items()}
+
+
+def train(hps: HParams,
+          train_loader: DataLoader,
+          valid_loader: Optional[DataLoader] = None,
+          test_loader: Optional[DataLoader] = None,
+          scale_factor: float = 1.0,
+          workdir: Optional[str] = None,
+          seed: int = 0,
+          num_steps: Optional[int] = None,
+          use_mesh: bool = True,
+          resume: bool = True) -> TrainState:
+    """Train for ``num_steps`` (default ``hps.num_steps``); returns state.
+
+    Resumes from the latest checkpoint in ``workdir`` when present
+    (reference parity: resume-from-latest, SURVEY §5).
+    """
+    num_steps = hps.num_steps if num_steps is None else num_steps
+    model = SketchRNN(hps)
+    mesh = make_mesh(hps) if use_mesh else None
+
+    key = jax.random.key(seed)
+    key, init_key = jax.random.split(key)
+    state = make_train_state(model, hps, init_key)
+    if workdir and resume and latest_checkpoint(workdir) is not None:
+        state, scale_factor, meta = restore_checkpoint(workdir, state)
+        print(f"[train] resumed from step {meta['step']}", flush=True)
+
+    train_step = make_train_step(model, hps, mesh)
+    eval_step = make_eval_step(model, hps, mesh)
+    writer = MetricsWriter(workdir, "train")
+    eval_writer = MetricsWriter(workdir, "valid")
+
+    step = int(state.step)
+    t_last, s_last = time.time(), step
+    while step < num_steps:
+        batch = train_loader.random_batch()
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        key, step_key = jax.random.split(key)
+        state, metrics = train_step(state, batch, step_key)
+        step += 1
+
+        if step % hps.log_every == 0 or step == num_steps:
+            scalars = {k: float(v) for k, v in metrics.items()}
+            dt = time.time() - t_last
+            if dt > 0:
+                steps_s = (step - s_last) / dt
+                scalars["steps_per_sec"] = steps_s
+                scalars["strokes_per_sec"] = (
+                    steps_s * hps.batch_size * hps.max_seq_len)
+            t_last, s_last = time.time(), step
+            writer.write(step, scalars)
+            writer.log_console(step, scalars)
+
+        if valid_loader is not None and step % hps.eval_every == 0:
+            ev = evaluate(model, state.params, valid_loader, eval_step, mesh)
+            eval_writer.write(step, ev)
+            eval_writer.log_console(step, ev)
+
+        if workdir and step % hps.save_every == 0:
+            save_checkpoint(workdir, state, scale_factor, hps)
+
+    if workdir:
+        save_checkpoint(workdir, state, scale_factor, hps)
+    if test_loader is not None and test_loader.num_batches > 0:
+        ev = evaluate(model, state.params, test_loader, eval_step, mesh)
+        MetricsWriter(workdir, "test").write(int(state.step), ev)
+        print("[test] " + " ".join(f"{k}={v:.4f}"
+                                   for k, v in sorted(ev.items())),
+              flush=True)
+    return state

@@ -1,0 +1,54 @@
+"""Metrics logging: console + CSV + JSONL (TensorBoard-free).
+
+TPU-native equivalent of the reference's TF summary scalars
+(SURVEY.md §2 component 16, §5 "Metrics / logging": total loss, recon-NLL,
+KL, lr, KL weight to TensorBoard plus console prints). Here a dependency-
+free writer emits the same scalars as append-only CSV and JSONL under the
+work dir, which any plotting tool can consume.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import time
+from typing import Dict, Optional, Sequence
+
+
+class MetricsWriter:
+    """Append-only scalar logger; one row per logged step."""
+
+    def __init__(self, workdir: Optional[str], name: str = "train"):
+        self.workdir = workdir
+        self.name = name
+        self._csv_path = None
+        self._jsonl_path = None
+        self._fields: Optional[Sequence[str]] = None
+        if workdir:
+            os.makedirs(workdir, exist_ok=True)
+            self._csv_path = os.path.join(workdir, f"{name}_metrics.csv")
+            self._jsonl_path = os.path.join(workdir, f"{name}_metrics.jsonl")
+
+    def write(self, step: int, scalars: Dict[str, float]) -> None:
+        row = {"step": int(step), "wall_time": time.time()}
+        row.update({k: float(v) for k, v in sorted(scalars.items())})
+        if self._jsonl_path:
+            with open(self._jsonl_path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        if self._csv_path:
+            new = self._fields is None and not os.path.exists(self._csv_path)
+            if self._fields is None:
+                self._fields = list(row)
+            with open(self._csv_path, "a", newline="") as f:
+                w = csv.DictWriter(f, fieldnames=self._fields,
+                                   extrasaction="ignore")
+                if new:
+                    w.writeheader()
+                w.writerow(row)
+
+    def log_console(self, step: int, scalars: Dict[str, float],
+                    prefix: str = "") -> None:
+        parts = " ".join(f"{k}={float(v):.4f}"
+                         for k, v in sorted(scalars.items()))
+        print(f"[{self.name}] step {step} {prefix}{parts}", flush=True)

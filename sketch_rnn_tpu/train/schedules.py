@@ -1,0 +1,35 @@
+"""Learning-rate decay and KL-annealing schedules (pure jnp functions).
+
+TPU-native equivalent of the reference's per-step schedule updates
+(SURVEY.md §2 component 11-12, §5 "Config": ``learning_rate=1e-3`` with
+exponential decay to ``min_learning_rate``, and the KL weight annealed as
+``eta = kl_weight - (kl_weight - kl_weight_start) * R^step``; reference
+unreadable — formulas per the canonical implementation noted there).
+
+Both are pure functions of the step so they trace into the jitted train
+step; nothing is recompiled as the step advances.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sketch_rnn_tpu.config import HParams
+
+
+def _exp_decay(step: jax.Array, rate: float) -> jax.Array:
+    # rate**step via exp/log: step may be a traced int32 inside jit
+    return jnp.exp(jnp.asarray(step, jnp.float32) * jnp.log(jnp.float32(rate)))
+
+
+def lr_schedule(hps: HParams, step: jax.Array) -> jax.Array:
+    """``(lr0 - lr_min) * decay^step + lr_min``."""
+    return ((hps.learning_rate - hps.min_learning_rate)
+            * _exp_decay(step, hps.decay_rate) + hps.min_learning_rate)
+
+
+def kl_weight_schedule(hps: HParams, step: jax.Array) -> jax.Array:
+    """Annealed KL weight: rises from ``kl_weight_start`` to ``kl_weight``."""
+    return (hps.kl_weight - (hps.kl_weight - hps.kl_weight_start)
+            * _exp_decay(step, hps.kl_decay_rate))

@@ -1,0 +1,46 @@
+"""Train state and optimizer assembly.
+
+TPU-native equivalent of the reference's Adam train-op construction
+(SURVEY.md §2 component 11: Adam, exponential lr decay, global-norm
+gradient clipping): an optax chain ``clip_by_global_norm -> adam(schedule)``
+acting on an explicit ``TrainState`` pytree. The state is a NamedTuple so
+it flows through ``jit``/``grad``/sharding and serializes as a plain
+pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.train.schedules import lr_schedule
+
+
+class TrainState(NamedTuple):
+    params: Dict[str, Any]
+    opt_state: Any
+    step: jax.Array  # int32 scalar
+
+
+def make_optimizer(hps: HParams) -> optax.GradientTransformation:
+    """``clip_by_global_norm(grad_clip) -> adam(exp-decay lr)``.
+
+    optax's ``adam`` takes the schedule as a callable of its own update
+    count, which equals ``TrainState.step`` (both start at 0 and advance
+    once per ``train_step``).
+    """
+    return optax.chain(
+        optax.clip_by_global_norm(hps.grad_clip),
+        optax.adam(learning_rate=lambda count: lr_schedule(hps, count)),
+    )
+
+
+def make_train_state(model, hps: HParams, key: jax.Array) -> TrainState:
+    params = model.init_params(key)
+    tx = make_optimizer(hps)
+    return TrainState(params=params, opt_state=tx.init(params),
+                      step=jnp.zeros((), jnp.int32))

@@ -1,0 +1,95 @@
+"""Jitted train / eval steps with mesh-aware sharding.
+
+TPU-native equivalent of the reference's per-step ``sess.run(train_op)``
+(SURVEY.md §3.1: on the GPU reference the host↔device boundary is crossed
+every step; here the whole step — forward, backward, gradient all-reduce,
+Adam update, schedules — is ONE jitted XLA computation). Data parallelism
+(component 18) is expressed with ``NamedSharding``: the batch is split
+along the mesh's ``data`` axis, parameters/optimizer state are replicated,
+and the SPMD partitioner inserts the gradient all-reduce over ICI (the
+NCCL-allreduce equivalent).
+
+``donate_argnums=0`` donates the previous state's buffers to the update so
+parameters are updated in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import optax
+from jax.sharding import Mesh
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.parallel.mesh import (
+    batch_sharding,
+    check_batch_divisible,
+    replicated_sharding,
+)
+from sketch_rnn_tpu.train.schedules import kl_weight_schedule, lr_schedule
+from sketch_rnn_tpu.train.state import TrainState, make_optimizer
+
+Batch = Dict[str, jax.Array]
+Metrics = Dict[str, jax.Array]
+StepFn = Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Metrics]]
+EvalFn = Callable[[Any, Batch, jax.Array], Metrics]
+
+
+def make_train_step(model, hps: HParams,
+                    mesh: Optional[Mesh] = None) -> StepFn:
+    """Build the jitted ``(state, batch, key) -> (state, metrics)`` step."""
+    tx = make_optimizer(hps)
+
+    def step_fn(state: TrainState, batch: Batch, key: jax.Array
+                ) -> Tuple[TrainState, Metrics]:
+        kl_w = kl_weight_schedule(hps, state.step)
+
+        def loss_fn(params):
+            return model.loss(params, batch, key, kl_w, train=True)
+
+        (_, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        metrics["lr"] = lr_schedule(hps, state.step)
+        return TrainState(params, opt_state, state.step + 1), metrics
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=0)
+    check_batch_divisible(hps.batch_size, mesh)
+    repl = replicated_sharding(mesh)
+    data = batch_sharding(mesh)
+    return jax.jit(
+        step_fn,
+        # pytree-prefix shardings: whole state replicated, whole batch
+        # data-sharded, key replicated
+        in_shardings=(repl, data, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=0,
+    )
+
+
+def make_eval_step(model, hps: HParams,
+                   mesh: Optional[Mesh] = None) -> EvalFn:
+    """Jitted eval: dropout off, pen CE masked, KL un-annealed (weight=1).
+
+    Mirrors the reference's weight-tied eval graph (SURVEY §3.4) — here
+    simply the same pure loss with ``train=False`` compiled as a second
+    XLA program. Returned metrics use the eval normalization that is the
+    parity surface: recon-NLL, KL (floored) and total with full KL weight.
+    """
+
+    def eval_fn(params, batch: Batch, key: jax.Array) -> Metrics:
+        _, metrics = model.loss(params, batch, key,
+                                kl_weight=1.0, train=False)
+        return metrics
+
+    if mesh is None:
+        return jax.jit(eval_fn)
+    repl = replicated_sharding(mesh)
+    data = batch_sharding(mesh)
+    return jax.jit(eval_fn, in_shardings=(repl, data, repl),
+                   out_shardings=repl)

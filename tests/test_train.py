@@ -1,0 +1,236 @@
+"""Training subsystem tests: schedules, step, DP mesh, checkpoint, loop.
+
+SURVEY.md §4 test pyramid: 1-step train test (loss decrease + finite
+grads), 8-way virtual-CPU-mesh DP test, checkpoint roundtrip/resume.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+from sketch_rnn_tpu.train import (
+    kl_weight_schedule,
+    lr_schedule,
+    make_eval_step,
+    make_train_state,
+    make_train_step,
+)
+from sketch_rnn_tpu.train.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from sketch_rnn_tpu.train.loop import evaluate, train
+
+TINY = dict(batch_size=16, max_seq_len=32, enc_rnn_size=16, dec_rnn_size=24,
+            z_size=8, num_mixture=3, hyper_rnn_size=8, hyper_embed_size=4)
+
+
+def tiny_hps(**kw) -> HParams:
+    return HParams(**{**TINY, **kw})
+
+
+def make_loader(hps, n=64, seed=0, augment=False):
+    seqs, labels = make_synthetic_strokes(
+        n, num_classes=max(hps.num_classes, 1),
+        min_len=10, max_len=hps.max_seq_len - 2, seed=seed)
+    return DataLoader(seqs, hps, labels=labels, augment=augment, seed=seed)
+
+
+# -- schedules --------------------------------------------------------------
+
+
+def test_lr_schedule_endpoints():
+    hps = tiny_hps()
+    lr0 = float(lr_schedule(hps, 0))
+    assert lr0 == pytest.approx(hps.learning_rate, rel=1e-6)
+    lr_inf = float(lr_schedule(hps, 10**7))
+    assert lr_inf == pytest.approx(hps.min_learning_rate, rel=1e-3)
+    assert float(lr_schedule(hps, 100)) < lr0
+
+
+def test_kl_weight_schedule_endpoints():
+    hps = tiny_hps()
+    w0 = float(kl_weight_schedule(hps, 0))
+    assert w0 == pytest.approx(hps.kl_weight_start, rel=1e-5)
+    w_inf = float(kl_weight_schedule(hps, 10**7))
+    assert w_inf == pytest.approx(hps.kl_weight, rel=1e-4)
+    # monotone rising
+    ws = [float(kl_weight_schedule(hps, s)) for s in (0, 10, 100, 10000)]
+    assert ws == sorted(ws)
+
+
+# -- single-device training -------------------------------------------------
+
+
+def test_train_step_decreases_loss_and_grads_finite():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    loader = make_loader(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh=None)
+    key = jax.random.key(1)
+    batch = loader.get_batch(0)
+    first = None
+    for i in range(30):
+        key, k = jax.random.split(key)
+        state, metrics = step(state, batch, k)
+        assert np.isfinite(float(metrics["loss"])), f"step {i} non-finite"
+        assert np.isfinite(float(metrics["grad_norm"]))
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+    assert int(state.step) == 30
+
+
+def test_unconditional_train_step():
+    hps = tiny_hps(conditional=False)
+    model = SketchRNN(hps)
+    loader = make_loader(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh=None)
+    state, metrics = step(state, loader.get_batch(0), jax.random.key(1))
+    assert float(metrics["kl"]) == 0.0
+    assert np.isfinite(float(metrics["loss"]))
+
+
+# -- data-parallel mesh -----------------------------------------------------
+
+
+def test_mesh_has_8_devices():
+    mesh = make_mesh(tiny_hps())
+    assert mesh.shape["data"] == 8
+
+
+def test_mesh_train_matches_single_device():
+    """8-way DP on the virtual mesh must be numerically equivalent to
+    single-device training (same global batch, same key)."""
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    loader = make_loader(hps)
+    mesh = make_mesh(hps)
+
+    batch = loader.get_batch(0)
+    key = jax.random.key(1)
+
+    s1 = make_train_state(model, hps, jax.random.key(0))
+    s2 = jax.tree_util.tree_map(jnp.copy, s1)
+
+    step_single = make_train_step(model, hps, mesh=None)
+    step_mesh = make_train_step(model, hps, mesh=mesh)
+
+    s1, m1 = step_single(s1, batch, key)
+    s2, m2 = step_mesh(s2, shard_batch(batch, mesh), key)
+
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]),
+                               rtol=2e-5)
+    leaves1 = jax.tree_util.tree_leaves(s1.params)
+    leaves2 = jax.tree_util.tree_leaves(s2.params)
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
+
+
+def test_mesh_batch_not_divisible_raises():
+    hps = tiny_hps(batch_size=12)  # 12 % 8 != 0
+    model = SketchRNN(hps)
+    mesh = make_mesh(hps)
+    with pytest.raises(ValueError, match="divisible"):
+        make_train_step(model, hps, mesh=mesh)
+
+
+def test_mesh_shape_validation():
+    hps = tiny_hps(mesh_shape=(3,))
+    with pytest.raises(ValueError):
+        make_mesh(hps)
+    mesh = make_mesh(tiny_hps(mesh_shape=(2, -1),
+                              mesh_axes=("model", "data")))
+    assert mesh.shape == {"model": 2, "data": 4}
+
+
+# -- eval -------------------------------------------------------------------
+
+
+def test_eval_step_deterministic_and_masked():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    loader = make_loader(hps)
+    params = model.init_params(jax.random.key(0))
+    ev = make_eval_step(model, hps, mesh=None)
+    batch = loader.get_batch(0)
+    m1 = ev(params, batch, jax.random.key(5))
+    m2 = ev(params, batch, jax.random.key(5))
+    assert float(m1["loss"]) == float(m2["loss"])
+    assert float(m1["kl_weight"]) == 1.0
+
+
+def test_evaluate_sweep():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    loader = make_loader(hps, n=48)
+    params = model.init_params(jax.random.key(0))
+    ev = make_eval_step(model, hps, mesh=None)
+    out = evaluate(model, params, loader, ev)
+    assert "recon" in out and np.isfinite(out["recon"])
+
+
+# -- checkpoint -------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    state = state._replace(step=jnp.asarray(7, jnp.int32))
+    d = str(tmp_path)
+    save_checkpoint(d, state, scale_factor=3.5, hps=hps)
+    assert latest_checkpoint(d) == 7
+
+    template = make_train_state(model, hps, jax.random.key(99))
+    restored, scale, meta = restore_checkpoint(d, template)
+    assert scale == 3.5
+    assert int(restored.step) == 7
+    assert meta["hps"]["dec_rnn_size"] == hps.dec_rnn_size
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_prune_keeps_latest(tmp_path):
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    d = str(tmp_path)
+    for s in (1, 2, 3, 4, 5):
+        save_checkpoint(d, state._replace(step=jnp.asarray(s, jnp.int32)),
+                        1.0, hps, keep=2)
+    names = sorted(os.listdir(d))
+    assert latest_checkpoint(d) == 5
+    assert sum(n.endswith(".msgpack") for n in names) == 2
+
+
+# -- end-to-end loop --------------------------------------------------------
+
+
+def test_train_loop_end_to_end_with_resume(tmp_path):
+    hps = tiny_hps(num_steps=6, save_every=3, eval_every=3, log_every=2)
+    loader = make_loader(hps, n=32, augment=True)
+    valid = make_loader(hps, n=16, seed=9)
+    d = str(tmp_path)
+    state = train(hps, loader, valid_loader=valid, scale_factor=2.0,
+                  workdir=d, use_mesh=True)
+    assert int(state.step) == 6
+    assert latest_checkpoint(d) == 6
+    assert os.path.exists(os.path.join(d, "train_metrics.csv"))
+    assert os.path.exists(os.path.join(d, "valid_metrics.jsonl"))
+    # resume continues, does not restart
+    state2 = train(hps.replace(num_steps=8), loader, workdir=d,
+                   use_mesh=True)
+    assert int(state2.step) == 8
