@@ -180,6 +180,21 @@ class SketchRNN:
             hs = hs * mask / keep
         return L.matmul(hs, params["out_w"], _dtype(hps)) + params["out_b"]
 
+    def decode_step(self, params: Params, carry, x_prev: jax.Array,
+                    z: Optional[jax.Array] = None,
+                    labels: Optional[jax.Array] = None
+                    ) -> Tuple[Any, jax.Array]:
+        """One autoregressive decoder step for sampling.
+
+        ``x_prev`` is the previous stroke-5 ``[B, 5]``; returns the new cell
+        carry and the raw MDN projection ``[B, 6M+3]``. Used inside the
+        on-device sampling loop (SURVEY §2 component 15, §3.3).
+        """
+        inputs = self._decoder_inputs(params, x_prev[None], z, labels)[0]
+        carry, h = self.dec(params["dec"], carry, inputs)
+        return carry, L.matmul(h, params["out_w"], _dtype(self.hps)) \
+            + params["out_b"]
+
     # -- loss --------------------------------------------------------------
 
     def loss(self, params: Params, batch: Dict[str, jax.Array],

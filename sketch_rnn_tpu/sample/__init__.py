@@ -1,0 +1,26 @@
+"""Sampling / generation subsystem (SURVEY.md §2 components 15 and 17)."""
+
+from sketch_rnn_tpu.sample.sampler import (
+    make_sampler,
+    sample,
+    sample_from_mixture,
+)
+from sketch_rnn_tpu.sample.interpolate import (
+    encode_mu,
+    interpolate_latents,
+    lerp,
+    slerp,
+)
+from sketch_rnn_tpu.sample.svg import strokes_to_svg, svg_grid
+
+__all__ = [
+    "make_sampler",
+    "sample",
+    "sample_from_mixture",
+    "slerp",
+    "lerp",
+    "interpolate_latents",
+    "encode_mu",
+    "strokes_to_svg",
+    "svg_grid",
+]

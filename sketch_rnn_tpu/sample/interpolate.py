@@ -1,0 +1,57 @@
+"""Latent-space utilities: encode, interpolate (SURVEY.md §2 component 17).
+
+TPU-native-framework equivalent of the reference notebook's latent
+interpolation demo (reference unreadable — canonical behavior: encode two
+sketches, spherically interpolate between their latents, decode each).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sketch_rnn_tpu.config import HParams
+
+
+def encode_mu(model, params, batch) -> jax.Array:
+    """Posterior mean for a loader batch — the deterministic embedding.
+
+    ``batch`` is a loader dict (``strokes [B, Nmax+1, 5]``, ``seq_len``).
+    The encoder consumes the sequence without the start token, as in
+    training (SURVEY §3.2: the encoder sees S_1..S_Nmax).
+    """
+    strokes = jnp.transpose(jnp.asarray(batch["strokes"]), (1, 0, 2))[1:]
+    mu, _ = model.encode(params, strokes, jnp.asarray(batch["seq_len"]),
+                         train=False)
+    return mu
+
+
+def lerp(z0: jax.Array, z1: jax.Array, t: jax.Array) -> jax.Array:
+    return (1.0 - t) * z0 + t * z1
+
+
+def slerp(z0: jax.Array, z1: jax.Array, t: jax.Array) -> jax.Array:
+    """Spherical interpolation (canonical for VAE latents on ~N(0,I))."""
+    z0 = jnp.asarray(z0, jnp.float32)
+    z1 = jnp.asarray(z1, jnp.float32)
+    dot = jnp.sum(z0 * z1) / (jnp.linalg.norm(z0) * jnp.linalg.norm(z1))
+    omega = jnp.arccos(jnp.clip(dot, -1.0 + 1e-7, 1.0 - 1e-7))
+    so = jnp.sin(omega)
+    return jnp.where(
+        so < 1e-6,
+        lerp(z0, z1, t),
+        (jnp.sin((1.0 - t) * omega) / so) * z0
+        + (jnp.sin(t * omega) / so) * z1)
+
+
+def interpolate_latents(z0: jax.Array, z1: jax.Array, n: int = 10,
+                        mode: str = "slerp") -> jax.Array:
+    """``n`` latents from z0 to z1 inclusive, stacked ``[n, Nz]``."""
+    if mode not in ("slerp", "lerp"):
+        raise ValueError(f"mode must be slerp|lerp, got {mode!r}")
+    f = slerp if mode == "slerp" else lerp
+    ts = jnp.linspace(0.0, 1.0, n)
+    return jnp.stack([f(z0, z1, t) for t in ts])

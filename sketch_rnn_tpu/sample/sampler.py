@@ -1,0 +1,171 @@
+"""On-device autoregressive sampler: the whole loop is one XLA program.
+
+TPU-native equivalent of the reference's ``sample()`` (SURVEY.md §2
+component 15, §3.3; reference unreadable — semantics per the canonical
+host loop: per step, temperature-scale the mixture logits, draw a
+component, draw (dx, dy) from the chosen bivariate Gaussian with sigma
+scaled by sqrt(temperature), draw the pen state, stop at p3 or max_len).
+
+The reference crosses the host↔device boundary EVERY step; here the loop
+is a ``lax.while_loop`` inside one jitted computation — no host sync until
+the finished batch of sketches is fetched (BASELINE.json: "runs as an
+on-device lax.while_loop so generation needs no host sync"). The loop
+early-exits as soon as every sketch in the batch has drawn its
+end-of-sketch pen state; finished rows within a still-running batch are
+frozen to the end token.
+
+Sampling is batched: one call draws B sketches in parallel — B small MXU
+matmuls per step become one batched matmul, which is how an RNN sampler
+keeps a TPU busy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data import strokes as S
+from sketch_rnn_tpu.ops import mdn
+
+END_TOKEN = jnp.array([0.0, 0.0, 0.0, 0.0, 1.0], jnp.float32)
+START_TOKEN = jnp.array([0.0, 0.0, 1.0, 0.0, 0.0], jnp.float32)
+
+
+def sample_from_mixture(mp: mdn.MixtureParams, key: jax.Array,
+                        temperature: jax.Array, greedy: bool = False
+                        ) -> jax.Array:
+    """Draw one stroke-5 row per batch element from MDN parameters ``[B,·]``.
+
+    Temperature ``tau`` scales the component/pen logits by ``1/tau`` and the
+    Gaussian stds by ``sqrt(tau)`` (canonical semantics). ``greedy`` takes
+    the argmax component, its mean, and the argmax pen state (tau ignored).
+    """
+    kc, kg, kp = jax.random.split(key, 3)
+    tau = jnp.asarray(temperature, jnp.float32)
+    if greedy:
+        idx = jnp.argmax(mp.log_pi, axis=-1)
+        pen_idx = jnp.argmax(mp.pen_logits, axis=-1)
+    else:
+        idx = jax.random.categorical(kc, mp.log_pi / tau, axis=-1)
+        pen_idx = jax.random.categorical(kp, mp.pen_logits / tau, axis=-1)
+
+    take = lambda a: jnp.take_along_axis(a, idx[..., None], axis=-1)[..., 0]
+    mu1, mu2 = take(mp.mu1), take(mp.mu2)
+    s1, s2 = jnp.exp(take(mp.log_s1)), jnp.exp(take(mp.log_s2))
+    rho = take(mp.rho)
+    if greedy:
+        dx, dy = mu1, mu2
+    else:
+        e = jax.random.normal(kg, (*mu1.shape, 2), jnp.float32)
+        sq = jnp.sqrt(tau)
+        dx = mu1 + s1 * sq * e[..., 0]
+        dy = mu2 + s2 * sq * (rho * e[..., 0]
+                              + jnp.sqrt(1.0 - jnp.square(rho)) * e[..., 1])
+    pen = jax.nn.one_hot(pen_idx, 3, dtype=jnp.float32)
+    return jnp.concatenate([dx[..., None], dy[..., None], pen], axis=-1)
+
+
+def make_sampler(model, hps: HParams, max_len: Optional[int] = None,
+                 greedy: bool = False):
+    """Cached wrapper around :func:`_build_sampler`.
+
+    The compiled sampler is memoized on the model instance so repeated
+    ``sample()`` calls (per temperature, per interpolation frame) reuse one
+    XLA program instead of re-tracing.
+    """
+    cache = getattr(model, "_sampler_cache", None)
+    if cache is None:
+        cache = model._sampler_cache = {}
+    ckey = (int(max_len or hps.max_seq_len), bool(greedy))
+    if ckey not in cache:
+        cache[ckey] = _build_sampler(model, hps, max_len, greedy)
+    return cache[ckey]
+
+
+def _build_sampler(model, hps: HParams, max_len: Optional[int] = None,
+                   greedy: bool = False):
+    """Build the jitted batched sampler.
+
+    Returns ``fn(params, key, batch_size, z, labels, temperature) ->
+    (strokes5 [B, max_len, 5], lengths [B])``. ``z`` is required when the
+    model is conditional (``[B, Nz]``) and must be None otherwise;
+    ``labels`` likewise for class-conditional models. ``batch_size`` is
+    static (one compile per B); ``temperature`` is a runtime scalar.
+    ``lengths`` counts rows before the end-of-sketch pen state (or
+    ``max_len`` if it never fired); rows past each sketch's end are end
+    tokens, so the buffer is valid stroke-5 padding.
+    """
+    t_max = int(max_len or hps.max_seq_len)
+
+    @functools.partial(jax.jit, static_argnames=("batch_size",))
+    def sampler(params, key, batch_size: int, z=None, labels=None,
+                temperature=1.0):
+        carry0 = model.decoder_initial_carry(params, z, batch_size)
+        prev0 = jnp.broadcast_to(START_TOKEN, (batch_size, 5))
+        done0 = jnp.zeros((batch_size,), bool)
+        len0 = jnp.zeros((batch_size,), jnp.int32)
+        out0 = jnp.broadcast_to(END_TOKEN, (t_max, batch_size, 5))
+
+        def cond(st):
+            t, _, _, done, _, _, _ = st
+            return (t < t_max) & ~jnp.all(done)
+
+        def body(st):
+            t, carry, prev, done, length, out, key = st
+            key, k = jax.random.split(key)
+            new_carry, raw = model.decode_step(params, carry, prev, z, labels)
+            mp = mdn.get_mixture_params(raw, hps.num_mixture)
+            stroke = sample_from_mixture(mp, k, temperature, greedy=greedy)
+            # freeze finished rows: emit end tokens, keep the old carry
+            stroke = jnp.where(done[:, None], END_TOKEN[None], stroke)
+            carry = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(
+                    done.reshape((-1,) + (1,) * (new.ndim - 1)), old, new),
+                new_carry, carry)
+            new_done = done | (stroke[:, 4] > 0.5)
+            length = length + (~new_done).astype(jnp.int32)
+            out = lax.dynamic_update_index_in_dim(out, stroke, t, axis=0)
+            return (t + 1, carry, stroke, new_done, length, out, key)
+
+        _, _, _, done, length, out, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), carry0, prev0, done0, len0, out0, key))
+        # sketches that never drew p3 run the full buffer
+        length = jnp.where(done, length, t_max)
+        return jnp.transpose(out, (1, 0, 2)), length
+
+    return sampler
+
+
+def sample(model, params, hps: HParams, key: jax.Array, n: int = 1,
+           temperature: float = 1.0, z: Optional[jax.Array] = None,
+           labels: Optional[jax.Array] = None,
+           max_len: Optional[int] = None, greedy: bool = False,
+           scale_factor: float = 1.0) -> Tuple[list, np.ndarray]:
+    """Convenience wrapper: draw ``n`` sketches, return host stroke-3 list.
+
+    For conditional models with no ``z`` given, draws z ~ N(0, I) (the
+    prior), matching the reference's unconditional-generation mode of a
+    trained VAE. Offsets are multiplied back by ``scale_factor`` so the
+    output is in data units.
+    """
+    kz, ks = jax.random.split(key)
+    if hps.conditional and z is None:
+        z = jax.random.normal(kz, (n, hps.z_size), jnp.float32)
+    if hps.num_classes > 0 and labels is None:
+        labels = jnp.zeros((n,), jnp.int32)
+    sampler = make_sampler(model, hps, max_len=max_len, greedy=greedy)
+    strokes5, lengths = sampler(params, ks, n, z, labels,
+                                jnp.float32(temperature))
+    strokes5 = np.asarray(strokes5)
+    out = []
+    for i in range(n):
+        s3 = S.to_normal_strokes(strokes5[i])
+        s3[:, 0:2] *= scale_factor
+        out.append(s3)
+    return out, np.asarray(lengths)

@@ -32,7 +32,7 @@ from sketch_rnn_tpu.train.state import TrainState, make_train_state
 from sketch_rnn_tpu.train.step import make_eval_step, make_train_step
 
 
-def evaluate(model: SketchRNN, params, loader: DataLoader, eval_step,
+def evaluate(params, loader: DataLoader, eval_step,
              mesh=None, key: Optional[jax.Array] = None
              ) -> Dict[str, float]:
     """Average eval metrics over every full batch of ``loader``."""
@@ -71,8 +71,8 @@ def train(hps: HParams,
     model = SketchRNN(hps)
     mesh = make_mesh(hps) if use_mesh else None
 
-    key = jax.random.key(seed)
-    key, init_key = jax.random.split(key)
+    root_key = jax.random.key(seed)
+    root_key, init_key = jax.random.split(root_key)
     state = make_train_state(model, hps, init_key)
     if workdir and resume and latest_checkpoint(workdir) is not None:
         state, scale_factor, meta = restore_checkpoint(workdir, state)
@@ -89,7 +89,9 @@ def train(hps: HParams,
         batch = train_loader.random_batch()
         if mesh is not None:
             batch = shard_batch(batch, mesh)
-        key, step_key = jax.random.split(key)
+        # key is a pure function of (seed, step): a resumed run continues
+        # the stream instead of replaying the pre-checkpoint keys
+        step_key = jax.random.fold_in(root_key, step)
         state, metrics = train_step(state, batch, step_key)
         step += 1
 
@@ -106,7 +108,7 @@ def train(hps: HParams,
             writer.log_console(step, scalars)
 
         if valid_loader is not None and step % hps.eval_every == 0:
-            ev = evaluate(model, state.params, valid_loader, eval_step, mesh)
+            ev = evaluate(state.params, valid_loader, eval_step, mesh)
             eval_writer.write(step, ev)
             eval_writer.log_console(step, ev)
 
@@ -116,7 +118,7 @@ def train(hps: HParams,
     if workdir:
         save_checkpoint(workdir, state, scale_factor, hps)
     if test_loader is not None and test_loader.num_batches > 0:
-        ev = evaluate(model, state.params, test_loader, eval_step, mesh)
+        ev = evaluate(state.params, test_loader, eval_step, mesh)
         MetricsWriter(workdir, "test").write(int(state.step), ev)
         print("[test] " + " ".join(f"{k}={v:.4f}"
                                    for k, v in sorted(ev.items())),

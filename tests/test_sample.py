@@ -1,0 +1,183 @@
+"""Sampler / SVG / interpolation tests (SURVEY.md §4 test pyramid).
+
+The sampler's stop-on-p3 semantics, temperature behavior, and the mixture
+draw itself are unit-tested; end-to-end sampling runs on every cell type.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.ops import mdn
+from sketch_rnn_tpu.sample import (
+    encode_mu,
+    interpolate_latents,
+    lerp,
+    make_sampler,
+    sample,
+    sample_from_mixture,
+    slerp,
+    strokes_to_svg,
+    svg_grid,
+)
+
+TINY = dict(batch_size=8, max_seq_len=24, enc_rnn_size=12, dec_rnn_size=16,
+            z_size=6, num_mixture=3, hyper_rnn_size=8, hyper_embed_size=4)
+
+
+def tiny_hps(**kw) -> HParams:
+    return HParams(**{**TINY, **kw})
+
+
+def _mixture(b=4, m=3, mean=(2.0, -1.0), pen_idx=0):
+    """A mixture massively favoring component 0 at `mean`, pen `pen_idx`."""
+    logits = jnp.full((b, m), -50.0).at[:, 0].set(50.0)
+    mu1 = jnp.zeros((b, m)).at[:, 0].set(mean[0])
+    mu2 = jnp.zeros((b, m)).at[:, 0].set(mean[1])
+    pen = jnp.full((b, 3), -50.0).at[:, pen_idx].set(50.0)
+    return mdn.MixtureParams(
+        log_pi=jax.nn.log_softmax(logits),
+        mu1=mu1, mu2=mu2,
+        log_s1=jnp.full((b, m), -3.0), log_s2=jnp.full((b, m), -3.0),
+        rho=jnp.zeros((b, m)), pen_logits=pen)
+
+
+def test_sample_from_mixture_concentrates():
+    mp = _mixture(mean=(2.0, -1.0), pen_idx=1)
+    s = sample_from_mixture(mp, jax.random.key(0), temperature=0.01)
+    s = np.asarray(s)
+    assert s.shape == (4, 5)
+    np.testing.assert_allclose(s[:, 0], 2.0, atol=0.05)
+    np.testing.assert_allclose(s[:, 1], -1.0, atol=0.05)
+    np.testing.assert_array_equal(s[:, 2:], np.tile([0, 1, 0], (4, 1)))
+
+
+def test_sample_from_mixture_greedy_is_exact():
+    mp = _mixture(mean=(0.7, 0.3), pen_idx=2)
+    s = np.asarray(sample_from_mixture(mp, jax.random.key(3),
+                                       temperature=1.0, greedy=True))
+    np.testing.assert_allclose(s[:, 0], 0.7, rtol=1e-6)
+    np.testing.assert_allclose(s[:, 1], 0.3, rtol=1e-6)
+    assert (s[:, 4] == 1.0).all()
+
+
+def test_temperature_widens_spread():
+    mp = _mixture(b=256)
+    lo = np.asarray(sample_from_mixture(mp, jax.random.key(0), 0.1)[:, 0])
+    hi = np.asarray(sample_from_mixture(mp, jax.random.key(0), 1.0)[:, 0])
+    assert np.std(hi) > 2.0 * np.std(lo)
+
+
+@pytest.mark.parametrize("dec", ["lstm", "layer_norm", "hyper"])
+def test_sampler_end_to_end(dec):
+    hps = tiny_hps(dec_model=dec)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    z = jax.random.normal(jax.random.key(1), (4, hps.z_size))
+    sampler = make_sampler(model, hps)
+    strokes, lengths = sampler(params, jax.random.key(2), 4, z, None,
+                               jnp.float32(0.8))
+    strokes, lengths = np.asarray(strokes), np.asarray(lengths)
+    assert strokes.shape == (4, hps.max_seq_len, 5)
+    assert np.isfinite(strokes).all()
+    # pen state is one-hot everywhere
+    np.testing.assert_allclose(strokes[:, :, 2:].sum(-1), 1.0)
+    for i in range(4):
+        n = lengths[i]
+        assert 0 <= n <= hps.max_seq_len
+        # row n is the end-of-sketch row (sampled offsets, p3 pen state);
+        # every row after it is a frozen zero-offset end token
+        if n < hps.max_seq_len:
+            assert (strokes[i, n:, 4] == 1.0).all()
+            assert (strokes[i, n + 1:, 0:2] == 0.0).all()
+
+
+def test_sampler_deterministic_same_key():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    z = jnp.zeros((2, hps.z_size))
+    sampler = make_sampler(model, hps)
+    a, la = sampler(params, jax.random.key(7), 2, z, None, jnp.float32(1.0))
+    b, lb = sampler(params, jax.random.key(7), 2, z, None, jnp.float32(1.0))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_unconditional_sample_wrapper():
+    hps = tiny_hps(conditional=False)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    sketches, lengths = sample(model, params, hps, jax.random.key(1), n=3,
+                               temperature=0.5, scale_factor=2.0)
+    assert len(sketches) == 3
+    for s3, n in zip(sketches, lengths):
+        assert s3.shape == (n, 3)
+
+
+def test_class_conditional_sample():
+    hps = tiny_hps(num_classes=4)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    sketches, _ = sample(model, params, hps, jax.random.key(1), n=2,
+                         labels=jnp.array([1, 3]))
+    assert len(sketches) == 2
+
+
+# -- svg --------------------------------------------------------------------
+
+
+def test_svg_writer(tmp_path):
+    s3 = np.array([[1, 0, 0], [0, 1, 1], [1, 1, 0], [-1, 2, 1]], np.float32)
+    p = str(tmp_path / "out.svg")
+    svg = strokes_to_svg(s3, path=p)
+    assert svg.startswith("<svg") and svg.count("<path") == 2
+    assert open(p).read() == svg
+
+
+def test_svg_grid(tmp_path):
+    s3 = np.array([[1, 0, 0], [0, 1, 1]], np.float32)
+    svg = svg_grid([s3, s3, s3], cols=2, path=str(tmp_path / "g.svg"))
+    assert svg.count("<path") == 3
+
+
+# -- interpolation ----------------------------------------------------------
+
+
+def test_slerp_endpoints_and_lerp():
+    z0 = jnp.array([1.0, 0.0, 0.0])
+    z1 = jnp.array([0.0, 1.0, 0.0])
+    np.testing.assert_allclose(np.asarray(slerp(z0, z1, 0.0)), z0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(slerp(z0, z1, 1.0)), z1, atol=1e-5)
+    mid = np.asarray(slerp(z0, z1, 0.5))
+    np.testing.assert_allclose(np.linalg.norm(mid), 1.0, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lerp(z0, z1, 0.5)),
+                               [0.5, 0.5, 0.0])
+
+
+def test_interpolate_latents_shape():
+    z0 = jnp.ones((6,))
+    z1 = -jnp.ones((6,))
+    zs = interpolate_latents(z0, z1, n=5)
+    assert zs.shape == (5, 6)
+    with pytest.raises(ValueError):
+        interpolate_latents(z0, z1, mode="cubic")
+
+
+def test_encode_mu_roundtrip():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    strokes = np.zeros((2, hps.max_seq_len + 1, 5), np.float32)
+    strokes[:, 0] = [0, 0, 1, 0, 0]
+    strokes[:, 1:, 0] = 0.1
+    strokes[:, 1:, 2] = 1.0
+    strokes[:, -1, :] = [0, 0, 0, 0, 1]
+    batch = {"strokes": strokes,
+             "seq_len": np.array([10, 20], np.int32)}
+    mu = encode_mu(model, params, batch)
+    assert mu.shape == (2, hps.z_size)
+    assert np.isfinite(np.asarray(mu)).all()

@@ -177,7 +177,7 @@ def test_evaluate_sweep():
     loader = make_loader(hps, n=48)
     params = model.init_params(jax.random.key(0))
     ev = make_eval_step(model, hps, mesh=None)
-    out = evaluate(model, params, loader, ev)
+    out = evaluate(params, loader, ev)
     assert "recon" in out and np.isfinite(out["recon"])
 
 
