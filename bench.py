@@ -1,0 +1,88 @@
+"""Benchmark: flagship training-step throughput in strokes/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is BASELINE.json's "QuickDraw strokes/sec/chip": stroke points
+processed per second of training (global batch x padded seq len per step),
+divided by chip count. ``vs_baseline`` is 1.0 because the reference
+published no number (BASELINE.json "published": {}); when an A100 baseline
+becomes available, set the BENCH_BASELINE env var to it.
+
+Env knobs: BENCH_STEPS (timed steps, default 20), BENCH_BATCH,
+BENCH_SEQ_LEN, BENCH_DEC (decoder cell), BENCH_DTYPE (float32|bfloat16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> int:
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.data.loader import synthetic_loader
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+
+    n_chips = jax.device_count()
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    batch = int(os.environ.get("BENCH_BATCH", "128")) * n_chips
+    hps = get_default_hparams().replace(
+        dec_model=os.environ.get("BENCH_DEC", "layer_norm"),
+        batch_size=batch,
+        max_seq_len=int(os.environ.get("BENCH_SEQ_LEN", "250")),
+        compute_dtype=os.environ.get("BENCH_DTYPE", "float32"),
+    )
+
+    model = SketchRNN(hps)
+    mesh = make_mesh(hps)
+    loader, _ = synthetic_loader(hps, batch, seed=0)
+    host_batch = loader.random_batch()
+
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh)
+    dev_batch = shard_batch(host_batch, mesh)
+    key = jax.random.key(1)
+
+    # warmup: both compiles (initial-sharding + donated steady state) and a
+    # settled step; sync via host value fetch — under the axon runtime,
+    # block_until_ready alone does not reliably drain the remote pipeline
+    for i in range(3):
+        state, metrics = step(state, dev_batch, jax.random.fold_in(key, i))
+        float(metrics["loss"])
+
+    best = float("inf")
+    for trial in range(3):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            state, metrics = step(state, dev_batch,
+                                  jax.random.fold_in(key, 100 + i))
+        float(metrics["loss"])  # drains the chained steps
+        best = min(best, time.perf_counter() - t0)
+    dt = best
+
+    strokes_per_sec = steps * hps.batch_size * hps.max_seq_len / dt
+    per_chip = strokes_per_sec / n_chips
+    baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
+    out = {
+        "metric": "train_strokes_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "strokes/sec/chip",
+        "vs_baseline": round(per_chip / baseline, 3) if baseline else 1.0,
+    }
+    print(json.dumps(out))
+    print(f"# {n_chips} chip(s), dec={hps.dec_model}, "
+          f"batch={hps.batch_size}, seq={hps.max_seq_len}, "
+          f"dtype={hps.compute_dtype}, {steps} steps in {dt:.2f}s, "
+          f"loss={float(metrics['loss']):.4f}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

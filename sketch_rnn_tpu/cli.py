@@ -1,0 +1,179 @@
+"""Command-line interface: train / eval / sample subcommands.
+
+TPU-native equivalent of the reference's ``python sketch_rnn_train.py
+--hparams=...`` entry point (SURVEY.md §1 "CLI / entry point", §2
+component 14; reference unreadable — flag surface per the canonical CLI):
+the ``--hparams`` override string uses the same ``key=value,key=value``
+contract, plus subcommands replacing the reference's mode flags.
+
+Usage:
+    python -m sketch_rnn_tpu.cli train  --data_dir=D --workdir=W [--hparams=...]
+    python -m sketch_rnn_tpu.cli eval   --data_dir=D --workdir=W [--split=test]
+    python -m sketch_rnn_tpu.cli sample --workdir=W --output=out.svg [-n 10]
+
+``--synthetic`` substitutes the deterministic synthetic corpus when no
+QuickDraw ``.npz`` files are available (SURVEY §7 "Data availability").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from sketch_rnn_tpu.config import HParams, get_default_hparams
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--hparams", default="",
+                   help="comma-separated key=value overrides")
+    p.add_argument("--workdir", default="workdir",
+                   help="checkpoints + metrics directory")
+    p.add_argument("--data_dir", default="", help="QuickDraw .npz directory")
+    p.add_argument("--synthetic", action="store_true",
+                   help="use the synthetic corpus instead of .npz files")
+    p.add_argument("--seed", type=int, default=0)
+
+
+def _resolve_hps(args) -> HParams:
+    # workdir config (from a previous run's checkpoint meta) seeds the
+    # defaults so eval/sample agree with training automatically
+    base = get_default_hparams()
+    meta_hps = _workdir_hps(args.workdir)
+    if meta_hps is not None:
+        base = meta_hps
+    if args.data_dir:
+        base = base.replace(data_dir=args.data_dir)
+    return base.parse(args.hparams)
+
+
+def _workdir_hps(workdir: str) -> Optional[HParams]:
+    from sketch_rnn_tpu.train.checkpoint import latest_checkpoint
+    step = latest_checkpoint(workdir) if workdir else None
+    if step is None:
+        return None
+    meta = json.load(open(os.path.join(workdir, f"ckpt_{step:08d}.json")))
+    return HParams.from_json(json.dumps(meta["hps"]))
+
+
+def _load_data(hps: HParams, args,
+               scale_factor: Optional[float] = None
+               ) -> Tuple[object, object, object, float]:
+    """Build loaders; ``scale_factor`` (from a checkpoint) overrides the
+    recomputed train-split normalization — eval/sample must use the scale
+    the model was trained with."""
+    from sketch_rnn_tpu.data.loader import load_dataset, synthetic_loader
+    if args.synthetic:
+        train_l, scale = synthetic_loader(hps, 20 * hps.batch_size, seed=1,
+                                          augment=True,
+                                          scale_factor=scale_factor)
+        valid_l, _ = synthetic_loader(hps, 2 * hps.batch_size, seed=2,
+                                      scale_factor=scale)
+        test_l, _ = synthetic_loader(hps, 2 * hps.batch_size, seed=3,
+                                     scale_factor=scale)
+        return train_l, valid_l, test_l, scale
+    return load_dataset(hps, scale_factor=scale_factor)
+
+
+def _restore(hps: HParams, workdir: str):
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train import make_train_state, restore_checkpoint
+    model = SketchRNN(hps)
+    template = make_train_state(model, hps, jax.random.key(0))
+    state, scale, meta = restore_checkpoint(workdir, template)
+    return model, state, scale, meta
+
+
+def cmd_train(args) -> int:
+    from sketch_rnn_tpu.train import train
+    hps = _resolve_hps(args)
+    train_l, valid_l, test_l, scale = _load_data(hps, args)
+    print(f"[cli] {len(train_l)} train / {len(valid_l)} valid sketches, "
+          f"scale={scale:.4f}, devices={jax.device_count()}", flush=True)
+    train(hps, train_l, valid_l, test_l, scale_factor=scale,
+          workdir=args.workdir, seed=args.seed)
+    return 0
+
+
+def cmd_eval(args) -> int:
+    from sketch_rnn_tpu.parallel.mesh import make_mesh
+    from sketch_rnn_tpu.train import make_eval_step
+    from sketch_rnn_tpu.train.loop import evaluate
+    hps = _resolve_hps(args)
+    model, state, scale, meta = _restore(hps, args.workdir)
+    _, valid_l, test_l, _ = _load_data(hps, args, scale_factor=scale)
+    loader = {"valid": valid_l, "test": test_l}[args.split]
+    mesh = make_mesh(hps)
+    ev = evaluate(state.params, loader, make_eval_step(model, hps, mesh),
+                  mesh)
+    print(json.dumps({"split": args.split, "step": meta["step"],
+                      **{k: round(v, 6) for k, v in sorted(ev.items())}}))
+    return 0
+
+
+def cmd_sample(args) -> int:
+    from sketch_rnn_tpu.sample import (
+        encode_mu, interpolate_latents, sample, svg_grid)
+    hps = _resolve_hps(args)
+    model, state, scale, meta = _restore(hps, args.workdir)
+    key = jax.random.key(args.seed)
+    z = None
+    if args.interpolate:
+        _, valid_l, _, _ = _load_data(hps, args, scale_factor=scale)
+        batch = valid_l.get_batch(0)
+        mu = encode_mu(model, state.params, batch)
+        z = interpolate_latents(mu[0], mu[1], n=args.n)
+    labels = None
+    if hps.num_classes > 0:
+        labels = np.full((args.n,), args.label, np.int32)
+    sketches, lengths = sample(model, state.params, hps, key, n=args.n,
+                               temperature=args.temperature, z=z,
+                               labels=labels, scale_factor=scale,
+                               greedy=args.greedy)
+    svg_grid(sketches, cols=args.cols, path=args.output)
+    print(f"[cli] wrote {args.n} sketches (lengths "
+          f"{[int(x) for x in lengths]}) to {args.output}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="sketch_rnn_tpu",
+                                 description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("train", help="train a model")
+    _add_common(p)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("eval", help="evaluate a checkpoint")
+    _add_common(p)
+    p.add_argument("--split", choices=("valid", "test"), default="valid")
+    p.set_defaults(fn=cmd_eval)
+
+    p = sub.add_parser("sample", help="draw sketches from a checkpoint")
+    _add_common(p)
+    p.add_argument("-n", type=int, default=10, help="number of sketches")
+    p.add_argument("--temperature", type=float, default=0.5)
+    p.add_argument("--greedy", action="store_true")
+    p.add_argument("--interpolate", action="store_true",
+                   help="interpolate between two encoded valid sketches")
+    p.add_argument("--label", type=int, default=0,
+                   help="class id for class-conditional models")
+    p.add_argument("--output", default="samples.svg")
+    p.add_argument("--cols", type=int, default=5)
+    p.set_defaults(fn=cmd_sample)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

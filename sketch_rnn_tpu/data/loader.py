@@ -135,6 +135,7 @@ def load_dataset(hps: HParams,
                  data_dir: Optional[str] = None,
                  host_id: int = 0,
                  num_hosts: int = 1,
+                 scale_factor: Optional[float] = None,
                  ) -> Tuple[DataLoader, DataLoader, DataLoader, float]:
     """Read category ``.npz`` files and build train/valid/test loaders.
 
@@ -144,7 +145,9 @@ def load_dataset(hps: HParams,
     parallelism (each host feeds its own slice of the global batch).
 
     Returns ``(train, valid, test, scale_factor)``; every split is
-    normalized by the train split's scale factor (SURVEY §3.5).
+    normalized by the train split's scale factor (SURVEY §3.5) — or by a
+    given ``scale_factor`` (eval/sample against a checkpoint must reuse the
+    checkpointed value, which is part of the model contract).
     """
     data_dir = data_dir or hps.data_dir
     splits = {"train": ([], []), "valid": ([], []), "test": ([], [])}
@@ -179,7 +182,8 @@ def load_dataset(hps: HParams,
     # Scale factor comes from the FULL train split (pre-shard): every host
     # must normalize identically (it is part of the model contract and is
     # checkpointed — SURVEY §5 'Checkpoint / resume').
-    scale = S.calculate_normalizing_scale_factor(splits["train"][0])
+    scale = (scale_factor if scale_factor is not None
+             else S.calculate_normalizing_scale_factor(splits["train"][0]))
     valid = build("valid", augment=False, shard=False)
     test = build("test", augment=False, shard=False)
     for dl in (train, valid, test):
@@ -206,6 +210,7 @@ def make_synthetic_strokes(num: int,
     Returns ``(stroke3_list, labels)``.
     """
     rng = np.random.default_rng(seed)
+    min_len = max(2, min(min_len, max_len))  # callers may shrink max_len only
     out: List[np.ndarray] = []
     if fixed_class is not None:
         labels = np.full((num,), fixed_class, dtype=np.int32)
@@ -238,6 +243,25 @@ def make_synthetic_strokes(num: int,
         pen[-1] = 1.0
         out.append(np.stack([dx, dy, pen], axis=1))
     return out, labels
+
+
+def synthetic_loader(hps: HParams, num: int, seed: int = 0,
+                     augment: bool = False,
+                     scale_factor: Optional[float] = None
+                     ) -> Tuple[DataLoader, float]:
+    """One synthetic-corpus DataLoader sized to ``hps`` (shared helper for
+    the CLI, bench and driver entry; sequence lengths are clamped to fit
+    ``max_seq_len``). Returns ``(loader, scale_factor)`` — pass a stored
+    ``scale_factor`` to normalize by a checkpoint's contract instead of
+    recomputing from this corpus."""
+    seqs, labels = make_synthetic_strokes(
+        num, num_classes=max(hps.num_classes, 1),
+        max_len=min(96, hps.max_seq_len - 2), seed=seed)
+    loader = DataLoader(seqs, hps, labels=labels, augment=augment, seed=seed)
+    if scale_factor is None:
+        scale_factor = loader.calculate_normalizing_scale_factor()
+    loader.normalize(scale_factor)
+    return loader, scale_factor
 
 
 def write_synthetic_npz(path: str, num_train: int = 200, num_valid: int = 50,

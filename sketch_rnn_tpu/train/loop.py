@@ -11,7 +11,6 @@ and averages, which is the recon-NLL/KL parity surface.
 
 from __future__ import annotations
 
-import time
 from typing import Dict, Optional
 
 import jax
@@ -30,6 +29,8 @@ from sketch_rnn_tpu.train.checkpoint import (
 from sketch_rnn_tpu.train.metrics import MetricsWriter
 from sketch_rnn_tpu.train.state import TrainState, make_train_state
 from sketch_rnn_tpu.train.step import make_eval_step, make_train_step
+from sketch_rnn_tpu.utils.debug import check_finite
+from sketch_rnn_tpu.utils.profiling import Throughput
 
 
 def evaluate(params, loader: DataLoader, eval_step,
@@ -84,7 +85,9 @@ def train(hps: HParams,
     eval_writer = MetricsWriter(workdir, "valid")
 
     step = int(state.step)
-    t_last, s_last = time.time(), step
+    throughput = Throughput(hps.batch_size * hps.max_seq_len,
+                            num_chips=mesh.size if mesh is not None else 1)
+    throughput.update(step)
     while step < num_steps:
         batch = train_loader.random_batch()
         if mesh is not None:
@@ -97,15 +100,14 @@ def train(hps: HParams,
 
         if step % hps.log_every == 0 or step == num_steps:
             scalars = {k: float(v) for k, v in metrics.items()}
-            dt = time.time() - t_last
-            if dt > 0:
-                steps_s = (step - s_last) / dt
-                scalars["steps_per_sec"] = steps_s
-                scalars["strokes_per_sec"] = (
-                    steps_s * hps.batch_size * hps.max_seq_len)
-            t_last, s_last = time.time(), step
+            rates = throughput.update(step)
+            if rates:
+                scalars.update(rates)
+            # persist the row BEFORE the guard so a divergence leaves its
+            # diagnostic record in the metrics files
             writer.write(step, scalars)
             writer.log_console(step, scalars)
+            check_finite(scalars, step)
 
         if valid_loader is not None and step % hps.eval_every == 0:
             ev = evaluate(state.params, valid_loader, eval_step, mesh)

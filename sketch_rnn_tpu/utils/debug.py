@@ -1,0 +1,38 @@
+"""NaN/Inf guards (SURVEY.md §5 "Race detection / sanitizers").
+
+The reference has nothing to sanitize (single-process Python); the JAX
+equivalents of its implicit safety net are explicit finiteness checks on
+metrics/params. These are host-side helpers the train loop can call
+cheaply on already-fetched scalars, plus a pytree scanner for post-mortem
+debugging (which leaf went non-finite first).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import numpy as np
+
+
+def check_finite(scalars: Dict[str, float], step: int) -> None:
+    """Raise FloatingPointError naming every non-finite metric."""
+    bad = [k for k, v in scalars.items() if not np.isfinite(v)]
+    if bad:
+        raise FloatingPointError(
+            f"non-finite metrics at step {step}: {bad} "
+            f"(values {[scalars[k] for k in bad]}); "
+            f"restore the previous checkpoint and lower the learning rate "
+            f"or enable gradient clipping")
+
+
+def find_nonfinite(tree: Any, prefix: str = "") -> List[str]:
+    """Paths of all non-finite leaves in a pytree (post-mortem helper)."""
+    out: List[str] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            name = prefix + jax.tree_util.keystr(path)
+            frac = float(np.mean(~np.isfinite(arr)))
+            out.append(f"{name} ({frac:.1%} non-finite)")
+    return out

@@ -1,0 +1,62 @@
+"""Profiling helpers (SURVEY.md §5 "Tracing / profiling").
+
+The reference had no in-repo profiler and leaned on TF timeline /
+TensorBoard; the TPU-native equivalents are ``jax.profiler`` traces
+(viewable in XProf/TensorBoard) plus simple steps/sec / strokes/sec/chip
+counters — the BASELINE.json metric.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a ``jax.profiler`` device trace into ``logdir``.
+
+    Wrap a few training steps; open the result with XProf/TensorBoard.
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Throughput:
+    """Streaming steps/sec and strokes/sec/chip counter.
+
+    ``update(step)`` returns a dict of rates since the previous update (or
+    None on the first call / zero elapsed time). ``strokes_per_step`` is
+    ``global_batch * padded_seq_len`` — the stroke points processed by one
+    training step.
+    """
+
+    def __init__(self, strokes_per_step: int,
+                 num_chips: Optional[int] = None):
+        self.strokes_per_step = strokes_per_step
+        self.num_chips = num_chips or jax.device_count()
+        self._t: Optional[float] = None
+        self._step: int = 0
+
+    def update(self, step: int) -> Optional[dict]:
+        now = time.perf_counter()
+        if self._t is None or step <= self._step:
+            self._t, self._step = now, step
+            return None
+        dt = now - self._t
+        if dt <= 0:
+            return None
+        steps_s = (step - self._step) / dt
+        self._t, self._step = now, step
+        return {
+            "steps_per_sec": steps_s,
+            "strokes_per_sec": steps_s * self.strokes_per_step,
+            "strokes_per_sec_per_chip":
+                steps_s * self.strokes_per_step / self.num_chips,
+        }

@@ -1,0 +1,39 @@
+"""Tests for aux subsystems: throughput counter, NaN guards."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.utils import Throughput, check_finite, find_nonfinite
+
+
+def test_throughput_counter():
+    tp = Throughput(strokes_per_step=100, num_chips=4)
+    assert tp.update(0) is None
+    import time
+    time.sleep(0.01)
+    rates = tp.update(10)
+    assert rates is not None
+    assert rates["strokes_per_sec"] == pytest.approx(
+        rates["steps_per_sec"] * 100)
+    assert rates["strokes_per_sec_per_chip"] == pytest.approx(
+        rates["strokes_per_sec"] / 4)
+    # non-advancing step resets instead of dividing by zero
+    assert tp.update(10) is None
+
+
+def test_check_finite_passes_and_raises():
+    check_finite({"loss": 1.0, "kl": 0.2}, step=5)
+    with pytest.raises(FloatingPointError, match="loss"):
+        check_finite({"loss": float("nan"), "kl": 0.2}, step=5)
+    with pytest.raises(FloatingPointError, match="step 7"):
+        check_finite({"g": float("inf")}, step=7)
+
+
+def test_find_nonfinite_paths():
+    tree = {"a": jnp.ones((3,)),
+            "b": {"c": jnp.array([1.0, np.nan]),
+                  "d": jnp.array([2, 3])}}  # int leaf ignored
+    bad = find_nonfinite(tree)
+    assert len(bad) == 1 and "'b'" in bad[0] and "'c'" in bad[0]
+    assert find_nonfinite({"x": jnp.zeros(2)}) == []
