@@ -9,7 +9,13 @@ published no number (BASELINE.json "published": {}); when an A100 baseline
 becomes available, set the BENCH_BASELINE env var to it.
 
 Env knobs: BENCH_STEPS (timed steps, default 20), BENCH_BATCH,
-BENCH_SEQ_LEN, BENCH_DEC (decoder cell), BENCH_DTYPE (float32|bfloat16).
+BENCH_SEQ_LEN, BENCH_DEC (decoder cell), BENCH_DTYPE (float32|bfloat16),
+BENCH_REMAT (0|1).
+
+Defaults are the measured-best v5e config (see ops/rnn.py docstring and
+the sweep recorded in PROGRESS notes): bfloat16 matmuls, global batch
+2048/chip, jax.checkpoint'd scans — 2.56M strokes/sec/chip vs 1.29M for
+the first float32 batch-128 configuration.
 """
 
 from __future__ import annotations
@@ -32,17 +38,18 @@ def main() -> int:
 
     n_chips = jax.device_count()
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    batch = int(os.environ.get("BENCH_BATCH", "128")) * n_chips
+    batch = int(os.environ.get("BENCH_BATCH", "2048")) * n_chips
     hps = get_default_hparams().replace(
         dec_model=os.environ.get("BENCH_DEC", "layer_norm"),
         batch_size=batch,
         max_seq_len=int(os.environ.get("BENCH_SEQ_LEN", "250")),
-        compute_dtype=os.environ.get("BENCH_DTYPE", "float32"),
+        compute_dtype=os.environ.get("BENCH_DTYPE", "bfloat16"),
+        remat=os.environ.get("BENCH_REMAT", "1") == "1",
     )
 
     model = SketchRNN(hps)
     mesh = make_mesh(hps)
-    loader, _ = synthetic_loader(hps, batch, seed=0)
+    loader, _ = synthetic_loader(hps, min(batch, 2048), seed=0)
     host_batch = loader.random_batch()
 
     state = make_train_state(model, hps, jax.random.key(0))
@@ -79,7 +86,8 @@ def main() -> int:
     print(json.dumps(out))
     print(f"# {n_chips} chip(s), dec={hps.dec_model}, "
           f"batch={hps.batch_size}, seq={hps.max_seq_len}, "
-          f"dtype={hps.compute_dtype}, {steps} steps in {dt:.2f}s, "
+          f"dtype={hps.compute_dtype}, remat={hps.remat}, "
+          f"{steps} steps in {dt:.2f}s, "
           f"loss={float(metrics['loss']):.4f}", file=sys.stderr)
     return 0
 

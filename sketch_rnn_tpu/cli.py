@@ -69,9 +69,13 @@ def _load_data(hps: HParams, args,
     the model was trained with."""
     from sketch_rnn_tpu.data.loader import load_dataset, synthetic_loader
     if args.synthetic:
-        train_l, scale = synthetic_loader(hps, 20 * hps.batch_size, seed=1,
-                                          augment=True,
-                                          scale_factor=scale_factor)
+        if scale_factor is None:
+            train_l, scale = synthetic_loader(hps, 20 * hps.batch_size,
+                                              seed=1, augment=True)
+        else:
+            # eval/sample with a checkpointed scale never touch the train
+            # corpus — skip generating it
+            train_l, scale = None, scale_factor
         valid_l, _ = synthetic_loader(hps, 2 * hps.batch_size, seed=2,
                                       scale_factor=scale)
         test_l, _ = synthetic_loader(hps, 2 * hps.batch_size, seed=3,

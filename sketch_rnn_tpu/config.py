@@ -77,6 +77,9 @@ class HParams:
 
     # --- TPU / parallelism (component 18) ---
     compute_dtype: str = "float32"     # "bfloat16" for MXU-friendly matmuls
+    remat: bool = False                # jax.checkpoint the RNN scan steps
+    #   (trades ~30% step time for the per-step residual memory; enables
+    #   global batches >=1024 at max_seq_len=250 on a 16G-HBM chip)
     mesh_shape: Tuple[int, ...] = (-1,)  # -1 = all devices on the data axis
     mesh_axes: Tuple[str, ...] = ("data",)
 

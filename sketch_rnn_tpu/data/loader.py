@@ -210,7 +210,9 @@ def make_synthetic_strokes(num: int,
     Returns ``(stroke3_list, labels)``.
     """
     rng = np.random.default_rng(seed)
-    min_len = max(2, min(min_len, max_len))  # callers may shrink max_len only
+    # callers may shrink max_len arbitrarily (e.g. tiny max_seq_len configs)
+    max_len = max(2, max_len)
+    min_len = max(2, min(min_len, max_len))
     out: List[np.ndarray] = []
     if fixed_class is not None:
         labels = np.full((num,), fixed_class, dtype=np.int32)

@@ -33,7 +33,7 @@ from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.ops import linear as L
 from sketch_rnn_tpu.ops import mdn
 from sketch_rnn_tpu.ops.cells import make_cell
-from sketch_rnn_tpu.ops.rnn import bidirectional_rnn, make_dropout_masks, run_rnn
+from sketch_rnn_tpu.ops.rnn import bidirectional_rnn, run_rnn
 
 Params = Dict[str, Any]
 
@@ -108,18 +108,17 @@ class SketchRNN:
                ) -> Tuple[jax.Array, jax.Array]:
         """Time-major strokes ``[T, B, 5]`` -> (mu, presig), each [B, Nz]."""
         hps = self.hps
-        masks_f = masks_b = None
+        gen_f = gen_b = None
         if train and hps.use_recurrent_dropout and key is not None:
+            # masks are drawn inside the scan (rdrop_gen) so no [T, B, H]
+            # mask buffer is ever resident in HBM
             kf, kb = jax.random.split(key)
-            t, b = x_tm.shape[0], x_tm.shape[1]
-            masks_f = make_dropout_masks(kf, hps.recurrent_dropout_keep,
-                                         t, b, hps.enc_rnn_size)
-            masks_b = make_dropout_masks(kb, hps.recurrent_dropout_keep,
-                                         t, b, hps.enc_rnn_size)
+            gen_f = (kf, hps.recurrent_dropout_keep)
+            gen_b = (kb, hps.recurrent_dropout_keep)
         h_final, _ = bidirectional_rnn(
             self.enc_fwd, self.enc_bwd, params["enc_fwd"], params["enc_bwd"],
             x_tm, seq_len=seq_len,
-            rdrop_masks_fwd=masks_f, rdrop_masks_bwd=masks_b)
+            rdrop_gen_fwd=gen_f, rdrop_gen_bwd=gen_b, remat=hps.remat)
         mu = L.matmul(h_final, params["mu_w"], _dtype(hps)) + params["mu_b"]
         presig = L.matmul(h_final, params["presig_w"], _dtype(hps)) \
             + params["presig_b"]
@@ -159,21 +158,20 @@ class SketchRNN:
                ) -> jax.Array:
         """Teacher-forced decoder -> raw MDN projections ``[T, B, 6M+3]``."""
         hps = self.hps
-        t, b = x_in_tm.shape[0], x_in_tm.shape[1]
+        b = x_in_tm.shape[1]
         inputs = self._decoder_inputs(params, x_in_tm, z, labels)
-        rmasks = None
+        rgen = None
         if train and key is not None:
             krec, kin, kout = jax.random.split(key, 3)
             if hps.use_recurrent_dropout:
-                rmasks = make_dropout_masks(krec, hps.recurrent_dropout_keep,
-                                            t, b, hps.dec_rnn_size)
+                rgen = (krec, hps.recurrent_dropout_keep)
             if hps.use_input_dropout:
                 keep = hps.input_dropout_keep
                 mask = jax.random.bernoulli(kin, keep, inputs.shape)
                 inputs = inputs * mask / keep
         carry0 = self.decoder_initial_carry(params, z, b)
         _, hs = run_rnn(self.dec, params["dec"], inputs, carry0,
-                        rdrop_masks=rmasks)
+                        rdrop_gen=rgen, remat=hps.remat)
         if train and key is not None and hps.use_output_dropout:
             keep = hps.output_dropout_keep
             mask = jax.random.bernoulli(kout, keep, hs.shape)

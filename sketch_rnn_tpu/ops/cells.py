@@ -75,10 +75,22 @@ class LSTMCell:
     def __call__(self, params: Params, carry: Carry, x: jax.Array,
                  rdrop_mask: Optional[jax.Array] = None
                  ) -> Tuple[Carry, jax.Array]:
+        xp = L.matmul(x, params["wx"], self.compute_dtype) + params["b"]
+        return self.step_pre(params, carry, xp, rdrop_mask)
+
+    # -- hoisted-input path (cuDNN-style): the x @ wx projection for ALL
+    # timesteps is one large MXU matmul outside the scan; the scan step
+    # only does the recurrent h @ wh matmul (SURVEY §2 component 5).
+
+    def precompute_inputs(self, params: Params, xs: jax.Array) -> jax.Array:
+        """``[T, B, D] -> [T, B, 4H]`` input projections, one batched matmul."""
+        return L.matmul(xs, params["wx"], self.compute_dtype) + params["b"]
+
+    def step_pre(self, params: Params, carry: Carry, xp: jax.Array,
+                 rdrop_mask: Optional[jax.Array] = None
+                 ) -> Tuple[Carry, jax.Array]:
         c, h = carry
-        pre = (L.matmul(x, params["wx"], self.compute_dtype)
-               + L.matmul(h, params["wh"], self.compute_dtype)
-               + params["b"])
+        pre = xp + L.matmul(h, params["wh"], self.compute_dtype)
         i, g, f, o = _split_gates(pre)
         g = jnp.tanh(g)
         if rdrop_mask is not None:
@@ -131,9 +143,18 @@ class LayerNormLSTMCell:
     def __call__(self, params: Params, carry: Carry, x: jax.Array,
                  rdrop_mask: Optional[jax.Array] = None
                  ) -> Tuple[Carry, jax.Array]:
+        xp = L.matmul(x, params["wx"], self.compute_dtype)
+        return self.step_pre(params, carry, xp, rdrop_mask)
+
+    def precompute_inputs(self, params: Params, xs: jax.Array) -> jax.Array:
+        """``[T, B, D] -> [T, B, 4H]``; no bias — the LN betas take that role."""
+        return L.matmul(xs, params["wx"], self.compute_dtype)
+
+    def step_pre(self, params: Params, carry: Carry, xp: jax.Array,
+                 rdrop_mask: Optional[jax.Array] = None
+                 ) -> Tuple[Carry, jax.Array]:
         c, h = carry
-        pre = (L.matmul(x, params["wx"], self.compute_dtype)
-               + L.matmul(h, params["wh"], self.compute_dtype))
+        pre = xp + L.matmul(h, params["wh"], self.compute_dtype)
         gates = []
         for j, gate in enumerate(_split_gates(pre)):
             gates.append(L.layer_norm(gate, params["ln_gamma"][j],
@@ -241,11 +262,35 @@ class HyperLSTMCell:
     def __call__(self, params: Params, carry: Carry, x: jax.Array,
                  rdrop_mask: Optional[jax.Array] = None
                  ) -> Tuple[Carry, jax.Array]:
+        xp = self.precompute_inputs(params, x)
+        return self.step_pre(params, carry, xp, rdrop_mask)
+
+    def precompute_inputs(self, params: Params, xs: jax.Array
+                          ) -> Tuple[jax.Array, jax.Array]:
+        """x-dependent projections for main and hyper cells.
+
+        The hyper LSTM consumes ``[x; h]``; its fused input weight splits
+        row-wise into an x-part (precomputable for all timesteps at once)
+        and an h-part (recurrent, stays in the scan step). Returns
+        ``(xs @ wx, xs @ hyper_wx[:D] + hyper_b)``.
+        """
+        wxh = params["hyper"]["wx"]
+        d = wxh.shape[0] - self.hidden_size
+        return (L.matmul(xs, params["wx"], self.compute_dtype),
+                L.matmul(xs, wxh[:d], self.compute_dtype)
+                + params["hyper"]["b"])
+
+    def step_pre(self, params: Params, carry: Carry,
+                 xp: Tuple[jax.Array, jax.Array],
+                 rdrop_mask: Optional[jax.Array] = None
+                 ) -> Tuple[Carry, jax.Array]:
         (c, h), hyper_carry = carry
-        hyper_in = jnp.concatenate([x, h], axis=-1)
-        hyper_carry, hyper_h = self._hyper_cell(params["hyper"], hyper_carry,
-                                                hyper_in)
-        xh = L.matmul(x, params["wx"], self.compute_dtype)
+        xh, hyper_xp = xp
+        wxh = params["hyper"]["wx"]
+        d = wxh.shape[0] - self.hidden_size
+        hyper_pre = hyper_xp + L.matmul(h, wxh[d:], self.compute_dtype)
+        hyper_carry, hyper_h = self._hyper_cell.step_pre(
+            params["hyper"], hyper_carry, hyper_pre)
         hhp = L.matmul(h, params["wh"], self.compute_dtype)
         b4 = params["b"].reshape(4, self.hidden_size)
         sx = self._scales(params, hyper_h, "x")

@@ -227,3 +227,89 @@ def test_bf16_compute_close_to_f32():
     _, h16 = run_rnn(cell16, params, xs)
     assert h16.dtype == jnp.float32  # f32 accumulate/carry contract
     np.testing.assert_allclose(np.asarray(h32), np.asarray(h16), atol=0.05)
+
+
+# -- hoisted-input (cuDNN-style) path equivalence ---------------------------
+
+
+@pytest.mark.parametrize("kind", ["lstm", "layer_norm", "hyper"])
+def test_hoisted_scan_matches_per_step(kind):
+    """run_rnn(hoist=True) must be numerically identical to the naive
+    per-step path for every cell type (with and without dropout masks)."""
+    from sketch_rnn_tpu.ops.rnn import make_dropout_masks, run_rnn
+
+    t, b, d, h = 7, 4, 5, 12
+    cell = make_cell(kind, h, hyper_size=6, hyper_embed_size=3)
+    key = jax.random.key(0)
+    params = cell.init_params(key, d)
+    xs = jax.random.normal(jax.random.key(1), (t, b, d))
+
+    f1, hs1 = run_rnn(cell, params, xs, hoist=True)
+    f2, hs2 = run_rnn(cell, params, xs, hoist=False)
+    np.testing.assert_allclose(np.asarray(hs1), np.asarray(hs2),
+                               rtol=1e-5, atol=1e-6)
+    for a, b_ in zip(jax.tree_util.tree_leaves(f1),
+                     jax.tree_util.tree_leaves(f2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6)
+
+    masks = make_dropout_masks(jax.random.key(2), 0.8, t, b, h)
+    _, hs3 = run_rnn(cell, params, xs, rdrop_masks=masks, hoist=True)
+    _, hs4 = run_rnn(cell, params, xs, rdrop_masks=masks, hoist=False)
+    np.testing.assert_allclose(np.asarray(hs3), np.asarray(hs4),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("kind", ["lstm", "layer_norm", "hyper"])
+def test_hoisted_reverse_matches(kind):
+    from sketch_rnn_tpu.ops.rnn import run_rnn
+
+    t, b, d, h = 6, 3, 4, 8
+    cell = make_cell(kind, h, hyper_size=6, hyper_embed_size=3)
+    params = cell.init_params(jax.random.key(0), d)
+    xs = jax.random.normal(jax.random.key(1), (t, b, d))
+    _, hs1 = run_rnn(cell, params, xs, reverse=True, hoist=True)
+    _, hs2 = run_rnn(cell, params, xs, reverse=True, hoist=False)
+    np.testing.assert_allclose(np.asarray(hs1), np.asarray(hs2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_remat_scan_identical_values_and_grads():
+    """jax.checkpoint on the scan step must not change values or grads."""
+    from sketch_rnn_tpu.ops.rnn import run_rnn
+
+    t, b, d, h = 8, 4, 5, 12
+    cell = make_cell("layer_norm", h)
+    params = cell.init_params(jax.random.key(0), d)
+    xs = jax.random.normal(jax.random.key(1), (t, b, d))
+    gen = (jax.random.key(2), 0.85)
+
+    def loss(params, remat):
+        _, hs = run_rnn(cell, params, xs, rdrop_gen=gen, remat=remat)
+        return jnp.mean(hs ** 2)
+
+    v1, g1 = jax.value_and_grad(lambda p: loss(p, False))(params)
+    v2, g2 = jax.value_and_grad(lambda p: loss(p, True))(params)
+    np.testing.assert_allclose(float(v1), float(v2), rtol=1e-6)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g1),
+                     jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_rdrop_gen_deterministic_and_masking():
+    from sketch_rnn_tpu.ops.rnn import run_rnn
+
+    t, b, d, h = 6, 3, 4, 8
+    cell = make_cell("lstm", h)
+    params = cell.init_params(jax.random.key(0), d)
+    xs = jax.random.normal(jax.random.key(1), (t, b, d))
+    gen = (jax.random.key(2), 0.7)
+    _, hs1 = run_rnn(cell, params, xs, rdrop_gen=gen)
+    _, hs2 = run_rnn(cell, params, xs, rdrop_gen=gen)
+    np.testing.assert_array_equal(np.asarray(hs1), np.asarray(hs2))
+    _, hs_none = run_rnn(cell, params, xs)
+    assert not np.allclose(np.asarray(hs1), np.asarray(hs_none))
+    with pytest.raises(ValueError, match="not both"):
+        run_rnn(cell, params, xs, rdrop_gen=gen,
+                rdrop_masks=jnp.ones((t, b, h)))

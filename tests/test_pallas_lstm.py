@@ -1,0 +1,98 @@
+"""Pallas fused-LSTM kernel vs the lax.scan reference (interpret mode).
+
+Forward values AND custom-VJP gradients must match the autodiff of the
+scan path (SURVEY.md §4: golden-value testing of the performance core).
+Shapes use (8, 128)-aligned dims as on real hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.ops.cells import LSTMCell
+from sketch_rnn_tpu.ops.pallas_lstm import lstm_seq
+from sketch_rnn_tpu.ops.rnn import make_dropout_masks, run_rnn
+
+T, B, H, D = 6, 8, 128, 16
+
+
+def _setup(seed=0):
+    cell = LSTMCell(H)
+    params = cell.init_params(jax.random.key(seed), D)
+    xs = jax.random.normal(jax.random.key(seed + 1), (T, B, D))
+    xp = cell.precompute_inputs(params, xs)
+    c0 = jnp.zeros((B, H))
+    h0 = jnp.zeros((B, H))
+    return cell, params, xs, xp, c0, h0
+
+
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_forward_matches_scan(use_mask):
+    cell, params, xs, xp, c0, h0 = _setup()
+    masks = (make_dropout_masks(jax.random.key(9), 0.8, T, B, H)
+             if use_mask else None)
+    hs_ref_out = run_rnn(cell, params, xs, rdrop_masks=masks)[1]
+    hs, (cT, hT) = lstm_seq(xp, params["wh"], c0, h0, 1.0, masks)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(hs_ref_out),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(hs_ref_out[-1]),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_forward_nonzero_carry():
+    cell, params, xs, xp, _, _ = _setup()
+    c0 = jax.random.normal(jax.random.key(5), (B, H))
+    h0 = jax.random.normal(jax.random.key(6), (B, H))
+    final, hs_scan = run_rnn(cell, params, xs, carry0=(c0, h0))
+    hs, (cT, hT) = lstm_seq(xp, params["wh"], c0, h0)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(hs_scan),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(cT), np.asarray(final[0]),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_gradients_match_scan(use_mask):
+    cell, params, xs, xp, c0, h0 = _setup()
+    masks = (make_dropout_masks(jax.random.key(9), 0.8, T, B, H)
+             if use_mask else None)
+    wtgt = jax.random.normal(jax.random.key(7), (T, B, H)) * 0.1
+
+    def loss_pallas(xp_, wh_, c0_, h0_):
+        hs, (cT, hT) = lstm_seq(xp_, wh_, c0_, h0_, 1.0, masks)
+        return jnp.sum(hs * wtgt) + jnp.sum(cT) + 0.5 * jnp.sum(hT)
+
+    def loss_scan(xp_, wh_, c0_, h0_):
+        p = dict(params, wh=wh_)
+
+        def step(carry, inp):
+            xpt, m = inp
+            carry, h = cell.step_pre(p, carry, xpt,
+                                     rdrop_mask=m if use_mask else None)
+            return carry, h
+        m_in = masks if use_mask else jnp.zeros((T, 0))
+        (cT, hT), hs = jax.lax.scan(step, (c0_, h0_), (xp_, m_in))
+        return jnp.sum(hs * wtgt) + jnp.sum(cT) + 0.5 * jnp.sum(hT)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2, 3))(xp, params["wh"],
+                                                     c0, h0)
+    gs = jax.grad(loss_scan, argnums=(0, 1, 2, 3))(xp, params["wh"],
+                                                   c0, h0)
+    names = ["dxp", "dwh", "dc0", "dh0"]
+    for n, a, b in zip(names, gp, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5, err_msg=n)
+
+
+def test_value_and_grad_under_jit():
+    _, params, _, xp, c0, h0 = _setup()
+
+    @jax.jit
+    def f(xp_, wh_):
+        hs, _ = lstm_seq(xp_, wh_, c0, h0)
+        return jnp.mean(hs ** 2)
+
+    v, g = jax.value_and_grad(f, argnums=1)(xp, params["wh"])
+    assert np.isfinite(float(v))
+    assert np.isfinite(np.asarray(g)).all()
