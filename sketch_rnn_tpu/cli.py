@@ -66,22 +66,36 @@ def _load_data(hps: HParams, args,
                ) -> Tuple[object, object, object, float]:
     """Build loaders; ``scale_factor`` (from a checkpoint) overrides the
     recomputed train-split normalization — eval/sample must use the scale
-    the model was trained with."""
+    the model was trained with.
+
+    ``hps`` here carries the GLOBAL batch size; per-host striping and the
+    local loader batch size are applied internally (each host assembles
+    ``1/process_count`` of every global batch)."""
     from sketch_rnn_tpu.data.loader import load_dataset, synthetic_loader
+    from sketch_rnn_tpu.parallel import multihost as mh
+    lhps = mh.local_batch_hps(hps)
+    host, nhosts = mh.process_index(), mh.process_count()
     if args.synthetic:
         if scale_factor is None:
-            train_l, scale = synthetic_loader(hps, 20 * hps.batch_size,
-                                              seed=1, augment=True)
+            train_l, scale = synthetic_loader(
+                lhps, 20 * hps.batch_size, seed=1, augment=True,
+                host_id=host, num_hosts=nhosts)
         else:
             # eval/sample with a checkpointed scale never touch the train
             # corpus — skip generating it
             train_l, scale = None, scale_factor
-        valid_l, _ = synthetic_loader(hps, 2 * hps.batch_size, seed=2,
-                                      scale_factor=scale)
-        test_l, _ = synthetic_loader(hps, 2 * hps.batch_size, seed=3,
-                                     scale_factor=scale)
+        # valid/test are striped too: each global eval batch then holds
+        # num_hosts * (B/P) DISTINCT rows and the sweep does no
+        # duplicated work across hosts
+        valid_l, _ = synthetic_loader(lhps, 2 * hps.batch_size, seed=2,
+                                      scale_factor=scale,
+                                      host_id=host, num_hosts=nhosts)
+        test_l, _ = synthetic_loader(lhps, 2 * hps.batch_size, seed=3,
+                                     scale_factor=scale,
+                                     host_id=host, num_hosts=nhosts)
         return train_l, valid_l, test_l, scale
-    return load_dataset(hps, scale_factor=scale_factor)
+    return load_dataset(lhps, scale_factor=scale_factor,
+                        host_id=host, num_hosts=nhosts)
 
 
 def _restore(hps: HParams, workdir: str):
@@ -94,13 +108,17 @@ def _restore(hps: HParams, workdir: str):
 
 
 def cmd_train(args) -> int:
+    from sketch_rnn_tpu.parallel import multihost as mh
     from sketch_rnn_tpu.train import train
+    mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
     train_l, valid_l, test_l, scale = _load_data(hps, args)
-    print(f"[cli] {len(train_l)} train / {len(valid_l)} valid sketches, "
+    print(f"[cli] host {mh.process_index()}/{mh.process_count()}: "
+          f"{len(train_l)} train / {len(valid_l)} valid sketches, "
           f"scale={scale:.4f}, devices={jax.device_count()}", flush=True)
     train(hps, train_l, valid_l, test_l, scale_factor=scale,
-          workdir=args.workdir, seed=args.seed)
+          workdir=args.workdir, seed=args.seed,
+          profile=getattr(args, "profile", False))
     return 0
 
 
@@ -152,6 +170,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("train", help="train a model")
     _add_common(p)
+    p.add_argument("--profile", action="store_true",
+                   help="capture a jax.profiler device trace of steps "
+                        "~10-20 into <workdir>/trace (view with XProf)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint")

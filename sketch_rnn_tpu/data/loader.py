@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data import native_batcher as NB
 from sketch_rnn_tpu.data import strokes as S
 
 
@@ -109,9 +110,18 @@ class DataLoader:
                 s = S.random_scale(s, self.hps.random_scale_factor, self.rng)
                 s = S.augment_strokes(s, self.hps.augment_stroke_prob, self.rng)
             raw.append(s)
+        # hot path: the C++ batcher packs + stroke-5-converts the whole
+        # batch in one native loop (SURVEY §2 component 1 native path);
+        # golden-tested equal to the numpy path below
+        native = NB.assemble_batch(raw, self.hps.max_seq_len)
+        if native is not None:
+            strokes, seq_len = native
+        else:
+            strokes = self._pad_batch(raw)
+            seq_len = np.array([len(s) for s in raw], dtype=np.int32)
         return {
-            "strokes": self._pad_batch(raw),
-            "seq_len": np.array([len(s) for s in raw], dtype=np.int32),
+            "strokes": strokes,
+            "seq_len": seq_len,
             "labels": self.labels[idx],
         }
 
@@ -129,6 +139,19 @@ class DataLoader:
 
 
 # -- dataset assembly ------------------------------------------------------
+
+
+def _stripe(seqs, labels, host_id: int, num_hosts: int):
+    """Disjoint per-host slice of a corpus (shared by real + synthetic
+    paths so the striping scheme cannot drift between them)."""
+    if num_hosts <= 1:
+        return seqs, labels
+    return seqs[host_id::num_hosts], labels[host_id::num_hosts]
+
+
+def _host_seed(seed: int, host_id: int) -> int:
+    """Decorrelate per-host loader RNG streams."""
+    return seed + 7919 * host_id
 
 
 def load_dataset(hps: HParams,
@@ -165,27 +188,29 @@ def load_dataset(hps: HParams,
 
     _SEEDS = {"train": 1, "valid": 2, "test": 3}  # fixed: runs must be reproducible
 
-    def build(split: str, augment: bool, shard: bool) -> DataLoader:
+    def build(split: str, augment: bool) -> DataLoader:
         seqs, labels = splits[split]
         if not seqs:
             raise ValueError(
                 f"{split} split is empty after filtering to "
                 f"max_seq_len={hps.max_seq_len}; raise max_seq_len or check "
                 f"the data files {hps.data_set}")
-        if shard and num_hosts > 1:
-            seqs = seqs[host_id::num_hosts]
-            labels = labels[host_id::num_hosts]
+        # every split is host-striped: train for data parallelism, valid/
+        # test so the eval sweep's global batches hold DISTINCT rows (each
+        # host feeds 1/num_hosts of each global batch)
+        seqs, labels = _stripe(seqs, labels, host_id, num_hosts)
         return DataLoader(seqs, hps, labels=np.array(labels, np.int32),
-                          augment=augment, seed=_SEEDS[split] + 7919 * host_id)
+                          augment=augment,
+                          seed=_host_seed(_SEEDS[split], host_id))
 
-    train = build("train", augment=True, shard=True)
+    train = build("train", augment=True)
     # Scale factor comes from the FULL train split (pre-shard): every host
     # must normalize identically (it is part of the model contract and is
     # checkpointed — SURVEY §5 'Checkpoint / resume').
     scale = (scale_factor if scale_factor is not None
              else S.calculate_normalizing_scale_factor(splits["train"][0]))
-    valid = build("valid", augment=False, shard=False)
-    test = build("test", augment=False, shard=False)
+    valid = build("valid", augment=False)
+    test = build("test", augment=False)
     for dl in (train, valid, test):
         dl.normalize(scale)
     return train, valid, test, scale
@@ -249,19 +274,25 @@ def make_synthetic_strokes(num: int,
 
 def synthetic_loader(hps: HParams, num: int, seed: int = 0,
                      augment: bool = False,
-                     scale_factor: Optional[float] = None
+                     scale_factor: Optional[float] = None,
+                     host_id: int = 0, num_hosts: int = 1,
                      ) -> Tuple[DataLoader, float]:
     """One synthetic-corpus DataLoader sized to ``hps`` (shared helper for
     the CLI, bench and driver entry; sequence lengths are clamped to fit
     ``max_seq_len``). Returns ``(loader, scale_factor)`` — pass a stored
     ``scale_factor`` to normalize by a checkpoint's contract instead of
-    recomputing from this corpus."""
+    recomputing from this corpus. ``host_id``/``num_hosts`` stripe the
+    corpus for multi-host DP; like ``load_dataset``, the scale factor is
+    computed from the FULL pre-stripe corpus so every host normalizes
+    identically."""
     seqs, labels = make_synthetic_strokes(
         num, num_classes=max(hps.num_classes, 1),
         max_len=min(96, hps.max_seq_len - 2), seed=seed)
-    loader = DataLoader(seqs, hps, labels=labels, augment=augment, seed=seed)
     if scale_factor is None:
-        scale_factor = loader.calculate_normalizing_scale_factor()
+        scale_factor = S.calculate_normalizing_scale_factor(seqs)
+    seqs, labels = _stripe(seqs, labels, host_id, num_hosts)
+    loader = DataLoader(seqs, hps, labels=labels, augment=augment,
+                        seed=_host_seed(seed, host_id))
     loader.normalize(scale_factor)
     return loader, scale_factor
 

@@ -1,0 +1,68 @@
+// Native batch assembler: the hot host loop of the input pipeline.
+//
+// TPU-native-framework equivalent of the reference's host-side batch
+// assembly (SURVEY.md §2 component 1). The reference leans on external
+// native libraries for its performance core; this framework's own native
+// surface is this C++ batcher: stroke-3 -> padded stroke-5 conversion and
+// batch packing run as one tight loop per batch instead of a Python loop
+// of small numpy ops, keeping 8 chips fed at large global batch sizes.
+//
+// C ABI (used from Python via ctypes, see ../native_batcher.py):
+//
+//   assemble_batch(seq_data, seq_lens, n, max_len, out)
+//
+//   seq_data    flattened float32 stroke-3 rows (dx, dy, pen) of all n
+//               sequences, concatenated in order
+//   seq_lens    int32[n] row counts per sequence
+//   n           batch size
+//   max_len     padded sequence length (excluding the start token)
+//   out         float32[n, max_len + 1, 5], written fully
+//
+// Output layout per sequence (matches strokes.to_big_strokes + the
+// loader's start token exactly; golden-tested for equality in
+// tests/test_native_batcher.py):
+//   row 0:                  (0, 0, 1, 0, 0)   start token
+//   rows 1..len:            (dx, dy, 1-p, p, 0)
+//   rows len+1..max_len:    (0, 0, 0, 0, 1)   end-of-sketch padding
+//
+// Build: g++ -O3 -shared -fPIC (see ../native_batcher.py _ensure_built).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+int assemble_batch(const float* seq_data,
+                   const int32_t* seq_lens,
+                   int32_t n,
+                   int32_t max_len,
+                   float* out) {
+  const int32_t row = 5;
+  const int64_t per_seq = static_cast<int64_t>(max_len + 1) * row;
+  const float* src = seq_data;
+  for (int32_t i = 0; i < n; ++i) {
+    const int32_t len = seq_lens[i];
+    if (len < 0 || len > max_len) return -1;  // caller filtered; guard anyway
+    float* dst = out + i * per_seq;
+    // start token
+    dst[0] = 0.f; dst[1] = 0.f; dst[2] = 1.f; dst[3] = 0.f; dst[4] = 0.f;
+    float* p = dst + row;
+    for (int32_t t = 0; t < len; ++t, p += row, src += 3) {
+      const float pen = src[2];
+      p[0] = src[0];
+      p[1] = src[1];
+      p[2] = 1.f - pen;
+      p[3] = pen;
+      p[4] = 0.f;
+    }
+    for (int32_t t = len; t < max_len; ++t, p += row) {
+      p[0] = 0.f; p[1] = 0.f; p[2] = 0.f; p[3] = 0.f; p[4] = 1.f;
+    }
+  }
+  return 0;
+}
+
+// Version tag so the Python side can detect a stale shared object.
+int batcher_abi_version() { return 2; }
+
+}  // extern "C"

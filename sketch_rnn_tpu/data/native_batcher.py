@@ -1,0 +1,120 @@
+"""ctypes binding for the native C++ batch assembler.
+
+SURVEY.md §2 component 1 — native input-pipeline path. The shared object
+is built on demand with g++ (the toolchain is part of the target
+environment); any failure — no compiler, missing source, corrupt or
+wrong-ABI artifact — silently falls back to the numpy path in
+:mod:`sketch_rnn_tpu.data.loader`, so the framework stays
+pure-Python-capable. Set ``SKETCH_RNN_TPU_NO_NATIVE=1`` to force the
+fallback.
+
+The ABI version is part of the shared-object FILENAME
+(``batcher_v<N>.so``): a Python/C++ version skew can therefore never
+dlopen a stale mapping — the old artifact is simply never referenced.
+Builds write to a per-process temp name and ``os.replace`` into place, so
+concurrent builders (multi-process launches, pytest-xdist) cannot corrupt
+each other's output.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_ABI_VERSION = 2
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "native", "batcher.cc")
+_SO = os.path.join(_HERE, "native", f"batcher_v{_ABI_VERSION}.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SKETCH_RNN_TPU_NO_NATIVE") == "1":
+            return None
+        try:
+            needs_build = (not os.path.exists(_SO)
+                           or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        except OSError:
+            # source missing: use a prebuilt artifact as-is, else fall back
+            needs_build = not os.path.exists(_SO)
+            if needs_build:
+                return None
+        if needs_build and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            if lib.batcher_abi_version() != _ABI_VERSION:
+                return None  # foreign artifact under our versioned name
+        except (OSError, AttributeError):
+            return None
+        lib.assemble_batch.restype = ctypes.c_int
+        lib.assemble_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_float),   # seq_data
+            ctypes.POINTER(ctypes.c_int32),   # seq_lens
+            ctypes.c_int32,                   # n
+            ctypes.c_int32,                   # max_len
+            ctypes.POINTER(ctypes.c_float),   # out
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def assemble_batch(seqs: List[np.ndarray], max_len: int
+                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Pad + stroke-5-convert a batch natively.
+
+    ``seqs`` are float32 stroke-3 arrays. Returns ``(strokes, seq_len)``
+    — ``strokes [n, max_len + 1, 5]`` with the start token at t=0 — or
+    None when the native library is unavailable (caller falls back).
+    """
+    lib = _load()
+    if lib is None or not seqs:
+        return None
+    n = len(seqs)
+    lens = np.array([len(s) for s in seqs], dtype=np.int32)
+    if (lens > max_len).any():
+        return None
+    flat = np.ascontiguousarray(
+        np.concatenate([np.asarray(s, np.float32) for s in seqs], axis=0))
+    out = np.empty((n, max_len + 1, 5), dtype=np.float32)
+    rc = lib.assemble_batch(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n), ctypes.c_int32(max_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    if rc != 0:
+        return None
+    return out, lens

@@ -145,7 +145,7 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def lstm_seq(xp: jax.Array, wh: jax.Array, c0: jax.Array, h0: jax.Array,
              forget_bias: float = 1.0,
              masks: Optional[jax.Array] = None):
@@ -157,7 +157,9 @@ def lstm_seq(xp: jax.Array, wh: jax.Array, c0: jax.Array, h0: jax.Array,
       c0, h0: ``[B, H]`` initial carry.
       forget_bias: added to the forget gate pre-activation (static).
       masks: optional ``[T, B, H]`` recurrent-dropout masks on the
-        candidate gate (static presence; traced values).
+        candidate gate. A regular (traceable) operand — only its
+        *presence* is static; its cotangent is defined as zero (dropout
+        masks are never trained through).
 
     Returns ``(hs [T, B, H], (cT, hT))``.
     """
@@ -205,8 +207,8 @@ def _lstm_seq_fwd(xp, wh, c0, h0, forget_bias, masks):
     return (hs, (cT, hT)), (wh, gates, cs, hs, h0, masks)
 
 
-def _lstm_seq_bwd(forget_bias, masks_static, residuals, grads):
-    del masks_static
+def _lstm_seq_bwd(forget_bias, residuals, grads):
+    del forget_bias
     wh, gates, cs, hs, h0, masks = residuals
     dhs, (dcT, dhT) = grads
     t, b, h = dhs.shape
@@ -246,7 +248,8 @@ def _lstm_seq_bwd(forget_bias, masks_static, residuals, grads):
         interpret=_interpret_default(),
     )(wh, rev(gates), rev(cs), rev(h_prev),
       rev(mask_arg) if with_mask else mask_arg, rev(dhs), dcT, dhT)
-    return rev(dxp_rev), dwh, dc0, dh0
+    dmasks = jnp.zeros_like(masks) if masks is not None else None
+    return rev(dxp_rev), dwh, dc0, dh0, dmasks
 
 
 lstm_seq.defvjp(_lstm_seq_fwd, _lstm_seq_bwd)

@@ -80,8 +80,15 @@ def shard_batch(batch: Dict[str, Any], mesh: Mesh,
     """Move a host numpy batch onto the mesh, split along ``axis``.
 
     One sharded transfer per step — the only host→device boundary in the
-    training loop (SURVEY §3.1 boundary notes).
+    training loop (SURVEY §3.1 boundary notes). Under multi-host
+    execution each process passes its LOCAL shard of the global batch
+    (``1/process_count`` of the rows, see ``parallel.multihost``) and the
+    global array is assembled without any cross-host data movement.
     """
     sharding = batch_sharding(mesh, axis)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(sharding, x),
+            batch)
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(x, sharding), batch)

@@ -21,6 +21,7 @@ from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.data.loader import DataLoader
 from sketch_rnn_tpu.models.vae import SketchRNN
 from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+from sketch_rnn_tpu.parallel.multihost import is_primary
 from sketch_rnn_tpu.train.checkpoint import (
     latest_checkpoint,
     restore_checkpoint,
@@ -62,11 +63,14 @@ def train(hps: HParams,
           seed: int = 0,
           num_steps: Optional[int] = None,
           use_mesh: bool = True,
-          resume: bool = True) -> TrainState:
+          resume: bool = True,
+          profile: bool = False) -> TrainState:
     """Train for ``num_steps`` (default ``hps.num_steps``); returns state.
 
     Resumes from the latest checkpoint in ``workdir`` when present
-    (reference parity: resume-from-latest, SURVEY §5).
+    (reference parity: resume-from-latest, SURVEY §5). ``profile=True``
+    captures a ``jax.profiler`` trace of steps 10-20 (post-compile) into
+    ``<workdir>/trace`` (SURVEY §5 "Tracing / profiling").
     """
     num_steps = hps.num_steps if num_steps is None else num_steps
     model = SketchRNN(hps)
@@ -81,14 +85,26 @@ def train(hps: HParams,
 
     train_step = make_train_step(model, hps, mesh)
     eval_step = make_eval_step(model, hps, mesh)
-    writer = MetricsWriter(workdir, "train")
-    eval_writer = MetricsWriter(workdir, "valid")
+    # multi-host: only the primary process writes metrics and checkpoints.
+    # workdir MUST be shared storage in multi-host runs — every host
+    # restores from it on resume, so a per-host dir would desynchronize
+    # the SPMD step counts (host 0 resumes, others restart at 0)
+    write_dir = workdir if is_primary() else None
+    writer = MetricsWriter(write_dir, "train")
+    eval_writer = MetricsWriter(write_dir, "valid")
 
     step = int(state.step)
     throughput = Throughput(hps.batch_size * hps.max_seq_len,
                             num_chips=mesh.size if mesh is not None else 1)
     throughput.update(step)
+    profile_span = None
+    if profile and workdir:
+        span = (step + 10, min(step + 20, num_steps))
+        if span[0] < span[1]:  # enough post-compile steps left to trace
+            profile_span = span
     while step < num_steps:
+        if profile_span and step == profile_span[0]:
+            jax.profiler.start_trace(f"{workdir}/trace")
         batch = train_loader.random_batch()
         if mesh is not None:
             batch = shard_batch(batch, mesh)
@@ -97,6 +113,10 @@ def train(hps: HParams,
         step_key = jax.random.fold_in(root_key, step)
         state, metrics = train_step(state, batch, step_key)
         step += 1
+        if profile_span and step == profile_span[1]:
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            profile_span = None
 
         if step % hps.log_every == 0 or step == num_steps:
             scalars = {k: float(v) for k, v in metrics.items()}
@@ -114,14 +134,14 @@ def train(hps: HParams,
             eval_writer.write(step, ev)
             eval_writer.log_console(step, ev)
 
-        if workdir and step % hps.save_every == 0:
-            save_checkpoint(workdir, state, scale_factor, hps)
+        if write_dir and step % hps.save_every == 0:
+            save_checkpoint(write_dir, state, scale_factor, hps)
 
-    if workdir:
-        save_checkpoint(workdir, state, scale_factor, hps)
+    if write_dir:
+        save_checkpoint(write_dir, state, scale_factor, hps)
     if test_loader is not None and test_loader.num_batches > 0:
         ev = evaluate(state.params, test_loader, eval_step, mesh)
-        MetricsWriter(workdir, "test").write(int(state.step), ev)
+        MetricsWriter(write_dir, "test").write(int(state.step), ev)
         print("[test] " + " ".join(f"{k}={v:.4f}"
                                    for k, v in sorted(ev.items())),
               flush=True)

@@ -1,0 +1,49 @@
+"""Native C++ batcher vs numpy golden equality (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data import native_batcher as NB
+from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+
+
+@pytest.fixture(scope="module")
+def native_available():
+    if not NB.available():
+        pytest.skip("native batcher unavailable (no g++?)")
+
+
+def test_native_matches_numpy(native_available):
+    hps = HParams(batch_size=8, max_seq_len=64)
+    seqs, _ = make_synthetic_strokes(8, min_len=5, max_len=60, seed=3)
+    seqs = [np.asarray(s, np.float32) for s in seqs]
+    out = NB.assemble_batch(seqs, hps.max_seq_len)
+    assert out is not None
+    strokes, seq_len = out
+
+    loader = DataLoader([s.copy() for s in seqs], hps)
+    ref = loader._pad_batch(seqs)
+    np.testing.assert_array_equal(strokes, ref)
+    np.testing.assert_array_equal(seq_len,
+                                  np.array([len(s) for s in seqs], np.int32))
+
+
+def test_native_rejects_overlong():
+    seqs = [np.zeros((10, 3), np.float32)]
+    assert NB.assemble_batch(seqs, 5) is None
+
+
+def test_loader_uses_native_transparently(native_available, monkeypatch):
+    """Batches must be identical whether or not the native path is active."""
+    hps = HParams(batch_size=4, max_seq_len=48)
+    seqs, labels = make_synthetic_strokes(8, min_len=5, max_len=40, seed=1)
+    l1 = DataLoader([np.array(s) for s in seqs], hps, labels=labels, seed=7)
+    b1 = l1.get_batch(0)
+    monkeypatch.setenv("SKETCH_RNN_TPU_NO_NATIVE", "1")
+    monkeypatch.setattr(NB, "_lib", None)
+    monkeypatch.setattr(NB, "_tried", False)
+    l2 = DataLoader([np.array(s) for s in seqs], hps, labels=labels, seed=7)
+    b2 = l2.get_batch(0)
+    for k in b1:
+        np.testing.assert_array_equal(b1[k], b2[k])

@@ -96,3 +96,22 @@ def test_value_and_grad_under_jit():
     v, g = jax.value_and_grad(f, argnums=1)(xp, params["wh"])
     assert np.isfinite(float(v))
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_masks_traced_under_jit():
+    """Masks drawn from a key INSIDE jit (the realistic training usage)
+    must work — they are a regular operand, not a static argnum."""
+    cell, params, xs, xp, c0, h0 = _setup()
+
+    @jax.jit
+    def f(key, wh):
+        masks = make_dropout_masks(key, 0.8, T, B, H)
+
+        def loss(wh_):
+            hs, _ = lstm_seq(xp, wh_, c0, h0, 1.0, masks)
+            return jnp.mean(hs ** 2)
+        return jax.value_and_grad(loss)(wh)
+
+    v, g = f(jax.random.key(3), params["wh"])
+    assert np.isfinite(float(v))
+    assert np.isfinite(np.asarray(g)).all()
