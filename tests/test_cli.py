@@ -65,3 +65,24 @@ def test_graft_entry_compiles():
 def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+def test_graft_entry_dryrun_multichip_clean_subprocess():
+    """Exercise the dryrun exactly as the driver does: a plain environment
+    with NO pre-set JAX_PLATFORMS / XLA_FLAGS (conftest.py pre-configures
+    them in-process, which is the one environment the driver does NOT
+    provide). dryrun_multichip must self-configure the virtual platform.
+    """
+    import subprocess
+
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64")}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as ge; ge.dryrun_multichip(8)"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"dryrun failed in clean env:\nstdout: {proc.stdout}\n"
+        f"stderr: {proc.stderr}")
+    assert "8 devices OK" in proc.stdout
