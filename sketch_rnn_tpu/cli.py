@@ -123,9 +123,11 @@ def cmd_train(args) -> int:
 
 
 def cmd_eval(args) -> int:
+    from sketch_rnn_tpu.parallel import multihost as mh
     from sketch_rnn_tpu.parallel.mesh import make_mesh
     from sketch_rnn_tpu.train import make_eval_step
     from sketch_rnn_tpu.train.loop import evaluate
+    mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
     model, state, scale, meta = _restore(hps, args.workdir)
     _, valid_l, test_l, _ = _load_data(hps, args, scale_factor=scale)
@@ -139,8 +141,10 @@ def cmd_eval(args) -> int:
 
 
 def cmd_sample(args) -> int:
+    from sketch_rnn_tpu.parallel import multihost as mh
     from sketch_rnn_tpu.sample import (
         encode_mu, interpolate_latents, sample, svg_grid)
+    mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
     model, state, scale, meta = _restore(hps, args.workdir)
     key = jax.random.key(args.seed)

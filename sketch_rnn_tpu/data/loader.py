@@ -65,7 +65,9 @@ class DataLoader:
                  hps: HParams,
                  labels: Optional[np.ndarray] = None,
                  augment: bool = False,
-                 seed: int = 0):
+                 seed: int = 0,
+                 global_size: Optional[int] = None,
+                 num_hosts: int = 1):
         self.hps = hps
         self.strokes: List[np.ndarray] = [np.asarray(s, np.float32)
                                           for s in stroke3_list]
@@ -75,7 +77,23 @@ class DataLoader:
         assert len(self.labels) == len(self.strokes)
         self.augment = augment
         self.rng = np.random.default_rng(seed)
-        self.num_batches = len(self.strokes) // hps.batch_size
+        # Multi-host SPMD safety: every host must run the SAME number of
+        # jitted eval programs (each contains cross-host all-reduces, so a
+        # host running one extra batch deadlocks the cluster). Host-striped
+        # corpora differ in size by at most 1; both batch counts derive
+        # from the GLOBAL size so they are identical on every host:
+        # - num_batches (training-era full batches) from the guaranteed-
+        #   common floor global//num_hosts,
+        # - num_eval_batches from the ceil, so the sweep covers every
+        #   host's full local corpus (hosts holding a striping remainder
+        #   would otherwise never evaluate it when the common length is an
+        #   exact batch multiple).
+        if global_size is not None and num_hosts > 1:
+            self._common_len = global_size // num_hosts
+            self._max_local_len = -(-global_size // num_hosts)
+        else:
+            self._common_len = self._max_local_len = len(self.strokes)
+        self.num_batches = self._common_len // hps.batch_size
 
     def __len__(self) -> int:
         return len(self.strokes)
@@ -125,16 +143,37 @@ class DataLoader:
             "labels": self.labels[idx],
         }
 
+    @property
+    def num_eval_batches(self) -> int:
+        """Batches for a full eval sweep, including a wrap-filled tail.
+
+        ``ceil(max_local_len / batch_size)``: trailing batches wrap around
+        to the start of the corpus, so every example on EVERY host is
+        evaluated at least once while all batches keep the full (compiled)
+        batch shape. Identical on every host (derived from the pre-stripe
+        corpus size), so the SPMD sweep launches the same program count
+        cluster-wide. Zero when any host's stripe is empty (common length
+        0): eval is then impossible cluster-wide and every host must agree
+        on that rather than deadlock.
+        """
+        if self._common_len == 0:
+            return 0
+        b = self.hps.batch_size
+        return (self._max_local_len + b - 1) // b
+
     def random_batch(self) -> Dict[str, np.ndarray]:
         idx = self.rng.choice(len(self.strokes), self.hps.batch_size,
                               replace=len(self.strokes) < self.hps.batch_size)
         return self._assemble(idx)
 
     def get_batch(self, batch_index: int) -> Dict[str, np.ndarray]:
-        if not 0 <= batch_index < self.num_batches:
-            raise IndexError(f"batch {batch_index} of {self.num_batches}")
+        if not 0 <= batch_index < self.num_eval_batches:
+            raise IndexError(f"batch {batch_index} of {self.num_eval_batches}")
         lo = batch_index * self.hps.batch_size
-        idx = np.arange(lo, lo + self.hps.batch_size)
+        # the tail batch (index num_batches, when common_len % B != 0)
+        # wraps around to the corpus start; modulo is over the LOCAL length
+        # so hosts holding a striping remainder example still use it
+        idx = np.arange(lo, lo + self.hps.batch_size) % len(self.strokes)
         return self._assemble(idx)
 
 
@@ -198,10 +237,12 @@ def load_dataset(hps: HParams,
         # every split is host-striped: train for data parallelism, valid/
         # test so the eval sweep's global batches hold DISTINCT rows (each
         # host feeds 1/num_hosts of each global batch)
+        global_size = len(seqs)
         seqs, labels = _stripe(seqs, labels, host_id, num_hosts)
         return DataLoader(seqs, hps, labels=np.array(labels, np.int32),
                           augment=augment,
-                          seed=_host_seed(_SEEDS[split], host_id))
+                          seed=_host_seed(_SEEDS[split], host_id),
+                          global_size=global_size, num_hosts=num_hosts)
 
     train = build("train", augment=True)
     # Scale factor comes from the FULL train split (pre-shard): every host
@@ -290,9 +331,11 @@ def synthetic_loader(hps: HParams, num: int, seed: int = 0,
         max_len=min(96, hps.max_seq_len - 2), seed=seed)
     if scale_factor is None:
         scale_factor = S.calculate_normalizing_scale_factor(seqs)
+    global_size = len(seqs)
     seqs, labels = _stripe(seqs, labels, host_id, num_hosts)
     loader = DataLoader(seqs, hps, labels=labels, augment=augment,
-                        seed=_host_seed(seed, host_id))
+                        seed=_host_seed(seed, host_id),
+                        global_size=global_size, num_hosts=num_hosts)
     loader.normalize(scale_factor)
     return loader, scale_factor
 

@@ -41,16 +41,19 @@ def save_checkpoint(ckpt_dir: str, state: TrainState, scale_factor: float,
     state = jax.device_get(state)
     step = int(state.step)
     data_path, meta_path = _paths(ckpt_dir, step)
-    tmp = data_path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(state))
-    os.replace(tmp, data_path)
+    # sidecar FIRST: latest_checkpoint() requires both files, so a crash
+    # after this write but before the msgpack lands leaves only a harmless
+    # orphan json and resume falls back to the previous complete checkpoint
     meta = {"step": step, "scale_factor": float(scale_factor),
             "hps": json.loads(hps.to_json())}
     tmp = meta_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(meta, f, indent=2)
     os.replace(tmp, meta_path)
+    tmp = data_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(state))
+    os.replace(tmp, data_path)
     _prune(ckpt_dir, keep)
     return data_path
 
@@ -59,8 +62,12 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[int]:
     """Highest checkpointed step in ``ckpt_dir``, or None."""
     if not os.path.isdir(ckpt_dir):
         return None
-    steps = [int(m.group(1)) for name in os.listdir(ckpt_dir)
-             if (m := _CKPT_RE.match(name))]
+    # a checkpoint only counts when BOTH the msgpack and its json sidecar
+    # exist — a crash mid-save leaves at most one of them, and resume must
+    # fall back to the previous complete pair
+    steps = [s for name in os.listdir(ckpt_dir)
+             if (m := _CKPT_RE.match(name))
+             and os.path.exists(_paths(ckpt_dir, s := int(m.group(1)))[1])]
     return max(steps) if steps else None
 
 
@@ -81,12 +88,22 @@ def restore_checkpoint(ckpt_dir: str, target: TrainState,
     return state, float(meta["scale_factor"]), meta
 
 
+_ANY_CKPT_RE = re.compile(r"^ckpt_(\d+)\.(?:msgpack|json)$")
+
+
 def _prune(ckpt_dir: str, keep: int) -> None:
-    steps = sorted(int(m.group(1)) for name in os.listdir(ckpt_dir)
-                   if (m := _CKPT_RE.match(name)))
-    for s in steps[:-keep] if keep > 0 else []:
-        for p in _paths(ckpt_dir, s):
+    """Keep the ``keep`` newest COMPLETE checkpoints; drop everything else,
+    including orphan files from crashed saves (a sidecar-first save that
+    dies mid-write leaves a lone json, which would otherwise accumulate)."""
+    complete = sorted(s for name in os.listdir(ckpt_dir)
+                      if (m := _CKPT_RE.match(name))
+                      and os.path.exists(_paths(ckpt_dir,
+                                                s := int(m.group(1)))[1]))
+    keep_steps = set(complete[-keep:]) if keep > 0 else set(complete)
+    for name in os.listdir(ckpt_dir):
+        m = _ANY_CKPT_RE.match(name)
+        if m and int(m.group(1)) not in keep_steps:
             try:
-                os.remove(p)
+                os.remove(os.path.join(ckpt_dir, name))
             except OSError:
                 pass

@@ -37,12 +37,24 @@ from sketch_rnn_tpu.utils.profiling import Throughput
 def evaluate(params, loader: DataLoader, eval_step,
              mesh=None, key: Optional[jax.Array] = None
              ) -> Dict[str, float]:
-    """Average eval metrics over every full batch of ``loader``."""
+    """Average eval metrics over a full sweep of ``loader``.
+
+    Sweeps ``loader.num_eval_batches`` batches — every example is covered
+    at least once; the final batch wrap-fills from the corpus start so all
+    batches keep the compiled shape. The batch count is identical on every
+    host (derived from the pre-stripe corpus size) so the SPMD sweep can
+    never launch mismatched collective programs across hosts.
+    """
     if key is None:
         key = jax.random.key(0)
+    n = loader.num_eval_batches
+    if n == 0:
+        raise ValueError(
+            f"eval split has no common batches ({len(loader)} local "
+            f"examples, batch_size={loader.hps.batch_size}): some host's "
+            f"stripe is empty; enlarge the split or reduce host count")
     totals: Dict[str, float] = {}
-    n = max(loader.num_batches, 1)
-    for i in range(loader.num_batches):
+    for i in range(n):
         batch = loader.get_batch(i)
         if mesh is not None:
             batch = shard_batch(batch, mesh)
@@ -73,6 +85,14 @@ def train(hps: HParams,
     ``<workdir>/trace`` (SURVEY §5 "Tracing / profiling").
     """
     num_steps = hps.num_steps if num_steps is None else num_steps
+    # fail fast: an un-evaluable valid split would otherwise only raise at
+    # the FIRST eval sweep, hours into training (everything needed for the
+    # check is known now)
+    if valid_loader is not None and valid_loader.num_eval_batches == 0:
+        raise ValueError(
+            f"valid split is not evaluable ({len(valid_loader)} local "
+            f"examples, batch_size={hps.batch_size}); enlarge the split, "
+            f"reduce batch_size, or pass valid_loader=None")
     model = SketchRNN(hps)
     mesh = make_mesh(hps) if use_mesh else None
 
@@ -102,44 +122,55 @@ def train(hps: HParams,
         span = (step + 10, min(step + 20, num_steps))
         if span[0] < span[1]:  # enough post-compile steps left to trace
             profile_span = span
-    while step < num_steps:
-        if profile_span and step == profile_span[0]:
-            jax.profiler.start_trace(f"{workdir}/trace")
-        batch = train_loader.random_batch()
-        if mesh is not None:
-            batch = shard_batch(batch, mesh)
-        # key is a pure function of (seed, step): a resumed run continues
-        # the stream instead of replaying the pre-checkpoint keys
-        step_key = jax.random.fold_in(root_key, step)
-        state, metrics = train_step(state, batch, step_key)
-        step += 1
-        if profile_span and step == profile_span[1]:
-            jax.block_until_ready(metrics["loss"])
+    trace_active = False
+    try:
+        while step < num_steps:
+            if profile_span and step == profile_span[0]:
+                jax.profiler.start_trace(f"{workdir}/trace")
+                trace_active = True
+            batch = train_loader.random_batch()
+            if mesh is not None:
+                batch = shard_batch(batch, mesh)
+            # key is a pure function of (seed, step): a resumed run
+            # continues the stream instead of replaying the pre-checkpoint
+            # keys
+            step_key = jax.random.fold_in(root_key, step)
+            state, metrics = train_step(state, batch, step_key)
+            step += 1
+            if trace_active and step == profile_span[1]:
+                jax.block_until_ready(metrics["loss"])
+                jax.profiler.stop_trace()
+                trace_active = False
+                profile_span = None
+
+            if step % hps.log_every == 0 or step == num_steps:
+                scalars = {k: float(v) for k, v in metrics.items()}
+                rates = throughput.update(step)
+                if rates:
+                    scalars.update(rates)
+                # persist the row BEFORE the guard so a divergence leaves
+                # its diagnostic record in the metrics files
+                writer.write(step, scalars)
+                writer.log_console(step, scalars)
+                check_finite(scalars, step)
+
+            if valid_loader is not None and step % hps.eval_every == 0:
+                ev = evaluate(state.params, valid_loader, eval_step, mesh)
+                eval_writer.write(step, ev)
+                eval_writer.log_console(step, ev)
+
+            if write_dir and step % hps.save_every == 0:
+                save_checkpoint(write_dir, state, scale_factor, hps)
+    finally:
+        # a check_finite/evaluate/save raise must not leave an open trace
+        # session (the partial trace would be unusable and the session
+        # poisons any later start_trace in this process)
+        if trace_active:
             jax.profiler.stop_trace()
-            profile_span = None
-
-        if step % hps.log_every == 0 or step == num_steps:
-            scalars = {k: float(v) for k, v in metrics.items()}
-            rates = throughput.update(step)
-            if rates:
-                scalars.update(rates)
-            # persist the row BEFORE the guard so a divergence leaves its
-            # diagnostic record in the metrics files
-            writer.write(step, scalars)
-            writer.log_console(step, scalars)
-            check_finite(scalars, step)
-
-        if valid_loader is not None and step % hps.eval_every == 0:
-            ev = evaluate(state.params, valid_loader, eval_step, mesh)
-            eval_writer.write(step, ev)
-            eval_writer.log_console(step, ev)
-
-        if write_dir and step % hps.save_every == 0:
-            save_checkpoint(write_dir, state, scale_factor, hps)
 
     if write_dir:
         save_checkpoint(write_dir, state, scale_factor, hps)
-    if test_loader is not None and test_loader.num_batches > 0:
+    if test_loader is not None and test_loader.num_eval_batches > 0:
         ev = evaluate(state.params, test_loader, eval_step, mesh)
         MetricsWriter(write_dir, "test").write(int(state.step), ev)
         print("[test] " + " ".join(f"{k}={v:.4f}"

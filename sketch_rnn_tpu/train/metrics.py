@@ -39,10 +39,23 @@ class MetricsWriter:
         if self._csv_path:
             new = self._fields is None and not os.path.exists(self._csv_path)
             if self._fields is None:
-                self._fields = list(row)
+                header = None
+                if not new:
+                    # resuming into an existing CSV: adopt ITS header so
+                    # columns stay aligned even if this run's first row has
+                    # a different key set (extras dropped, missing empty)
+                    with open(self._csv_path, newline="") as f:
+                        header = next(csv.reader(f), None)
+                if header:
+                    self._fields = header
+                else:
+                    # fresh file, or an existing-but-headerless file (a
+                    # crash truncated it): (re)write the header
+                    self._fields = list(row)
+                    new = True
             with open(self._csv_path, "a", newline="") as f:
                 w = csv.DictWriter(f, fieldnames=self._fields,
-                                   extrasaction="ignore")
+                                   extrasaction="ignore", restval="")
                 if new:
                     w.writeheader()
                 w.writerow(row)

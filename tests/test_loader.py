@@ -59,6 +59,77 @@ def test_get_batch_covers_dataset_in_order(hps):
         dl.get_batch(3)
 
 
+def test_eval_tail_wrap_fill(hps):
+    # 19 examples, batch 8: 2 full batches + a wrap-filled tail batch
+    seqs, labels = make_synthetic_strokes(19, num_classes=2)
+    dl = DataLoader(seqs, hps, labels=labels)
+    assert dl.num_batches == 2
+    assert dl.num_eval_batches == 3
+    tail = dl.get_batch(2)
+    # rows 16..18 are the real tail; rows 3.. wrap to the corpus start
+    np.testing.assert_array_equal(
+        tail["labels"], np.concatenate([labels[16:19], labels[:5]]))
+    with pytest.raises(IndexError):
+        dl.get_batch(3)
+
+
+def test_split_smaller_than_batch_still_evaluable(hps):
+    # fewer examples than one batch: num_batches floors to 0 but the eval
+    # sweep must still cover the split (VERDICT r1 'no silent empty eval')
+    seqs, labels = make_synthetic_strokes(5, num_classes=1)
+    dl = DataLoader(seqs, hps, labels=labels)
+    assert dl.num_batches == 0
+    assert dl.num_eval_batches == 1
+    batch = dl.get_batch(0)
+    assert batch["strokes"].shape[0] == hps.batch_size
+    np.testing.assert_array_equal(
+        batch["labels"], labels[np.arange(8) % 5])
+
+
+def test_common_batch_count_across_hosts(hps):
+    # 19 global examples striped over 2 hosts -> local sizes 10 and 9;
+    # both hosts must report IDENTICAL batch counts (common length 9) or
+    # an SPMD eval sweep deadlocks on mismatched collective launches
+    seqs, labels = make_synthetic_strokes(19, num_classes=1)
+    hps2 = hps.replace(batch_size=4)
+    h0 = DataLoader(seqs[0::2], hps2, labels=labels[0::2],
+                    global_size=19, num_hosts=2)
+    h1 = DataLoader(seqs[1::2], hps2, labels=labels[1::2],
+                    global_size=19, num_hosts=2)
+    assert len(h0) == 10 and len(h1) == 9
+    assert h0.num_batches == h1.num_batches == 2
+    assert h0.num_eval_batches == h1.num_eval_batches == 3
+    # the host holding the striping remainder still uses its 10th example
+    tail = h0.get_batch(2)
+    assert tail["strokes"].shape[0] == 4
+
+
+def test_striping_remainder_covered_at_exact_batch_multiple(hps):
+    # 17 global examples over 2 hosts, batch 4: common floor 8 is an exact
+    # batch multiple, but host 0 holds 9 examples — the sweep length must
+    # come from the ceil so its 9th example is still evaluated
+    seqs, labels = make_synthetic_strokes(17, num_classes=1)
+    hps2 = hps.replace(batch_size=4)
+    h0 = DataLoader(seqs[0::2], hps2, labels=labels[0::2],
+                    global_size=17, num_hosts=2)
+    h1 = DataLoader(seqs[1::2], hps2, labels=labels[1::2],
+                    global_size=17, num_hosts=2)
+    assert h0.num_batches == h1.num_batches == 2
+    assert h0.num_eval_batches == h1.num_eval_batches == 3
+    tail = h0.get_batch(2)  # idx 8,0,1,2 over the 9-example local corpus
+    np.testing.assert_array_equal(tail["labels"],
+                                  labels[0::2][[8, 0, 1, 2]])
+
+
+def test_empty_host_stripe_gives_zero_eval_batches(hps):
+    # global corpus smaller than the host count: some stripe is empty, so
+    # EVERY host must consistently report an un-evaluable split
+    seqs, labels = make_synthetic_strokes(1, num_classes=1)
+    full = DataLoader(seqs, hps, labels=labels, global_size=1, num_hosts=2)
+    empty = DataLoader([], hps, global_size=1, num_hosts=2)
+    assert full.num_eval_batches == empty.num_eval_batches == 0
+
+
 def test_load_dataset_end_to_end(tmp_path, hps):
     write_synthetic_npz(str(tmp_path / "synth.npz"), num_train=40,
                         num_valid=10, num_test=10, max_len=90)

@@ -181,6 +181,29 @@ def test_evaluate_sweep():
     assert "recon" in out and np.isfinite(out["recon"])
 
 
+def test_evaluate_split_smaller_than_batch():
+    # VERDICT r1 'no silent empty eval': a split smaller than one batch
+    # must still produce metrics via the wrap-filled tail batch
+    hps = tiny_hps()  # batch_size=16
+    model = SketchRNN(hps)
+    loader = make_loader(hps, n=5)
+    assert loader.num_batches == 0
+    params = model.init_params(jax.random.key(0))
+    ev = make_eval_step(model, hps, mesh=None)
+    out = evaluate(params, loader, ev)
+    assert "recon" in out and np.isfinite(out["recon"])
+
+
+def test_evaluate_empty_loader_raises_loudly():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    loader = DataLoader([], hps)
+    params = model.init_params(jax.random.key(0))
+    ev = make_eval_step(model, hps, mesh=None)
+    with pytest.raises(ValueError, match="no common batches"):
+        evaluate(params, loader, ev)
+
+
 # -- checkpoint -------------------------------------------------------------
 
 
@@ -214,6 +237,84 @@ def test_checkpoint_prune_keeps_latest(tmp_path):
     names = sorted(os.listdir(d))
     assert latest_checkpoint(d) == 5
     assert sum(n.endswith(".msgpack") for n in names) == 2
+
+
+def test_checkpoint_orphan_files_skipped(tmp_path):
+    # a crash mid-save leaves an incomplete pair; resume must fall back to
+    # the previous COMPLETE checkpoint (ADVICE r1: sidecar crash window)
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    d = str(tmp_path)
+    save_checkpoint(d, state._replace(step=jnp.asarray(3, jnp.int32)),
+                    1.5, hps)
+    # orphan msgpack without sidecar (legacy crash ordering)
+    open(os.path.join(d, "ckpt_00000009.msgpack"), "wb").write(b"junk")
+    assert latest_checkpoint(d) == 3
+    restored, scale, _ = restore_checkpoint(d, state)
+    assert int(restored.step) == 3 and scale == 1.5
+    # orphan sidecar without msgpack (current crash ordering) is inert too
+    open(os.path.join(d, "ckpt_00000011.json"), "w").write("{}")
+    assert latest_checkpoint(d) == 3
+
+
+def test_metrics_csv_resume_alignment(tmp_path):
+    # ADVICE r1: on resume into an existing CSV the original header must
+    # govern column order; new keys are dropped, missing keys left empty
+    import csv
+
+    from sketch_rnn_tpu.train.metrics import MetricsWriter
+    d = str(tmp_path)
+    w1 = MetricsWriter(d, "train")
+    w1.write(1, {"loss": 1.0, "recon": 2.0})
+    w2 = MetricsWriter(d, "train")  # fresh process, e.g. after resume
+    w2.write(2, {"loss": 0.5, "grad_norm": 3.0})
+    with open(os.path.join(d, "train_metrics.csv"), newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert rows[0]["loss"] == "1.0" and rows[0]["recon"] == "2.0"
+    assert rows[1]["loss"] == "0.5" and rows[1]["recon"] == ""
+    assert "grad_norm" not in rows[1]
+
+
+def test_metrics_csv_headerless_file_recovers(tmp_path):
+    # a crash can leave a created-but-empty CSV; the writer must rewrite
+    # the header instead of appending headerless data rows
+    import csv
+
+    from sketch_rnn_tpu.train.metrics import MetricsWriter
+    d = str(tmp_path)
+    open(os.path.join(d, "train_metrics.csv"), "w").close()
+    w = MetricsWriter(d, "train")
+    w.write(1, {"loss": 1.0})
+    rows = list(csv.DictReader(
+        open(os.path.join(d, "train_metrics.csv"), newline="")))
+    assert rows[0]["loss"] == "1.0"
+
+
+def test_checkpoint_prune_removes_orphans(tmp_path):
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    d = str(tmp_path)
+    save_checkpoint(d, state._replace(step=jnp.asarray(3, jnp.int32)),
+                    1.0, hps)
+    # crashed-save debris: a lone sidecar and a lone msgpack
+    open(os.path.join(d, "ckpt_00000005.json"), "w").write("{}")
+    open(os.path.join(d, "ckpt_00000007.msgpack"), "wb").write(b"junk")
+    save_checkpoint(d, state._replace(step=jnp.asarray(9, jnp.int32)),
+                    1.0, hps, keep=2)
+    names = set(os.listdir(d))
+    assert "ckpt_00000005.json" not in names
+    assert "ckpt_00000007.msgpack" not in names
+    assert latest_checkpoint(d) == 9
+
+
+def test_train_fails_fast_on_unevaluable_valid_split(tmp_path):
+    hps = tiny_hps(num_steps=4, eval_every=2)
+    loader = make_loader(hps, n=32)
+    with pytest.raises(ValueError, match="not evaluable"):
+        train(hps, loader, valid_loader=DataLoader([], hps),
+              workdir=str(tmp_path), use_mesh=False)
 
 
 # -- end-to-end loop --------------------------------------------------------
