@@ -161,9 +161,12 @@ def cmd_sample(args) -> int:
                                temperature=args.temperature, z=z,
                                labels=labels, scale_factor=scale,
                                greedy=args.greedy)
-    svg_grid(sketches, cols=args.cols, path=args.output)
-    print(f"[cli] wrote {args.n} sketches (lengths "
-          f"{[int(x) for x in lengths]}) to {args.output}")
+    # multi-host: only the primary writes (hosts hold different loader
+    # stripes, so concurrent writes to a shared path would tear the file)
+    if mh.is_primary():
+        svg_grid(sketches, cols=args.cols, path=args.output)
+        print(f"[cli] wrote {args.n} sketches (lengths "
+              f"{[int(x) for x in lengths]}) to {args.output}")
     return 0
 
 

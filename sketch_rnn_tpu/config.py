@@ -74,9 +74,14 @@ class HParams:
     save_every: int = 500
     eval_every: int = 500
     log_every: int = 20
+    prefetch_depth: int = 2            # input-pipeline overlap (0 = sync feed)
 
     # --- TPU / parallelism (component 18) ---
     compute_dtype: str = "float32"     # "bfloat16" for MXU-friendly matmuls
+    fused_rnn: bool = False            # Pallas recompute-backward kernels for
+    #   lstm/layer_norm cells (ops/pallas_fused.py; 2.1-2.3x the scan's
+    #   fwd+bwd at the flagship decoder shape on v5e). hyper cells and
+    #   other paths fall back to lax.scan.
     remat: bool = False                # jax.checkpoint the RNN scan steps
     #   (trades ~30% step time for the per-step residual memory; enables
     #   global batches >=1024 at max_seq_len=250 on a 16G-HBM chip)

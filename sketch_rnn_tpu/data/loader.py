@@ -167,14 +167,23 @@ class DataLoader:
         return self._assemble(idx)
 
     def get_batch(self, batch_index: int) -> Dict[str, np.ndarray]:
+        """Deterministic eval batch; includes a ``"weights"`` [B] vector.
+
+        Wrapped batches (linear index past the local corpus) repeat rows
+        from the corpus start to keep the compiled batch shape; those
+        duplicate rows get weight 0 so weighted eval metrics are exact
+        sample means over the split (first occurrences get weight 1).
+        """
         if not 0 <= batch_index < self.num_eval_batches:
             raise IndexError(f"batch {batch_index} of {self.num_eval_batches}")
         lo = batch_index * self.hps.batch_size
-        # the tail batch (index num_batches, when common_len % B != 0)
-        # wraps around to the corpus start; modulo is over the LOCAL length
-        # so hosts holding a striping remainder example still use it
-        idx = np.arange(lo, lo + self.hps.batch_size) % len(self.strokes)
-        return self._assemble(idx)
+        linear = np.arange(lo, lo + self.hps.batch_size)
+        # modulo is over the LOCAL length so hosts holding a striping
+        # remainder example still use it
+        idx = linear % len(self.strokes)
+        batch = self._assemble(idx)
+        batch["weights"] = (linear < len(self.strokes)).astype(np.float32)
+        return batch
 
 
 # -- dataset assembly ------------------------------------------------------

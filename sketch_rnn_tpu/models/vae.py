@@ -118,7 +118,8 @@ class SketchRNN:
         h_final, _ = bidirectional_rnn(
             self.enc_fwd, self.enc_bwd, params["enc_fwd"], params["enc_bwd"],
             x_tm, seq_len=seq_len,
-            rdrop_gen_fwd=gen_f, rdrop_gen_bwd=gen_b, remat=hps.remat)
+            rdrop_gen_fwd=gen_f, rdrop_gen_bwd=gen_b, remat=hps.remat,
+            fused=hps.fused_rnn)
         mu = L.matmul(h_final, params["mu_w"], _dtype(hps)) + params["mu_b"]
         presig = L.matmul(h_final, params["presig_w"], _dtype(hps)) \
             + params["presig_b"]
@@ -171,7 +172,8 @@ class SketchRNN:
                 inputs = inputs * mask / keep
         carry0 = self.decoder_initial_carry(params, z, b)
         _, hs = run_rnn(self.dec, params["dec"], inputs, carry0,
-                        rdrop_gen=rgen, remat=hps.remat)
+                        rdrop_gen=rgen, remat=hps.remat,
+                        fused=hps.fused_rnn)
         if train and key is not None and hps.use_output_dropout:
             keep = hps.output_dropout_keep
             mask = jax.random.bernoulli(kout, keep, hs.shape)
@@ -210,6 +212,9 @@ class SketchRNN:
         x_target = strokes[1:]
         seq_len = batch["seq_len"]
         labels = batch.get("labels") if hps.num_classes > 0 else None
+        # optional [B] example weights (eval sweeps zero out wrap-filled
+        # duplicate rows; absent in training batches -> uniform)
+        weights = batch.get("weights")
 
         kenc, kz, kdec = jax.random.split(key, 3)
         z = None
@@ -217,7 +222,7 @@ class SketchRNN:
             mu, presig = self.encode(params, x_target, seq_len,
                                      key=kenc, train=train)
             z = self.sample_z(mu, presig, kz)
-            kl_raw = mdn.kl_loss(mu, presig)
+            kl_raw = mdn.kl_loss(mu, presig, weights=weights)
         else:
             kl_raw = jnp.float32(0.0)
 
@@ -225,7 +230,8 @@ class SketchRNN:
         mp = mdn.get_mixture_params(raw, hps.num_mixture)
         # canonical asymmetry: pen CE unmasked in training, masked in eval
         offset_nll, pen_ce = mdn.reconstruction_loss(
-            mp, x_target, hps.max_seq_len, mask_pen=not train)
+            mp, x_target, hps.max_seq_len, mask_pen=not train,
+            weights=weights)
         r_cost = offset_nll + pen_ce
         if hps.conditional:
             kl_floored = mdn.kl_cost_with_floor(kl_raw, hps.kl_tolerance)

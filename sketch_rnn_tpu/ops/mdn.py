@@ -79,13 +79,19 @@ def gmm_nll(dx: jax.Array, dy: jax.Array, mp: MixtureParams) -> jax.Array:
 
 
 def reconstruction_loss(mp: MixtureParams, target: jax.Array,
-                        max_seq_len: int, mask_pen: bool = False
+                        max_seq_len: int, mask_pen: bool = False,
+                        weights: Optional[jax.Array] = None
                         ) -> Tuple[jax.Array, jax.Array]:
     """Offset-GMM NLL + pen-state CE, canonical masking and normalization.
 
     ``target`` is time-major stroke-5 ``[T, B, 5]`` (the sequence shifted
     one step ahead of the decoder input). Returns scalars
     ``(offset_nll, pen_ce)``, each already divided by ``max_seq_len * B``.
+
+    ``weights`` (``[B]``, optional) weights each example's contribution
+    and replaces ``B`` with ``sum(weights)`` in the normalization — used
+    by the eval sweep to zero out wrap-filled duplicate rows so metrics
+    are exact sample means while every batch keeps the compiled shape.
     """
     t, b = target.shape[0], target.shape[1]
     dx, dy, pen = target[..., 0], target[..., 1], target[..., 2:5]
@@ -94,13 +100,28 @@ def reconstruction_loss(mp: MixtureParams, target: jax.Array,
     pen_ce = -jnp.sum(pen * jax.nn.log_softmax(mp.pen_logits, -1), axis=-1)
     if mask_pen:
         pen_ce = pen_ce * fs
-    denom = float(max_seq_len * b)
+    if weights is None:
+        denom = float(max_seq_len * b)
+    else:
+        w = weights.astype(jnp.float32)
+        nll = nll * w[None, :]
+        pen_ce = pen_ce * w[None, :]
+        denom = max_seq_len * jnp.maximum(jnp.sum(w), 1.0)
     return jnp.sum(nll) / denom, jnp.sum(pen_ce) / denom
 
 
-def kl_loss(mu: jax.Array, presig: jax.Array) -> jax.Array:
-    """KL(q(z|x) || N(0, I)), mean over batch and latent dims."""
-    return -0.5 * jnp.mean(1.0 + presig - jnp.square(mu) - jnp.exp(presig))
+def kl_loss(mu: jax.Array, presig: jax.Array,
+            weights: Optional[jax.Array] = None) -> jax.Array:
+    """KL(q(z|x) || N(0, I)), mean over batch and latent dims.
+
+    ``weights`` (``[B]``, optional): weighted mean over the batch axis
+    (see :func:`reconstruction_loss`)."""
+    per = -0.5 * jnp.mean(1.0 + presig - jnp.square(mu) - jnp.exp(presig),
+                          axis=-1)                       # [B]
+    if weights is None:
+        return jnp.mean(per)
+    w = weights.astype(jnp.float32)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 def kl_cost_with_floor(kl: jax.Array, kl_tolerance: float) -> jax.Array:

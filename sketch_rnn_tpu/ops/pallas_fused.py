@@ -1,0 +1,584 @@
+"""Recompute-backward fused RNN kernels (LSTM + LayerNorm-LSTM).
+
+SURVEY.md §2 component 5 names the cuDNN fused LSTM as the reference's
+performance core; round 1 shipped a reserve-space kernel
+(:mod:`sketch_rnn_tpu.ops.pallas_lstm`) whose own profiling showed the
+``[T, B, 4H]`` gate reserve (262 MB at the flagship shape) losing to XLA
+scan's recompute AD. These kernels are the measured fix (VERDICT r1 next
+#3), redesigned around recomputation:
+
+- the input projection ``x @ wx`` happens INSIDE the kernel per step, so
+  no ``[T, B, 4H]`` array ever exists in HBM (neither projections nor
+  gates — the r1 kernel's whole bandwidth bill),
+- the forward saves only what the model needs anyway (``hs``) plus the
+  pre-step cell states ``cs`` — the same ``[T, B, 2H]`` residual
+  footprint as ``lax.scan``'s AD,
+- the backward re-runs the two gate matmuls per step (cheap: the MXU is
+  idle waiting on the sequential dependency anyway) and fuses the whole
+  gate/LN backward into the same grid step,
+- both kernels tile the batch (outer grid axis) so VMEM holds one
+  ``[bt, H]`` working set regardless of global batch size; weight
+  gradients accumulate across all grid steps.
+
+The LayerNorm variant covers the FLAGSHIP decoder cell (``layer_norm``),
+which the r1 kernel never did. Semantics are bit-compatible with
+:class:`sketch_rnn_tpu.ops.cells.LayerNormLSTMCell` (per-gate LN, cell
+LN, forget bias after LN, recurrent dropout on the candidate).
+
+Mixed precision: pass ``wx``/``wh`` already cast (e.g. bfloat16); the
+kernel casts activations to the weight dtype per matmul and accumulates
+in float32 — the same contract as ``ops.linear.matmul``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LN_EPS = 1e-6  # matches ops.linear.layer_norm
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _batch_tile(b: int) -> int:
+    """Largest VMEM-friendly divisor of the batch for the outer grid."""
+    for cand in (128, 64, 32, 16, 8):
+        if b % cand == 0:
+            return cand
+    return b
+
+
+def _cast(x, w_ref):
+    return x.astype(w_ref.dtype)
+
+
+def _ln_fwd(u, gamma, beta):
+    """Row layer-norm; returns (y, xhat, r) for reuse in the backward."""
+    mu = jnp.mean(u, axis=-1, keepdims=True)
+    xc = u - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    r = jax.lax.rsqrt(var + _LN_EPS)
+    xhat = xc * r
+    return xhat * gamma + beta, xhat, r
+
+
+def _ln_bwd_input(dy, gamma, xhat, r):
+    """Gradient w.r.t. the LN input (gamma/beta grads handled by caller)."""
+    dxhat = dy * gamma
+    return r * (dxhat
+                - jnp.mean(dxhat, axis=-1, keepdims=True)
+                - xhat * jnp.mean(dxhat * xhat, axis=-1, keepdims=True))
+
+
+# ===========================================================================
+# vanilla LSTM
+# ===========================================================================
+
+
+def _lstm_gates(pre, c_prev, mask, *, forget_bias, with_mask):
+    h = c_prev.shape[-1]
+    i = jax.nn.sigmoid(pre[:, :h])
+    g_u = jnp.tanh(pre[:, h:2 * h])
+    g = g_u * mask if with_mask else g_u
+    f = jax.nn.sigmoid(pre[:, 2 * h:3 * h] + forget_bias)
+    o = jax.nn.sigmoid(pre[:, 3 * h:])
+    new_c = c_prev * f + i * g
+    return i, g_u, f, o, new_c
+
+
+def _lstm_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref, mask_ref,
+                     hs_ref, cs_ref, cT_ref, hT_ref,
+                     c_scr, h_scr, *, forget_bias, with_mask):
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(it == 0)
+    def _():
+        c_scr[:] = c0_ref[:]
+        h_scr[:] = h0_ref[:]
+
+    c, h = c_scr[:], h_scr[:]
+    x = x_ref[0]
+    pre = (jnp.dot(_cast(x, wx_ref), wx_ref[:],
+                   preferred_element_type=jnp.float32)
+           + b_ref[0]
+           + jnp.dot(_cast(h, wh_ref), wh_ref[:],
+                     preferred_element_type=jnp.float32))
+    m = mask_ref[0] if with_mask else None
+    _, _, _, o, new_c = _lstm_gates(pre, c, m, forget_bias=forget_bias,
+                                    with_mask=with_mask)
+    new_h = jnp.tanh(new_c) * o
+
+    cs_ref[0] = c          # PRE-step cell state: the backward's residual
+    c_scr[:] = new_c
+    h_scr[:] = new_h
+    hs_ref[0] = new_h
+
+    @pl.when(it == nt - 1)
+    def _():
+        cT_ref[:] = new_c
+        hT_ref[:] = new_h
+
+
+def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
+                     dhs_ref, dcT_ref, dhT_ref,
+                     dx_ref, dwx_ref, db_ref, dwh_ref, dc0_ref, dh0_ref,
+                     dc_scr, dh_scr, *, forget_bias, with_mask):
+    """Reverse-time inner grid: program (ib, it) handles step T-1-it."""
+    ib = pl.program_id(0)
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when((ib == 0) & (it == 0))
+    def _():
+        dwx_ref[:] = jnp.zeros_like(dwx_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+        dwh_ref[:] = jnp.zeros_like(dwh_ref)
+
+    @pl.when(it == 0)
+    def _():
+        dc_scr[:] = dcT_ref[:]
+        dh_scr[:] = dhT_ref[:]
+
+    # ---- recompute the forward step (the whole point of this kernel) ----
+    x, h_prev, c_prev = x_ref[0], hp_ref[0], cs_ref[0]
+    pre = (jnp.dot(_cast(x, wx_ref), wx_ref[:],
+                   preferred_element_type=jnp.float32)
+           + b_ref[0]
+           + jnp.dot(_cast(h_prev, wh_ref), wh_ref[:],
+                     preferred_element_type=jnp.float32))
+    m = mask_ref[0] if with_mask else None
+    i, g_u, f, o, new_c = _lstm_gates(pre, c_prev, m,
+                                      forget_bias=forget_bias,
+                                      with_mask=with_mask)
+    tanh_c = jnp.tanh(new_c)
+
+    # ---- backward gate math ----
+    dh = dh_scr[:] + dhs_ref[0]
+    dc = dc_scr[:] + dh * o * (1.0 - tanh_c * tanh_c)
+    do = dh * tanh_c
+    df = dc * c_prev
+    g = g_u * m if with_mask else g_u
+    di = dc * g
+    dg_u = dc * i * m if with_mask else dc * i
+    d_pre = jnp.concatenate([
+        di * i * (1.0 - i),
+        dg_u * (1.0 - g_u * g_u),
+        df * f * (1.0 - f),
+        do * o * (1.0 - o),
+    ], axis=-1)
+
+    d_pre_c = _cast(d_pre, wx_ref)
+    dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
+                        preferred_element_type=jnp.float32)
+    dwx_ref[:] += jnp.dot(_cast(x, wx_ref).T, d_pre_c,
+                          preferred_element_type=jnp.float32)
+    db_ref[0] += jnp.sum(d_pre, axis=0)
+    dh_scr[:] = jnp.dot(d_pre_c, wh_ref[:].T,
+                        preferred_element_type=jnp.float32)
+    dwh_ref[:] += jnp.dot(_cast(h_prev, wh_ref).T, d_pre_c,
+                          preferred_element_type=jnp.float32)
+    dc_scr[:] = dc * f
+
+    @pl.when(it == nt - 1)
+    def _():
+        dc0_ref[:] = dc_scr[:]
+        dh0_ref[:] = dh_scr[:]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def fused_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array, wh: jax.Array,
+               c0: jax.Array, h0: jax.Array, forget_bias: float = 1.0,
+               masks: Optional[jax.Array] = None
+               ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Fused LSTM over a whole sequence, recompute-backward.
+
+    Args:
+      xs: ``[T, B, D]`` raw inputs (projection happens in-kernel).
+      wx: ``[D, 4H]`` input weights (pre-cast for mixed precision).
+      b: ``[4H]`` bias. wh: ``[H, 4H]`` recurrent weights.
+      c0, h0: ``[B, H]`` initial carry. forget_bias: static.
+      masks: optional ``[T, B, H]`` recurrent-dropout masks on the
+        candidate gate (cotangent defined as zero).
+
+    Returns ``(hs [T, B, H], (cT, hT))``.
+    """
+    hs, cT, hT, _ = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks)
+    return hs, (cT, hT)
+
+
+def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks):
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    bt = _batch_tile(bsz)
+    nbt = bsz // bt
+    with_mask = masks is not None
+    mask_arg = masks if with_mask else jnp.zeros((t, 1, 1), jnp.float32)
+    b2 = b.reshape(1, -1).astype(jnp.float32)
+
+    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
+                                    memory_space=pltpu.VMEM)
+    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
+                                    memory_space=pltpu.VMEM)
+    whole = lambda shape: pl.BlockSpec(
+        shape, lambda ib, it: tuple(0 for _ in shape),
+        memory_space=pltpu.VMEM)
+    mask_spec = step((bt, h)) if with_mask else whole(mask_arg.shape)
+
+    kernel = functools.partial(_lstm_fwd_kernel, forget_bias=forget_bias,
+                               with_mask=with_mask)
+    hs, cs, cT, hT = pl.pallas_call(
+        kernel,
+        grid=(nbt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape), tile((bt, h)), tile((bt, h)), mask_spec],
+        out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
+                   tile((bt, h))),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),  # hs
+            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),  # cs (c_{t-1})
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),     # cT
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),     # hT
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, h), jnp.float32)],
+        interpret=_interpret_default(),
+    )(xs, wx, b2, wh, c0, h0, mask_arg)
+    return hs, cT, hT, cs
+
+
+def _fused_lstm_fwd(xs, wx, b, wh, c0, h0, forget_bias, masks):
+    hs, cT, hT, cs = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias,
+                                    masks)
+    return (hs, (cT, hT)), (xs, wx, b, wh, h0, hs, cs, masks)
+
+
+def _fused_lstm_bwd(forget_bias, res, grads):
+    xs, wx, b, wh, h0, hs, cs, masks = res
+    dhs, (dcT, dhT) = grads
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    bt = _batch_tile(bsz)
+    nbt = bsz // bt
+    with_mask = masks is not None
+    mask_arg = masks if with_mask else jnp.zeros((t, 1, 1), jnp.float32)
+    b2 = b.reshape(1, -1).astype(jnp.float32)
+    h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    rev = lambda a: jnp.flip(a, axis=0)
+
+    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
+                                    memory_space=pltpu.VMEM)
+    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
+                                    memory_space=pltpu.VMEM)
+    whole = lambda shape: pl.BlockSpec(
+        shape, lambda ib, it: tuple(0 for _ in shape),
+        memory_space=pltpu.VMEM)
+    mask_spec = step((bt, h)) if with_mask else whole(mask_arg.shape)
+
+    kernel = functools.partial(_lstm_bwd_kernel, forget_bias=forget_bias,
+                               with_mask=with_mask)
+    dxs_rev, dwx, db2, dwh, dc0, dh0 = pl.pallas_call(
+        kernel,
+        grid=(nbt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape), step((bt, h)), step((bt, h)), mask_spec,
+                  step((bt, h)), tile((bt, h)), tile((bt, h))],
+        out_specs=(step((bt, d)), whole(wx.shape), whole(b2.shape),
+                   whole(wh.shape), tile((bt, h)), tile((bt, h))),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, bsz, d), jnp.float32),
+            jax.ShapeDtypeStruct(wx.shape, jnp.float32),
+            jax.ShapeDtypeStruct(b2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(wh.shape, jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, h), jnp.float32)],
+        interpret=_interpret_default(),
+    )(rev(xs), wx, b2, wh, rev(cs), rev(h_prev),
+      rev(mask_arg) if with_mask else mask_arg, rev(dhs), dcT, dhT)
+    dmasks = jnp.zeros_like(masks) if masks is not None else None
+    # cotangent dtypes must match the primals (wx/wh may be pre-cast bf16)
+    return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
+            db2.reshape(-1).astype(b.dtype), dwh.astype(wh.dtype),
+            dc0, dh0, dmasks)
+
+
+fused_lstm.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
+
+
+# ===========================================================================
+# LayerNorm LSTM
+# ===========================================================================
+
+
+def _ln_gates(pre, c_prev, mask, gam, bet, gc, bc, *, forget_bias,
+              with_mask, want_residuals: bool):
+    """Shared fwd gate math; optionally returns LN residuals for backward."""
+    h = c_prev.shape[-1]
+    ys, xhats, rs = [], [], []
+    for j in range(4):
+        y, xhat, r = _ln_fwd(pre[:, j * h:(j + 1) * h],
+                             gam[j][None, :], bet[j][None, :])
+        ys.append(y)
+        xhats.append(xhat)
+        rs.append(r)
+    i = jax.nn.sigmoid(ys[0])
+    g_u = jnp.tanh(ys[1])
+    g = g_u * mask if with_mask else g_u
+    f = jax.nn.sigmoid(ys[2] + forget_bias)
+    o = jax.nn.sigmoid(ys[3])
+    new_c = c_prev * f + i * g
+    yc, xhat_c, r_c = _ln_fwd(new_c, gc[0][None, :], bc[0][None, :])
+    new_h = jnp.tanh(yc) * o
+    if not want_residuals:
+        return new_c, new_h
+    return (i, g_u, f, o, new_c, new_h, yc, xhat_c, r_c, xhats, rs)
+
+
+def _lnlstm_fwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
+                       bc_ref, c0_ref, h0_ref, mask_ref,
+                       hs_ref, cs_ref, cT_ref, hT_ref,
+                       c_scr, h_scr, *, forget_bias, with_mask):
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(it == 0)
+    def _():
+        c_scr[:] = c0_ref[:]
+        h_scr[:] = h0_ref[:]
+
+    c, h = c_scr[:], h_scr[:]
+    pre = (jnp.dot(_cast(x_ref[0], wx_ref), wx_ref[:],
+                   preferred_element_type=jnp.float32)
+           + jnp.dot(_cast(h, wh_ref), wh_ref[:],
+                     preferred_element_type=jnp.float32))
+    m = mask_ref[0] if with_mask else None
+    new_c, new_h = _ln_gates(pre, c, m, gam_ref[...], bet_ref[...],
+                             gc_ref[...], bc_ref[...],
+                             forget_bias=forget_bias, with_mask=with_mask,
+                             want_residuals=False)
+    cs_ref[0] = c
+    c_scr[:] = new_c
+    h_scr[:] = new_h
+    hs_ref[0] = new_h
+
+    @pl.when(it == nt - 1)
+    def _():
+        cT_ref[:] = new_c
+        hT_ref[:] = new_h
+
+
+def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
+                       bc_ref, cs_ref, hp_ref, mask_ref,
+                       dhs_ref, dcT_ref, dhT_ref,
+                       dx_ref, dwx_ref, dwh_ref, dgam_ref, dbet_ref,
+                       dgc_ref, dbc_ref, dc0_ref, dh0_ref,
+                       dc_scr, dh_scr, *, forget_bias, with_mask):
+    ib = pl.program_id(0)
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when((ib == 0) & (it == 0))
+    def _():
+        dwx_ref[:] = jnp.zeros_like(dwx_ref)
+        dwh_ref[:] = jnp.zeros_like(dwh_ref)
+        dgam_ref[:] = jnp.zeros_like(dgam_ref)
+        dbet_ref[:] = jnp.zeros_like(dbet_ref)
+        dgc_ref[:] = jnp.zeros_like(dgc_ref)
+        dbc_ref[:] = jnp.zeros_like(dbc_ref)
+
+    @pl.when(it == 0)
+    def _():
+        dc_scr[:] = dcT_ref[:]
+        dh_scr[:] = dhT_ref[:]
+
+    x, h_prev, c_prev = x_ref[0], hp_ref[0], cs_ref[0]
+    gam, bet = gam_ref[...], bet_ref[...]
+    gc, bc = gc_ref[...], bc_ref[...]
+    pre = (jnp.dot(_cast(x, wx_ref), wx_ref[:],
+                   preferred_element_type=jnp.float32)
+           + jnp.dot(_cast(h_prev, wh_ref), wh_ref[:],
+                     preferred_element_type=jnp.float32))
+    m = mask_ref[0] if with_mask else None
+    (i, g_u, f, o, new_c, _, yc, xhat_c, r_c, xhats, rs) = _ln_gates(
+        pre, c_prev, m, gam, bet, gc, bc, forget_bias=forget_bias,
+        with_mask=with_mask, want_residuals=True)
+    tanh_yc = jnp.tanh(yc)
+
+    dh = dh_scr[:] + dhs_ref[0]
+    do = dh * tanh_yc
+    dyc = dh * o * (1.0 - tanh_yc * tanh_yc)
+    dgc_ref[0] += jnp.sum(dyc * xhat_c, axis=0)
+    dbc_ref[0] += jnp.sum(dyc, axis=0)
+    dc = dc_scr[:] + _ln_bwd_input(dyc, gc[0][None, :], xhat_c, r_c)
+
+    df = dc * c_prev
+    g = g_u * m if with_mask else g_u
+    di = dc * g
+    dg_u = dc * i * m if with_mask else dc * i
+    dys = [di * i * (1.0 - i),
+           dg_u * (1.0 - g_u * g_u),
+           df * f * (1.0 - f),
+           do * o * (1.0 - o)]
+    d_pre_parts = []
+    for j in range(4):
+        dgam_ref[j] += jnp.sum(dys[j] * xhats[j], axis=0)
+        dbet_ref[j] += jnp.sum(dys[j], axis=0)
+        d_pre_parts.append(
+            _ln_bwd_input(dys[j], gam[j][None, :], xhats[j], rs[j]))
+    d_pre = jnp.concatenate(d_pre_parts, axis=-1)
+
+    d_pre_c = _cast(d_pre, wx_ref)
+    dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
+                        preferred_element_type=jnp.float32)
+    dwx_ref[:] += jnp.dot(_cast(x, wx_ref).T, d_pre_c,
+                          preferred_element_type=jnp.float32)
+    dh_scr[:] = jnp.dot(d_pre_c, wh_ref[:].T,
+                        preferred_element_type=jnp.float32)
+    dwh_ref[:] += jnp.dot(_cast(h_prev, wh_ref).T, d_pre_c,
+                          preferred_element_type=jnp.float32)
+    dc_scr[:] = dc * f
+
+    @pl.when(it == nt - 1)
+    def _():
+        dc0_ref[:] = dc_scr[:]
+        dh0_ref[:] = dh_scr[:]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9,))
+def fused_ln_lstm(xs: jax.Array, wx: jax.Array, wh: jax.Array,
+                  ln_gamma: jax.Array, ln_beta: jax.Array,
+                  lnc_gamma: jax.Array, lnc_beta: jax.Array,
+                  c0: jax.Array, h0: jax.Array, forget_bias: float = 1.0,
+                  masks: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Fused LayerNorm-LSTM (the flagship decoder cell), recompute-backward.
+
+    Matches :class:`ops.cells.LayerNormLSTMCell`: per-gate LN with
+    ``ln_gamma/ln_beta [4, H]``, cell-state LN with ``lnc_gamma/lnc_beta
+    [H]``, no linear bias (the LN betas take that role), forget bias added
+    after the LN, dropout on the candidate. Returns ``(hs, (cT, hT))``.
+    """
+    hs, cT, hT, _ = _lnlstm_fwd_call(xs, wx, wh, ln_gamma, ln_beta,
+                                     lnc_gamma, lnc_beta, c0, h0,
+                                     forget_bias, masks)
+    return hs, (cT, hT)
+
+
+def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
+                     masks):
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    bt = _batch_tile(bsz)
+    nbt = bsz // bt
+    with_mask = masks is not None
+    mask_arg = masks if with_mask else jnp.zeros((t, 1, 1), jnp.float32)
+    gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
+
+    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
+                                    memory_space=pltpu.VMEM)
+    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
+                                    memory_space=pltpu.VMEM)
+    whole = lambda shape: pl.BlockSpec(
+        shape, lambda ib, it: tuple(0 for _ in shape),
+        memory_space=pltpu.VMEM)
+    mask_spec = step((bt, h)) if with_mask else whole(mask_arg.shape)
+
+    kernel = functools.partial(_lnlstm_fwd_kernel, forget_bias=forget_bias,
+                               with_mask=with_mask)
+    hs, cs, cT, hT = pl.pallas_call(
+        kernel,
+        grid=(nbt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(wh.shape),
+                  whole(gam.shape), whole(bet.shape), whole(gc2.shape),
+                  whole(bc2.shape), tile((bt, h)), tile((bt, h)), mask_spec],
+        out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
+                   tile((bt, h))),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, h), jnp.float32)],
+        interpret=_interpret_default(),
+    )(xs, wx, wh, gam, bet, gc2, bc2, c0, h0, mask_arg)
+    return hs, cT, hT, cs
+
+
+def _fused_ln_lstm_fwd(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
+                       masks):
+    hs, cT, hT, cs = _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0,
+                                      forget_bias, masks)
+    return (hs, (cT, hT)), (xs, wx, wh, gam, bet, gc, bc, h0, hs, cs, masks)
+
+
+def _fused_ln_lstm_bwd(forget_bias, res, grads):
+    xs, wx, wh, gam, bet, gc, bc, h0, hs, cs, masks = res
+    dhs, (dcT, dhT) = grads
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    bt = _batch_tile(bsz)
+    nbt = bsz // bt
+    with_mask = masks is not None
+    mask_arg = masks if with_mask else jnp.zeros((t, 1, 1), jnp.float32)
+    gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
+    h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    rev = lambda a: jnp.flip(a, axis=0)
+
+    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
+                                    memory_space=pltpu.VMEM)
+    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
+                                    memory_space=pltpu.VMEM)
+    whole = lambda shape: pl.BlockSpec(
+        shape, lambda ib, it: tuple(0 for _ in shape),
+        memory_space=pltpu.VMEM)
+    mask_spec = step((bt, h)) if with_mask else whole(mask_arg.shape)
+
+    kernel = functools.partial(_lnlstm_bwd_kernel, forget_bias=forget_bias,
+                               with_mask=with_mask)
+    (dxs_rev, dwx, dwh, dgam, dbet, dgc2, dbc2,
+     dc0, dh0) = pl.pallas_call(
+        kernel,
+        grid=(nbt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(wh.shape),
+                  whole(gam.shape), whole(bet.shape), whole(gc2.shape),
+                  whole(bc2.shape), step((bt, h)), step((bt, h)), mask_spec,
+                  step((bt, h)), tile((bt, h)), tile((bt, h))],
+        out_specs=(step((bt, d)), whole(wx.shape), whole(wh.shape),
+                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
+                   whole(bc2.shape), tile((bt, h)), tile((bt, h))),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, bsz, d), jnp.float32),
+            jax.ShapeDtypeStruct(wx.shape, jnp.float32),
+            jax.ShapeDtypeStruct(wh.shape, jnp.float32),
+            jax.ShapeDtypeStruct(gam.shape, jnp.float32),
+            jax.ShapeDtypeStruct(bet.shape, jnp.float32),
+            jax.ShapeDtypeStruct(gc2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(bc2.shape, jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, h), jnp.float32)],
+        interpret=_interpret_default(),
+    )(rev(xs), wx, wh, gam, bet, gc2, bc2, rev(cs), rev(h_prev),
+      rev(mask_arg) if with_mask else mask_arg, rev(dhs), dcT, dhT)
+    dmasks = jnp.zeros_like(masks) if masks is not None else None
+    # cotangent dtypes must match the primals (wx/wh may be pre-cast bf16)
+    return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
+            dwh.astype(wh.dtype), dgam, dbet, dgc2.reshape(-1),
+            dbc2.reshape(-1), dc0, dh0, dmasks)
+
+
+fused_ln_lstm.defvjp(_fused_ln_lstm_fwd, _fused_ln_lstm_bwd)

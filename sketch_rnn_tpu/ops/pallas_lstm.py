@@ -31,10 +31,15 @@ Profiling verdict (v5e, T=250 B=128 D=133 H=512, fwd+bwd): this kernel
 ``[T, B, 4H]`` gates (262 MB HBM traffic) while XLA's scan AD saves only
 the small inputs and recomputes gates in the backward, so at sketch-rnn
 shapes the bandwidth bill exceeds the fusion win. Forward-only they tie
-(13.1 vs 12.8 ms). Per SURVEY §7 ("Pallas kernels only if profiling
-shows XLA's scan fusion misses the target") the XLA scan remains the
-default training path; the kernel is kept as the measured alternative
-and for future recompute-style variants.
+(13.1 vs 12.8 ms).
+
+SUPERSEDED: :mod:`sketch_rnn_tpu.ops.pallas_fused` is the production
+kernel family — it keeps the fusion but drops the reserve space
+entirely (recompute backward, input projection in-kernel, batch tiling,
+LayerNorm variant) and BEATS the scan 2.1-2.3x fwd+bwd at the same
+shape (scripts/bench_kernel.py). This module stays as the measured
+negative result that motivated the redesign and as the simplest
+reference implementation of the Pallas sequence-grid pattern.
 """
 
 from __future__ import annotations

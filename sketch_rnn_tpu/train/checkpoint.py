@@ -58,17 +58,23 @@ def save_checkpoint(ckpt_dir: str, state: TrainState, scale_factor: float,
     return data_path
 
 
+def _complete_steps(ckpt_dir: str) -> list:
+    """Steps whose checkpoint is COMPLETE: both the msgpack and its json
+    sidecar exist. A crash mid-save leaves at most one of the pair, and
+    both resume (latest_checkpoint) and cleanup (_prune) must agree on
+    completeness — this helper is the single definition."""
+    return sorted(s for name in os.listdir(ckpt_dir)
+                  if (m := _CKPT_RE.match(name))
+                  and os.path.exists(_paths(ckpt_dir,
+                                            s := int(m.group(1)))[1]))
+
+
 def latest_checkpoint(ckpt_dir: str) -> Optional[int]:
-    """Highest checkpointed step in ``ckpt_dir``, or None."""
+    """Highest COMPLETELY checkpointed step in ``ckpt_dir``, or None."""
     if not os.path.isdir(ckpt_dir):
         return None
-    # a checkpoint only counts when BOTH the msgpack and its json sidecar
-    # exist — a crash mid-save leaves at most one of them, and resume must
-    # fall back to the previous complete pair
-    steps = [s for name in os.listdir(ckpt_dir)
-             if (m := _CKPT_RE.match(name))
-             and os.path.exists(_paths(ckpt_dir, s := int(m.group(1)))[1])]
-    return max(steps) if steps else None
+    steps = _complete_steps(ckpt_dir)
+    return steps[-1] if steps else None
 
 
 def restore_checkpoint(ckpt_dir: str, target: TrainState,
@@ -88,17 +94,18 @@ def restore_checkpoint(ckpt_dir: str, target: TrainState,
     return state, float(meta["scale_factor"]), meta
 
 
-_ANY_CKPT_RE = re.compile(r"^ckpt_(\d+)\.(?:msgpack|json)$")
+_ANY_CKPT_RE = re.compile(r"^ckpt_(\d+)\.(?:msgpack|json)(?:\.tmp)?$")
 
 
 def _prune(ckpt_dir: str, keep: int) -> None:
     """Keep the ``keep`` newest COMPLETE checkpoints; drop everything else,
-    including orphan files from crashed saves (a sidecar-first save that
-    dies mid-write leaves a lone json, which would otherwise accumulate)."""
-    complete = sorted(s for name in os.listdir(ckpt_dir)
-                      if (m := _CKPT_RE.match(name))
-                      and os.path.exists(_paths(ckpt_dir,
-                                                s := int(m.group(1)))[1]))
+    including orphan files from crashed saves (a lone json from a
+    sidecar-first save that died mid-write, or a ``.tmp`` from a crash
+    during the serialization write — both would otherwise accumulate).
+    ``.tmp`` files of kept steps are also stale (the save replaces them
+    before pruning) but are left alone: the next save of that step
+    overwrites them."""
+    complete = _complete_steps(ckpt_dir)
     keep_steps = set(complete[-keep:]) if keep > 0 else set(complete)
     for name in os.listdir(ckpt_dir):
         m = _ANY_CKPT_RE.match(name)

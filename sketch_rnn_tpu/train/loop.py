@@ -19,6 +19,7 @@ import numpy as np
 
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.data.loader import DataLoader
+from sketch_rnn_tpu.data.prefetch import prefetch_batches
 from sketch_rnn_tpu.models.vae import SketchRNN
 from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
 from sketch_rnn_tpu.parallel.multihost import is_primary
@@ -54,16 +55,22 @@ def evaluate(params, loader: DataLoader, eval_step,
             f"examples, batch_size={loader.hps.batch_size}): some host's "
             f"stripe is empty; enlarge the split or reduce host count")
     totals: Dict[str, float] = {}
+    weight_total = 0.0
     for i in range(n):
         batch = loader.get_batch(i)
         if mesh is not None:
             batch = shard_batch(batch, mesh)
         # eval is deterministic (no dropout, z uses the key) — a fixed
         # fold-in per batch keeps the sweep reproducible
-        metrics = eval_step(params, batch, jax.random.fold_in(key, i))
+        metrics = dict(eval_step(params, batch, jax.random.fold_in(key, i)))
+        # batch metrics are weighted means over the real (non-wrap-filled)
+        # rows; combine them weighted by the global real-row count so the
+        # sweep result is the exact mean over the split
+        w = float(metrics.pop("weight_sum", loader.hps.batch_size))
+        weight_total += w
         for k, v in metrics.items():
-            totals[k] = totals.get(k, 0.0) + float(v)
-    return {k: v / n for k, v in totals.items()}
+            totals[k] = totals.get(k, 0.0) + float(v) * w
+    return {k: v / max(weight_total, 1.0) for k, v in totals.items()}
 
 
 def train(hps: HParams,
@@ -123,14 +130,17 @@ def train(hps: HParams,
         if span[0] < span[1]:  # enough post-compile steps left to trace
             profile_span = span
     trace_active = False
+    # overlapped input pipeline: batch assembly + sharded device transfer
+    # happen on a producer thread, hidden behind the previous step's
+    # device compute (SURVEY §7 "input pipeline that doesn't starve 8
+    # chips"); prefetch_depth=0 gives the synchronous feed
+    feeder = prefetch_batches(train_loader, mesh, hps.prefetch_depth)
     try:
         while step < num_steps:
             if profile_span and step == profile_span[0]:
                 jax.profiler.start_trace(f"{workdir}/trace")
                 trace_active = True
-            batch = train_loader.random_batch()
-            if mesh is not None:
-                batch = shard_batch(batch, mesh)
+            batch = feeder.get()
             # key is a pure function of (seed, step): a resumed run
             # continues the stream instead of replaying the pre-checkpoint
             # keys
@@ -162,6 +172,7 @@ def train(hps: HParams,
             if write_dir and step % hps.save_every == 0:
                 save_checkpoint(write_dir, state, scale_factor, hps)
     finally:
+        feeder.close()
         # a check_finite/evaluate/save raise must not leave an open trace
         # session (the partial trace would be unusable and the session
         # poisons any later start_trace in this process)

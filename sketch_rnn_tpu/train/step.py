@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh
 
@@ -85,6 +86,13 @@ def make_eval_step(model, hps: HParams,
     def eval_fn(params, batch: Batch, key: jax.Array) -> Metrics:
         _, metrics = model.loss(params, batch, key,
                                 kl_weight=1.0, train=False)
+        # GLOBAL count of real (weight>0) rows, computed on device so each
+        # host sees the cluster-wide value — the eval sweep weights batch
+        # averages by it (wrap-filled duplicate rows carry weight 0)
+        if "weights" in batch:
+            metrics["weight_sum"] = jnp.sum(batch["weights"])
+        else:
+            metrics["weight_sum"] = jnp.float32(batch["strokes"].shape[0])
         return metrics
 
     if mesh is None:

@@ -1,0 +1,54 @@
+"""Shared fixture code for the 2-process multi-host test: both the worker
+processes and the in-process single-process reference must build EXACTLY
+the same model, corpus striping, batch sequence and PRNG keys, so any
+parameter divergence isolates the multi-process mechanics."""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+
+HPS = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=8, dec_rnn_size=12,
+              z_size=4, num_mixture=2, hyper_rnn_size=8, hyper_embed_size=4,
+              use_recurrent_dropout=False, prefetch_depth=0)
+
+CORPUS_SIZE = 24
+
+
+def make_striped_loader(hps: HParams, host_id: int,
+                        num_hosts: int) -> DataLoader:
+    """Deterministic stripe of a fixed synthetic corpus (no augmentation,
+    ordered get_batch access — no RNG involved in batch composition)."""
+    seqs, labels = make_synthetic_strokes(CORPUS_SIZE, min_len=8,
+                                          max_len=20, seed=0)
+    return DataLoader(seqs[host_id::num_hosts], hps,
+                      labels=labels[host_id::num_hosts],
+                      global_size=CORPUS_SIZE, num_hosts=num_hosts, seed=0)
+
+
+def step_keys(n: int) -> Iterator:
+    import jax
+
+    root = jax.random.key(42)
+    return (jax.random.fold_in(root, i) for i in range(n))
+
+
+def dump_params(params, path: str, extra: Optional[dict] = None) -> None:
+    """Flatten a params pytree to a keyed npz (replicated arrays: take the
+    first addressable shard)."""
+    import jax
+
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in kp)
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_data"):
+            leaf = leaf.addressable_data(0)
+        flat[name] = np.asarray(leaf)
+    for k, v in (extra or {}).items():
+        flat[f"__extra__/{k}"] = np.asarray(v)
+    np.savez(path, **flat)

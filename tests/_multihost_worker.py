@@ -1,0 +1,69 @@
+"""Worker process for the 2-process multi-host CPU test.
+
+Launched by tests/test_multihost.py as::
+
+    python _multihost_worker.py <rank> <nproc> <coordinator> <outdir>
+
+Each worker joins the ``jax.distributed`` cluster (the DCN path of
+SURVEY.md §2 component 18 — the reference's NCCL multi-node equivalent),
+contributes 2 virtual CPU devices, runs 3 deterministic data-parallel
+training steps over the global 4-device mesh feeding only its OWN stripe
+of the corpus, and dumps its replicated parameters for the test to
+compare across processes and against a single-process run.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank, nproc = int(sys.argv[1]), int(sys.argv[2])
+    coordinator, outdir = sys.argv[3], sys.argv[4]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=nproc, process_id=rank)
+    assert jax.process_count() == nproc
+    assert jax.device_count() == 2 * nproc
+    assert jax.local_device_count() == 2
+
+    import numpy as np
+
+    from sketch_rnn_tpu.parallel import multihost as mh
+    from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+    from tests._multihost_common import (
+        HPS, dump_params, make_striped_loader, step_keys)
+    from sketch_rnn_tpu.models.vae import SketchRNN
+
+    assert mh.process_index() == rank and not mh.is_primary() == bool(rank)
+    lhps = mh.local_batch_hps(HPS)
+    assert lhps.batch_size == HPS.batch_size // nproc
+    loader = make_striped_loader(lhps, host_id=rank, num_hosts=nproc)
+
+    model = SketchRNN(HPS)
+    mesh = make_mesh(HPS)
+    state = make_train_state(model, HPS, jax.random.key(0))
+    step = make_train_step(model, HPS, mesh)
+    for i, key in enumerate(step_keys(3)):
+        local = loader.get_batch(i % max(loader.num_batches, 1))
+        state, metrics = step(state, shard_batch(local, mesh), key)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss)
+
+    dump_params(state.params, os.path.join(outdir, f"params_{rank}.npz"),
+                extra={"loss": loss})
+    print(f"[worker {rank}] done, loss={loss:.5f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""Two-process multi-host data parallelism over CPU (SURVEY.md §2
+component 18 DCN path; VERDICT r1 'missing' #3).
+
+Spawns 2 real OS processes that form a ``jax.distributed`` cluster of
+2x2 virtual CPU devices, each feeding its own host stripe of the corpus,
+and asserts:
+
+1. the run completes (collectives over the loopback DCN work),
+2. parameters are bit-identical across the two processes (the replicated
+   DP invariant), and
+3. parameters match a single-process run of the same global computation
+   (4-device mesh, same global batches) — the multi-process mechanics
+   change nothing but the transport.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _clean_env() -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_two_process_dp_matches_single_process(tmp_path):
+    nproc = 2
+    coordinator = f"127.0.0.1:{_free_port()}"
+    outdir = str(tmp_path)
+    worker = os.path.join(REPO, "tests", "_multihost_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(rank), str(nproc), coordinator, outdir],
+        env=_clean_env(), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for rank in range(nproc)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=540)
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {rank} failed:\n{out}"
+
+    loaded = [np.load(os.path.join(outdir, f"params_{r}.npz"))
+              for r in range(nproc)]
+    keys = set(loaded[0].files)
+    assert keys == set(loaded[1].files) and len(keys) > 4
+
+    # (2) replicated params identical across processes, bitwise
+    for k in keys:
+        np.testing.assert_array_equal(loaded[0][k], loaded[1][k],
+                                      err_msg=f"cross-process mismatch: {k}")
+
+    # (3) equal to the same computation in ONE process (the in-process
+    # 8-virtual-device platform from conftest.py; mesh restricted to 4
+    # devices to match the cluster) feeding the concatenated global batch
+    import jax
+
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+    from tests._multihost_common import (
+        HPS, dump_params, make_striped_loader, step_keys)
+
+    lhps = HPS.replace(batch_size=HPS.batch_size // nproc)
+    stripes = [make_striped_loader(lhps, host_id=r, num_hosts=nproc)
+               for r in range(nproc)]
+    model = SketchRNN(HPS)
+    mesh = make_mesh(HPS, devices=jax.devices()[:4])
+    state = make_train_state(model, HPS, jax.random.key(0))
+    step = make_train_step(model, HPS, mesh)
+    for i, key in enumerate(step_keys(3)):
+        locals_ = [s.get_batch(i % max(s.num_batches, 1)) for s in stripes]
+        # multi-process global-array layout: process-local rows concatenate
+        # in process order (mesh device order is [p0d0, p0d1, p1d0, p1d1])
+        batch = {k: np.concatenate([lb[k] for lb in locals_])
+                 for k in locals_[0]}
+        state, _ = step(state, shard_batch(batch, mesh), key)
+    ref_path = os.path.join(outdir, "params_ref.npz")
+    dump_params(state.params, ref_path)
+    ref = np.load(ref_path)
+
+    for k in (set(keys) - {"__extra__/loss"}):
+        np.testing.assert_allclose(
+            loaded[0][k], ref[k], rtol=2e-6, atol=2e-7,
+            err_msg=f"multi-process vs single-process mismatch: {k}")

@@ -1,0 +1,99 @@
+"""Overlapped input pipeline tests (SURVEY.md §7 'input pipeline').
+
+The contract: prefetching changes throughput, never results — the
+prefetched batch sequence is bit-identical to a synchronous feed, and a
+training run with prefetch on equals one with it off.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+from sketch_rnn_tpu.data.prefetch import Prefetcher, prefetch_batches
+
+TINY = dict(batch_size=8, max_seq_len=32, enc_rnn_size=12, dec_rnn_size=16,
+            z_size=6, num_mixture=3, hyper_rnn_size=8, hyper_embed_size=4)
+
+
+def make_loader(seed=0):
+    hps = HParams(**TINY)
+    seqs, labels = make_synthetic_strokes(40, min_len=8, max_len=30,
+                                          seed=seed)
+    return DataLoader(seqs, hps, labels=labels, seed=seed), hps
+
+
+def test_prefetch_matches_synchronous_sequence():
+    sync_loader, _ = make_loader(seed=3)
+    pre_loader, _ = make_loader(seed=3)
+    want = [sync_loader.random_batch() for _ in range(12)]
+    with prefetch_batches(pre_loader, mesh=None, depth=3) as feeder:
+        got = [feeder.get() for _ in range(12)]
+    for w, g in zip(want, got):
+        for k in w:
+            np.testing.assert_array_equal(w[k], g[k])
+
+
+def test_prefetch_device_put_sequence():
+    # with a mesh the producer thread also does the sharded transfer;
+    # values must still match the host sequence exactly
+    from sketch_rnn_tpu.parallel.mesh import make_mesh
+    sync_loader, hps = make_loader(seed=5)
+    pre_loader, _ = make_loader(seed=5)
+    mesh = make_mesh(hps)
+    want = [sync_loader.random_batch() for _ in range(4)]
+    with prefetch_batches(pre_loader, mesh=mesh, depth=2) as feeder:
+        for w in want:
+            g = feeder.get()
+            assert isinstance(g["strokes"], jax.Array)
+            for k in w:
+                np.testing.assert_array_equal(w[k], np.asarray(g[k]))
+
+
+def test_prefetch_propagates_producer_error():
+    calls = {"n": 0}
+
+    def producer():
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("loader exploded")
+        return calls["n"]
+
+    with Prefetcher(producer, depth=1) as feeder:
+        assert feeder.get() == 1
+        assert feeder.get() == 2
+        with pytest.raises(RuntimeError, match="loader exploded"):
+            feeder.get()
+
+
+def test_prefetch_close_unblocks_full_queue():
+    feeder = Prefetcher(lambda: 0, depth=1)
+    assert feeder.get() == 0
+    t0 = time.perf_counter()
+    feeder.close()  # producer may be blocked on a full queue; must not hang
+    assert time.perf_counter() - t0 < 5.0
+    feeder.close()  # idempotent
+    with pytest.raises(RuntimeError, match="closed"):
+        feeder.get()
+
+
+def test_train_with_and_without_prefetch_identical():
+    from sketch_rnn_tpu.train.loop import train
+    hps = HParams(**TINY, num_steps=4, save_every=100, eval_every=100,
+                  log_every=2)
+
+    def run(depth):
+        seqs, labels = make_synthetic_strokes(32, min_len=8, max_len=30,
+                                              seed=1)
+        loader = DataLoader(seqs, hps.replace(prefetch_depth=depth),
+                            labels=labels, seed=1)
+        return train(hps.replace(prefetch_depth=depth), loader,
+                     use_mesh=True, seed=0)
+
+    a, b = run(0), run(2)
+    for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                    jax.tree_util.tree_leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -194,6 +194,45 @@ def test_evaluate_split_smaller_than_batch():
     assert "recon" in out and np.isfinite(out["recon"])
 
 
+def test_eval_weight_zero_rows_cannot_affect_metrics():
+    # the wrap-filled tail rows carry weight 0; corrupting them must not
+    # change any eval metric (this is the bias-free weighted-mean contract)
+    hps = tiny_hps()  # batch_size=16
+    model = SketchRNN(hps)
+    loader = make_loader(hps, n=5)
+    params = model.init_params(jax.random.key(0))
+    ev = make_eval_step(model, hps, mesh=None)
+    batch = loader.get_batch(0)
+    np.testing.assert_array_equal(batch["weights"],
+                                  (np.arange(16) < 5).astype(np.float32))
+    m_ref = ev(params, batch, jax.random.key(7))
+
+    bad = {k: np.array(v) for k, v in batch.items()}
+    bad["strokes"][5:] = bad["strokes"][5:] * 1000.0 + 3.0  # garbage rows
+    bad["seq_len"][5:] = hps.max_seq_len
+    m_bad = ev(params, bad, jax.random.key(7))
+    for k in m_ref:
+        assert float(m_ref[k]) == pytest.approx(float(m_bad[k]), rel=1e-6), k
+    assert float(m_ref["weight_sum"]) == 5.0
+
+
+def test_evaluate_weighted_mean_over_split():
+    # sweep weighting: metrics combine by real-row count, so the result is
+    # the exact split mean — duplicated wrap rows add nothing
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    loader = make_loader(hps, n=21)  # 1 full batch + wrapped tail of 5
+    params = model.init_params(jax.random.key(0))
+    ev = make_eval_step(model, hps, mesh=None)
+    out = evaluate(params, loader, ev, key=jax.random.key(3))
+    # manual: weighted average of the two batch results
+    b0, b1 = loader.get_batch(0), loader.get_batch(1)
+    m0 = ev(params, b0, jax.random.fold_in(jax.random.key(3), 0))
+    m1 = ev(params, b1, jax.random.fold_in(jax.random.key(3), 1))
+    want = (float(m0["recon"]) * 16 + float(m1["recon"]) * 5) / 21
+    assert out["recon"] == pytest.approx(want, rel=1e-6)
+
+
 def test_evaluate_empty_loader_raises_loudly():
     hps = tiny_hps()
     model = SketchRNN(hps)
@@ -298,14 +337,16 @@ def test_checkpoint_prune_removes_orphans(tmp_path):
     d = str(tmp_path)
     save_checkpoint(d, state._replace(step=jnp.asarray(3, jnp.int32)),
                     1.0, hps)
-    # crashed-save debris: a lone sidecar and a lone msgpack
+    # crashed-save debris: a lone sidecar, a lone msgpack, and a .tmp
     open(os.path.join(d, "ckpt_00000005.json"), "w").write("{}")
     open(os.path.join(d, "ckpt_00000007.msgpack"), "wb").write(b"junk")
+    open(os.path.join(d, "ckpt_00000008.msgpack.tmp"), "wb").write(b"junk")
     save_checkpoint(d, state._replace(step=jnp.asarray(9, jnp.int32)),
                     1.0, hps, keep=2)
     names = set(os.listdir(d))
     assert "ckpt_00000005.json" not in names
     assert "ckpt_00000007.msgpack" not in names
+    assert "ckpt_00000008.msgpack.tmp" not in names
     assert latest_checkpoint(d) == 9
 
 
