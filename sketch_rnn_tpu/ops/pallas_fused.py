@@ -59,6 +59,48 @@ def _cast(x, w_ref):
     return x.astype(w_ref.dtype)
 
 
+def _hash32(x):
+    """murmur3-style avalanche over uint32 — a counter-based RNG in plain
+    vector integer ops, so it runs identically on the TPU VPU and in
+    interpret mode (pltpu.prng_* has no CPU lowering), and the backward
+    kernel trivially regenerates the forward's bits from the same
+    counters."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7feb352d)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846ca68b)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _prng_mask(seed_ref, t_real, ib, nbt, shape, keep_prob):
+    """In-kernel recurrent-dropout mask: no [T, B, H] buffer ever exists
+    in HBM (at the flagship batch that buffer is ~1 GB per RNN). The
+    counter is unique per (time step, batch tile, element), so the
+    backward regenerates the exact forward mask by using the same
+    t_real. Counter wraparound at 2^32 only risks (harmless) mask
+    collisions between far-apart elements."""
+    bt, h = shape
+    base = (seed_ref[0, 0].astype(jnp.uint32) * jnp.uint32(2654435761)
+            + (t_real * nbt + ib).astype(jnp.uint32) * jnp.uint32(bt * h))
+    idx = (jax.lax.broadcasted_iota(jnp.uint32, shape, 0) * jnp.uint32(h)
+           + jax.lax.broadcasted_iota(jnp.uint32, shape, 1))
+    bits = _hash32(base + idx)
+    # Mosaic has no uint32->f32 cast; the 24-bit value fits int32 exactly
+    bits24 = jax.lax.bitcast_convert_type(bits >> 8, jnp.int32)
+    u = bits24.astype(jnp.float32) * (1.0 / (1 << 24))
+    return (u < keep_prob).astype(jnp.float32) * (1.0 / keep_prob)
+
+
+def _step_mask(mask_ref, seed_ref, t_real, ib, nbt, shape, keep_prob,
+               mask_mode):
+    if mask_mode == "streamed":
+        return mask_ref[0]
+    if mask_mode == "prng":
+        return _prng_mask(seed_ref, t_real, ib, nbt, shape, keep_prob)
+    return None
+
+
 def _ln_fwd(u, gamma, beta):
     """Row layer-norm; returns (y, xhat, r) for reuse in the backward."""
     mu = jnp.mean(u, axis=-1, keepdims=True)
@@ -82,11 +124,11 @@ def _ln_bwd_input(dy, gamma, xhat, r):
 # ===========================================================================
 
 
-def _lstm_gates(pre, c_prev, mask, *, forget_bias, with_mask):
+def _lstm_gates(pre, c_prev, mask, *, forget_bias):
     h = c_prev.shape[-1]
     i = jax.nn.sigmoid(pre[:, :h])
     g_u = jnp.tanh(pre[:, h:2 * h])
-    g = g_u * mask if with_mask else g_u
+    g = g_u * mask if mask is not None else g_u
     f = jax.nn.sigmoid(pre[:, 2 * h:3 * h] + forget_bias)
     o = jax.nn.sigmoid(pre[:, 3 * h:])
     new_c = c_prev * f + i * g
@@ -94,8 +136,9 @@ def _lstm_gates(pre, c_prev, mask, *, forget_bias, with_mask):
 
 
 def _lstm_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref, mask_ref,
-                     hs_ref, cs_ref, cT_ref, hT_ref,
-                     c_scr, h_scr, *, forget_bias, with_mask):
+                     seed_ref, hs_ref, cs_ref, cT_ref, hT_ref,
+                     c_scr, h_scr, *, forget_bias, mask_mode, keep_prob):
+    ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
 
@@ -111,9 +154,9 @@ def _lstm_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref, mask_ref,
            + b_ref[0]
            + jnp.dot(_cast(h, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
-    m = mask_ref[0] if with_mask else None
-    _, _, _, o, new_c = _lstm_gates(pre, c, m, forget_bias=forget_bias,
-                                    with_mask=with_mask)
+    m = _step_mask(mask_ref, seed_ref, it, ib, pl.num_programs(0),
+                   c.shape, keep_prob, mask_mode)
+    _, _, _, o, new_c = _lstm_gates(pre, c, m, forget_bias=forget_bias)
     new_h = jnp.tanh(new_c) * o
 
     cs_ref[0] = c          # PRE-step cell state: the backward's residual
@@ -128,9 +171,9 @@ def _lstm_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref, mask_ref,
 
 
 def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
-                     dhs_ref, dcT_ref, dhT_ref,
+                     seed_ref, dhs_ref, dcT_ref, dhT_ref,
                      dx_ref, dwx_ref, db_ref, dwh_ref, dc0_ref, dh0_ref,
-                     dc_scr, dh_scr, *, forget_bias, with_mask):
+                     dc_scr, dh_scr, *, forget_bias, mask_mode, keep_prob):
     """Reverse-time inner grid: program (ib, it) handles step T-1-it."""
     ib = pl.program_id(0)
     it = pl.program_id(1)
@@ -154,10 +197,11 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
            + b_ref[0]
            + jnp.dot(_cast(h_prev, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
-    m = mask_ref[0] if with_mask else None
+    # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
+    m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
+                   pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
     i, g_u, f, o, new_c = _lstm_gates(pre, c_prev, m,
-                                      forget_bias=forget_bias,
-                                      with_mask=with_mask)
+                                      forget_bias=forget_bias)
     tanh_c = jnp.tanh(new_c)
 
     # ---- backward gate math ----
@@ -165,9 +209,9 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
     dc = dc_scr[:] + dh * o * (1.0 - tanh_c * tanh_c)
     do = dh * tanh_c
     df = dc * c_prev
-    g = g_u * m if with_mask else g_u
+    g = g_u * m if m is not None else g_u
     di = dc * g
-    dg_u = dc * i * m if with_mask else dc * i
+    dg_u = dc * i * m if m is not None else dc * i
     d_pre = jnp.concatenate([
         di * i * (1.0 - i),
         dg_u * (1.0 - g_u * g_u),
@@ -193,10 +237,49 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
         dh0_ref[:] = dh_scr[:]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _specs(bt, h, d, mask_mode, mask_shape):
+    """Shared BlockSpec builders for the (batch-tile, time) grid."""
+    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
+                                    memory_space=pltpu.VMEM)
+    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
+                                    memory_space=pltpu.VMEM)
+    whole = lambda shape: pl.BlockSpec(
+        shape, lambda ib, it: tuple(0 for _ in shape),
+        memory_space=pltpu.VMEM)
+    mask_spec = step((bt, h)) if mask_mode == "streamed" \
+        else whole(mask_shape)
+    seed_spec = pl.BlockSpec((1, 1), lambda ib, it: (0, 0),
+                             memory_space=pltpu.SMEM)
+    return step, tile, whole, mask_spec, seed_spec
+
+
+def _mask_args(masks, seed, t):
+    """Resolve the dropout mode and its two (possibly dummy) operands."""
+    if masks is not None and seed is not None:
+        raise ValueError("pass masks or dropout_seed, not both")
+    mode = "streamed" if masks is not None else \
+        ("prng" if seed is not None else "none")
+    mask_arg = masks if masks is not None \
+        else jnp.zeros((t, 1, 1), jnp.float32)
+    seed_arg = (jnp.asarray(seed, jnp.int32).reshape(1, 1)
+                if seed is not None else jnp.zeros((1, 1), jnp.int32))
+    return mode, mask_arg, seed_arg
+
+
+def _seed_cotangent(seed):
+    if seed is None:
+        return None
+    import numpy as np
+
+    return np.zeros(jnp.shape(seed), dtype=jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 9))
 def fused_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array, wh: jax.Array,
                c0: jax.Array, h0: jax.Array, forget_bias: float = 1.0,
-               masks: Optional[jax.Array] = None
+               masks: Optional[jax.Array] = None,
+               dropout_seed: Optional[jax.Array] = None,
+               keep_prob: float = 1.0
                ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Fused LSTM over a whole sequence, recompute-backward.
 
@@ -207,38 +290,36 @@ def fused_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array, wh: jax.Array,
       c0, h0: ``[B, H]`` initial carry. forget_bias: static.
       masks: optional ``[T, B, H]`` recurrent-dropout masks on the
         candidate gate (cotangent defined as zero).
+      dropout_seed: optional int32 scalar — draw the masks INSIDE the
+        kernel from the TPU PRNG instead (mutually exclusive with
+        ``masks``; no mask buffer in HBM). ``keep_prob`` (static) is the
+        keep probability for this mode.
 
     Returns ``(hs [T, B, H], (cT, hT))``.
     """
-    hs, cT, hT, _ = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks)
+    hs, cT, hT, _ = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias,
+                                   masks, dropout_seed, keep_prob)
     return hs, (cT, hT)
 
 
-def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks):
+def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
+                   keep_prob):
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz)
-    nbt = bsz // bt
-    with_mask = masks is not None
-    mask_arg = masks if with_mask else jnp.zeros((t, 1, 1), jnp.float32)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
-
-    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
-                                    memory_space=pltpu.VMEM)
-    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
-                                    memory_space=pltpu.VMEM)
-    whole = lambda shape: pl.BlockSpec(
-        shape, lambda ib, it: tuple(0 for _ in shape),
-        memory_space=pltpu.VMEM)
-    mask_spec = step((bt, h)) if with_mask else whole(mask_arg.shape)
+    step, tile, whole, mask_spec, seed_spec = _specs(
+        bt, h, d, mode, mask_arg.shape)
 
     kernel = functools.partial(_lstm_fwd_kernel, forget_bias=forget_bias,
-                               with_mask=with_mask)
+                               mask_mode=mode, keep_prob=keep_prob)
     hs, cs, cT, hT = pl.pallas_call(
         kernel,
-        grid=(nbt, t),
+        grid=(bsz // bt, t),
         in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
-                  whole(wh.shape), tile((bt, h)), tile((bt, h)), mask_spec],
+                  whole(wh.shape), tile((bt, h)), tile((bt, h)), mask_spec,
+                  seed_spec],
         out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
                    tile((bt, h))),
         out_shape=(
@@ -250,46 +331,38 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks):
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
-    )(xs, wx, b2, wh, c0, h0, mask_arg)
+    )(xs, wx, b2, wh, c0, h0, mask_arg, seed_arg)
     return hs, cT, hT, cs
 
 
-def _fused_lstm_fwd(xs, wx, b, wh, c0, h0, forget_bias, masks):
+def _fused_lstm_fwd(xs, wx, b, wh, c0, h0, forget_bias, masks,
+                    dropout_seed, keep_prob):
     hs, cT, hT, cs = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias,
-                                    masks)
-    return (hs, (cT, hT)), (xs, wx, b, wh, h0, hs, cs, masks)
+                                    masks, dropout_seed, keep_prob)
+    return (hs, (cT, hT)), (xs, wx, b, wh, h0, hs, cs, masks, dropout_seed)
 
 
-def _fused_lstm_bwd(forget_bias, res, grads):
-    xs, wx, b, wh, h0, hs, cs, masks = res
+def _fused_lstm_bwd(forget_bias, keep_prob, res, grads):
+    xs, wx, b, wh, h0, hs, cs, masks, seed = res
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz)
-    nbt = bsz // bt
-    with_mask = masks is not None
-    mask_arg = masks if with_mask else jnp.zeros((t, 1, 1), jnp.float32)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
-
-    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
-                                    memory_space=pltpu.VMEM)
-    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
-                                    memory_space=pltpu.VMEM)
-    whole = lambda shape: pl.BlockSpec(
-        shape, lambda ib, it: tuple(0 for _ in shape),
-        memory_space=pltpu.VMEM)
-    mask_spec = step((bt, h)) if with_mask else whole(mask_arg.shape)
+    step, tile, whole, mask_spec, seed_spec = _specs(
+        bt, h, d, mode, mask_arg.shape)
 
     kernel = functools.partial(_lstm_bwd_kernel, forget_bias=forget_bias,
-                               with_mask=with_mask)
+                               mask_mode=mode, keep_prob=keep_prob)
     dxs_rev, dwx, db2, dwh, dc0, dh0 = pl.pallas_call(
         kernel,
-        grid=(nbt, t),
+        grid=(bsz // bt, t),
         in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
                   whole(wh.shape), step((bt, h)), step((bt, h)), mask_spec,
-                  step((bt, h)), tile((bt, h)), tile((bt, h))],
+                  seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h))],
         out_specs=(step((bt, d)), whole(wx.shape), whole(b2.shape),
                    whole(wh.shape), tile((bt, h)), tile((bt, h))),
         out_shape=(
@@ -304,12 +377,13 @@ def _fused_lstm_bwd(forget_bias, res, grads):
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
     )(rev(xs), wx, b2, wh, rev(cs), rev(h_prev),
-      rev(mask_arg) if with_mask else mask_arg, rev(dhs), dcT, dhT)
+      rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
+      rev(dhs), dcT, dhT)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
     # cotangent dtypes must match the primals (wx/wh may be pre-cast bf16)
     return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
             db2.reshape(-1).astype(b.dtype), dwh.astype(wh.dtype),
-            dc0, dh0, dmasks)
+            dc0, dh0, dmasks, _seed_cotangent(seed))
 
 
 fused_lstm.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
@@ -321,7 +395,7 @@ fused_lstm.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
 
 
 def _ln_gates(pre, c_prev, mask, gam, bet, gc, bc, *, forget_bias,
-              with_mask, want_residuals: bool):
+              want_residuals: bool):
     """Shared fwd gate math; optionally returns LN residuals for backward."""
     h = c_prev.shape[-1]
     ys, xhats, rs = [], [], []
@@ -333,7 +407,7 @@ def _ln_gates(pre, c_prev, mask, gam, bet, gc, bc, *, forget_bias,
         rs.append(r)
     i = jax.nn.sigmoid(ys[0])
     g_u = jnp.tanh(ys[1])
-    g = g_u * mask if with_mask else g_u
+    g = g_u * mask if mask is not None else g_u
     f = jax.nn.sigmoid(ys[2] + forget_bias)
     o = jax.nn.sigmoid(ys[3])
     new_c = c_prev * f + i * g
@@ -345,9 +419,10 @@ def _ln_gates(pre, c_prev, mask, gam, bet, gc, bc, *, forget_bias,
 
 
 def _lnlstm_fwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
-                       bc_ref, c0_ref, h0_ref, mask_ref,
+                       bc_ref, c0_ref, h0_ref, mask_ref, seed_ref,
                        hs_ref, cs_ref, cT_ref, hT_ref,
-                       c_scr, h_scr, *, forget_bias, with_mask):
+                       c_scr, h_scr, *, forget_bias, mask_mode, keep_prob):
+    ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
 
@@ -361,10 +436,11 @@ def _lnlstm_fwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
                    preferred_element_type=jnp.float32)
            + jnp.dot(_cast(h, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
-    m = mask_ref[0] if with_mask else None
+    m = _step_mask(mask_ref, seed_ref, it, ib, pl.num_programs(0),
+                   c.shape, keep_prob, mask_mode)
     new_c, new_h = _ln_gates(pre, c, m, gam_ref[...], bet_ref[...],
                              gc_ref[...], bc_ref[...],
-                             forget_bias=forget_bias, with_mask=with_mask,
+                             forget_bias=forget_bias,
                              want_residuals=False)
     cs_ref[0] = c
     c_scr[:] = new_c
@@ -378,11 +454,12 @@ def _lnlstm_fwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
 
 
 def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
-                       bc_ref, cs_ref, hp_ref, mask_ref,
+                       bc_ref, cs_ref, hp_ref, mask_ref, seed_ref,
                        dhs_ref, dcT_ref, dhT_ref,
                        dx_ref, dwx_ref, dwh_ref, dgam_ref, dbet_ref,
                        dgc_ref, dbc_ref, dc0_ref, dh0_ref,
-                       dc_scr, dh_scr, *, forget_bias, with_mask):
+                       dc_scr, dh_scr, *, forget_bias, mask_mode,
+                       keep_prob):
     ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -408,10 +485,12 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
                    preferred_element_type=jnp.float32)
            + jnp.dot(_cast(h_prev, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
-    m = mask_ref[0] if with_mask else None
+    # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
+    m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
+                   pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
     (i, g_u, f, o, new_c, _, yc, xhat_c, r_c, xhats, rs) = _ln_gates(
         pre, c_prev, m, gam, bet, gc, bc, forget_bias=forget_bias,
-        with_mask=with_mask, want_residuals=True)
+        want_residuals=True)
     tanh_yc = jnp.tanh(yc)
 
     dh = dh_scr[:] + dhs_ref[0]
@@ -422,9 +501,9 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
     dc = dc_scr[:] + _ln_bwd_input(dyc, gc[0][None, :], xhat_c, r_c)
 
     df = dc * c_prev
-    g = g_u * m if with_mask else g_u
+    g = g_u * m if m is not None else g_u
     di = dc * g
-    dg_u = dc * i * m if with_mask else dc * i
+    dg_u = dc * i * m if m is not None else dc * i
     dys = [di * i * (1.0 - i),
            dg_u * (1.0 - g_u * g_u),
            df * f * (1.0 - f),
@@ -454,53 +533,50 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
         dh0_ref[:] = dh_scr[:]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9,))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 12))
 def fused_ln_lstm(xs: jax.Array, wx: jax.Array, wh: jax.Array,
                   ln_gamma: jax.Array, ln_beta: jax.Array,
                   lnc_gamma: jax.Array, lnc_beta: jax.Array,
                   c0: jax.Array, h0: jax.Array, forget_bias: float = 1.0,
-                  masks: Optional[jax.Array] = None
+                  masks: Optional[jax.Array] = None,
+                  dropout_seed: Optional[jax.Array] = None,
+                  keep_prob: float = 1.0
                   ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Fused LayerNorm-LSTM (the flagship decoder cell), recompute-backward.
 
     Matches :class:`ops.cells.LayerNormLSTMCell`: per-gate LN with
     ``ln_gamma/ln_beta [4, H]``, cell-state LN with ``lnc_gamma/lnc_beta
     [H]``, no linear bias (the LN betas take that role), forget bias added
-    after the LN, dropout on the candidate. Returns ``(hs, (cT, hT))``.
+    after the LN, dropout on the candidate. Dropout comes as streamed
+    ``masks`` or as in-kernel PRNG draws (``dropout_seed`` + static
+    ``keep_prob`` — no mask buffer in HBM). Returns ``(hs, (cT, hT))``.
     """
     hs, cT, hT, _ = _lnlstm_fwd_call(xs, wx, wh, ln_gamma, ln_beta,
                                      lnc_gamma, lnc_beta, c0, h0,
-                                     forget_bias, masks)
+                                     forget_bias, masks, dropout_seed,
+                                     keep_prob)
     return hs, (cT, hT)
 
 
 def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
-                     masks):
+                     masks, seed, keep_prob):
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz)
-    nbt = bsz // bt
-    with_mask = masks is not None
-    mask_arg = masks if with_mask else jnp.zeros((t, 1, 1), jnp.float32)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
-
-    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
-                                    memory_space=pltpu.VMEM)
-    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
-                                    memory_space=pltpu.VMEM)
-    whole = lambda shape: pl.BlockSpec(
-        shape, lambda ib, it: tuple(0 for _ in shape),
-        memory_space=pltpu.VMEM)
-    mask_spec = step((bt, h)) if with_mask else whole(mask_arg.shape)
+    step, tile, whole, mask_spec, seed_spec = _specs(
+        bt, h, d, mode, mask_arg.shape)
 
     kernel = functools.partial(_lnlstm_fwd_kernel, forget_bias=forget_bias,
-                               with_mask=with_mask)
+                               mask_mode=mode, keep_prob=keep_prob)
     hs, cs, cT, hT = pl.pallas_call(
         kernel,
-        grid=(nbt, t),
+        grid=(bsz // bt, t),
         in_specs=[step((bt, d)), whole(wx.shape), whole(wh.shape),
                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
-                  whole(bc2.shape), tile((bt, h)), tile((bt, h)), mask_spec],
+                  whole(bc2.shape), tile((bt, h)), tile((bt, h)), mask_spec,
+                  seed_spec],
         out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
                    tile((bt, h))),
         out_shape=(
@@ -512,49 +588,42 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
-    )(xs, wx, wh, gam, bet, gc2, bc2, c0, h0, mask_arg)
+    )(xs, wx, wh, gam, bet, gc2, bc2, c0, h0, mask_arg, seed_arg)
     return hs, cT, hT, cs
 
 
 def _fused_ln_lstm_fwd(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
-                       masks):
+                       masks, dropout_seed, keep_prob):
     hs, cT, hT, cs = _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0,
-                                      forget_bias, masks)
-    return (hs, (cT, hT)), (xs, wx, wh, gam, bet, gc, bc, h0, hs, cs, masks)
+                                      forget_bias, masks, dropout_seed,
+                                      keep_prob)
+    return (hs, (cT, hT)), (xs, wx, wh, gam, bet, gc, bc, h0, hs, cs,
+                            masks, dropout_seed)
 
 
-def _fused_ln_lstm_bwd(forget_bias, res, grads):
-    xs, wx, wh, gam, bet, gc, bc, h0, hs, cs, masks = res
+def _fused_ln_lstm_bwd(forget_bias, keep_prob, res, grads):
+    xs, wx, wh, gam, bet, gc, bc, h0, hs, cs, masks, seed = res
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz)
-    nbt = bsz // bt
-    with_mask = masks is not None
-    mask_arg = masks if with_mask else jnp.zeros((t, 1, 1), jnp.float32)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
-
-    step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
-                                    memory_space=pltpu.VMEM)
-    tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
-                                    memory_space=pltpu.VMEM)
-    whole = lambda shape: pl.BlockSpec(
-        shape, lambda ib, it: tuple(0 for _ in shape),
-        memory_space=pltpu.VMEM)
-    mask_spec = step((bt, h)) if with_mask else whole(mask_arg.shape)
+    step, tile, whole, mask_spec, seed_spec = _specs(
+        bt, h, d, mode, mask_arg.shape)
 
     kernel = functools.partial(_lnlstm_bwd_kernel, forget_bias=forget_bias,
-                               with_mask=with_mask)
+                               mask_mode=mode, keep_prob=keep_prob)
     (dxs_rev, dwx, dwh, dgam, dbet, dgc2, dbc2,
      dc0, dh0) = pl.pallas_call(
         kernel,
-        grid=(nbt, t),
+        grid=(bsz // bt, t),
         in_specs=[step((bt, d)), whole(wx.shape), whole(wh.shape),
                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
                   whole(bc2.shape), step((bt, h)), step((bt, h)), mask_spec,
-                  step((bt, h)), tile((bt, h)), tile((bt, h))],
+                  seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h))],
         out_specs=(step((bt, d)), whole(wx.shape), whole(wh.shape),
                    whole(gam.shape), whole(bet.shape), whole(gc2.shape),
                    whole(bc2.shape), tile((bt, h)), tile((bt, h))),
@@ -573,12 +642,13 @@ def _fused_ln_lstm_bwd(forget_bias, res, grads):
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
     )(rev(xs), wx, wh, gam, bet, gc2, bc2, rev(cs), rev(h_prev),
-      rev(mask_arg) if with_mask else mask_arg, rev(dhs), dcT, dhT)
+      rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
+      rev(dhs), dcT, dhT)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
     # cotangent dtypes must match the primals (wx/wh may be pre-cast bf16)
     return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
             dwh.astype(wh.dtype), dgam, dbet, dgc2.reshape(-1),
-            dbc2.reshape(-1), dc0, dh0, dmasks)
+            dbc2.reshape(-1), dc0, dh0, dmasks, _seed_cotangent(seed))
 
 
 fused_ln_lstm.defvjp(_fused_ln_lstm_fwd, _fused_ln_lstm_bwd)

@@ -35,19 +35,21 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen):
 
     Supported for LSTM / LayerNormLSTM cells (the HyperLSTM's nested carry
     stays on the scan path). ``reverse`` flips inputs and outputs around
-    the kernel. ``rdrop_gen`` draws the per-step masks OUTSIDE the kernel
-    (one [T, B, H] buffer — unlike the scan path's in-loop draws; the
-    kernels accept any streamed masks, so the two paths stay
-    distributionally identical).
+    the kernel. ``rdrop_gen`` maps to the kernels' IN-KERNEL PRNG dropout
+    (a seed derived from the key; the TPU PRNG draws each step's mask
+    inside the kernel, so no [T, B, H] mask buffer exists in HBM — the
+    kernel equivalent of the scan path's in-loop draws; distributionally
+    identical, different bits).
     """
     from sketch_rnn_tpu.ops.cells import LayerNormLSTMCell, LSTMCell
     from sketch_rnn_tpu.ops import pallas_fused as PF
 
     masks = rdrop_masks
+    seed, keep = None, 1.0
     if rdrop_gen is not None:
         key, keep = rdrop_gen
-        masks = make_dropout_masks(key, keep, xs.shape[0], xs.shape[1],
-                                   cell.hidden_size)
+        seed = jax.random.randint(key, (), 0, jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)
     if reverse:
         xs = jnp.flip(xs, axis=0)
         if masks is not None:
@@ -60,10 +62,10 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen):
         hs, (cT, hT) = PF.fused_ln_lstm(
             xs, wx, wh, params["ln_gamma"], params["ln_beta"],
             params["lnc_gamma"], params["lnc_beta"], c0, h0,
-            cell.forget_bias, masks)
+            cell.forget_bias, masks, seed, keep)
     else:
         hs, (cT, hT) = PF.fused_lstm(xs, wx, params["b"], wh, c0, h0,
-                                     cell.forget_bias, masks)
+                                     cell.forget_bias, masks, seed, keep)
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return (cT, hT), hs

@@ -137,6 +137,71 @@ def test_bf16_weights_compile_and_are_finite():
         assert np.isfinite(np.asarray(x, np.float32)).all()
 
 
+@pytest.mark.parametrize("cell_cls", [LSTMCell, LayerNormLSTMCell])
+def test_prng_dropout_deterministic_and_distributed(cell_cls):
+    # same seed -> identical output; the dropout must actually drop
+    # (keep<1 changes the output vs no dropout)
+    cell, params, xs, c0, h0 = _setup(cell_cls)
+    seed = jnp.int32(1234)
+
+    def call(s, keep):
+        if isinstance(cell, LayerNormLSTMCell):
+            return fused_ln_lstm(xs, params["wx"], params["wh"],
+                                 params["ln_gamma"], params["ln_beta"],
+                                 params["lnc_gamma"], params["lnc_beta"],
+                                 c0, h0, 1.0, None, s, keep)[0]
+        return fused_lstm(xs, params["wx"], params["b"], params["wh"],
+                          c0, h0, 1.0, None, s, keep)[0]
+
+    a = np.asarray(call(seed, 0.8))
+    b = np.asarray(call(seed, 0.8))
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(call(jnp.int32(77), 0.8))
+    assert not np.allclose(a, c)  # different seed -> different masks
+    d = np.asarray(call(None, 1.0))
+    assert not np.allclose(a, d)  # dropout actually drops
+
+
+def test_prng_dropout_bwd_uses_fwd_masks():
+    # finite differences prove the backward regenerates EXACTLY the
+    # forward's masks (a mismatched mask would show up as a wrong grad)
+    cell, params, xs, c0, h0 = _setup(LSTMCell)
+    seed = jnp.int32(42)
+
+    def loss(wh):
+        hs, _ = fused_lstm(xs, params["wx"], params["b"], wh, c0, h0,
+                           1.0, None, seed, 0.8)
+        return jnp.sum(hs ** 2)
+
+    g = np.asarray(jax.grad(loss)(params["wh"]))
+    # directional derivative along g (f32 losses are too coarse for
+    # single-coordinate or random directions — the signal must dominate
+    # the ~1e-5-relative loss quantization). If the backward regenerated
+    # DIFFERENT masks than the forward, g would not be the true gradient
+    # and the measured slope along g would disagree with |g|.
+    eps = 3e-3
+    v = g / np.linalg.norm(g)
+    fd = (float(loss(params["wh"] + eps * v)) -
+          float(loss(params["wh"] - eps * v))) / (2 * eps)
+    assert float(np.sum(g * v)) == pytest.approx(fd, rel=2e-2)
+
+
+def test_prng_dropout_keep_statistics():
+    # the realized drop rate over the candidate-gate mask should be ~keep
+    cell, params, xs, c0, h0 = _setup(LSTMCell)
+    keep = 0.7
+    # with x=0, b=0, h0=0: g_u = tanh(0 + 0) = 0, so probe via output
+    # variance instead: run with large T*B and compare against the scan
+    # with outside masks — statistics only, so just check mean output
+    # magnitude ratio is within a loose band of 1.0
+    hs_drop, _ = fused_lstm(xs, params["wx"], params["b"], params["wh"],
+                            c0, h0, 1.0, None, jnp.int32(5), keep)
+    hs_ref, _ = fused_lstm(xs, params["wx"], params["b"], params["wh"],
+                           c0, h0, 1.0, None, None, 1.0)
+    ratio = float(jnp.mean(jnp.abs(hs_drop)) / jnp.mean(jnp.abs(hs_ref)))
+    assert 0.7 < ratio < 1.3
+
+
 def test_model_loss_matches_scan_path_eval():
     # full VAE forward (encoder + decoder) with fused_rnn on vs off must
     # agree in eval mode (no dropout -> identical math, kernel vs scan)
