@@ -17,7 +17,8 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from sketch_rnn_tpu.ops.cells import LayerNormLSTMCell, LSTMCell
+from sketch_rnn_tpu.ops.cells import (HyperLSTMCell, LayerNormLSTMCell,
+                                      LSTMCell)
 from sketch_rnn_tpu.ops.pallas_fused import fused_lstm, fused_ln_lstm
 from sketch_rnn_tpu.ops.rnn import run_rnn
 
@@ -84,6 +85,31 @@ def main():
             results[f"{name}/{label}"] = r
             print(f"{name:10s} {label:6s} fwd {r['fwd_ms']:8.2f} ms   "
                   f"fwd+bwd {r['fwdbwd_ms']:8.2f} ms", flush=True)
+
+    # hyper cell: nested carry, dispatched through run_rnn(fused=...) —
+    # the same path the model uses (flagship hyper sizes 256/32)
+    cell = HyperLSTMCell(H, hyper_size=256, embed_size=32, compute_dtype=CD)
+    params = cell.init_params(jax.random.key(0), D)
+    xs = jax.random.normal(jax.random.key(1), (T, B, D))
+    carry0 = cell.initial_carry(B)
+
+    def hyper_loss(fused):
+        def f(params_, xs_):
+            _, hs = run_rnn(cell, params_, xs_, carry0=carry0, fused=fused)
+            return jnp.mean(hs ** 2)
+        return f
+
+    for label, fused in (("scan", False), ("fused", True)):
+        loss = hyper_loss(fused)
+        fwd = jax.jit(loss)
+        fwdbwd = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        r = {
+            "fwd_ms": round(timeit(fwd, params, xs), 2),
+            "fwdbwd_ms": round(timeit(fwdbwd, params, xs), 2),
+        }
+        results[f"hyper/{label}"] = r
+        print(f"{'hyper':10s} {label:6s} fwd {r['fwd_ms']:8.2f} ms   "
+              f"fwd+bwd {r['fwdbwd_ms']:8.2f} ms", flush=True)
 
     print(json.dumps({"shape": [T, B, H, D], "dtype": DT, **results}))
 

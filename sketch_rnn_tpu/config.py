@@ -79,9 +79,9 @@ class HParams:
     # --- TPU / parallelism (component 18) ---
     compute_dtype: str = "float32"     # "bfloat16" for MXU-friendly matmuls
     fused_rnn: bool = False            # Pallas recompute-backward kernels for
-    #   lstm/layer_norm cells (ops/pallas_fused.py; 2.1-2.3x the scan's
-    #   fwd+bwd at the flagship decoder shape on v5e). hyper cells and
-    #   other paths fall back to lax.scan.
+    #   ALL three cells (ops/pallas_fused.py): measured fwd+bwd at the
+    #   flagship decoder shape (T=250 B=128 H=512, f32) on v5e vs scan:
+    #   lstm 10.6->6.6 ms, layer_norm 15.0->7.3 ms, hyper 29.0->12.5 ms.
     remat: bool = False                # jax.checkpoint the RNN scan steps
     #   (trades ~30% step time for the per-step residual memory; enables
     #   global batches >=1024 at max_seq_len=250 on a 16G-HBM chip)

@@ -237,7 +237,7 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
         dh0_ref[:] = dh_scr[:]
 
 
-def _specs(bt, h, d, mask_mode, mask_shape):
+def _specs(bt, h, mask_mode, mask_shape):
     """Shared BlockSpec builders for the (batch-tile, time) grid."""
     step = lambda blk: pl.BlockSpec((1, *blk), lambda ib, it: (it, ib, 0),
                                     memory_space=pltpu.VMEM)
@@ -310,7 +310,7 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     step, tile, whole, mask_spec, seed_spec = _specs(
-        bt, h, d, mode, mask_arg.shape)
+        bt, h, mode, mask_arg.shape)
 
     kernel = functools.partial(_lstm_fwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob)
@@ -353,7 +353,7 @@ def _fused_lstm_bwd(forget_bias, keep_prob, res, grads):
     h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
-        bt, h, d, mode, mask_arg.shape)
+        bt, h, mode, mask_arg.shape)
 
     kernel = functools.partial(_lstm_bwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob)
@@ -566,7 +566,7 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     step, tile, whole, mask_spec, seed_spec = _specs(
-        bt, h, d, mode, mask_arg.shape)
+        bt, h, mode, mask_arg.shape)
 
     kernel = functools.partial(_lnlstm_fwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob)
@@ -612,7 +612,7 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, res, grads):
     h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
-        bt, h, d, mode, mask_arg.shape)
+        bt, h, mode, mask_arg.shape)
 
     kernel = functools.partial(_lnlstm_bwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob)
@@ -652,3 +652,514 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, res, grads):
 
 
 fused_ln_lstm.defvjp(_fused_ln_lstm_fwd, _fused_ln_lstm_bwd)
+
+
+# ===========================================================================
+# HyperLSTM (layer-norm variant — the default and only one make_cell builds)
+# ===========================================================================
+#
+# Per step (ops/cells.py HyperLSTMCell.step_pre):
+#   hyper_pre = x @ wxh_x + h @ wxh_h + b_h + hyper_h @ whh     (aux LSTM)
+#   (hyper_c, hyper_h) <- vanilla LSTM gates
+#   z_p  = hyper_h @ w_hz_p (+ b_hz_p for p in {x, h})           [B, 4e]
+#   s_p  = z_p @ zd_p                                            [B, 4H]
+#   pre  = s_x * (x @ wx) + s_h * (h @ wh) + s_b + b
+#   then per-gate LN -> gates -> cell LN -> h, exactly LayerNormLSTM.
+#
+# The cell's per-gate [e, h] scale projections (a [4, e, h] einsum) become
+# ONE dense block-diagonal [4e, 4H] matmul per path — an MXU-shaped op
+# instead of 12 tiny ones. The wrapper (ops/rnn.py) builds the dense
+# matrix with traced jnp ops, so autodiff slices the dense gradient back
+# to the [4, e, h] blocks for free.
+#
+# Residuals are only the four carry streams (c, h, hyper_c, hyper_h) —
+# [T, B, 2(H+HH)] total, the same footprint scan AD needs for its carries
+# — and the backward recomputes everything else in-step, like the other
+# kernels in this file. The working set is ~2x the LayerNorm kernel's
+# (extra weights + their VMEM-resident gradient accumulators), so the
+# batch tile is capped separately (SRT_HYPER_TILE, default 64 — 128
+# exceeds v5e VMEM in the backward).
+
+import os as _os
+
+_HYPER_MAX_TILE = int(_os.environ.get("SRT_HYPER_TILE", "64"))
+
+
+def _hyper_batch_tile(b: int) -> int:
+    """Largest divisor of ``b`` that fits the hyper kernel's VMEM cap.
+
+    Must DIVIDE the batch — the grid is ``b // bt`` programs, so a
+    non-divisor would silently drop the trailing rows.
+    """
+    for cand in range(min(b, _HYPER_MAX_TILE), 0, -1):
+        if b % cand == 0:
+            return cand
+    return b
+
+
+def _hyper_recompute(x, h, c, hc, hh, wx_ref, b_ref, wh_ref, wxhx_ref,
+                     wxhh_ref, bh_ref, whh_ref, whzx_ref, bhzx_ref,
+                     whzh_ref, bhzh_ref, whzb_ref, zdx_ref, zdh_ref,
+                     zdb_ref, gam_ref, bet_ref, gc_ref, bc_ref, m,
+                     forget_bias, want_residuals):
+    """One forward step from (x, carries); shared by fwd and bwd kernels."""
+    hyper_pre = (jnp.dot(_cast(x, wxhx_ref), wxhx_ref[:],
+                         preferred_element_type=jnp.float32)
+                 + jnp.dot(_cast(h, wxhh_ref), wxhh_ref[:],
+                           preferred_element_type=jnp.float32)
+                 + bh_ref[0]
+                 + jnp.dot(_cast(hh, whh_ref), whh_ref[:],
+                           preferred_element_type=jnp.float32))
+    hi, hg, hf, ho, new_hc = _lstm_gates(hyper_pre, hc, None,
+                                         forget_bias=forget_bias)
+    new_hh = jnp.tanh(new_hc) * ho
+
+    xp = jnp.dot(_cast(x, wx_ref), wx_ref[:],
+                 preferred_element_type=jnp.float32)
+    hp = jnp.dot(_cast(h, wh_ref), wh_ref[:],
+                 preferred_element_type=jnp.float32)
+    zx = jnp.dot(_cast(new_hh, whzx_ref), whzx_ref[:],
+                 preferred_element_type=jnp.float32) + bhzx_ref[0]
+    zh = jnp.dot(_cast(new_hh, whzh_ref), whzh_ref[:],
+                 preferred_element_type=jnp.float32) + bhzh_ref[0]
+    zb = jnp.dot(_cast(new_hh, whzb_ref), whzb_ref[:],
+                 preferred_element_type=jnp.float32)
+    sx = jnp.dot(_cast(zx, zdx_ref), zdx_ref[:],
+                 preferred_element_type=jnp.float32)
+    sh = jnp.dot(_cast(zh, zdh_ref), zdh_ref[:],
+                 preferred_element_type=jnp.float32)
+    sb = jnp.dot(_cast(zb, zdb_ref), zdb_ref[:],
+                 preferred_element_type=jnp.float32)
+    pre = sx * xp + sh * hp + sb + b_ref[0]
+
+    ln = _ln_gates(pre, c, m, gam_ref[...], bet_ref[...], gc_ref[...],
+                   bc_ref[...], forget_bias=forget_bias,
+                   want_residuals=want_residuals)
+    aux = (hi, hg, hf, ho, new_hc, new_hh, xp, hp, zx, zh, zb, sx, sh)
+    return ln, aux
+
+
+def _hyper_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
+                      bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref,
+                      bhzh_ref, whzb_ref, zdx_ref, zdh_ref, zdb_ref,
+                      gam_ref, bet_ref, gc_ref, bc_ref,
+                      c0_ref, h0_ref, hc0_ref, hh0_ref, mask_ref, seed_ref,
+                      hs_ref, cs_ref, hycs_ref, hyhs_ref,
+                      cT_ref, hT_ref, hcT_ref, hhT_ref,
+                      c_scr, h_scr, hc_scr, hh_scr,
+                      *, forget_bias, mask_mode, keep_prob):
+    ib = pl.program_id(0)
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(it == 0)
+    def _():
+        c_scr[:] = c0_ref[:]
+        h_scr[:] = h0_ref[:]
+        hc_scr[:] = hc0_ref[:]
+        hh_scr[:] = hh0_ref[:]
+
+    c, h, hc, hh = c_scr[:], h_scr[:], hc_scr[:], hh_scr[:]
+    m = _step_mask(mask_ref, seed_ref, it, ib, pl.num_programs(0),
+                   c.shape, keep_prob, mask_mode)
+    (new_c, new_h), aux = _hyper_recompute(
+        x_ref[0], h, c, hc, hh, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
+        bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref, bhzh_ref, whzb_ref,
+        zdx_ref, zdh_ref, zdb_ref, gam_ref, bet_ref, gc_ref, bc_ref, m,
+        forget_bias, want_residuals=False)
+    new_hc, new_hh = aux[4], aux[5]
+
+    cs_ref[0] = c            # PRE-step states: the backward's residuals
+    hycs_ref[0] = hc
+    c_scr[:] = new_c
+    h_scr[:] = new_h
+    hc_scr[:] = new_hc
+    hh_scr[:] = new_hh
+    hs_ref[0] = new_h
+    hyhs_ref[0] = new_hh
+
+    @pl.when(it == nt - 1)
+    def _():
+        cT_ref[:] = new_c
+        hT_ref[:] = new_h
+        hcT_ref[:] = new_hc
+        hhT_ref[:] = new_hh
+
+
+def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
+                      bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref,
+                      bhzh_ref, whzb_ref, zdx_ref, zdh_ref, zdb_ref,
+                      gam_ref, bet_ref, gc_ref, bc_ref,
+                      cs_ref, hp_ref, hycs_ref, hyhp_ref, mask_ref, seed_ref,
+                      dhs_ref, dcT_ref, dhT_ref, dhcT_ref, dhhT_ref,
+                      dx_ref, dwx_ref, db_ref, dwh_ref, dwxhx_ref,
+                      dwxhh_ref, dbh_ref, dwhh_ref, dwhzx_ref, dbhzx_ref,
+                      dwhzh_ref, dbhzh_ref, dwhzb_ref, dzdx_ref, dzdh_ref,
+                      dzdb_ref, dgam_ref, dbet_ref, dgc_ref, dbc_ref,
+                      dc0_ref, dh0_ref, dhc0_ref, dhh0_ref,
+                      dc_scr, dh_scr, dhc_scr, dhh_scr,
+                      *, forget_bias, mask_mode, keep_prob):
+    """Reverse-time inner grid: program (ib, it) handles step T-1-it."""
+    ib = pl.program_id(0)
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when((ib == 0) & (it == 0))
+    def _():
+        for r in (dwx_ref, db_ref, dwh_ref, dwxhx_ref, dwxhh_ref, dbh_ref,
+                  dwhh_ref, dwhzx_ref, dbhzx_ref, dwhzh_ref, dbhzh_ref,
+                  dwhzb_ref, dzdx_ref, dzdh_ref, dzdb_ref, dgam_ref,
+                  dbet_ref, dgc_ref, dbc_ref):
+            r[:] = jnp.zeros_like(r)
+
+    @pl.when(it == 0)
+    def _():
+        dc_scr[:] = dcT_ref[:]
+        dh_scr[:] = dhT_ref[:]
+        dhc_scr[:] = dhcT_ref[:]
+        dhh_scr[:] = dhhT_ref[:]
+
+    # ---- recompute the forward step ----
+    x, h_prev, c_prev = x_ref[0], hp_ref[0], cs_ref[0]
+    hc_prev, hh_prev = hycs_ref[0], hyhp_ref[0]
+    # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
+    m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
+                   pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
+    ln, aux = _hyper_recompute(
+        x, h_prev, c_prev, hc_prev, hh_prev, wx_ref, b_ref, wh_ref,
+        wxhx_ref, wxhh_ref, bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref,
+        bhzh_ref, whzb_ref, zdx_ref, zdh_ref, zdb_ref, gam_ref, bet_ref,
+        gc_ref, bc_ref, m, forget_bias, want_residuals=True)
+    (i, g_u, f, o, new_c, _, yc, xhat_c, r_c, xhats, rs) = ln
+    (hi, hg, hf, ho, new_hc, new_hh, xp, hp_, zx, zh, zb, sx, sh) = aux
+    gam, gc = gam_ref[...], gc_ref[...]
+    tanh_yc = jnp.tanh(yc)
+
+    # ---- main LayerNorm-LSTM backward (as in _lnlstm_bwd_kernel) ----
+    dh = dh_scr[:] + dhs_ref[0]
+    do = dh * tanh_yc
+    dyc = dh * o * (1.0 - tanh_yc * tanh_yc)
+    dgc_ref[0] += jnp.sum(dyc * xhat_c, axis=0)
+    dbc_ref[0] += jnp.sum(dyc, axis=0)
+    dc = dc_scr[:] + _ln_bwd_input(dyc, gc[0][None, :], xhat_c, r_c)
+
+    df = dc * c_prev
+    g = g_u * m if m is not None else g_u
+    di = dc * g
+    dg_u = dc * i * m if m is not None else dc * i
+    dys = [di * i * (1.0 - i),
+           dg_u * (1.0 - g_u * g_u),
+           df * f * (1.0 - f),
+           do * o * (1.0 - o)]
+    d_pre_parts = []
+    for j in range(4):
+        dgam_ref[j] += jnp.sum(dys[j] * xhats[j], axis=0)
+        dbet_ref[j] += jnp.sum(dys[j], axis=0)
+        d_pre_parts.append(
+            _ln_bwd_input(dys[j], gam[j][None, :], xhats[j], rs[j]))
+    d_pre = jnp.concatenate(d_pre_parts, axis=-1)
+    dc_scr[:] = dc * f
+
+    # ---- pre = sx*xp + sh*hp + sb + b ----
+    dsx = d_pre * xp
+    dxp = d_pre * sx
+    dsh = d_pre * hp_
+    dhp = d_pre * sh
+    db_ref[0] += jnp.sum(d_pre, axis=0)                       # dsb == d_pre
+
+    # ---- scale projections (dense block-diagonal) ----
+    dsx_c, dsh_c, dsb_c = (_cast(dsx, zdx_ref), _cast(dsh, zdh_ref),
+                           _cast(d_pre, zdb_ref))
+    dzx = jnp.dot(dsx_c, zdx_ref[:].T, preferred_element_type=jnp.float32)
+    dzh = jnp.dot(dsh_c, zdh_ref[:].T, preferred_element_type=jnp.float32)
+    dzb = jnp.dot(dsb_c, zdb_ref[:].T, preferred_element_type=jnp.float32)
+    dzdx_ref[:] += jnp.dot(_cast(zx, zdx_ref).T, dsx_c,
+                           preferred_element_type=jnp.float32)
+    dzdh_ref[:] += jnp.dot(_cast(zh, zdh_ref).T, dsh_c,
+                           preferred_element_type=jnp.float32)
+    dzdb_ref[:] += jnp.dot(_cast(zb, zdb_ref).T, dsb_c,
+                           preferred_element_type=jnp.float32)
+
+    # ---- hyper_h -> z projections ----
+    dzx_c = _cast(dzx, whzx_ref)
+    dzh_c = _cast(dzh, whzh_ref)
+    dzb_c = _cast(dzb, whzb_ref)
+    dhh = (dhh_scr[:]
+           + jnp.dot(dzx_c, whzx_ref[:].T,
+                     preferred_element_type=jnp.float32)
+           + jnp.dot(dzh_c, whzh_ref[:].T,
+                     preferred_element_type=jnp.float32)
+           + jnp.dot(dzb_c, whzb_ref[:].T,
+                     preferred_element_type=jnp.float32))
+    hh_c = _cast(new_hh, whzx_ref)
+    dwhzx_ref[:] += jnp.dot(hh_c.T, dzx_c,
+                            preferred_element_type=jnp.float32)
+    dwhzh_ref[:] += jnp.dot(hh_c.T, dzh_c,
+                            preferred_element_type=jnp.float32)
+    dwhzb_ref[:] += jnp.dot(hh_c.T, dzb_c,
+                            preferred_element_type=jnp.float32)
+    dbhzx_ref[0] += jnp.sum(dzx, axis=0)
+    dbhzh_ref[0] += jnp.sum(dzh, axis=0)
+
+    # ---- aux (vanilla) LSTM backward ----
+    tanh_hc = jnp.tanh(new_hc)
+    dhc = dhc_scr[:] + dhh * ho * (1.0 - tanh_hc * tanh_hc)
+    dho = dhh * tanh_hc
+    dhf = dhc * hc_prev
+    dhi = dhc * hg
+    dhg = dhc * hi
+    dh_pre = jnp.concatenate([
+        dhi * hi * (1.0 - hi),
+        dhg * (1.0 - hg * hg),
+        dhf * hf * (1.0 - hf),
+        dho * ho * (1.0 - ho),
+    ], axis=-1)
+    dhc_scr[:] = dhc * hf
+
+    dh_pre_c = _cast(dh_pre, wxhx_ref)
+    dbh_ref[0] += jnp.sum(dh_pre, axis=0)
+    dwxhx_ref[:] += jnp.dot(_cast(x, wxhx_ref).T, dh_pre_c,
+                            preferred_element_type=jnp.float32)
+    dwxhh_ref[:] += jnp.dot(_cast(h_prev, wxhh_ref).T, dh_pre_c,
+                            preferred_element_type=jnp.float32)
+    dwhh_ref[:] += jnp.dot(_cast(hh_prev, whh_ref).T, dh_pre_c,
+                           preferred_element_type=jnp.float32)
+    dhh_scr[:] = jnp.dot(dh_pre_c, whh_ref[:].T,
+                         preferred_element_type=jnp.float32)
+
+    # ---- main input/recurrent projections + carry-out grads ----
+    dxp_c = _cast(dxp, wx_ref)
+    dhp_c = _cast(dhp, wh_ref)
+    dx_ref[0] = (jnp.dot(dxp_c, wx_ref[:].T,
+                         preferred_element_type=jnp.float32)
+                 + jnp.dot(dh_pre_c, wxhx_ref[:].T,
+                           preferred_element_type=jnp.float32))
+    dwx_ref[:] += jnp.dot(_cast(x, wx_ref).T, dxp_c,
+                          preferred_element_type=jnp.float32)
+    dwh_ref[:] += jnp.dot(_cast(h_prev, wh_ref).T, dhp_c,
+                          preferred_element_type=jnp.float32)
+    dh_scr[:] = (jnp.dot(dhp_c, wh_ref[:].T,
+                         preferred_element_type=jnp.float32)
+                 + jnp.dot(dh_pre_c, wxhh_ref[:].T,
+                           preferred_element_type=jnp.float32))
+
+    @pl.when(it == nt - 1)
+    def _():
+        dc0_ref[:] = dc_scr[:]
+        dh0_ref[:] = dh_scr[:]
+        dhc0_ref[:] = dhc_scr[:]
+        dhh0_ref[:] = dhh_scr[:]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(24, 27))
+def fused_hyper_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array,
+                     wh: jax.Array, wxh_x: jax.Array, wxh_h: jax.Array,
+                     bh: jax.Array, whh: jax.Array,
+                     w_hz_x: jax.Array, b_hz_x: jax.Array,
+                     w_hz_h: jax.Array, b_hz_h: jax.Array,
+                     w_hz_b: jax.Array,
+                     zd_x: jax.Array, zd_h: jax.Array, zd_b: jax.Array,
+                     ln_gamma: jax.Array, ln_beta: jax.Array,
+                     lnc_gamma: jax.Array, lnc_beta: jax.Array,
+                     c0: jax.Array, h0: jax.Array,
+                     hc0: jax.Array, hh0: jax.Array,
+                     forget_bias: float = 1.0,
+                     masks: Optional[jax.Array] = None,
+                     dropout_seed: Optional[jax.Array] = None,
+                     keep_prob: float = 1.0):
+    """Fused HyperLSTM (layer-norm variant), recompute-backward.
+
+    Matches :class:`ops.cells.HyperLSTMCell` with ``use_layer_norm=True``
+    (the only variant ``make_cell`` builds). Weight layout:
+
+    - ``wx [D, 4H]``, ``wh [H, 4H]``, ``b [4H]``: main-gate projections.
+    - ``wxh_x [D, 4HH]``, ``wxh_h [H, 4HH]``, ``bh [4HH]``,
+      ``whh [HH, 4HH]``: the aux LSTM over ``[x; h]`` (its fused input
+      weight split row-wise) and its own recurrent weights.
+    - ``w_hz_p [HH, 4e]`` (+ ``b_hz_p [4e]`` for p ∈ {x, h}): hyper_h →
+      per-gate embeddings.
+    - ``zd_p [4e, 4H]``: DENSE block-diagonal expansion of the cell's
+      ``[4, e, h]`` scale projections (built by the caller with traced
+      jnp ops so the dense cotangent autodiffs back to the blocks).
+    - per-gate LN ``ln_gamma/ln_beta [4, H]``, cell LN ``[H]``.
+
+    Returns ``(hs [T, B, H], ((cT, hT), (hcT, hhT)))`` — the same carry
+    structure as the scan cell.
+    """
+    hs, fin, _ = _hyper_fwd_call(
+        xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
+        b_hz_h, w_hz_b, zd_x, zd_h, zd_b, ln_gamma, ln_beta, lnc_gamma,
+        lnc_beta, c0, h0, hc0, hh0, forget_bias, masks, dropout_seed,
+        keep_prob)
+    return hs, fin
+
+
+def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
+                    w_hz_h, b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet,
+                    gc, bc, c0, h0, hc0, hh0, forget_bias, masks, seed,
+                    keep_prob):
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    hh_size = whh.shape[0]
+    bt = _hyper_batch_tile(bsz)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
+    b2 = b.reshape(1, -1).astype(jnp.float32)
+    bh2 = bh.reshape(1, -1).astype(jnp.float32)
+    bhzx2 = b_hz_x.reshape(1, -1).astype(jnp.float32)
+    bhzh2 = b_hz_h.reshape(1, -1).astype(jnp.float32)
+    gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
+    step, tile, whole, mask_spec, seed_spec = _specs(
+        bt, h, mode, mask_arg.shape)
+
+    kernel = functools.partial(_hyper_fwd_kernel, forget_bias=forget_bias,
+                               mask_mode=mode, keep_prob=keep_prob)
+    hs, cs, hycs, hyhs, cT, hT, hcT, hhT = pl.pallas_call(
+        kernel,
+        grid=(bsz // bt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape), whole(wxh_x.shape), whole(wxh_h.shape),
+                  whole(bh2.shape), whole(whh.shape), whole(w_hz_x.shape),
+                  whole(bhzx2.shape), whole(w_hz_h.shape),
+                  whole(bhzh2.shape), whole(w_hz_b.shape),
+                  whole(zd_x.shape), whole(zd_h.shape), whole(zd_b.shape),
+                  whole(gam.shape), whole(bet.shape), whole(gc2.shape),
+                  whole(bc2.shape), tile((bt, h)), tile((bt, h)),
+                  tile((bt, hh_size)), tile((bt, hh_size)), mask_spec,
+                  seed_spec],
+        out_specs=(step((bt, h)), step((bt, h)), step((bt, hh_size)),
+                   step((bt, hh_size)), tile((bt, h)), tile((bt, h)),
+                   tile((bt, hh_size)), tile((bt, hh_size))),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),       # hs
+            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),       # cs
+            jax.ShapeDtypeStruct((t, bsz, hh_size), jnp.float32),  # hycs
+            jax.ShapeDtypeStruct((t, bsz, hh_size), jnp.float32),  # hyhs
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, hh_size), jnp.float32),
+                        pltpu.VMEM((bt, hh_size), jnp.float32)],
+        interpret=_interpret_default(),
+    )(xs, wx, b2, wh, wxh_x, wxh_h, bh2, whh, w_hz_x, bhzx2, w_hz_h,
+      bhzh2, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc2, bc2, c0, h0, hc0,
+      hh0, mask_arg, seed_arg)
+    return hs, ((cT, hT), (hcT, hhT)), (cs, hycs, hyhs)
+
+
+def _fused_hyper_fwd(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
+                     w_hz_h, b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet,
+                     gc, bc, c0, h0, hc0, hh0, forget_bias, masks,
+                     dropout_seed, keep_prob):
+    hs, fin, (cs, hycs, hyhs) = _hyper_fwd_call(
+        xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
+        b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, c0, h0, hc0,
+        hh0, forget_bias, masks, dropout_seed, keep_prob)
+    res = (xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
+           b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, h0, hh0,
+           hs, cs, hycs, hyhs, masks, dropout_seed)
+    return (hs, fin), res
+
+
+def _fused_hyper_bwd(forget_bias, keep_prob, res, grads):
+    (xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h, b_hz_h,
+     w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, h0, hh0, hs, cs, hycs,
+     hyhs, masks, seed) = res
+    dhs, ((dcT, dhT), (dhcT, dhhT)) = grads
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    hh_size = whh.shape[0]
+    bt = _hyper_batch_tile(bsz)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
+    b2 = b.reshape(1, -1).astype(jnp.float32)
+    bh2 = bh.reshape(1, -1).astype(jnp.float32)
+    bhzx2 = b_hz_x.reshape(1, -1).astype(jnp.float32)
+    bhzh2 = b_hz_h.reshape(1, -1).astype(jnp.float32)
+    gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
+    h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    hyh_prev = jnp.concatenate([hh0[None], hyhs[:-1]], axis=0)
+    rev = lambda a: jnp.flip(a, axis=0)
+    step, tile, whole, mask_spec, seed_spec = _specs(
+        bt, h, mode, mask_arg.shape)
+
+    kernel = functools.partial(_hyper_bwd_kernel, forget_bias=forget_bias,
+                               mask_mode=mode, keep_prob=keep_prob)
+    (dxs_rev, dwx, db2, dwh, dwxhx, dwxhh, dbh2, dwhh, dwhzx, dbhzx2,
+     dwhzh, dbhzh2, dwhzb, dzdx, dzdh, dzdb, dgam, dbet, dgc2, dbc2,
+     dc0, dh0, dhc0, dhh0) = pl.pallas_call(
+        kernel,
+        grid=(bsz // bt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape), whole(wxh_x.shape), whole(wxh_h.shape),
+                  whole(bh2.shape), whole(whh.shape), whole(w_hz_x.shape),
+                  whole(bhzx2.shape), whole(w_hz_h.shape),
+                  whole(bhzh2.shape), whole(w_hz_b.shape),
+                  whole(zd_x.shape), whole(zd_h.shape), whole(zd_b.shape),
+                  whole(gam.shape), whole(bet.shape), whole(gc2.shape),
+                  whole(bc2.shape), step((bt, h)), step((bt, h)),
+                  step((bt, hh_size)), step((bt, hh_size)), mask_spec,
+                  seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h)),
+                  tile((bt, hh_size)), tile((bt, hh_size))],
+        out_specs=(step((bt, d)), whole(wx.shape), whole(b2.shape),
+                   whole(wh.shape), whole(wxh_x.shape), whole(wxh_h.shape),
+                   whole(bh2.shape), whole(whh.shape), whole(w_hz_x.shape),
+                   whole(bhzx2.shape), whole(w_hz_h.shape),
+                   whole(bhzh2.shape), whole(w_hz_b.shape),
+                   whole(zd_x.shape), whole(zd_h.shape), whole(zd_b.shape),
+                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
+                   whole(bc2.shape), tile((bt, h)), tile((bt, h)),
+                   tile((bt, hh_size)), tile((bt, hh_size))),
+        out_shape=(
+            jax.ShapeDtypeStruct((t, bsz, d), jnp.float32),
+            jax.ShapeDtypeStruct(wx.shape, jnp.float32),
+            jax.ShapeDtypeStruct(b2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(wh.shape, jnp.float32),
+            jax.ShapeDtypeStruct(wxh_x.shape, jnp.float32),
+            jax.ShapeDtypeStruct(wxh_h.shape, jnp.float32),
+            jax.ShapeDtypeStruct(bh2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(whh.shape, jnp.float32),
+            jax.ShapeDtypeStruct(w_hz_x.shape, jnp.float32),
+            jax.ShapeDtypeStruct(bhzx2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(w_hz_h.shape, jnp.float32),
+            jax.ShapeDtypeStruct(bhzh2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(w_hz_b.shape, jnp.float32),
+            jax.ShapeDtypeStruct(zd_x.shape, jnp.float32),
+            jax.ShapeDtypeStruct(zd_h.shape, jnp.float32),
+            jax.ShapeDtypeStruct(zd_b.shape, jnp.float32),
+            jax.ShapeDtypeStruct(gam.shape, jnp.float32),
+            jax.ShapeDtypeStruct(bet.shape, jnp.float32),
+            jax.ShapeDtypeStruct(gc2.shape, jnp.float32),
+            jax.ShapeDtypeStruct(bc2.shape, jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, hh_size), jnp.float32),
+                        pltpu.VMEM((bt, hh_size), jnp.float32)],
+        interpret=_interpret_default(),
+    )(rev(xs), wx, b2, wh, wxh_x, wxh_h, bh2, whh, w_hz_x, bhzx2, w_hz_h,
+      bhzh2, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc2, bc2, rev(cs),
+      rev(h_prev), rev(hycs), rev(hyh_prev),
+      rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
+      rev(dhs), dcT, dhT, dhcT, dhhT)
+    dmasks = jnp.zeros_like(masks) if masks is not None else None
+    # cotangent dtypes must match the primals (big weights may be bf16)
+    return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
+            db2.reshape(-1).astype(b.dtype), dwh.astype(wh.dtype),
+            dwxhx.astype(wxh_x.dtype), dwxhh.astype(wxh_h.dtype),
+            dbh2.reshape(-1).astype(bh.dtype), dwhh.astype(whh.dtype),
+            dwhzx.astype(w_hz_x.dtype), dbhzx2.reshape(-1),
+            dwhzh.astype(w_hz_h.dtype), dbhzh2.reshape(-1),
+            dwhzb.astype(w_hz_b.dtype), dzdx.astype(zd_x.dtype),
+            dzdh.astype(zd_h.dtype), dzdb.astype(zd_b.dtype),
+            dgam, dbet, dgc2.reshape(-1), dbc2.reshape(-1),
+            dc0, dh0, dhc0, dhh0, dmasks, _seed_cotangent(seed))
+
+
+fused_hyper_lstm.defvjp(_fused_hyper_fwd, _fused_hyper_bwd)

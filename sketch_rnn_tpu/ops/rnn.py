@@ -30,18 +30,28 @@ def make_dropout_masks(key: jax.Array, keep_prob: float, steps: int,
     return m.astype(jnp.float32) / keep_prob
 
 
+def _block_diag4(w: jax.Array) -> jax.Array:
+    """``[4, e, h] -> [4e, 4h]`` block-diagonal expansion.
+
+    The HyperLSTM kernel runs the per-gate scale projections as ONE dense
+    MXU matmul; traced, so autodiff slices the dense cotangent back to
+    the blocks automatically.
+    """
+    return jax.scipy.linalg.block_diag(*w)
+
+
 def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen):
     """Dispatch to the Pallas recompute-backward kernels (ops.pallas_fused).
 
-    Supported for LSTM / LayerNormLSTM cells (the HyperLSTM's nested carry
-    stays on the scan path). ``reverse`` flips inputs and outputs around
-    the kernel. ``rdrop_gen`` maps to the kernels' IN-KERNEL PRNG dropout
-    (a seed derived from the key; the TPU PRNG draws each step's mask
-    inside the kernel, so no [T, B, H] mask buffer exists in HBM — the
-    kernel equivalent of the scan path's in-loop draws; distributionally
-    identical, different bits).
+    Covers all three cells (LSTM / LayerNormLSTM / HyperLSTM). ``reverse``
+    flips inputs and outputs around the kernel. ``rdrop_gen`` maps to the
+    kernels' IN-KERNEL PRNG dropout (a seed derived from the key; the TPU
+    PRNG draws each step's mask inside the kernel, so no [T, B, H] mask
+    buffer exists in HBM — the kernel equivalent of the scan path's
+    in-loop draws; distributionally identical, different bits).
     """
-    from sketch_rnn_tpu.ops.cells import LayerNormLSTMCell, LSTMCell
+    from sketch_rnn_tpu.ops.cells import (HyperLSTMCell, LayerNormLSTMCell,
+                                          LSTMCell)
     from sketch_rnn_tpu.ops import pallas_fused as PF
 
     masks = rdrop_masks
@@ -54,26 +64,50 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen):
         xs = jnp.flip(xs, axis=0)
         if masks is not None:
             masks = jnp.flip(masks, axis=0)
-    c0, h0 = carry0
     cd = cell.compute_dtype
-    wx = params["wx"].astype(cd) if cd else params["wx"]
-    wh = params["wh"].astype(cd) if cd else params["wh"]
-    if isinstance(cell, LayerNormLSTMCell):
-        hs, (cT, hT) = PF.fused_ln_lstm(
+    cast = (lambda w: w.astype(cd)) if cd else (lambda w: w)
+    wx, wh = cast(params["wx"]), cast(params["wh"])
+    if isinstance(cell, HyperLSTMCell):
+        if not cell.use_layer_norm:
+            raise NotImplementedError(
+                "fused HyperLSTM kernel covers the layer-norm variant "
+                "(the only one make_cell builds)")
+        (c0, h0), (hc0, hh0) = carry0
+        hyper = params["hyper"]
+        d = hyper["wx"].shape[0] - cell.hidden_size
+        hs, fin = PF.fused_hyper_lstm(
+            xs, wx, params["b"], wh,
+            cast(hyper["wx"][:d]), cast(hyper["wx"][d:]), hyper["b"],
+            cast(hyper["wh"]),
+            cast(params["w_hz_x"]), params["b_hz_x"],
+            cast(params["w_hz_h"]), params["b_hz_h"],
+            cast(params["w_hz_b"]),
+            _block_diag4(params["w_zd_x"]), _block_diag4(params["w_zd_h"]),
+            _block_diag4(params["w_zd_b"]),
+            params["ln_gamma"], params["ln_beta"],
+            params["lnc_gamma"], params["lnc_beta"],
+            c0, h0, hc0, hh0, cell.forget_bias, masks, seed, keep)
+    elif isinstance(cell, LayerNormLSTMCell):
+        c0, h0 = carry0
+        hs, fin = PF.fused_ln_lstm(
             xs, wx, wh, params["ln_gamma"], params["ln_beta"],
             params["lnc_gamma"], params["lnc_beta"], c0, h0,
             cell.forget_bias, masks, seed, keep)
     else:
-        hs, (cT, hT) = PF.fused_lstm(xs, wx, params["b"], wh, c0, h0,
-                                     cell.forget_bias, masks, seed, keep)
+        c0, h0 = carry0
+        hs, fin = PF.fused_lstm(xs, wx, params["b"], wh, c0, h0,
+                                cell.forget_bias, masks, seed, keep)
     if reverse:
         hs = jnp.flip(hs, axis=0)
-    return (cT, hT), hs
+    return fin, hs
 
 
 def fused_supported(cell) -> bool:
     """True when ``cell`` has a Pallas fused kernel (ops.pallas_fused)."""
-    from sketch_rnn_tpu.ops.cells import LayerNormLSTMCell, LSTMCell
+    from sketch_rnn_tpu.ops.cells import (HyperLSTMCell, LayerNormLSTMCell,
+                                          LSTMCell)
+    if isinstance(cell, HyperLSTMCell):
+        return cell.use_layer_norm
     return type(cell) in (LSTMCell, LayerNormLSTMCell)
 
 

@@ -12,12 +12,14 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from sketch_rnn_tpu.ops.cells import LayerNormLSTMCell, LSTMCell
+from sketch_rnn_tpu.ops.cells import (HyperLSTMCell, LayerNormLSTMCell,
+                                      LSTMCell)
 from sketch_rnn_tpu.ops.pallas_fused import fused_lstm, fused_ln_lstm
 from sketch_rnn_tpu.ops.rnn import make_dropout_masks, run_rnn
 
 T, B, H, D = 5, 8, 128, 16
 BIG_B = 24  # > _batch_tile(24)=8 -> 3 batch tiles
+HYPER_HH, HYPER_E = 32, 8
 
 
 def _setup(cell_cls, b=B, seed=0):
@@ -200,6 +202,197 @@ def test_prng_dropout_keep_statistics():
                            c0, h0, 1.0, None, None, 1.0)
     ratio = float(jnp.mean(jnp.abs(hs_drop)) / jnp.mean(jnp.abs(hs_ref)))
     assert 0.7 < ratio < 1.3
+
+
+# ---------------------------------------------------------------------------
+# HyperLSTM kernel (nested carry; dispatched through run_rnn(fused=True)).
+#
+# Tolerances are looser than the LSTM/LN kernels': the kernel's dense
+# block-diagonal scale matmul and the cell's [4, e, h] einsum accumulate
+# in different SIMD orders, and per-gate layer-norm gradients amplify that
+# ~1e-6 forward reassociation noise into ~1e-3-relative gradient noise. A
+# real missing gradient path shows up as 10-100% error (measured while
+# building the kernel), so these bands still catch logic bugs; the
+# directional-FD test below pins the fused gradient to the true slope.
+# ---------------------------------------------------------------------------
+
+
+def _setup_hyper(b=B, seed=0):
+    cell = HyperLSTMCell(H, hyper_size=HYPER_HH, embed_size=HYPER_E)
+    params = cell.init_params(jax.random.key(seed), D)
+    # perturb the zero/constant-init hyper projections so every gradient
+    # path is exercised with non-degenerate weights
+    for i, k in enumerate(("w_hz_x", "w_hz_h", "w_zd_x", "w_zd_h",
+                           "w_zd_b")):
+        params[k] = params[k] + 0.05 * jax.random.normal(
+            jax.random.key(100 + i), params[k].shape)
+    xs = jax.random.normal(jax.random.key(seed + 1), (T, b, D))
+    c0 = jax.random.normal(jax.random.key(seed + 2), (b, H)) * 0.3
+    h0 = jax.random.normal(jax.random.key(seed + 3), (b, H)) * 0.3
+    hc0 = jax.random.normal(jax.random.key(seed + 4), (b, HYPER_HH)) * 0.3
+    hh0 = jax.random.normal(jax.random.key(seed + 5), (b, HYPER_HH)) * 0.3
+    return cell, params, xs, ((c0, h0), (hc0, hh0))
+
+
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_hyper_forward_matches_scan(use_mask):
+    cell, params, xs, carry0 = _setup_hyper()
+    masks = (make_dropout_masks(jax.random.key(9), 0.8, T, B, H)
+             if use_mask else None)
+    fin_ref, hs_ref = run_rnn(cell, params, xs, carry0=carry0,
+                              rdrop_masks=masks)
+    fin, hs = run_rnn(cell, params, xs, carry0=carry0, rdrop_masks=masks,
+                      fused=True)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(hs_ref),
+                               rtol=1e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(fin),
+                    jax.tree_util.tree_leaves(fin_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_hyper_gradients_match_scan(use_mask):
+    cell, params, xs, carry0 = _setup_hyper()
+    masks = (make_dropout_masks(jax.random.key(9), 0.8, T, B, H)
+             if use_mask else None)
+    wtgt = jax.random.normal(jax.random.key(7), (T, B, H)) * 0.1
+
+    def make_loss(fused):
+        def f(params_, xs_, carry_):
+            fin, hs = run_rnn(cell, params_, xs_, carry0=carry_,
+                              rdrop_masks=masks, fused=fused)
+            return (jnp.sum(hs * wtgt)
+                    + sum(0.3 * jnp.sum(l)
+                          for l in jax.tree_util.tree_leaves(fin)))
+        return f
+
+    gf = jax.grad(make_loss(True), argnums=(0, 1, 2))(params, xs, carry0)
+    gs = jax.grad(make_loss(False), argnums=(0, 1, 2))(params, xs, carry0)
+    for (ka, a), (kb, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(gf)[0],
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(gs)[0],
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=1e-3,
+                                   err_msg=f"{ka} vs {kb}")
+
+
+def test_hyper_gradients_batch_tiled():
+    cell, params, xs, carry0 = _setup_hyper(b=BIG_B)
+
+    def make_loss(fused):
+        def f(params_):
+            _, hs = run_rnn(cell, params_, xs, carry0=carry0, fused=fused)
+            return jnp.mean(hs ** 2)
+        return f
+
+    gf = jax.grad(make_loss(True))(params)
+    gs = jax.grad(make_loss(False))(params)
+    for (ka, a), (kb, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(gf)[0],
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(gs)[0],
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=1e-3,
+                                   err_msg=f"{ka} vs {kb}")
+
+
+def test_hyper_forward_non_divisible_batch():
+    # regression: B=20 has no divisor in {64..} below the tile cap except
+    # 20 itself via the largest-divisor search — a tile that does not
+    # divide B would silently drop the trailing rows (found in review)
+    cell, params, xs, carry0 = _setup_hyper(b=20)
+    _, hs_ref = run_rnn(cell, params, xs, carry0=carry0)
+    _, hs = run_rnn(cell, params, xs, carry0=carry0, fused=True)
+    np.testing.assert_allclose(np.asarray(hs), np.asarray(hs_ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_hyper_gradient_is_true_slope():
+    # directional finite difference along the fused gradient: guards
+    # against a plausible-but-wrong backward that still matches scan's
+    # numerics-noise band (and vice versa)
+    cell, params, xs, carry0 = _setup_hyper()
+
+    def loss(wh):
+        p = dict(params)
+        p["wh"] = wh
+        _, hs = run_rnn(cell, p, xs, carry0=carry0, fused=True)
+        return jnp.sum(hs ** 2)
+
+    g = np.asarray(jax.grad(loss)(params["wh"]))
+    eps = 3e-3
+    v = g / np.linalg.norm(g)
+    fd = (float(loss(params["wh"] + eps * v)) -
+          float(loss(params["wh"] - eps * v))) / (2 * eps)
+    assert float(np.sum(g * v)) == pytest.approx(fd, rel=2e-2)
+
+
+def test_hyper_prng_dropout_deterministic():
+    cell, params, xs, carry0 = _setup_hyper()
+
+    def call(seed, keep):
+        gen = None if seed is None else (jax.random.key(seed), keep)
+        _, hs = run_rnn(cell, params, xs, carry0=carry0, rdrop_gen=gen,
+                        fused=True)
+        return np.asarray(hs)
+
+    a = call(1234, 0.8)
+    b = call(1234, 0.8)
+    np.testing.assert_array_equal(a, b)
+    c = call(77, 0.8)
+    assert not np.allclose(a, c)   # different seed -> different masks
+    d = call(None, 1.0)
+    assert not np.allclose(a, d)   # dropout actually drops
+
+
+def test_hyper_fused_model_loss_matches_scan_eval():
+    # full VAE forward with a hyper decoder, fused on vs off, eval mode
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+    from sketch_rnn_tpu.models.vae import SketchRNN
+
+    base = dict(batch_size=8, max_seq_len=24, enc_rnn_size=16,
+                dec_rnn_size=128, z_size=6, num_mixture=3,
+                dec_model="hyper", hyper_rnn_size=32, hyper_embed_size=8)
+    seqs, labels = make_synthetic_strokes(16, min_len=8, max_len=20, seed=0)
+    h_off = HParams(**base, fused_rnn=False)
+    h_on = HParams(**base, fused_rnn=True)
+    batch = DataLoader(seqs, h_off, labels=labels).get_batch(0)
+    m_off, m_on = SketchRNN(h_off), SketchRNN(h_on)
+    params = m_off.init_params(jax.random.key(0))
+    key = jax.random.key(1)
+    t_off, _ = m_off.loss(params, batch, key, kl_weight=1.0, train=False)
+    t_on, _ = m_on.loss(params, batch, key, kl_weight=1.0, train=False)
+    np.testing.assert_allclose(float(t_on), float(t_off),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_hyper_fused_train_step_decreases_loss():
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+
+    hps = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=16,
+                  dec_rnn_size=128, z_size=6, num_mixture=3,
+                  dec_model="hyper", hyper_rnn_size=32, hyper_embed_size=8,
+                  fused_rnn=True)
+    seqs, labels = make_synthetic_strokes(16, min_len=8, max_len=20, seed=0)
+    loader = DataLoader(seqs, hps, labels=labels)
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh=None)
+    batch = loader.get_batch(0)
+    losses = []
+    for i in range(8):
+        state, metrics = step(state, batch, jax.random.key(i))
+        losses.append(float(metrics["loss"]))
+        assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0]
 
 
 def test_model_loss_matches_scan_path_eval():
