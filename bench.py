@@ -27,11 +27,11 @@ Env knobs: BENCH_STEPS (timed steps, default 50), BENCH_BATCH,
 BENCH_SEQ_LEN, BENCH_DEC (decoder cell), BENCH_DTYPE (float32|bfloat16),
 BENCH_REMAT (0|1), BENCH_PREFETCH (depth, default 2; 0 = synchronous
 feed), BENCH_FUSED (default 1: Pallas recompute-backward kernels for
-lstm/layer_norm cells — measured +20% end-to-end over the scan path at
-the flagship config; hyper falls back to scan), BENCH_MATRIX=1 (bench
-all three decoder cells; flagship line is still the one JSON line
-printed), BENCH_SAMPLER=1 (also bench the on-device sampler at B in
-{1, 64, 1024}).
+all three cells), BENCH_RESID (fused kernels' residual storage dtype,
+default bfloat16 — halves residual HBM; float32 for exact-AD runs),
+BENCH_MATRIX=1 (bench all three decoder cells; flagship line is still
+the one JSON line printed), BENCH_SAMPLER=1 (also bench the on-device
+sampler at B in {1, 64, 1024}).
 
 Defaults are the measured-best v5e config: bfloat16 matmuls, global batch
 4096/chip (amortizes the per-step dispatch/feed overhead — measured
@@ -60,7 +60,8 @@ def _hist_append(record: dict) -> None:
 
 def bench_train(dec_model: str, steps: int, batch_per_chip: int,
                 seq_len: int, dtype: str, remat: bool,
-                prefetch_depth: int, fused: bool = False) -> dict:
+                prefetch_depth: int, fused: bool = False,
+                resid_dtype: str = "float32") -> dict:
     """Measure train-step throughput for one decoder cell; fresh batch
     per timed step via the prefetch pipeline."""
     from sketch_rnn_tpu.config import get_default_hparams
@@ -76,7 +77,7 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
     hps = get_default_hparams().replace(
         dec_model=dec_model, batch_size=batch, max_seq_len=seq_len,
         compute_dtype=dtype, remat=remat, prefetch_depth=prefetch_depth,
-        fused_rnn=fused)
+        fused_rnn=fused, fused_residual_dtype=resid_dtype)
 
     model = SketchRNN(hps)
     mesh = make_mesh(hps)
@@ -119,6 +120,7 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
     return {
         "kind": "train",
         "fused_rnn": fused,
+        "resid_dtype": resid_dtype,
         "dec_model": dec_model,
         "batch_size": batch,
         "seq_len": seq_len,
@@ -187,6 +189,7 @@ def main() -> int:
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
     depth = int(os.environ.get("BENCH_PREFETCH", "2"))
     fused = os.environ.get("BENCH_FUSED", "1") == "1"
+    resid = os.environ.get("BENCH_RESID", "bfloat16")
     flagship = os.environ.get("BENCH_DEC", "layer_norm")
 
     cells = (("lstm", "layer_norm", "hyper")
@@ -197,12 +200,14 @@ def main() -> int:
         return 2
     results = {}
     for cell in cells:
-        # hyper carries [T, B, 2*hyper_size] extra residual streams; 4096
-        # with them exceeds the 16G HBM, so its matrix row caps at 2048
-        cell_batch = min(batch_per_chip, 2048) if cell == "hyper" \
-            else batch_per_chip
+        # hyper carries [T, B, 2*hyper_size] extra residual streams; with
+        # f32 residuals (or the scan path, which always saves f32 carries)
+        # batch 4096 exceeds the 16G HBM — only bf16 fused residuals fit
+        cell_batch = batch_per_chip
+        if cell == "hyper" and (resid == "float32" or not fused):
+            cell_batch = min(batch_per_chip, 2048)
         r = bench_train(cell, steps, cell_batch, seq_len, dtype,
-                        remat, depth, fused=fused)
+                        remat, depth, fused=fused, resid_dtype=resid)
         results[cell] = r
         _hist_append(r)
         print(f"# {json.dumps(r)}", file=sys.stderr)

@@ -82,6 +82,13 @@ class HParams:
     #   ALL three cells (ops/pallas_fused.py): measured fwd+bwd at the
     #   flagship decoder shape (T=250 B=128 H=512, f32) on v5e vs scan:
     #   lstm 10.6->6.6 ms, layer_norm 15.0->7.3 ms, hyper 29.0->12.5 ms.
+    fused_residual_dtype: str = "float32"  # storage dtype of the fused
+    #   kernels' saved streams (hs + pre-step carries): "bfloat16" halves
+    #   residual HBM footprint/bandwidth — the difference between batch
+    #   4096 fitting and OOM for the hyper decoder on a 16G chip. The
+    #   in-kernel recurrence stays f32, but hs (the RNN's OUTPUT) is
+    #   stored rounded, so downstream activations/losses shift by bf16
+    #   rounding and gradients pick up ~0.4-1% relative recompute noise.
     remat: bool = False                # jax.checkpoint the RNN scan steps
     #   (trades ~30% step time for the per-step residual memory; enables
     #   global batches >=1024 at max_seq_len=250 on a 16G-HBM chip)
@@ -99,6 +106,10 @@ class HParams:
             raise ValueError(
                 f"compute_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.compute_dtype!r}")
+        if self.fused_residual_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"fused_residual_dtype must be 'float32' or 'bfloat16', "
+                f"got {self.fused_residual_dtype!r}")
 
     # -- overrides ---------------------------------------------------------
 

@@ -42,6 +42,12 @@ def _dtype(hps: HParams):
     return {"float32": None, "bfloat16": jnp.bfloat16}[hps.compute_dtype]
 
 
+def _rdtype(hps: HParams):
+    """Fused-kernel residual storage dtype (None = float32)."""
+    return {"float32": None,
+            "bfloat16": jnp.bfloat16}[hps.fused_residual_dtype]
+
+
 class SketchRNN:
     """Static model definition; parameters are explicit pytrees."""
 
@@ -119,7 +125,7 @@ class SketchRNN:
             self.enc_fwd, self.enc_bwd, params["enc_fwd"], params["enc_bwd"],
             x_tm, seq_len=seq_len,
             rdrop_gen_fwd=gen_f, rdrop_gen_bwd=gen_b, remat=hps.remat,
-            fused=hps.fused_rnn)
+            fused=hps.fused_rnn, residual_dtype=_rdtype(hps))
         mu = L.matmul(h_final, params["mu_w"], _dtype(hps)) + params["mu_b"]
         presig = L.matmul(h_final, params["presig_w"], _dtype(hps)) \
             + params["presig_b"]
@@ -173,7 +179,7 @@ class SketchRNN:
         carry0 = self.decoder_initial_carry(params, z, b)
         _, hs = run_rnn(self.dec, params["dec"], inputs, carry0,
                         rdrop_gen=rgen, remat=hps.remat,
-                        fused=hps.fused_rnn)
+                        fused=hps.fused_rnn, residual_dtype=_rdtype(hps))
         if train and key is not None and hps.use_output_dropout:
             keep = hps.output_dropout_keep
             mask = jax.random.bernoulli(kout, keep, hs.shape)

@@ -28,6 +28,19 @@ LN, forget bias after LN, recurrent dropout on the candidate).
 Mixed precision: pass ``wx``/``wh`` already cast (e.g. bfloat16); the
 kernel casts activations to the weight dtype per matmul and accumulates
 in float32 — the same contract as ``ops.linear.matmul``.
+
+``residual_dtype`` (static, default float32) sets the storage dtype of
+the saved streams — ``hs`` (which is ALSO the kernel's output, so the
+model downstream of the RNN sees bf16-rounded activations) and the
+pre-step carries: bfloat16 halves the kernels' HBM residual footprint
+and bandwidth — at the flagship shape that is the difference between
+fitting batch 4096 and OOM for the hyper cell. Carry state, gate math
+and weight-grad accumulation stay float32; the in-kernel recurrence is
+unrounded (each step reads the f32 VMEM carry, not the rounded HBM
+copy), while outputs/residuals are rounded on write, so downstream
+losses shift by bf16 rounding (~1e-2 relative) and gradients pick up
+~0.4-1% relative noise from the recompute — the standard
+mixed-precision activation trade.
 """
 
 from __future__ import annotations
@@ -159,10 +172,11 @@ def _lstm_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref, mask_ref,
     _, _, _, o, new_c = _lstm_gates(pre, c, m, forget_bias=forget_bias)
     new_h = jnp.tanh(new_c) * o
 
-    cs_ref[0] = c          # PRE-step cell state: the backward's residual
+    # PRE-step cell state: the backward's residual (possibly bf16 storage)
+    cs_ref[0] = c.astype(cs_ref.dtype)
     c_scr[:] = new_c
     h_scr[:] = new_h
-    hs_ref[0] = new_h
+    hs_ref[0] = new_h.astype(hs_ref.dtype)
 
     @pl.when(it == nt - 1)
     def _():
@@ -191,7 +205,9 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
         dh_scr[:] = dhT_ref[:]
 
     # ---- recompute the forward step (the whole point of this kernel) ----
-    x, h_prev, c_prev = x_ref[0], hp_ref[0], cs_ref[0]
+    x = x_ref[0]
+    h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
+    c_prev = cs_ref[0].astype(jnp.float32)
     pre = (jnp.dot(_cast(x, wx_ref), wx_ref[:],
                    preferred_element_type=jnp.float32)
            + b_ref[0]
@@ -205,7 +221,7 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
     tanh_c = jnp.tanh(new_c)
 
     # ---- backward gate math ----
-    dh = dh_scr[:] + dhs_ref[0]
+    dh = dh_scr[:] + dhs_ref[0].astype(jnp.float32)
     dc = dc_scr[:] + dh * o * (1.0 - tanh_c * tanh_c)
     do = dh * tanh_c
     df = dc * c_prev
@@ -274,12 +290,13 @@ def _seed_cotangent(seed):
     return np.zeros(jnp.shape(seed), dtype=jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 9, 10))
 def fused_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array, wh: jax.Array,
                c0: jax.Array, h0: jax.Array, forget_bias: float = 1.0,
                masks: Optional[jax.Array] = None,
                dropout_seed: Optional[jax.Array] = None,
-               keep_prob: float = 1.0
+               keep_prob: float = 1.0,
+               residual_dtype=jnp.float32
                ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Fused LSTM over a whole sequence, recompute-backward.
 
@@ -294,16 +311,20 @@ def fused_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array, wh: jax.Array,
         kernel from the TPU PRNG instead (mutually exclusive with
         ``masks``; no mask buffer in HBM). ``keep_prob`` (static) is the
         keep probability for this mode.
+      residual_dtype: storage dtype for ``hs`` and the saved pre-step
+        cell states (bfloat16 halves residual HBM; math stays f32).
 
-    Returns ``(hs [T, B, H], (cT, hT))``.
+    Returns ``(hs [T, B, H], (cT, hT))`` with ``hs`` in
+    ``residual_dtype``; the final carry is always float32.
     """
     hs, cT, hT, _ = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias,
-                                   masks, dropout_seed, keep_prob)
+                                   masks, dropout_seed, keep_prob,
+                                   residual_dtype)
     return hs, (cT, hT)
 
 
 def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
-                   keep_prob):
+                   keep_prob, residual_dtype):
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz)
@@ -323,10 +344,10 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
         out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
                    tile((bt, h))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),  # hs
-            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),  # cs (c_{t-1})
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),     # cT
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),     # hT
+            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),  # hs
+            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),  # cs (c_{t-1})
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),        # cT
+            jax.ShapeDtypeStruct((bsz, h), jnp.float32),        # hT
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
@@ -336,13 +357,14 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
 
 
 def _fused_lstm_fwd(xs, wx, b, wh, c0, h0, forget_bias, masks,
-                    dropout_seed, keep_prob):
+                    dropout_seed, keep_prob, residual_dtype):
     hs, cT, hT, cs = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias,
-                                    masks, dropout_seed, keep_prob)
+                                    masks, dropout_seed, keep_prob,
+                                    residual_dtype)
     return (hs, (cT, hT)), (xs, wx, b, wh, h0, hs, cs, masks, dropout_seed)
 
 
-def _fused_lstm_bwd(forget_bias, keep_prob, res, grads):
+def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     xs, wx, b, wh, h0, hs, cs, masks, seed = res
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
@@ -350,7 +372,7 @@ def _fused_lstm_bwd(forget_bias, keep_prob, res, grads):
     bt = _batch_tile(bsz)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
-    h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
@@ -442,15 +464,51 @@ def _lnlstm_fwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
                              gc_ref[...], bc_ref[...],
                              forget_bias=forget_bias,
                              want_residuals=False)
-    cs_ref[0] = c
+    cs_ref[0] = c.astype(cs_ref.dtype)
     c_scr[:] = new_c
     h_scr[:] = new_h
-    hs_ref[0] = new_h
+    hs_ref[0] = new_h.astype(hs_ref.dtype)
 
     @pl.when(it == nt - 1)
     def _():
         cT_ref[:] = new_c
         hT_ref[:] = new_h
+
+
+def _ln_lstm_bwd_gates(dh, dc_carry, c_prev, m, ln_res, gam, gc,
+                       dgam_ref, dbet_ref, dgc_ref, dbc_ref):
+    """Backward through the LayerNorm-LSTM gate block (shared by the
+    layer_norm and hyper kernels).
+
+    ``ln_res`` is ``_ln_gates(..., want_residuals=True)``'s output for the
+    recomputed step. Accumulates the four LN parameter grads into the
+    given refs in place and returns ``(d_pre [bt, 4H], dc_next)`` — the
+    gradient w.r.t. the pre-LN gate activations and the cell-state carry
+    gradient to propagate to step t-1.
+    """
+    (i, g_u, f, o, _new_c, _new_h, yc, xhat_c, r_c, xhats, rs) = ln_res
+    tanh_yc = jnp.tanh(yc)
+    do = dh * tanh_yc
+    dyc = dh * o * (1.0 - tanh_yc * tanh_yc)
+    dgc_ref[0] += jnp.sum(dyc * xhat_c, axis=0)
+    dbc_ref[0] += jnp.sum(dyc, axis=0)
+    dc = dc_carry + _ln_bwd_input(dyc, gc[0][None, :], xhat_c, r_c)
+
+    df = dc * c_prev
+    g = g_u * m if m is not None else g_u
+    di = dc * g
+    dg_u = dc * i * m if m is not None else dc * i
+    dys = [di * i * (1.0 - i),
+           dg_u * (1.0 - g_u * g_u),
+           df * f * (1.0 - f),
+           do * o * (1.0 - o)]
+    d_pre_parts = []
+    for j in range(4):
+        dgam_ref[j] += jnp.sum(dys[j] * xhats[j], axis=0)
+        dbet_ref[j] += jnp.sum(dys[j], axis=0)
+        d_pre_parts.append(
+            _ln_bwd_input(dys[j], gam[j][None, :], xhats[j], rs[j]))
+    return jnp.concatenate(d_pre_parts, axis=-1), dc * f
 
 
 def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
@@ -478,7 +536,9 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
         dc_scr[:] = dcT_ref[:]
         dh_scr[:] = dhT_ref[:]
 
-    x, h_prev, c_prev = x_ref[0], hp_ref[0], cs_ref[0]
+    x = x_ref[0]
+    h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
+    c_prev = cs_ref[0].astype(jnp.float32)
     gam, bet = gam_ref[...], bet_ref[...]
     gc, bc = gc_ref[...], bc_ref[...]
     pre = (jnp.dot(_cast(x, wx_ref), wx_ref[:],
@@ -488,33 +548,13 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
     # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
     m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
                    pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
-    (i, g_u, f, o, new_c, _, yc, xhat_c, r_c, xhats, rs) = _ln_gates(
-        pre, c_prev, m, gam, bet, gc, bc, forget_bias=forget_bias,
-        want_residuals=True)
-    tanh_yc = jnp.tanh(yc)
+    ln_res = _ln_gates(pre, c_prev, m, gam, bet, gc, bc,
+                       forget_bias=forget_bias, want_residuals=True)
 
-    dh = dh_scr[:] + dhs_ref[0]
-    do = dh * tanh_yc
-    dyc = dh * o * (1.0 - tanh_yc * tanh_yc)
-    dgc_ref[0] += jnp.sum(dyc * xhat_c, axis=0)
-    dbc_ref[0] += jnp.sum(dyc, axis=0)
-    dc = dc_scr[:] + _ln_bwd_input(dyc, gc[0][None, :], xhat_c, r_c)
-
-    df = dc * c_prev
-    g = g_u * m if m is not None else g_u
-    di = dc * g
-    dg_u = dc * i * m if m is not None else dc * i
-    dys = [di * i * (1.0 - i),
-           dg_u * (1.0 - g_u * g_u),
-           df * f * (1.0 - f),
-           do * o * (1.0 - o)]
-    d_pre_parts = []
-    for j in range(4):
-        dgam_ref[j] += jnp.sum(dys[j] * xhats[j], axis=0)
-        dbet_ref[j] += jnp.sum(dys[j], axis=0)
-        d_pre_parts.append(
-            _ln_bwd_input(dys[j], gam[j][None, :], xhats[j], rs[j]))
-    d_pre = jnp.concatenate(d_pre_parts, axis=-1)
+    dh = dh_scr[:] + dhs_ref[0].astype(jnp.float32)
+    d_pre, dc_next = _ln_lstm_bwd_gates(dh, dc_scr[:], c_prev, m, ln_res,
+                                        gam, gc, dgam_ref, dbet_ref,
+                                        dgc_ref, dbc_ref)
 
     d_pre_c = _cast(d_pre, wx_ref)
     dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
@@ -525,7 +565,7 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
                         preferred_element_type=jnp.float32)
     dwh_ref[:] += jnp.dot(_cast(h_prev, wh_ref).T, d_pre_c,
                           preferred_element_type=jnp.float32)
-    dc_scr[:] = dc * f
+    dc_scr[:] = dc_next
 
     @pl.when(it == nt - 1)
     def _():
@@ -533,14 +573,15 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
         dh0_ref[:] = dh_scr[:]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 12))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 12, 13))
 def fused_ln_lstm(xs: jax.Array, wx: jax.Array, wh: jax.Array,
                   ln_gamma: jax.Array, ln_beta: jax.Array,
                   lnc_gamma: jax.Array, lnc_beta: jax.Array,
                   c0: jax.Array, h0: jax.Array, forget_bias: float = 1.0,
                   masks: Optional[jax.Array] = None,
                   dropout_seed: Optional[jax.Array] = None,
-                  keep_prob: float = 1.0
+                  keep_prob: float = 1.0,
+                  residual_dtype=jnp.float32
                   ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Fused LayerNorm-LSTM (the flagship decoder cell), recompute-backward.
 
@@ -549,17 +590,18 @@ def fused_ln_lstm(xs: jax.Array, wx: jax.Array, wh: jax.Array,
     [H]``, no linear bias (the LN betas take that role), forget bias added
     after the LN, dropout on the candidate. Dropout comes as streamed
     ``masks`` or as in-kernel PRNG draws (``dropout_seed`` + static
-    ``keep_prob`` — no mask buffer in HBM). Returns ``(hs, (cT, hT))``.
+    ``keep_prob`` — no mask buffer in HBM). Returns ``(hs, (cT, hT))``
+    with ``hs`` stored in ``residual_dtype``.
     """
     hs, cT, hT, _ = _lnlstm_fwd_call(xs, wx, wh, ln_gamma, ln_beta,
                                      lnc_gamma, lnc_beta, c0, h0,
                                      forget_bias, masks, dropout_seed,
-                                     keep_prob)
+                                     keep_prob, residual_dtype)
     return hs, (cT, hT)
 
 
 def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
-                     masks, seed, keep_prob):
+                     masks, seed, keep_prob, residual_dtype):
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz)
@@ -580,8 +622,8 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
         out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
                    tile((bt, h))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),
-            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),
+            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),
+            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),
             jax.ShapeDtypeStruct((bsz, h), jnp.float32),
             jax.ShapeDtypeStruct((bsz, h), jnp.float32),
         ),
@@ -593,15 +635,15 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
 
 
 def _fused_ln_lstm_fwd(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
-                       masks, dropout_seed, keep_prob):
+                       masks, dropout_seed, keep_prob, residual_dtype):
     hs, cT, hT, cs = _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0,
                                       forget_bias, masks, dropout_seed,
-                                      keep_prob)
+                                      keep_prob, residual_dtype)
     return (hs, (cT, hT)), (xs, wx, wh, gam, bet, gc, bc, h0, hs, cs,
                             masks, dropout_seed)
 
 
-def _fused_ln_lstm_bwd(forget_bias, keep_prob, res, grads):
+def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     xs, wx, wh, gam, bet, gc, bc, h0, hs, cs, masks, seed = res
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
@@ -609,7 +651,7 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, res, grads):
     bt = _batch_tile(bsz)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
-    h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
@@ -769,14 +811,15 @@ def _hyper_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         forget_bias, want_residuals=False)
     new_hc, new_hh = aux[4], aux[5]
 
-    cs_ref[0] = c            # PRE-step states: the backward's residuals
-    hycs_ref[0] = hc
+    # PRE-step states: the backward's residuals (possibly bf16 storage)
+    cs_ref[0] = c.astype(cs_ref.dtype)
+    hycs_ref[0] = hc.astype(hycs_ref.dtype)
     c_scr[:] = new_c
     h_scr[:] = new_h
     hc_scr[:] = new_hc
     hh_scr[:] = new_hh
-    hs_ref[0] = new_h
-    hyhs_ref[0] = new_hh
+    hs_ref[0] = new_h.astype(hs_ref.dtype)
+    hyhs_ref[0] = new_hh.astype(hyhs_ref.dtype)
 
     @pl.when(it == nt - 1)
     def _():
@@ -820,8 +863,11 @@ def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         dhh_scr[:] = dhhT_ref[:]
 
     # ---- recompute the forward step ----
-    x, h_prev, c_prev = x_ref[0], hp_ref[0], cs_ref[0]
-    hc_prev, hh_prev = hycs_ref[0], hyhp_ref[0]
+    x = x_ref[0]
+    h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
+    c_prev = cs_ref[0].astype(jnp.float32)
+    hc_prev = hycs_ref[0].astype(jnp.float32)
+    hh_prev = hyhp_ref[0].astype(jnp.float32)
     # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
     m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
                    pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
@@ -830,35 +876,15 @@ def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         wxhx_ref, wxhh_ref, bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref,
         bhzh_ref, whzb_ref, zdx_ref, zdh_ref, zdb_ref, gam_ref, bet_ref,
         gc_ref, bc_ref, m, forget_bias, want_residuals=True)
-    (i, g_u, f, o, new_c, _, yc, xhat_c, r_c, xhats, rs) = ln
     (hi, hg, hf, ho, new_hc, new_hh, xp, hp_, zx, zh, zb, sx, sh) = aux
     gam, gc = gam_ref[...], gc_ref[...]
-    tanh_yc = jnp.tanh(yc)
 
-    # ---- main LayerNorm-LSTM backward (as in _lnlstm_bwd_kernel) ----
-    dh = dh_scr[:] + dhs_ref[0]
-    do = dh * tanh_yc
-    dyc = dh * o * (1.0 - tanh_yc * tanh_yc)
-    dgc_ref[0] += jnp.sum(dyc * xhat_c, axis=0)
-    dbc_ref[0] += jnp.sum(dyc, axis=0)
-    dc = dc_scr[:] + _ln_bwd_input(dyc, gc[0][None, :], xhat_c, r_c)
-
-    df = dc * c_prev
-    g = g_u * m if m is not None else g_u
-    di = dc * g
-    dg_u = dc * i * m if m is not None else dc * i
-    dys = [di * i * (1.0 - i),
-           dg_u * (1.0 - g_u * g_u),
-           df * f * (1.0 - f),
-           do * o * (1.0 - o)]
-    d_pre_parts = []
-    for j in range(4):
-        dgam_ref[j] += jnp.sum(dys[j] * xhats[j], axis=0)
-        dbet_ref[j] += jnp.sum(dys[j], axis=0)
-        d_pre_parts.append(
-            _ln_bwd_input(dys[j], gam[j][None, :], xhats[j], rs[j]))
-    d_pre = jnp.concatenate(d_pre_parts, axis=-1)
-    dc_scr[:] = dc * f
+    # ---- main LayerNorm-LSTM backward (shared with _lnlstm_bwd_kernel) --
+    dh = dh_scr[:] + dhs_ref[0].astype(jnp.float32)
+    d_pre, dc_next = _ln_lstm_bwd_gates(dh, dc_scr[:], c_prev, m, ln,
+                                        gam, gc, dgam_ref, dbet_ref,
+                                        dgc_ref, dbc_ref)
+    dc_scr[:] = dc_next
 
     # ---- pre = sx*xp + sh*hp + sb + b ----
     dsx = d_pre * xp
@@ -951,7 +977,7 @@ def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         dhh0_ref[:] = dhh_scr[:]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(24, 27))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(24, 27, 28))
 def fused_hyper_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array,
                      wh: jax.Array, wxh_x: jax.Array, wxh_h: jax.Array,
                      bh: jax.Array, whh: jax.Array,
@@ -966,7 +992,8 @@ def fused_hyper_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array,
                      forget_bias: float = 1.0,
                      masks: Optional[jax.Array] = None,
                      dropout_seed: Optional[jax.Array] = None,
-                     keep_prob: float = 1.0):
+                     keep_prob: float = 1.0,
+                     residual_dtype=jnp.float32):
     """Fused HyperLSTM (layer-norm variant), recompute-backward.
 
     Matches :class:`ops.cells.HyperLSTMCell` with ``use_layer_norm=True``
@@ -990,14 +1017,14 @@ def fused_hyper_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array,
         xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
         b_hz_h, w_hz_b, zd_x, zd_h, zd_b, ln_gamma, ln_beta, lnc_gamma,
         lnc_beta, c0, h0, hc0, hh0, forget_bias, masks, dropout_seed,
-        keep_prob)
+        keep_prob, residual_dtype)
     return hs, fin
 
 
 def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
                     w_hz_h, b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet,
                     gc, bc, c0, h0, hc0, hh0, forget_bias, masks, seed,
-                    keep_prob):
+                    keep_prob, residual_dtype):
     t, bsz, d = xs.shape
     h = wh.shape[0]
     hh_size = whh.shape[0]
@@ -1030,10 +1057,10 @@ def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
                    step((bt, hh_size)), tile((bt, h)), tile((bt, h)),
                    tile((bt, hh_size)), tile((bt, hh_size))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),       # hs
-            jax.ShapeDtypeStruct((t, bsz, h), jnp.float32),       # cs
-            jax.ShapeDtypeStruct((t, bsz, hh_size), jnp.float32),  # hycs
-            jax.ShapeDtypeStruct((t, bsz, hh_size), jnp.float32),  # hyhs
+            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),       # hs
+            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),       # cs
+            jax.ShapeDtypeStruct((t, bsz, hh_size), residual_dtype),  # hycs
+            jax.ShapeDtypeStruct((t, bsz, hh_size), residual_dtype),  # hyhs
             jax.ShapeDtypeStruct((bsz, h), jnp.float32),
             jax.ShapeDtypeStruct((bsz, h), jnp.float32),
             jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
@@ -1053,18 +1080,18 @@ def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
 def _fused_hyper_fwd(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
                      w_hz_h, b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet,
                      gc, bc, c0, h0, hc0, hh0, forget_bias, masks,
-                     dropout_seed, keep_prob):
+                     dropout_seed, keep_prob, residual_dtype):
     hs, fin, (cs, hycs, hyhs) = _hyper_fwd_call(
         xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
         b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, c0, h0, hc0,
-        hh0, forget_bias, masks, dropout_seed, keep_prob)
+        hh0, forget_bias, masks, dropout_seed, keep_prob, residual_dtype)
     res = (xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
            b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, h0, hh0,
            hs, cs, hycs, hyhs, masks, dropout_seed)
     return (hs, fin), res
 
 
-def _fused_hyper_bwd(forget_bias, keep_prob, res, grads):
+def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     (xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h, b_hz_h,
      w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, h0, hh0, hs, cs, hycs,
      hyhs, masks, seed) = res
@@ -1079,8 +1106,9 @@ def _fused_hyper_bwd(forget_bias, keep_prob, res, grads):
     bhzx2 = b_hz_x.reshape(1, -1).astype(jnp.float32)
     bhzh2 = b_hz_h.reshape(1, -1).astype(jnp.float32)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
-    h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
-    hyh_prev = jnp.concatenate([hh0[None], hyhs[:-1]], axis=0)
+    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
+    hyh_prev = jnp.concatenate([hh0[None].astype(hyhs.dtype), hyhs[:-1]],
+                               axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)

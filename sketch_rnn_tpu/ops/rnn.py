@@ -40,7 +40,8 @@ def _block_diag4(w: jax.Array) -> jax.Array:
     return jax.scipy.linalg.block_diag(*w)
 
 
-def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen):
+def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
+               residual_dtype=None):
     """Dispatch to the Pallas recompute-backward kernels (ops.pallas_fused).
 
     Covers all three cells (LSTM / LayerNormLSTM / HyperLSTM). ``reverse``
@@ -67,6 +68,7 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen):
     cd = cell.compute_dtype
     cast = (lambda w: w.astype(cd)) if cd else (lambda w: w)
     wx, wh = cast(params["wx"]), cast(params["wh"])
+    rd = residual_dtype if residual_dtype is not None else jnp.float32
     if isinstance(cell, HyperLSTMCell):
         if not cell.use_layer_norm:
             raise NotImplementedError(
@@ -86,17 +88,17 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen):
             _block_diag4(params["w_zd_b"]),
             params["ln_gamma"], params["ln_beta"],
             params["lnc_gamma"], params["lnc_beta"],
-            c0, h0, hc0, hh0, cell.forget_bias, masks, seed, keep)
+            c0, h0, hc0, hh0, cell.forget_bias, masks, seed, keep, rd)
     elif isinstance(cell, LayerNormLSTMCell):
         c0, h0 = carry0
         hs, fin = PF.fused_ln_lstm(
             xs, wx, wh, params["ln_gamma"], params["ln_beta"],
             params["lnc_gamma"], params["lnc_beta"], c0, h0,
-            cell.forget_bias, masks, seed, keep)
+            cell.forget_bias, masks, seed, keep, rd)
     else:
         c0, h0 = carry0
         hs, fin = PF.fused_lstm(xs, wx, params["b"], wh, c0, h0,
-                                cell.forget_bias, masks, seed, keep)
+                                cell.forget_bias, masks, seed, keep, rd)
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return fin, hs
@@ -115,7 +117,8 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
             rdrop_masks: Optional[jax.Array] = None, reverse: bool = False,
             hoist: bool = False,
             rdrop_gen: Optional[Tuple[jax.Array, float]] = None,
-            remat: bool = False, fused: bool = False) -> Tuple[Any, jax.Array]:
+            remat: bool = False, fused: bool = False,
+            residual_dtype=None) -> Tuple[Any, jax.Array]:
     """Scan ``cell`` over time-major inputs ``xs`` of shape ``[T, B, D]``.
 
     Returns ``(final_carry, hs)`` with ``hs`` of shape ``[T, B, H]``.
@@ -145,6 +148,10 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
     intermediates — the standard FLOPs-for-HBM trade that unlocks large
     global batches (the OOM at batch 1024 f32 was exactly these
     residuals).
+
+    ``residual_dtype`` (fused path only): storage dtype for the kernels'
+    saved streams — bfloat16 halves residual HBM footprint/bandwidth at
+    ~0.4% relative gradient noise; None keeps float32.
     """
     if carry0 is None:
         carry0 = cell.initial_carry(xs.shape[1])
@@ -153,11 +160,11 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
 
     if fused and fused_supported(cell):
         # Pallas recompute-backward kernel (ops.pallas_fused): measured
-        # 2.1-2.3x faster fwd+bwd than this scan for the layer_norm cell
-        # at T=250 B=128 H=512 on v5e (scripts/bench_kernel.py); remat is
-        # moot there (the kernel saves only hs/cs and recomputes gates)
+        # 1.6-2.3x faster fwd+bwd than this scan per cell at T=250 B=128
+        # H=512 on v5e (scripts/bench_kernel.py); remat is moot there
+        # (the kernels save only the carry streams and recompute gates)
         return _run_fused(cell, params, xs, carry0, rdrop_masks, reverse,
-                          rdrop_gen)
+                          rdrop_gen, residual_dtype)
 
     inputs = cell.precompute_inputs(params, xs) if hoist else xs
     stepper = cell.step_pre if hoist else cell
@@ -209,6 +216,7 @@ def bidirectional_rnn(cell_fwd, cell_bwd, params_fwd, params_bwd,
                       rdrop_gen_fwd: Optional[Tuple[jax.Array, float]] = None,
                       rdrop_gen_bwd: Optional[Tuple[jax.Array, float]] = None,
                       remat: bool = False, fused: bool = False,
+                      residual_dtype=None,
                       ) -> Tuple[jax.Array, jax.Array]:
     """Forward + backward scans; returns ``(h_final_concat, hs_concat)``.
 
@@ -228,11 +236,13 @@ def bidirectional_rnn(cell_fwd, cell_bwd, params_fwd, params_bwd,
     if seq_len is None:
         fwd_carry, hs_f = run_rnn(cell_fwd, params_fwd, xs,
                                   rdrop_masks=rdrop_masks_fwd,
-                                  rdrop_gen=rdrop_gen_fwd, remat=remat, fused=fused)
+                                  rdrop_gen=rdrop_gen_fwd, remat=remat,
+                                  fused=fused, residual_dtype=residual_dtype)
         bwd_carry, hs_b = run_rnn(cell_bwd, params_bwd, xs,
                                   rdrop_masks=rdrop_masks_bwd,
                                   rdrop_gen=rdrop_gen_bwd, remat=remat,
-                                  reverse=True, fused=fused)
+                                  reverse=True, fused=fused,
+                                  residual_dtype=residual_dtype)
         h_f = final_hidden(cell_fwd, fwd_carry)
         h_b = final_hidden(cell_bwd, bwd_carry)
     else:
@@ -244,11 +254,13 @@ def bidirectional_rnn(cell_fwd, cell_bwd, params_fwd, params_bwd,
         xs_rev = jnp.take_along_axis(xs, rev_idx[:, :, None], axis=0)
         _, hs_f = run_rnn(cell_fwd, params_fwd, xs,
                           rdrop_masks=rdrop_masks_fwd,
-                          rdrop_gen=rdrop_gen_fwd, remat=remat, fused=fused)
+                          rdrop_gen=rdrop_gen_fwd, remat=remat, fused=fused,
+                          residual_dtype=residual_dtype)
         # dropout masks are i.i.d. per step, so they need no matching reversal
         _, hs_b_rev = run_rnn(cell_bwd, params_bwd, xs_rev,
                               rdrop_masks=rdrop_masks_bwd,
-                              rdrop_gen=rdrop_gen_bwd, remat=remat, fused=fused)
+                              rdrop_gen=rdrop_gen_bwd, remat=remat,
+                              fused=fused, residual_dtype=residual_dtype)
         # forward state at the last valid step
         last = jnp.clip(seq_len - 1, 0, t - 1)            # [B]
         h_f = jnp.take_along_axis(

@@ -395,6 +395,52 @@ def test_hyper_fused_train_step_decreases_loss():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.parametrize("cell_kind", ["lstm", "layer_norm", "hyper"])
+def test_bf16_residuals_train_and_match_f32(cell_kind):
+    # bfloat16 residual storage: forward values must match the f32-residual
+    # kernel to bf16 rounding (the forward math is identical — only the
+    # saved streams are rounded), gradients to ~1% (backward recomputes
+    # from rounded residuals), and a train step must still learn
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+
+    hps16 = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=16,
+                    dec_rnn_size=128, z_size=6, num_mixture=3,
+                    dec_model=cell_kind, hyper_rnn_size=32,
+                    hyper_embed_size=8, fused_rnn=True,
+                    fused_residual_dtype="bfloat16")
+    hps32 = hps16.replace(fused_residual_dtype="float32")
+    seqs, labels = make_synthetic_strokes(16, min_len=8, max_len=20, seed=0)
+    batch = DataLoader(seqs, hps16, labels=labels).get_batch(0)
+    m16, m32 = SketchRNN(hps16), SketchRNN(hps32)
+    params = m32.init_params(jax.random.key(0))
+    key = jax.random.key(1)
+    t16, _ = m16.loss(params, batch, key, kl_weight=1.0, train=False)
+    t32, _ = m32.loss(params, batch, key, kl_weight=1.0, train=False)
+    np.testing.assert_allclose(float(t16), float(t32), rtol=2e-2)
+
+    g16 = jax.grad(lambda p: m16.loss(p, batch, key, 1.0, train=False)[0])(
+        params)
+    g32 = jax.grad(lambda p: m32.loss(p, batch, key, 1.0, train=False)[0])(
+        params)
+    n16 = jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2)
+                       for l in jax.tree_util.tree_leaves(g16)))
+    n32 = jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2)
+                       for l in jax.tree_util.tree_leaves(g32)))
+    assert float(n16) == pytest.approx(float(n32), rel=5e-2)
+
+    state = make_train_state(m16, hps16, jax.random.key(0))
+    step = make_train_step(m16, hps16, mesh=None)
+    losses = []
+    for i in range(6):
+        state, metrics = step(state, batch, jax.random.key(i))
+        losses.append(float(metrics["loss"]))
+        assert np.isfinite(losses[-1])
+    assert losses[-1] < losses[0]
+
+
 def test_model_loss_matches_scan_path_eval():
     # full VAE forward (encoder + decoder) with fused_rnn on vs off must
     # agree in eval mode (no dropout -> identical math, kernel vs scan)
