@@ -121,20 +121,28 @@ class DataLoader:
         return out
 
     def _assemble(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
-        raw = []
-        for i in idx:
-            s = self.strokes[i]
-            if self.augment:
-                s = S.random_scale(s, self.hps.random_scale_factor, self.rng)
-                s = S.augment_strokes(s, self.hps.augment_stroke_prob, self.rng)
-            raw.append(s)
-        # hot path: the C++ batcher packs + stroke-5-converts the whole
-        # batch in one native loop (SURVEY §2 component 1 native path);
-        # golden-tested equal to the numpy path below
-        native = NB.assemble_batch(raw, self.hps.max_seq_len)
+        # hot path: the C++ batcher (SURVEY §2 component 1 native path)
+        # runs the whole batch assembly as one native call — at train time
+        # including the augmentations (scale jitter + point dropout), so
+        # no per-sequence Python loop remains. Golden-tested equal to the
+        # numpy path (bit-exact without augmentation, distributionally
+        # with — the native RNG is a counter-based stream, not numpy's).
+        raw = [self.strokes[i] for i in idx]
+        if self.augment:
+            native = NB.assemble_batch_aug(
+                raw, self.hps.max_seq_len,
+                self.hps.random_scale_factor, self.hps.augment_stroke_prob,
+                seed=int(self.rng.integers(0, 2 ** 63)))
+        else:
+            native = NB.assemble_batch(raw, self.hps.max_seq_len)
         if native is not None:
             strokes, seq_len = native
         else:
+            if self.augment:
+                raw = [S.augment_strokes(
+                    S.random_scale(s, self.hps.random_scale_factor,
+                                   self.rng),
+                    self.hps.augment_stroke_prob, self.rng) for s in raw]
             strokes = self._pad_batch(raw)
             seq_len = np.array([len(s) for s in raw], dtype=np.int32)
         return {

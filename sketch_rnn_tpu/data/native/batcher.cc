@@ -3,13 +3,36 @@
 // TPU-native-framework equivalent of the reference's host-side batch
 // assembly (SURVEY.md §2 component 1). The reference leans on external
 // native libraries for its performance core; this framework's own native
-// surface is this C++ batcher: stroke-3 -> padded stroke-5 conversion and
-// batch packing run as one tight loop per batch instead of a Python loop
-// of small numpy ops, keeping 8 chips fed at large global batch sizes.
+// surface is this C++ batcher: train-time augmentation (random per-axis
+// scale jitter + point-dropout), stroke-3 -> padded stroke-5 conversion
+// and batch packing run as one tight (optionally multi-threaded) loop
+// per batch instead of a Python loop of small numpy ops, keeping 8 chips
+// fed at large global batch sizes.
 //
 // C ABI (used from Python via ctypes, see ../native_batcher.py):
 //
 //   assemble_batch(seq_data, seq_lens, n, max_len, out)
+//       the eval-path entry: no augmentation. Bit-exact equal to
+//       strokes.to_big_strokes + the loader's start token (golden-tested
+//       in tests/test_native_batcher.py).
+//
+//   assemble_batch_aug(seq_data, seq_lens, n, max_len, scale_factor,
+//                      drop_prob, seed, n_threads, out, out_lens)
+//       the train-path entry: per-sequence augmentation THEN packing.
+//       - scale_factor > 0: each sequence's dx (dy) is multiplied by an
+//         independent uniform draw from [1-f, 1+f] (strokes.random_scale
+//         semantics).
+//       - drop_prob > 0: pen-down points whose two predecessors are also
+//         pen-down are merged into the previous point with probability
+//         drop_prob (strokes.augment_strokes semantics — offsets summed,
+//         so the drawing is unchanged; pen-lift structure preserved).
+//       - seed: batch-level RNG seed. Each sequence uses an independent
+//         splitmix64 stream seeded by (seed, index), so results are
+//         deterministic in (seed, index) and INDEPENDENT of n_threads.
+//         Distributionally equivalent to the numpy path, different bits.
+//       - n_threads: sequences are chunked across std::threads (<=1 or
+//         n small: serial). Output rows are disjoint per sequence.
+//       - out_lens: int32[n], the post-augmentation lengths.
 //
 //   seq_data    flattened float32 stroke-3 rows (dx, dy, pen) of all n
 //               sequences, concatenated in order
@@ -18,9 +41,7 @@
 //   max_len     padded sequence length (excluding the start token)
 //   out         float32[n, max_len + 1, 5], written fully
 //
-// Output layout per sequence (matches strokes.to_big_strokes + the
-// loader's start token exactly; golden-tested for equality in
-// tests/test_native_batcher.py):
+// Output layout per sequence (start token at t=0):
 //   row 0:                  (0, 0, 1, 0, 0)   start token
 //   rows 1..len:            (dx, dy, 1-p, p, 0)
 //   rows len+1..max_len:    (0, 0, 0, 0, 1)   end-of-sketch padding
@@ -29,6 +50,96 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// splitmix64: tiny, high-quality counter-based PRNG — each (seed, index)
+// pair is an independent stream, which is what makes the augmentation
+// deterministic under any thread count.
+struct SplitMix64 {
+  uint64_t state;
+  explicit SplitMix64(uint64_t s) : state(s) {}
+  uint64_t next() {
+    uint64_t z = (state += 0x9e3779b97f4a7c15ull);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+    return z ^ (z >> 31);
+  }
+  // uniform in [0, 1)
+  double uniform() { return (next() >> 11) * (1.0 / 9007199254740992.0); }
+};
+
+// One sequence: augment (optional) then pack into its output rows.
+// Returns the post-augmentation length.
+int32_t process_one(const float* src, int32_t len, int32_t max_len,
+                    float scale_factor, float drop_prob, uint64_t seed,
+                    int64_t index, float* dst, float* scratch) {
+  const int32_t row = 5;
+  SplitMix64 rng(seed * 0x2545f4914f6cdd1dull + 0x9e3779b97f4a7c15ull
+                 + static_cast<uint64_t>(index));
+
+  float sx = 1.f, sy = 1.f;
+  if (scale_factor > 0.f) {
+    sx = static_cast<float>(rng.uniform() * 2.0 - 1.0) * scale_factor + 1.f;
+    sy = static_cast<float>(rng.uniform() * 2.0 - 1.0) * scale_factor + 1.f;
+  }
+
+  // point-dropout into scratch (stroke-3), merging dropped offsets into
+  // the previous kept point; mirrors strokes.augment_strokes exactly
+  // (candidates need >2 consecutive pen-down predecessors and a kept
+  // previous point).
+  const float* s3 = src;
+  int32_t out_len = len;
+  if (drop_prob > 0.f) {
+    int32_t kept = 0;
+    float prev_pen = 0.f;
+    int32_t count = 0;
+    bool have_prev = false;
+    for (int32_t i = 0; i < len; ++i) {
+      const float dx = src[3 * i], dy = src[3 * i + 1], pen = src[3 * i + 2];
+      if (pen >= 0.5f || prev_pen >= 0.5f) {
+        count = 0;
+      } else {
+        ++count;
+      }
+      const bool check = pen < 0.5f && prev_pen < 0.5f && count > 2;
+      if (check && have_prev && rng.uniform() < drop_prob) {
+        scratch[3 * (kept - 1)] += dx;
+        scratch[3 * (kept - 1) + 1] += dy;
+      } else {
+        scratch[3 * kept] = dx;
+        scratch[3 * kept + 1] = dy;
+        scratch[3 * kept + 2] = pen;
+        ++kept;
+        prev_pen = pen;
+        have_prev = true;
+      }
+    }
+    s3 = scratch;
+    out_len = kept;
+  }
+
+  // pack: start token, stroke-5 rows (with the scale jitter applied on
+  // the fly), end-of-sketch padding
+  dst[0] = 0.f; dst[1] = 0.f; dst[2] = 1.f; dst[3] = 0.f; dst[4] = 0.f;
+  float* p = dst + row;
+  for (int32_t t = 0; t < out_len; ++t, p += row) {
+    const float pen = s3[3 * t + 2];
+    p[0] = s3[3 * t] * sx;
+    p[1] = s3[3 * t + 1] * sy;
+    p[2] = 1.f - pen;
+    p[3] = pen;
+    p[4] = 0.f;
+  }
+  for (int32_t t = out_len; t < max_len; ++t, p += row) {
+    p[0] = 0.f; p[1] = 0.f; p[2] = 0.f; p[3] = 0.f; p[4] = 1.f;
+  }
+  return out_len;
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -62,7 +173,64 @@ int assemble_batch(const float* seq_data,
   return 0;
 }
 
+int assemble_batch_aug(const float* seq_data,
+                       const int32_t* seq_lens,
+                       int32_t n,
+                       int32_t max_len,
+                       float scale_factor,
+                       float drop_prob,
+                       uint64_t seed,
+                       int32_t n_threads,
+                       float* out,
+                       int32_t* out_lens) {
+  const int32_t row = 5;
+  const int64_t per_seq = static_cast<int64_t>(max_len + 1) * row;
+
+  // per-sequence source offsets (prefix sum; sequences vary in length)
+  std::vector<int64_t> offsets(n + 1, 0);
+  for (int32_t i = 0; i < n; ++i) {
+    const int32_t len = seq_lens[i];
+    if (len < 0 || len > max_len) return -1;
+    offsets[i + 1] = offsets[i] + 3 * static_cast<int64_t>(len);
+  }
+
+  auto work = [&](int32_t lo, int32_t hi) {
+    std::vector<float> scratch(3 * static_cast<size_t>(max_len));
+    for (int32_t i = lo; i < hi; ++i) {
+      out_lens[i] = process_one(seq_data + offsets[i], seq_lens[i], max_len,
+                                scale_factor, drop_prob, seed, i,
+                                out + i * per_seq, scratch.data());
+    }
+  };
+
+  int32_t threads = n_threads;
+  const int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  if (threads <= 0) threads = hw > 0 ? hw : 1;
+  if (threads > n) threads = n;
+  // cap by total work so thread create/join (~tens of us each) never
+  // rivals the packing itself on many-core hosts: one thread per ~64k
+  // source points (~a millisecond of work each)
+  const int64_t total_points = offsets[n] / 3;
+  const int32_t by_work = static_cast<int32_t>(total_points / 65536) + 1;
+  if (threads > by_work) threads = by_work;
+  if (threads <= 1 || n < 64) {
+    work(0, n);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(threads);
+  const int32_t chunk = (n + threads - 1) / threads;
+  for (int32_t t = 0; t < threads; ++t) {
+    const int32_t lo = t * chunk;
+    const int32_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    pool.emplace_back(work, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+  return 0;
+}
+
 // Version tag so the Python side can detect a stale shared object.
-int batcher_abi_version() { return 2; }
+int batcher_abi_version() { return 3; }
 
 }  // extern "C"

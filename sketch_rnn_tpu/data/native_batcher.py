@@ -26,7 +26,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "native", "batcher.cc")
 _SO = os.path.join(_HERE, "native", f"batcher_v{_ABI_VERSION}.so")
@@ -40,7 +40,7 @@ def _build() -> bool:
     tmp = f"{_SO}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
         return True
@@ -84,6 +84,19 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32,                   # max_len
             ctypes.POINTER(ctypes.c_float),   # out
         ]
+        lib.assemble_batch_aug.restype = ctypes.c_int
+        lib.assemble_batch_aug.argtypes = [
+            ctypes.POINTER(ctypes.c_float),   # seq_data
+            ctypes.POINTER(ctypes.c_int32),   # seq_lens
+            ctypes.c_int32,                   # n
+            ctypes.c_int32,                   # max_len
+            ctypes.c_float,                   # scale_factor
+            ctypes.c_float,                   # drop_prob
+            ctypes.c_uint64,                  # seed
+            ctypes.c_int32,                   # n_threads
+            ctypes.POINTER(ctypes.c_float),   # out
+            ctypes.POINTER(ctypes.c_int32),   # out_lens
+        ]
         _lib = lib
         return _lib
 
@@ -92,23 +105,32 @@ def available() -> bool:
     return _load() is not None
 
 
-def assemble_batch(seqs: List[np.ndarray], max_len: int
-                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Pad + stroke-5-convert a batch natively.
-
-    ``seqs`` are float32 stroke-3 arrays. Returns ``(strokes, seq_len)``
-    — ``strokes [n, max_len + 1, 5]`` with the start token at t=0 — or
-    None when the native library is unavailable (caller falls back).
-    """
-    lib = _load()
-    if lib is None or not seqs:
-        return None
+def _flatten(seqs: List[np.ndarray], max_len: int):
     n = len(seqs)
     lens = np.array([len(s) for s in seqs], dtype=np.int32)
     if (lens > max_len).any():
         return None
     flat = np.ascontiguousarray(
         np.concatenate([np.asarray(s, np.float32) for s in seqs], axis=0))
+    return n, lens, flat
+
+
+def assemble_batch(seqs: List[np.ndarray], max_len: int
+                   ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Pad + stroke-5-convert a batch natively (no augmentation).
+
+    ``seqs`` are float32 stroke-3 arrays. Returns ``(strokes, seq_len)``
+    — ``strokes [n, max_len + 1, 5]`` with the start token at t=0 — or
+    None when the native library is unavailable (caller falls back).
+    Bit-exact equal to the numpy path (golden-tested).
+    """
+    lib = _load()
+    if lib is None or not seqs:
+        return None
+    packed = _flatten(seqs, max_len)
+    if packed is None:
+        return None
+    n, lens, flat = packed
     out = np.empty((n, max_len + 1, 5), dtype=np.float32)
     rc = lib.assemble_batch(
         flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
@@ -118,3 +140,41 @@ def assemble_batch(seqs: List[np.ndarray], max_len: int
     if rc != 0:
         return None
     return out, lens
+
+
+def assemble_batch_aug(seqs: List[np.ndarray], max_len: int,
+                       scale_factor: float, drop_prob: float, seed: int,
+                       n_threads: int = 0
+                       ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Augment + pad + stroke-5-convert a batch natively (train path).
+
+    Applies per-sequence random scale jitter (``scale_factor``) and
+    point-dropout (``drop_prob``) inside the C++ loop — the whole
+    train-time batch assembly is one native call. Each sequence draws
+    from an independent counter-based RNG stream keyed by ``(seed,
+    index)``, so results are deterministic and independent of
+    ``n_threads`` (0 = hardware concurrency). Distributionally
+    equivalent to the numpy path (strokes.random_scale /
+    augment_strokes), not bit-identical. Returns ``(strokes, seq_len)``
+    with post-augmentation lengths, or None (caller falls back).
+    """
+    lib = _load()
+    if lib is None or not seqs:
+        return None
+    packed = _flatten(seqs, max_len)
+    if packed is None:
+        return None
+    n, lens, flat = packed
+    out = np.empty((n, max_len + 1, 5), dtype=np.float32)
+    out_lens = np.empty((n,), dtype=np.int32)
+    rc = lib.assemble_batch_aug(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n), ctypes.c_int32(max_len),
+        ctypes.c_float(scale_factor), ctypes.c_float(drop_prob),
+        ctypes.c_uint64(seed & (2 ** 64 - 1)), ctypes.c_int32(n_threads),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        return None
+    return out, out_lens

@@ -47,3 +47,114 @@ def test_loader_uses_native_transparently(native_available, monkeypatch):
     b2 = l2.get_batch(0)
     for k in b1:
         np.testing.assert_array_equal(b1[k], b2[k])
+
+
+# -- augmented (train-path) native assembly ---------------------------------
+
+
+def _aug_setup(n=32, seed=5):
+    seqs, _ = make_synthetic_strokes(n, min_len=20, max_len=60, seed=seed)
+    return [np.asarray(s, np.float32) for s in seqs]
+
+
+def test_aug_no_op_matches_plain(native_available):
+    # scale_factor=0, drop_prob=0 must be bit-exact the non-augmented path
+    seqs = _aug_setup()
+    a = NB.assemble_batch_aug(seqs, 64, 0.0, 0.0, seed=1)
+    b = NB.assemble_batch(seqs, 64)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_aug_deterministic_and_seed_dependent(native_available):
+    seqs = _aug_setup()
+    a = NB.assemble_batch_aug(seqs, 64, 0.15, 0.1, seed=42)
+    b = NB.assemble_batch_aug(seqs, 64, 0.15, 0.1, seed=42)
+    c = NB.assemble_batch_aug(seqs, 64, 0.15, 0.1, seed=43)
+    np.testing.assert_array_equal(a[0], b[0])
+    assert not np.array_equal(a[0], c[0])
+
+
+def test_aug_thread_count_invariant(native_available):
+    # per-sequence counter-based RNG: results must not depend on threading
+    seqs = _aug_setup(n=96)
+    a = NB.assemble_batch_aug(seqs, 64, 0.15, 0.1, seed=9, n_threads=1)
+    b = NB.assemble_batch_aug(seqs, 64, 0.15, 0.1, seed=9, n_threads=4)
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+
+
+def test_aug_dropout_preserves_drawing(native_available):
+    # point dropout merges offsets: per-sequence total displacement and
+    # pen-lift count are invariant; lengths shrink by roughly drop_prob
+    # of eligible points
+    seqs = _aug_setup(n=48)
+    out, lens = NB.assemble_batch_aug(seqs, 64, 0.0, 0.3, seed=11)
+    orig_lens = np.array([len(s) for s in seqs])
+    assert (lens <= orig_lens).all() and (lens < orig_lens).any()
+    for i, s in enumerate(seqs):
+        got = out[i, 1:1 + lens[i]]
+        np.testing.assert_allclose(got[:, :2].sum(0), s[:, :2].sum(0),
+                                   rtol=1e-5, atol=1e-5)
+        assert int(got[:, 3].sum()) == int(s[:, 2].sum())
+
+
+def test_aug_scale_is_per_axis_uniform(native_available):
+    # with dropout off, each sequence's offsets are an exact per-axis
+    # rescale of the originals; scales must lie in [1-f, 1+f] and vary
+    seqs = _aug_setup(n=64)
+    f = 0.15
+    out, lens = NB.assemble_batch_aug(seqs, 64, f, 0.0, seed=3)
+    scales = []
+    for i, s in enumerate(seqs):
+        got = out[i, 1:1 + lens[i], :2]
+        nz = np.abs(s[:, 0]) > 1e-6
+        sx = np.median(got[nz, 0] / s[nz, 0])
+        nz = np.abs(s[:, 1]) > 1e-6
+        sy = np.median(got[nz, 1] / s[nz, 1])
+        np.testing.assert_allclose(got[:, 0], s[:, 0] * sx, rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(got[:, 1], s[:, 1] * sy, rtol=1e-4,
+                                   atol=1e-6)
+        assert 1 - f - 1e-5 <= sx <= 1 + f + 1e-5
+        assert 1 - f - 1e-5 <= sy <= 1 + f + 1e-5
+        scales.append((sx, sy))
+    scales = np.array(scales)
+    assert scales.std(0).min() > 0.01  # actually random per sequence
+
+
+def test_aug_length_reduction_tracks_prob(native_available):
+    # eligible points (pen-down runs past the 3rd point) drop at ~prob
+    rng = np.random.default_rng(0)
+    n, L = 64, 60
+    seqs = []
+    for _ in range(n):
+        s = np.zeros((L, 3), np.float32)
+        s[:, :2] = rng.normal(size=(L, 2)).astype(np.float32)
+        s[-1, 2] = 1.0  # single stroke: all interior points eligible
+        seqs.append(s)
+    prob = 0.25
+    _, lens = NB.assemble_batch_aug(seqs, L, 0.0, prob, seed=17)
+    dropped = (L - lens).sum()
+    eligible = (L - 3) * n  # count>2 requires 3 pen-down predecessors
+    rate = dropped / eligible
+    assert abs(rate - prob) < 0.05
+
+
+def test_loader_train_batch_uses_native_aug(native_available):
+    # augment=True loader must produce valid augmented batches through the
+    # native path: stroke-5 one-hot rows, plausible lengths, finite values
+    hps = HParams(batch_size=16, max_seq_len=64, augment_stroke_prob=0.2,
+                  random_scale_factor=0.15)
+    seqs, labels = make_synthetic_strokes(32, min_len=20, max_len=60, seed=2)
+    loader = DataLoader([np.array(s) for s in seqs], hps, labels=labels,
+                        augment=True, seed=3)
+    b = loader.random_batch()
+    assert b["strokes"].shape == (16, 65, 5)
+    assert np.isfinite(b["strokes"]).all()
+    onehot = b["strokes"][:, :, 2:].sum(-1)
+    np.testing.assert_array_equal(onehot, np.ones_like(onehot))
+    assert (b["seq_len"] >= 1).all() and (b["seq_len"] <= 64).all()
+    # augmentation varies across draws
+    b2 = loader.random_batch()
+    assert not np.array_equal(b["strokes"], b2["strokes"])
