@@ -60,10 +60,19 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _batch_tile(b: int) -> int:
-    """Largest VMEM-friendly divisor of the batch for the outer grid."""
-    for cand in (128, 64, 32, 16, 8):
-        if b % cand == 0:
+def _batch_tile(b: int, h: int) -> int:
+    """Largest VMEM-friendly divisor of the batch for the outer grid.
+
+    Scaled inversely with the hidden size: the per-step working set is
+    O(tile * 4h) f32 buffers, so ``tile * h`` is held under an
+    empirically VMEM-safe budget (v5e, lstm/ln backward — the tightest
+    kernel). Bigger tiles cut the grid-step count, which dominates for
+    small-H cells: the H=256 encoder at B=4096 measured 56.6 ms fwd+bwd
+    at tile 128 vs 46.2 ms at tile 512 (tile 1024 exceeds VMEM).
+    """
+    cap = max(8, 131072 // max(h, 1))
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if cand <= cap and b % cand == 0:
             return cand
     return b
 
@@ -327,7 +336,7 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
                    keep_prob, residual_dtype):
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz)
+    bt = _batch_tile(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     step, tile, whole, mask_spec, seed_spec = _specs(
@@ -369,7 +378,7 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz)
+    bt = _batch_tile(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
@@ -604,7 +613,7 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
                      masks, seed, keep_prob, residual_dtype):
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz)
+    bt = _batch_tile(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     step, tile, whole, mask_spec, seed_spec = _specs(
@@ -648,7 +657,7 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz)
+    bt = _batch_tile(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
