@@ -103,7 +103,9 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
             float(metrics["loss"])
 
         best = float("inf")
-        for trial in range(3):
+        # 4 trials: the tunneled chip shows occasional 2x dispatch-stall
+        # variance; best-of-n is the honest steady-state number
+        for trial in range(4):
             t0 = time.perf_counter()
             for i in range(steps):
                 state, metrics = step(state, feeder.get(),
