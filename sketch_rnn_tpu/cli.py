@@ -141,32 +141,71 @@ def cmd_eval(args) -> int:
 
 
 def cmd_sample(args) -> int:
+    from sketch_rnn_tpu.data import strokes as S
     from sketch_rnn_tpu.parallel import multihost as mh
     from sketch_rnn_tpu.sample import (
         encode_mu, interpolate_latents, sample, svg_grid)
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
+    # usage errors fail before the (expensive) checkpoint restore
+    if (args.interpolate or args.reconstruct) and not hps.conditional:
+        print("[cli] --interpolate/--reconstruct need a conditional "
+              "(encoder) model", file=sys.stderr)
+        return 2
     model, state, scale, meta = _restore(hps, args.workdir)
     key = jax.random.key(args.seed)
     z = None
-    if args.interpolate:
+    labels = None
+    originals = None
+    n = args.n
+    if args.interpolate or args.reconstruct:
         _, valid_l, _, _ = _load_data(hps, args, scale_factor=scale)
         batch = valid_l.get_batch(0)
         mu = encode_mu(model, state.params, batch)
-        z = interpolate_latents(mu[0], mu[1], n=args.n)
-    labels = None
-    if hps.num_classes > 0:
-        labels = np.full((args.n,), args.label, np.int32)
-    sketches, lengths = sample(model, state.params, hps, key, n=args.n,
+        if args.interpolate:
+            z = interpolate_latents(mu[0], mu[1], n=n)
+        else:
+            # the reference notebook's reconstruction demo: encode real
+            # sketches, decode conditioned on their posterior means, and
+            # show inputs (top row) against reconstructions (bottom row)
+            if n > mu.shape[0]:
+                print(f"[cli] requested {n} reconstructions but the valid "
+                      f"batch holds {mu.shape[0]}; clamping",
+                      file=sys.stderr)
+                n = mu.shape[0]
+            z = mu[:n]
+            originals = []
+            for i in range(n):
+                s3 = S.to_normal_strokes(np.asarray(batch["strokes"][i, 1:]))
+                s3[:, 0:2] *= scale
+                originals.append(s3)
+            if hps.num_classes > 0:
+                labels = np.asarray(batch["labels"][:n], np.int32)
+    if labels is None and hps.num_classes > 0:
+        labels = np.full((n,), args.label, np.int32)
+    sketches, lengths = sample(model, state.params, hps, key, n=n,
                                temperature=args.temperature, z=z,
                                labels=labels, scale_factor=scale,
                                greedy=args.greedy)
     # multi-host: only the primary writes (hosts hold different loader
     # stripes, so concurrent writes to a shared path would tear the file)
     if mh.is_primary():
-        svg_grid(sketches, cols=args.cols, path=args.output)
-        print(f"[cli] wrote {args.n} sketches (lengths "
-              f"{[int(x) for x in lengths]}) to {args.output}")
+        if originals is not None:
+            # alternate input rows and reconstruction rows in blocks of
+            # --cols so wide requests wrap instead of one 2xN strip
+            cols = max(1, min(args.cols, n))
+            blank = np.zeros((0, 3), np.float32)
+            cells = []
+            for lo in range(0, n, cols):
+                for row in (originals[lo:lo + cols], sketches[lo:lo + cols]):
+                    cells += row + [blank] * (cols - len(row))
+            svg_grid(cells, cols=cols, path=args.output)
+            print(f"[cli] wrote {n} input|reconstruction pairs "
+                  f"(lengths {[int(x) for x in lengths]}) to {args.output}")
+        else:
+            svg_grid(sketches, cols=args.cols, path=args.output)
+            print(f"[cli] wrote {n} sketches (lengths "
+                  f"{[int(x) for x in lengths]}) to {args.output}")
     return 0
 
 
@@ -192,8 +231,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-n", type=int, default=10, help="number of sketches")
     p.add_argument("--temperature", type=float, default=0.5)
     p.add_argument("--greedy", action="store_true")
-    p.add_argument("--interpolate", action="store_true",
-                   help="interpolate between two encoded valid sketches")
+    mode = p.add_mutually_exclusive_group()
+    mode.add_argument("--interpolate", action="store_true",
+                      help="interpolate between two encoded valid sketches")
+    mode.add_argument("--reconstruct", action="store_true",
+                      help="encode n valid sketches and decode from their "
+                           "latents; output pairs inputs (top row) with "
+                           "reconstructions (bottom row)")
     p.add_argument("--label", type=int, default=0,
                    help="class id for class-conditional models")
     p.add_argument("--output", default="samples.svg")

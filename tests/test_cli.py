@@ -45,6 +45,25 @@ def test_cli_interpolate_sample(tmp_path):
     assert os.path.exists(out)
 
 
+def test_cli_reconstruct_sample(tmp_path, capsys):
+    wd = str(tmp_path / "work")
+    main(["train", "--synthetic", f"--workdir={wd}", f"--hparams={HP}"])
+    out = str(tmp_path / "r.svg")
+    assert main(["sample", "--synthetic", f"--workdir={wd}", "-n", "3",
+                 "--reconstruct", f"--output={out}"]) == 0
+    assert "input|reconstruction pairs" in capsys.readouterr().out
+    assert open(out).read().startswith("<svg")
+
+
+def test_cli_reconstruct_and_interpolate_exclusive(tmp_path):
+    # argparse rejects the combination at parse time (SystemExit 2),
+    # before any checkpoint restore
+    with pytest.raises(SystemExit) as e:
+        main(["sample", "--synthetic", f"--workdir={tmp_path}",
+              "--reconstruct", "--interpolate"])
+    assert e.value.code == 2
+
+
 def test_cli_rejects_unknown_hparam(tmp_path):
     with pytest.raises(ValueError, match="unknown hparam"):
         main(["train", "--synthetic", f"--workdir={tmp_path}",
