@@ -204,13 +204,23 @@ class SketchRNN:
     # -- loss --------------------------------------------------------------
 
     def loss(self, params: Params, batch: Dict[str, jax.Array],
-             key: jax.Array, kl_weight: jax.Array, train: bool = True
+             key: jax.Array, kl_weight: jax.Array, train: bool = True,
+             axis_name: Optional[str] = None
              ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         """Full VAE loss on a loader batch; one fused XLA computation.
 
         ``batch["strokes"]`` is ``[B, Nmax+1, 5]`` (start token at t=0);
         ``kl_weight`` is the *annealed* weight (schedule computed outside,
         so the jitted graph is step-agnostic). Returns (total, metrics).
+
+        ``axis_name``: set when ``batch`` is a per-device shard inside
+        ``shard_map`` — every scalar (including the nonlinear KL floor)
+        is then computed on psum'd GLOBAL sums, so the result equals the
+        single-device global-batch loss and its local gradient is the
+        device's contribution to the global gradient (psum grads to
+        finish the all-reduce). This is the path that keeps the Pallas
+        fused kernels shardable: pallas_call cannot be partitioned by
+        GSPMD, so data parallelism must be explicit SPMD.
         """
         hps = self.hps
         strokes = jnp.transpose(batch["strokes"], (1, 0, 2))  # [T+1, B, 5]
@@ -228,7 +238,8 @@ class SketchRNN:
             mu, presig = self.encode(params, x_target, seq_len,
                                      key=kenc, train=train)
             z = self.sample_z(mu, presig, kz)
-            kl_raw = mdn.kl_loss(mu, presig, weights=weights)
+            kl_raw = mdn.kl_loss(mu, presig, weights=weights,
+                                 axis_name=axis_name)
         else:
             kl_raw = jnp.float32(0.0)
 
@@ -237,7 +248,7 @@ class SketchRNN:
         # canonical asymmetry: pen CE unmasked in training, masked in eval
         offset_nll, pen_ce = mdn.reconstruction_loss(
             mp, x_target, hps.max_seq_len, mask_pen=not train,
-            weights=weights)
+            weights=weights, axis_name=axis_name)
         r_cost = offset_nll + pen_ce
         if hps.conditional:
             kl_floored = mdn.kl_cost_with_floor(kl_raw, hps.kl_tolerance)

@@ -22,12 +22,25 @@ the surrounding graph (SURVEY §2: "fuse into a single XLA graph").
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 LOG_2PI = 1.8378770664093453  # log(2*pi)
+
+
+def _global_sum(x: jax.Array, axis_name: Optional[str]) -> jax.Array:
+    """Sum across the named device axis (inside shard_map), else identity.
+
+    With ``axis_name`` set, the losses below compute GLOBAL-batch sums and
+    normalizers via ``psum``, so a per-shard call inside ``shard_map``
+    yields exactly the single-device global-batch value — including the
+    KL free-bits floor, which is nonlinear and would be wrong if applied
+    per shard and averaged (SURVEY §2 component 18: the gradient
+    all-reduce then falls out of AD through the psum).
+    """
+    return jax.lax.psum(x, axis_name) if axis_name else x
 
 
 class MixtureParams(NamedTuple):
@@ -80,7 +93,8 @@ def gmm_nll(dx: jax.Array, dy: jax.Array, mp: MixtureParams) -> jax.Array:
 
 def reconstruction_loss(mp: MixtureParams, target: jax.Array,
                         max_seq_len: int, mask_pen: bool = False,
-                        weights: Optional[jax.Array] = None
+                        weights: Optional[jax.Array] = None,
+                        axis_name: Optional[str] = None
                         ) -> Tuple[jax.Array, jax.Array]:
     """Offset-GMM NLL + pen-state CE, canonical masking and normalization.
 
@@ -92,6 +106,10 @@ def reconstruction_loss(mp: MixtureParams, target: jax.Array,
     and replaces ``B`` with ``sum(weights)`` in the normalization — used
     by the eval sweep to zero out wrap-filled duplicate rows so metrics
     are exact sample means while every batch keeps the compiled shape.
+
+    ``axis_name``: when called on a per-device batch shard inside
+    ``shard_map``, numerators AND normalizers are psum'd over that mesh
+    axis, so the returned scalars are exactly the global-batch values.
     """
     t, b = target.shape[0], target.shape[1]
     dx, dy, pen = target[..., 0], target[..., 1], target[..., 2:5]
@@ -101,27 +119,34 @@ def reconstruction_loss(mp: MixtureParams, target: jax.Array,
     if mask_pen:
         pen_ce = pen_ce * fs
     if weights is None:
-        denom = float(max_seq_len * b)
+        denom = max_seq_len * _global_sum(jnp.float32(b), axis_name)
     else:
         w = weights.astype(jnp.float32)
         nll = nll * w[None, :]
         pen_ce = pen_ce * w[None, :]
-        denom = max_seq_len * jnp.maximum(jnp.sum(w), 1.0)
-    return jnp.sum(nll) / denom, jnp.sum(pen_ce) / denom
+        denom = max_seq_len * jnp.maximum(
+            _global_sum(jnp.sum(w), axis_name), 1.0)
+    return (_global_sum(jnp.sum(nll), axis_name) / denom,
+            _global_sum(jnp.sum(pen_ce), axis_name) / denom)
 
 
 def kl_loss(mu: jax.Array, presig: jax.Array,
-            weights: Optional[jax.Array] = None) -> jax.Array:
+            weights: Optional[jax.Array] = None,
+            axis_name: Optional[str] = None) -> jax.Array:
     """KL(q(z|x) || N(0, I)), mean over batch and latent dims.
 
-    ``weights`` (``[B]``, optional): weighted mean over the batch axis
-    (see :func:`reconstruction_loss`)."""
+    ``weights`` (``[B]``, optional): weighted mean over the batch axis;
+    ``axis_name``: global-batch mean across a mesh axis (see
+    :func:`reconstruction_loss`)."""
     per = -0.5 * jnp.mean(1.0 + presig - jnp.square(mu) - jnp.exp(presig),
                           axis=-1)                       # [B]
     if weights is None:
-        return jnp.mean(per)
+        num = _global_sum(jnp.sum(per), axis_name)
+        den = _global_sum(jnp.float32(per.shape[0]), axis_name)
+        return num / den
     w = weights.astype(jnp.float32)
-    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+    return (_global_sum(jnp.sum(per * w), axis_name)
+            / jnp.maximum(_global_sum(jnp.sum(w), axis_name), 1.0))
 
 
 def kl_cost_with_floor(kl: jax.Array, kl_tolerance: float) -> jax.Array:

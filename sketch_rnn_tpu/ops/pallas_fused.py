@@ -60,6 +60,20 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _sds(shape, dtype, ref):
+    """ShapeDtypeStruct matching ``ref``'s varying-manual-axes.
+
+    Inside ``shard_map`` (the data-parallel train step) every operand is
+    varying over the data axis, and JAX 0.9 requires pallas_call outputs
+    to declare their vma explicitly; outside shard_map this is a plain
+    ShapeDtypeStruct.
+    """
+    vma = getattr(jax.typeof(ref), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _batch_tile(b: int, h: int) -> int:
     """Largest VMEM-friendly divisor of the batch for the outer grid.
 
@@ -353,10 +367,10 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
         out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
                    tile((bt, h))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),  # hs
-            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),  # cs (c_{t-1})
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),        # cT
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),        # hT
+            _sds((t, bsz, h), residual_dtype, xs),  # hs
+            _sds((t, bsz, h), residual_dtype, xs),  # cs (c_{t-1})
+            _sds((bsz, h), jnp.float32, xs),        # cT
+            _sds((bsz, h), jnp.float32, xs),        # hT
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
@@ -397,12 +411,12 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
         out_specs=(step((bt, d)), whole(wx.shape), whole(b2.shape),
                    whole(wh.shape), tile((bt, h)), tile((bt, h))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, d), jnp.float32),
-            jax.ShapeDtypeStruct(wx.shape, jnp.float32),
-            jax.ShapeDtypeStruct(b2.shape, jnp.float32),
-            jax.ShapeDtypeStruct(wh.shape, jnp.float32),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            _sds((t, bsz, d), jnp.float32, xs),
+            _sds(wx.shape, jnp.float32, xs),
+            _sds(b2.shape, jnp.float32, xs),
+            _sds(wh.shape, jnp.float32, xs),
+            _sds((bsz, h), jnp.float32, xs),
+            _sds((bsz, h), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
@@ -631,10 +645,10 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
         out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
                    tile((bt, h))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),
-            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            _sds((t, bsz, h), residual_dtype, xs),
+            _sds((t, bsz, h), residual_dtype, xs),
+            _sds((bsz, h), jnp.float32, xs),
+            _sds((bsz, h), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
@@ -679,15 +693,15 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
                    whole(gam.shape), whole(bet.shape), whole(gc2.shape),
                    whole(bc2.shape), tile((bt, h)), tile((bt, h))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, d), jnp.float32),
-            jax.ShapeDtypeStruct(wx.shape, jnp.float32),
-            jax.ShapeDtypeStruct(wh.shape, jnp.float32),
-            jax.ShapeDtypeStruct(gam.shape, jnp.float32),
-            jax.ShapeDtypeStruct(bet.shape, jnp.float32),
-            jax.ShapeDtypeStruct(gc2.shape, jnp.float32),
-            jax.ShapeDtypeStruct(bc2.shape, jnp.float32),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
+            _sds((t, bsz, d), jnp.float32, xs),
+            _sds(wx.shape, jnp.float32, xs),
+            _sds(wh.shape, jnp.float32, xs),
+            _sds(gam.shape, jnp.float32, xs),
+            _sds(bet.shape, jnp.float32, xs),
+            _sds(gc2.shape, jnp.float32, xs),
+            _sds(bc2.shape, jnp.float32, xs),
+            _sds((bsz, h), jnp.float32, xs),
+            _sds((bsz, h), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
@@ -1066,14 +1080,14 @@ def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
                    step((bt, hh_size)), tile((bt, h)), tile((bt, h)),
                    tile((bt, hh_size)), tile((bt, hh_size))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),       # hs
-            jax.ShapeDtypeStruct((t, bsz, h), residual_dtype),       # cs
-            jax.ShapeDtypeStruct((t, bsz, hh_size), residual_dtype),  # hycs
-            jax.ShapeDtypeStruct((t, bsz, hh_size), residual_dtype),  # hyhs
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
+            _sds((t, bsz, h), residual_dtype, xs),       # hs
+            _sds((t, bsz, h), residual_dtype, xs),       # cs
+            _sds((t, bsz, hh_size), residual_dtype, xs),  # hycs
+            _sds((t, bsz, hh_size), residual_dtype, xs),  # hyhs
+            _sds((bsz, h), jnp.float32, xs),
+            _sds((bsz, h), jnp.float32, xs),
+            _sds((bsz, hh_size), jnp.float32, xs),
+            _sds((bsz, hh_size), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32),
@@ -1150,30 +1164,30 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
                    whole(bc2.shape), tile((bt, h)), tile((bt, h)),
                    tile((bt, hh_size)), tile((bt, hh_size))),
         out_shape=(
-            jax.ShapeDtypeStruct((t, bsz, d), jnp.float32),
-            jax.ShapeDtypeStruct(wx.shape, jnp.float32),
-            jax.ShapeDtypeStruct(b2.shape, jnp.float32),
-            jax.ShapeDtypeStruct(wh.shape, jnp.float32),
-            jax.ShapeDtypeStruct(wxh_x.shape, jnp.float32),
-            jax.ShapeDtypeStruct(wxh_h.shape, jnp.float32),
-            jax.ShapeDtypeStruct(bh2.shape, jnp.float32),
-            jax.ShapeDtypeStruct(whh.shape, jnp.float32),
-            jax.ShapeDtypeStruct(w_hz_x.shape, jnp.float32),
-            jax.ShapeDtypeStruct(bhzx2.shape, jnp.float32),
-            jax.ShapeDtypeStruct(w_hz_h.shape, jnp.float32),
-            jax.ShapeDtypeStruct(bhzh2.shape, jnp.float32),
-            jax.ShapeDtypeStruct(w_hz_b.shape, jnp.float32),
-            jax.ShapeDtypeStruct(zd_x.shape, jnp.float32),
-            jax.ShapeDtypeStruct(zd_h.shape, jnp.float32),
-            jax.ShapeDtypeStruct(zd_b.shape, jnp.float32),
-            jax.ShapeDtypeStruct(gam.shape, jnp.float32),
-            jax.ShapeDtypeStruct(bet.shape, jnp.float32),
-            jax.ShapeDtypeStruct(gc2.shape, jnp.float32),
-            jax.ShapeDtypeStruct(bc2.shape, jnp.float32),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, h), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
-            jax.ShapeDtypeStruct((bsz, hh_size), jnp.float32),
+            _sds((t, bsz, d), jnp.float32, xs),
+            _sds(wx.shape, jnp.float32, xs),
+            _sds(b2.shape, jnp.float32, xs),
+            _sds(wh.shape, jnp.float32, xs),
+            _sds(wxh_x.shape, jnp.float32, xs),
+            _sds(wxh_h.shape, jnp.float32, xs),
+            _sds(bh2.shape, jnp.float32, xs),
+            _sds(whh.shape, jnp.float32, xs),
+            _sds(w_hz_x.shape, jnp.float32, xs),
+            _sds(bhzx2.shape, jnp.float32, xs),
+            _sds(w_hz_h.shape, jnp.float32, xs),
+            _sds(bhzh2.shape, jnp.float32, xs),
+            _sds(w_hz_b.shape, jnp.float32, xs),
+            _sds(zd_x.shape, jnp.float32, xs),
+            _sds(zd_h.shape, jnp.float32, xs),
+            _sds(zd_b.shape, jnp.float32, xs),
+            _sds(gam.shape, jnp.float32, xs),
+            _sds(bet.shape, jnp.float32, xs),
+            _sds(gc2.shape, jnp.float32, xs),
+            _sds(bc2.shape, jnp.float32, xs),
+            _sds((bsz, h), jnp.float32, xs),
+            _sds((bsz, h), jnp.float32, xs),
+            _sds((bsz, hh_size), jnp.float32, xs),
+            _sds((bsz, hh_size), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32),

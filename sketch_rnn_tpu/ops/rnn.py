@@ -30,6 +30,27 @@ def make_dropout_masks(key: jax.Array, keep_prob: float, steps: int,
     return m.astype(jnp.float32) / keep_prob
 
 
+def _match_vma(carry, ref: jax.Array):
+    """Mark ``carry`` as varying over ``ref``'s manual mesh axes.
+
+    Inside ``shard_map`` (the data-parallel step), a zeros initial carry
+    is unvarying while the scan/kernel outputs vary over the data axis —
+    JAX 0.9's varying-manual-axes tracking rejects that carry mismatch.
+    Broadcasting the carry to the inputs' vma fixes it without the cell
+    or model code knowing the mesh axis; a no-op outside shard_map.
+    """
+    vma = getattr(jax.typeof(ref), "vma", None)
+    if not vma:
+        return carry
+
+    def widen(c):
+        missing = tuple(a for a in vma
+                        if a not in (getattr(jax.typeof(c), "vma", ()) or ()))
+        return jax.lax.pcast(c, missing, to="varying") if missing else c
+
+    return jax.tree_util.tree_map(widen, carry)
+
+
 def _block_diag4(w: jax.Array) -> jax.Array:
     """``[4, e, h] -> [4e, 4h]`` block-diagonal expansion.
 
@@ -65,6 +86,12 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
         xs = jnp.flip(xs, axis=0)
         if masks is not None:
             masks = jnp.flip(masks, axis=0)
+    # inside shard_map every kernel operand must share the inputs'
+    # varying axes (each device owns its copy of the replicated params;
+    # carry0 was already matched by run_rnn); no-ops outside shard_map
+    params = _match_vma(params, xs)
+    masks = _match_vma(masks, xs) if masks is not None else None
+    seed = _match_vma(seed, xs) if seed is not None else None
     cd = cell.compute_dtype
     cast = (lambda w: w.astype(cd)) if cd else (lambda w: w)
     wx, wh = cast(params["wx"]), cast(params["wh"])
@@ -155,6 +182,7 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
     """
     if carry0 is None:
         carry0 = cell.initial_carry(xs.shape[1])
+    carry0 = _match_vma(carry0, xs)
     if rdrop_masks is not None and rdrop_gen is not None:
         raise ValueError("pass rdrop_masks or rdrop_gen, not both")
 

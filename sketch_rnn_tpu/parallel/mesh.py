@@ -5,13 +5,16 @@ TPU-native replacement for the reference's NCCL data-parallel layer
 reference unreadable — semantics per BASELINE.json's "pmap'd data-parallel
 loop with gradient allreduce over ICI instead of NCCL").
 
-Design: the modern ``jit``-with-``NamedSharding`` idiom rather than a
-literal ``pmap`` translation. Parameters are replicated over the mesh, the
-batch is sharded along the ``data`` axis, and the gradient all-reduce is
-inserted by the XLA SPMD partitioner and rides ICI — there is no explicit
-collective in user code, which is exactly the "let XLA insert collectives"
-recipe. The mesh keeps extra named axes (``hps.mesh_shape``/``mesh_axes``)
-open for model-parallel sharding later without changing the step API.
+Design: a named device mesh with the batch sharded along the ``data``
+axis and parameters replicated. The training step runs the per-device
+loss/gradient computation under ``jax.shard_map`` (see
+``train/step.py``): explicit SPMD is load-bearing because the Pallas
+fused RNN kernels lower to ``tpu_custom_call``, which the automatic
+GSPMD partitioner cannot shard — the gradient all-reduce is an explicit
+``lax.psum`` over ICI (the NCCL-allreduce equivalent), falling out of AD
+through the psum'd global loss. The mesh keeps extra named axes
+(``hps.mesh_shape``/``mesh_axes``) open for model-parallel sharding
+later without changing the step API.
 """
 
 from __future__ import annotations

@@ -3,11 +3,24 @@
 TPU-native equivalent of the reference's per-step ``sess.run(train_op)``
 (SURVEY.md §3.1: on the GPU reference the host↔device boundary is crossed
 every step; here the whole step — forward, backward, gradient all-reduce,
-Adam update, schedules — is ONE jitted XLA computation). Data parallelism
-(component 18) is expressed with ``NamedSharding``: the batch is split
-along the mesh's ``data`` axis, parameters/optimizer state are replicated,
-and the SPMD partitioner inserts the gradient all-reduce over ICI (the
-NCCL-allreduce equivalent).
+Adam update, schedules — is ONE jitted XLA computation).
+
+Data parallelism (component 18) is EXPLICIT SPMD: the per-device loss/
+gradient computation runs under ``jax.shard_map`` over the mesh's
+``data`` axis with the batch sharded and parameters replicated, and the
+gradient all-reduce is a ``lax.psum`` over ICI — the NCCL-allreduce
+equivalent. Explicit (rather than GSPMD-automatic) partitioning is
+load-bearing: the Pallas fused RNN kernels lower to ``tpu_custom_call``,
+which the automatic partitioner cannot shard — under plain
+``jit(in_shardings=...)`` each chip would all-gather the global batch
+and run the full kernel, silently serializing data parallelism. Inside
+``shard_map`` every device runs the kernel on its own batch shard.
+
+Loss semantics stay EXACTLY global-batch: ``model.loss(axis_name=...)``
+computes psum'd global sums/normalizers, so nonlinear terms (the KL
+free-bits floor) see the global batch mean, and each device's local
+gradient is its contribution to the global gradient (one psum finishes
+the all-reduce — this is AD through the psum'd loss).
 
 ``donate_argnums=0`` donates the previous state's buffers to the update so
 parameters are updated in place in HBM.
@@ -20,10 +33,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.parallel.mesh import (
+    DATA_AXIS,
     batch_sharding,
     check_batch_divisible,
     replicated_sharding,
@@ -37,20 +51,43 @@ StepFn = Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Metrics]]
 EvalFn = Callable[[Any, Batch, jax.Array], Metrics]
 
 
+def _vma_check(hps: HParams) -> bool:
+    """Whether shard_map's varying-manual-axes replication check can run.
+
+    The Pallas HLO interpreter (used on non-TPU backends, i.e. the CPU
+    test mesh) generates unvarying slice indices that jax 0.9's vma
+    checker rejects ("open an issue / pass check_vma=False"); on real TPU
+    the Mosaic path declares output vma (ops.pallas_fused._sds) and the
+    check stays live everywhere.
+    """
+    return not (hps.fused_rnn and jax.default_backend() != "tpu")
+
+
 def make_train_step(model, hps: HParams,
                     mesh: Optional[Mesh] = None) -> StepFn:
     """Build the jitted ``(state, batch, key) -> (state, metrics)`` step."""
     tx = make_optimizer(hps)
 
-    def step_fn(state: TrainState, batch: Batch, key: jax.Array
-                ) -> Tuple[TrainState, Metrics]:
-        kl_w = kl_weight_schedule(hps, state.step)
+    def grads_and_metrics(params, batch, key, kl_w, axis_name):
+        if axis_name is not None:
+            # decorrelate per-device dropout streams: each shard's rows
+            # draw iid masks (a fresh global draw, not a split of one)
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
 
-        def loss_fn(params):
-            return model.loss(params, batch, key, kl_w, train=True)
+        def loss_fn(p):
+            return model.loss(p, batch, key, kl_w, train=True,
+                              axis_name=axis_name)
 
         (_, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+            loss_fn, has_aux=True)(params)
+        if axis_name is not None:
+            # local grads are per-device contributions to the GLOBAL loss
+            # gradient (the loss is psum'd-global); sum completes the
+            # all-reduce over ICI
+            grads = jax.lax.psum(grads, axis_name)
+        return grads, metrics
+
+    def finish(state, grads, metrics):
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
@@ -59,8 +96,29 @@ def make_train_step(model, hps: HParams,
         return TrainState(params, opt_state, state.step + 1), metrics
 
     if mesh is None:
+        def step_fn(state: TrainState, batch: Batch, key: jax.Array):
+            kl_w = kl_weight_schedule(hps, state.step)
+            grads, metrics = grads_and_metrics(state.params, batch, key,
+                                               kl_w, None)
+            return finish(state, grads, metrics)
+
         return jax.jit(step_fn, donate_argnums=0)
+
     check_batch_divisible(hps.batch_size, mesh)
+    sharded = jax.shard_map(
+        lambda params, batch, key, kl_w: grads_and_metrics(
+            params, batch, key, kl_w, DATA_AXIS),
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=_vma_check(hps),
+    )
+
+    def step_fn(state: TrainState, batch: Batch, key: jax.Array):
+        kl_w = kl_weight_schedule(hps, state.step)
+        grads, metrics = sharded(state.params, batch, key, kl_w)
+        return finish(state, grads, metrics)
+
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
     return jax.jit(
@@ -81,23 +139,43 @@ def make_eval_step(model, hps: HParams,
     simply the same pure loss with ``train=False`` compiled as a second
     XLA program. Returned metrics use the eval normalization that is the
     parity surface: recon-NLL, KL (floored) and total with full KL weight.
+    On a mesh the sweep runs under ``shard_map`` like training; psum'd
+    global sums make every weighted metric exactly the global-batch value
+    regardless of how the zero-weight wrap rows fall across shards.
     """
 
-    def eval_fn(params, batch: Batch, key: jax.Array) -> Metrics:
-        _, metrics = model.loss(params, batch, key,
-                                kl_weight=1.0, train=False)
+    def eval_fn(params, batch: Batch, key: jax.Array,
+                axis_name: Optional[str] = None) -> Metrics:
+        if axis_name is not None:
+            # decorrelate per-shard z draws (as in training): without the
+            # fold every device would sample identical posterior noise and
+            # the NLL estimator's variance would not average down
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+        _, metrics = model.loss(params, batch, key, kl_weight=1.0,
+                                train=False, axis_name=axis_name)
         # GLOBAL count of real (weight>0) rows, computed on device so each
         # host sees the cluster-wide value — the eval sweep weights batch
         # averages by it (wrap-filled duplicate rows carry weight 0)
         if "weights" in batch:
-            metrics["weight_sum"] = jnp.sum(batch["weights"])
+            ws = jnp.sum(batch["weights"])
         else:
-            metrics["weight_sum"] = jnp.float32(batch["strokes"].shape[0])
+            ws = jnp.float32(batch["strokes"].shape[0])
+        if axis_name is not None:
+            ws = jax.lax.psum(ws, axis_name)
+        metrics["weight_sum"] = ws
         return metrics
 
     if mesh is None:
         return jax.jit(eval_fn)
+
+    sharded = jax.shard_map(
+        lambda params, batch, key: eval_fn(params, batch, key, DATA_AXIS),
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P()),
+        out_specs=P(),
+        check_vma=_vma_check(hps),
+    )
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
-    return jax.jit(eval_fn, in_shardings=(repl, data, repl),
+    return jax.jit(sharded, in_shardings=(repl, data, repl),
                    out_shardings=repl)
