@@ -60,6 +60,15 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def vma_of(x) -> frozenset:
+    """``x``'s varying-manual-axes (empty outside shard_map).
+
+    The single place that knows about jax 0.9's ``typeof(...).vma``
+    attribute; shared with ops.rnn's operand widening.
+    """
+    return frozenset(getattr(jax.typeof(x), "vma", None) or ())
+
+
 def _sds(shape, dtype, ref):
     """ShapeDtypeStruct matching ``ref``'s varying-manual-axes.
 
@@ -68,7 +77,7 @@ def _sds(shape, dtype, ref):
     to declare their vma explicitly; outside shard_map this is a plain
     ShapeDtypeStruct.
     """
-    vma = getattr(jax.typeof(ref), "vma", None)
+    vma = vma_of(ref)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -39,13 +39,14 @@ def _match_vma(carry, ref: jax.Array):
     Broadcasting the carry to the inputs' vma fixes it without the cell
     or model code knowing the mesh axis; a no-op outside shard_map.
     """
-    vma = getattr(jax.typeof(ref), "vma", None)
+    from sketch_rnn_tpu.ops.pallas_fused import vma_of
+
+    vma = vma_of(ref)
     if not vma:
         return carry
 
     def widen(c):
-        missing = tuple(a for a in vma
-                        if a not in (getattr(jax.typeof(c), "vma", ()) or ()))
+        missing = tuple(vma - vma_of(c))
         return jax.lax.pcast(c, missing, to="varying") if missing else c
 
     return jax.tree_util.tree_map(widen, carry)

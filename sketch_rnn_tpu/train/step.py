@@ -54,13 +54,15 @@ EvalFn = Callable[[Any, Batch, jax.Array], Metrics]
 def _vma_check(hps: HParams) -> bool:
     """Whether shard_map's varying-manual-axes replication check can run.
 
-    The Pallas HLO interpreter (used on non-TPU backends, i.e. the CPU
-    test mesh) generates unvarying slice indices that jax 0.9's vma
-    checker rejects ("open an issue / pass check_vma=False"); on real TPU
-    the Mosaic path declares output vma (ops.pallas_fused._sds) and the
-    check stays live everywhere.
+    The Pallas HLO interpreter (used whenever the kernels run in
+    interpret mode, i.e. non-TPU backends / the CPU test mesh) generates
+    unvarying slice indices that jax 0.9's vma checker rejects ("open an
+    issue / pass check_vma=False"); on real TPU the Mosaic path declares
+    output vma (ops.pallas_fused._sds) and the check stays live.
     """
-    return not (hps.fused_rnn and jax.default_backend() != "tpu")
+    from sketch_rnn_tpu.ops.pallas_fused import _interpret_default
+
+    return not (hps.fused_rnn and _interpret_default())
 
 
 def make_train_step(model, hps: HParams,

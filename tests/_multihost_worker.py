@@ -2,7 +2,10 @@
 
 Launched by tests/test_multihost.py as::
 
-    python _multihost_worker.py <rank> <nproc> <coordinator> <outdir>
+    python _multihost_worker.py <rank> <nproc> <coordinator> <outdir> [fused]
+
+``fused=1`` runs the production config (Pallas fused kernels, interpret
+mode on CPU, bf16 residuals) through the same sharded step.
 
 Each worker joins the ``jax.distributed`` cluster (the DCN path of
 SURVEY.md §2 component 18 — the reference's NCCL multi-node equivalent),
@@ -19,6 +22,7 @@ import sys
 def main() -> int:
     rank, nproc = int(sys.argv[1]), int(sys.argv[2])
     coordinator, outdir = sys.argv[3], sys.argv[4]
+    fused = len(sys.argv) > 5 and sys.argv[5] == "1"
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
@@ -44,15 +48,17 @@ def main() -> int:
         HPS, dump_params, make_striped_loader, step_keys)
     from sketch_rnn_tpu.models.vae import SketchRNN
 
+    hps = HPS.replace(fused_rnn=True, fused_residual_dtype="bfloat16") \
+        if fused else HPS
     assert mh.process_index() == rank and not mh.is_primary() == bool(rank)
-    lhps = mh.local_batch_hps(HPS)
-    assert lhps.batch_size == HPS.batch_size // nproc
+    lhps = mh.local_batch_hps(hps)
+    assert lhps.batch_size == hps.batch_size // nproc
     loader = make_striped_loader(lhps, host_id=rank, num_hosts=nproc)
 
-    model = SketchRNN(HPS)
-    mesh = make_mesh(HPS)
-    state = make_train_state(model, HPS, jax.random.key(0))
-    step = make_train_step(model, HPS, mesh)
+    model = SketchRNN(hps)
+    mesh = make_mesh(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh)
     for i, key in enumerate(step_keys(3)):
         local = loader.get_batch(i % max(loader.num_batches, 1))
         state, metrics = step(state, shard_batch(local, mesh), key)

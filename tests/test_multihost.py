@@ -19,6 +19,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -36,13 +37,16 @@ def _clean_env() -> dict:
     return env
 
 
-def test_two_process_dp_matches_single_process(tmp_path):
+@pytest.mark.parametrize("fused", [False, True],
+                         ids=["scan", "fused-production"])
+def test_two_process_dp_matches_single_process(tmp_path, fused):
     nproc = 2
     coordinator = f"127.0.0.1:{_free_port()}"
     outdir = str(tmp_path)
     worker = os.path.join(REPO, "tests", "_multihost_worker.py")
     procs = [subprocess.Popen(
-        [sys.executable, worker, str(rank), str(nproc), coordinator, outdir],
+        [sys.executable, worker, str(rank), str(nproc), coordinator, outdir,
+         "1" if fused else "0"],
         env=_clean_env(), cwd=REPO,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for rank in range(nproc)]
@@ -74,13 +78,15 @@ def test_two_process_dp_matches_single_process(tmp_path):
     from tests._multihost_common import (
         HPS, dump_params, make_striped_loader, step_keys)
 
-    lhps = HPS.replace(batch_size=HPS.batch_size // nproc)
+    hps = (HPS.replace(fused_rnn=True, fused_residual_dtype="bfloat16")
+           if fused else HPS)
+    lhps = hps.replace(batch_size=hps.batch_size // nproc)
     stripes = [make_striped_loader(lhps, host_id=r, num_hosts=nproc)
                for r in range(nproc)]
-    model = SketchRNN(HPS)
-    mesh = make_mesh(HPS, devices=jax.devices()[:4])
-    state = make_train_state(model, HPS, jax.random.key(0))
-    step = make_train_step(model, HPS, mesh)
+    model = SketchRNN(hps)
+    mesh = make_mesh(hps, devices=jax.devices()[:4])
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh)
     for i, key in enumerate(step_keys(3)):
         locals_ = [s.get_batch(i % max(s.num_batches, 1)) for s in stripes]
         # multi-process global-array layout: process-local rows concatenate

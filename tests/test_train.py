@@ -146,6 +146,29 @@ def test_mesh_train_matches_single_device():
                                    rtol=2e-4, atol=2e-6)
 
 
+def test_mesh_kl_metrics_match_single_device():
+    """The psum'd-global KL path: with dropout off the encoder (and thus
+    mu/presig, kl_raw and the free-bits floor) is deterministic, so the
+    sharded step's KL metrics must equal the single-device step's exactly
+    — this is the one term that is WRONG if floored per shard and
+    averaged instead of floored on the global-batch mean."""
+    hps = tiny_hps(use_recurrent_dropout=False)
+    assert hps.conditional
+    model = SketchRNN(hps)
+    loader = make_loader(hps)
+    mesh = make_mesh(hps)
+    batch = loader.get_batch(0)
+    key = jax.random.key(1)
+    s1 = make_train_state(model, hps, jax.random.key(0))
+    s2 = jax.tree_util.tree_map(jnp.copy, s1)
+    _, m1 = make_train_step(model, hps, mesh=None)(s1, batch, key)
+    _, m2 = make_train_step(model, hps, mesh=mesh)(
+        s2, shard_batch(batch, mesh), key)
+    np.testing.assert_allclose(float(m2["kl_raw"]), float(m1["kl_raw"]),
+                               rtol=2e-5)
+    np.testing.assert_allclose(float(m2["kl"]), float(m1["kl"]), rtol=2e-5)
+
+
 def test_mesh_train_with_dropout_learns():
     """With dropout on, the sharded step still trains (finite metrics,
     decreasing loss); exact single-device parity is impossible by design
