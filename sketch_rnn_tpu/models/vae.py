@@ -145,19 +145,34 @@ class SketchRNN:
             + params["dec_init_b"])
         return self.dec.unflatten_carry(flat)
 
-    def _decoder_inputs(self, params: Params, x_in_tm: jax.Array,
-                        z: Optional[jax.Array],
-                        labels: Optional[jax.Array]) -> jax.Array:
-        t = x_in_tm.shape[0]
-        parts = [x_in_tm]
+    def _decoder_extra(self, params: Params, z: Optional[jax.Array],
+                       labels: Optional[jax.Array]
+                       ) -> Optional[jax.Array]:
+        """Time-invariant decoder features ``[B, E]``: z, class embedding."""
+        parts = []
         if z is not None:
-            parts.append(jnp.broadcast_to(z[None], (t, *z.shape)))
+            parts.append(z)
         if self.hps.num_classes > 0:
             if labels is None:
                 raise ValueError("num_classes > 0 requires batch labels")
-            emb = params["class_embed"][labels]           # [B, E]
-            parts.append(jnp.broadcast_to(emb[None], (t, *emb.shape)))
-        return jnp.concatenate(parts, axis=-1)
+            parts.append(params["class_embed"][labels])   # [B, E]
+        return jnp.concatenate(parts, axis=-1) if parts else None
+
+    @staticmethod
+    def _broadcast_concat(x_tm: jax.Array,
+                          extra: Optional[jax.Array]) -> jax.Array:
+        if extra is None:
+            return x_tm
+        t = x_tm.shape[0]
+        return jnp.concatenate(
+            [x_tm, jnp.broadcast_to(extra[None], (t, *extra.shape))],
+            axis=-1)
+
+    def _decoder_inputs(self, params: Params, x_in_tm: jax.Array,
+                        z: Optional[jax.Array],
+                        labels: Optional[jax.Array]) -> jax.Array:
+        return self._broadcast_concat(
+            x_in_tm, self._decoder_extra(params, z, labels))
 
     def decode(self, params: Params, x_in_tm: jax.Array,
                z: Optional[jax.Array], labels: Optional[jax.Array] = None,
@@ -166,20 +181,27 @@ class SketchRNN:
         """Teacher-forced decoder -> raw MDN projections ``[T, B, 6M+3]``."""
         hps = self.hps
         b = x_in_tm.shape[1]
-        inputs = self._decoder_inputs(params, x_in_tm, z, labels)
+        # time-invariant features ride as a per-example bias on the fused
+        # path (run_rnn concatenates them for scan/hyper) — no [T, B, E]
+        # z broadcast in HBM unless input dropout needs the full stream
+        extra = self._decoder_extra(params, z, labels)
+        inputs = x_in_tm
         rgen = None
         if train and key is not None:
             krec, kin, kout = jax.random.split(key, 3)
             if hps.use_recurrent_dropout:
                 rgen = (krec, hps.recurrent_dropout_keep)
             if hps.use_input_dropout:
+                inputs = self._broadcast_concat(x_in_tm, extra)
+                extra = None
                 keep = hps.input_dropout_keep
                 mask = jax.random.bernoulli(kin, keep, inputs.shape)
                 inputs = inputs * mask / keep
         carry0 = self.decoder_initial_carry(params, z, b)
         _, hs = run_rnn(self.dec, params["dec"], inputs, carry0,
                         rdrop_gen=rgen, remat=hps.remat,
-                        fused=hps.fused_rnn, residual_dtype=_rdtype(hps))
+                        fused=hps.fused_rnn, residual_dtype=_rdtype(hps),
+                        x_extra=extra)
         if train and key is not None and hps.use_output_dropout:
             keep = hps.output_dropout_keep
             mask = jax.random.bernoulli(kout, keep, hs.shape)

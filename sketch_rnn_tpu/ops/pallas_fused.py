@@ -100,6 +100,18 @@ def _batch_tile(b: int, h: int) -> int:
     return b
 
 
+def _tile_for(b: int, h: int, x_bias) -> int:
+    """Batch tile accounting for the optional per-example bias.
+
+    ``x_bias`` adds ~3 more ``[tile, 4H]`` f32 buffers to the backward's
+    working set (the bias tile, its gradient accumulator and output), so
+    its effective hidden size is ~1.5x — at the flagship decoder shape
+    tile 256 with a bias exceeds the 16M scoped VMEM by ~0.7M while 128
+    fits.
+    """
+    return _batch_tile(b, h + h // 2 if x_bias is not None else h)
+
+
 def _cast(x, w_ref):
     return x.astype(w_ref.dtype)
 
@@ -180,9 +192,10 @@ def _lstm_gates(pre, c_prev, mask, *, forget_bias):
     return i, g_u, f, o, new_c
 
 
-def _lstm_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref, mask_ref,
-                     seed_ref, hs_ref, cs_ref, cT_ref, hT_ref,
-                     c_scr, h_scr, *, forget_bias, mask_mode, keep_prob):
+def _lstm_fwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref,
+                     mask_ref, seed_ref, hs_ref, cs_ref, cT_ref, hT_ref,
+                     c_scr, h_scr, *, forget_bias, mask_mode, keep_prob,
+                     xb_mode):
     ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -199,6 +212,8 @@ def _lstm_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref, mask_ref,
            + b_ref[0]
            + jnp.dot(_cast(h, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
+    if xb_mode:
+        pre = pre + xb_ref[...]
     m = _step_mask(mask_ref, seed_ref, it, ib, pl.num_programs(0),
                    c.shape, keep_prob, mask_mode)
     _, _, _, o, new_c = _lstm_gates(pre, c, m, forget_bias=forget_bias)
@@ -216,10 +231,11 @@ def _lstm_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref, mask_ref,
         hT_ref[:] = new_h
 
 
-def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
-                     seed_ref, dhs_ref, dcT_ref, dhT_ref,
-                     dx_ref, dwx_ref, db_ref, dwh_ref, dc0_ref, dh0_ref,
-                     dc_scr, dh_scr, *, forget_bias, mask_mode, keep_prob):
+def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
+                     mask_ref, seed_ref, dhs_ref, dcT_ref, dhT_ref,
+                     dx_ref, dxb_ref, dwx_ref, db_ref, dwh_ref, dc0_ref,
+                     dh0_ref, dc_scr, dh_scr, dxb_scr,
+                     *, forget_bias, mask_mode, keep_prob, xb_mode):
     """Reverse-time inner grid: program (ib, it) handles step T-1-it."""
     ib = pl.program_id(0)
     it = pl.program_id(1)
@@ -235,6 +251,7 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
     def _():
         dc_scr[:] = dcT_ref[:]
         dh_scr[:] = dhT_ref[:]
+        dxb_scr[:] = jnp.zeros_like(dxb_scr)
 
     # ---- recompute the forward step (the whole point of this kernel) ----
     x = x_ref[0]
@@ -245,6 +262,8 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
            + b_ref[0]
            + jnp.dot(_cast(h_prev, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
+    if xb_mode:
+        pre = pre + xb_ref[...]
     # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
     m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
                    pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
@@ -267,6 +286,8 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
         do * o * (1.0 - o),
     ], axis=-1)
 
+    if xb_mode:
+        dxb_scr[:] += d_pre
     d_pre_c = _cast(d_pre, wx_ref)
     dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
                         preferred_element_type=jnp.float32)
@@ -283,6 +304,7 @@ def _lstm_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref, mask_ref,
     def _():
         dc0_ref[:] = dc_scr[:]
         dh0_ref[:] = dh_scr[:]
+        dxb_ref[...] = dxb_scr[:].astype(dxb_ref.dtype)
 
 
 def _specs(bt, h, mask_mode, mask_shape):
@@ -314,6 +336,23 @@ def _mask_args(masks, seed, t):
     return mode, mask_arg, seed_arg
 
 
+def _xb_args(x_bias, bt, tile, whole):
+    """Resolve the per-example input-bias operand and its BlockSpec.
+
+    ``x_bias [B, 4H]`` carries the projection of TIME-INVARIANT decoder
+    inputs (the latent z and the class embedding): instead of streaming
+    them through every step's ``[T, B, D]`` xs (and paying the wider
+    in-kernel matmul plus the broadcast HBM traffic), the caller
+    projects them ONCE and the kernel adds the result to each step's
+    gate pre-activations.
+    """
+    if x_bias is None:
+        dummy = jnp.zeros((1, 1), jnp.float32)
+        return False, dummy, whole((1, 1)), dummy.shape
+    return (True, x_bias, tile((bt, x_bias.shape[-1])),
+            (bt, x_bias.shape[-1]))
+
+
 def _seed_cotangent(seed):
     if seed is None:
         return None
@@ -328,7 +367,8 @@ def fused_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array, wh: jax.Array,
                masks: Optional[jax.Array] = None,
                dropout_seed: Optional[jax.Array] = None,
                keep_prob: float = 1.0,
-               residual_dtype=jnp.float32
+               residual_dtype=jnp.float32,
+               x_bias: Optional[jax.Array] = None
                ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Fused LSTM over a whole sequence, recompute-backward.
 
@@ -345,32 +385,38 @@ def fused_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array, wh: jax.Array,
         keep probability for this mode.
       residual_dtype: storage dtype for ``hs`` and the saved pre-step
         cell states (bfloat16 halves residual HBM; math stays f32).
+      x_bias: optional ``[B, 4H]`` per-example bias added to every
+        step's gate pre-activations — the projection of time-invariant
+        inputs (z, class embedding), hoisted out of the per-step matmul.
 
     Returns ``(hs [T, B, H], (cT, hT))`` with ``hs`` in
     ``residual_dtype``; the final carry is always float32.
     """
     hs, cT, hT, _ = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias,
                                    masks, dropout_seed, keep_prob,
-                                   residual_dtype)
+                                   residual_dtype, x_bias)
     return hs, (cT, hT)
 
 
 def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
-                   keep_prob, residual_dtype):
+                   keep_prob, residual_dtype, x_bias):
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz, h)
+    bt = _tile_for(bsz, h, x_bias)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
+    xb_mode, xb_arg, xb_spec, xb_scr_shape = _xb_args(
+        x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lstm_fwd_kernel, forget_bias=forget_bias,
-                               mask_mode=mode, keep_prob=keep_prob)
+                               mask_mode=mode, keep_prob=keep_prob,
+                               xb_mode=xb_mode)
     hs, cs, cT, hT = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+        in_specs=[step((bt, d)), xb_spec, whole(wx.shape), whole(b2.shape),
                   whole(wh.shape), tile((bt, h)), tile((bt, h)), mask_spec,
                   seed_spec],
         out_specs=(step((bt, h)), step((bt, h)), tile((bt, h)),
@@ -384,43 +430,48 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
-    )(xs, wx, b2, wh, c0, h0, mask_arg, seed_arg)
+    )(xs, xb_arg, wx, b2, wh, c0, h0, mask_arg, seed_arg)
     return hs, cT, hT, cs
 
 
 def _fused_lstm_fwd(xs, wx, b, wh, c0, h0, forget_bias, masks,
-                    dropout_seed, keep_prob, residual_dtype):
+                    dropout_seed, keep_prob, residual_dtype, x_bias):
     hs, cT, hT, cs = _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias,
                                     masks, dropout_seed, keep_prob,
-                                    residual_dtype)
-    return (hs, (cT, hT)), (xs, wx, b, wh, h0, hs, cs, masks, dropout_seed)
+                                    residual_dtype, x_bias)
+    return (hs, (cT, hT)), (xs, wx, b, wh, h0, hs, cs, masks, dropout_seed,
+                            x_bias)
 
 
 def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
-    xs, wx, b, wh, h0, hs, cs, masks, seed = res
+    xs, wx, b, wh, h0, hs, cs, masks, seed, x_bias = res
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz, h)
+    bt = _tile_for(bsz, h, x_bias)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
+    xb_mode, xb_arg, xb_spec, xb_scr_shape = _xb_args(
+        x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lstm_bwd_kernel, forget_bias=forget_bias,
-                               mask_mode=mode, keep_prob=keep_prob)
-    dxs_rev, dwx, db2, dwh, dc0, dh0 = pl.pallas_call(
+                               mask_mode=mode, keep_prob=keep_prob,
+                               xb_mode=xb_mode)
+    dxs_rev, dxb, dwx, db2, dwh, dc0, dh0 = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+        in_specs=[step((bt, d)), xb_spec, whole(wx.shape), whole(b2.shape),
                   whole(wh.shape), step((bt, h)), step((bt, h)), mask_spec,
                   seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h))],
-        out_specs=(step((bt, d)), whole(wx.shape), whole(b2.shape),
+        out_specs=(step((bt, d)), xb_spec, whole(wx.shape), whole(b2.shape),
                    whole(wh.shape), tile((bt, h)), tile((bt, h))),
         out_shape=(
             _sds((t, bsz, d), jnp.float32, xs),
+            _sds(xb_arg.shape, jnp.float32, xs),
             _sds(wx.shape, jnp.float32, xs),
             _sds(b2.shape, jnp.float32, xs),
             _sds(wh.shape, jnp.float32, xs),
@@ -428,16 +479,18 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
             _sds((bsz, h), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
-                        pltpu.VMEM((bt, h), jnp.float32)],
+                        pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM(xb_scr_shape, jnp.float32)],
         interpret=_interpret_default(),
-    )(rev(xs), wx, b2, wh, rev(cs), rev(h_prev),
+    )(rev(xs), xb_arg, wx, b2, wh, rev(cs), rev(h_prev),
       rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
       rev(dhs), dcT, dhT)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
+    dxb_out = dxb.astype(x_bias.dtype) if x_bias is not None else None
     # cotangent dtypes must match the primals (wx/wh may be pre-cast bf16)
     return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
             db2.reshape(-1).astype(b.dtype), dwh.astype(wh.dtype),
-            dc0, dh0, dmasks, _seed_cotangent(seed))
+            dc0, dh0, dmasks, _seed_cotangent(seed), dxb_out)
 
 
 fused_lstm.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
@@ -472,10 +525,11 @@ def _ln_gates(pre, c_prev, mask, gam, bet, gc, bc, *, forget_bias,
     return (i, g_u, f, o, new_c, new_h, yc, xhat_c, r_c, xhats, rs)
 
 
-def _lnlstm_fwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
-                       bc_ref, c0_ref, h0_ref, mask_ref, seed_ref,
+def _lnlstm_fwd_kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
+                       gc_ref, bc_ref, c0_ref, h0_ref, mask_ref, seed_ref,
                        hs_ref, cs_ref, cT_ref, hT_ref,
-                       c_scr, h_scr, *, forget_bias, mask_mode, keep_prob):
+                       c_scr, h_scr, *, forget_bias, mask_mode, keep_prob,
+                       xb_mode):
     ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -490,6 +544,8 @@ def _lnlstm_fwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
                    preferred_element_type=jnp.float32)
            + jnp.dot(_cast(h, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
+    if xb_mode:
+        pre = pre + xb_ref[...]
     m = _step_mask(mask_ref, seed_ref, it, ib, pl.num_programs(0),
                    c.shape, keep_prob, mask_mode)
     new_c, new_h = _ln_gates(pre, c, m, gam_ref[...], bet_ref[...],
@@ -543,13 +599,13 @@ def _ln_lstm_bwd_gates(dh, dc_carry, c_prev, m, ln_res, gam, gc,
     return jnp.concatenate(d_pre_parts, axis=-1), dc * f
 
 
-def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
-                       bc_ref, cs_ref, hp_ref, mask_ref, seed_ref,
+def _lnlstm_bwd_kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
+                       gc_ref, bc_ref, cs_ref, hp_ref, mask_ref, seed_ref,
                        dhs_ref, dcT_ref, dhT_ref,
-                       dx_ref, dwx_ref, dwh_ref, dgam_ref, dbet_ref,
-                       dgc_ref, dbc_ref, dc0_ref, dh0_ref,
-                       dc_scr, dh_scr, *, forget_bias, mask_mode,
-                       keep_prob):
+                       dx_ref, dxb_ref, dwx_ref, dwh_ref, dgam_ref,
+                       dbet_ref, dgc_ref, dbc_ref, dc0_ref, dh0_ref,
+                       dc_scr, dh_scr, dxb_scr, *, forget_bias, mask_mode,
+                       keep_prob, xb_mode):
     ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -567,6 +623,7 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
     def _():
         dc_scr[:] = dcT_ref[:]
         dh_scr[:] = dhT_ref[:]
+        dxb_scr[:] = jnp.zeros_like(dxb_scr)
 
     x = x_ref[0]
     h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
@@ -577,6 +634,8 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
                    preferred_element_type=jnp.float32)
            + jnp.dot(_cast(h_prev, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
+    if xb_mode:
+        pre = pre + xb_ref[...]
     # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
     m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
                    pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
@@ -587,6 +646,8 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
     d_pre, dc_next = _ln_lstm_bwd_gates(dh, dc_scr[:], c_prev, m, ln_res,
                                         gam, gc, dgam_ref, dbet_ref,
                                         dgc_ref, dbc_ref)
+    if xb_mode:
+        dxb_scr[:] += d_pre
 
     d_pre_c = _cast(d_pre, wx_ref)
     dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
@@ -603,6 +664,7 @@ def _lnlstm_bwd_kernel(x_ref, wx_ref, wh_ref, gam_ref, bet_ref, gc_ref,
     def _():
         dc0_ref[:] = dc_scr[:]
         dh0_ref[:] = dh_scr[:]
+        dxb_ref[...] = dxb_scr[:].astype(dxb_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(9, 12, 13))
@@ -613,7 +675,8 @@ def fused_ln_lstm(xs: jax.Array, wx: jax.Array, wh: jax.Array,
                   masks: Optional[jax.Array] = None,
                   dropout_seed: Optional[jax.Array] = None,
                   keep_prob: float = 1.0,
-                  residual_dtype=jnp.float32
+                  residual_dtype=jnp.float32,
+                  x_bias: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """Fused LayerNorm-LSTM (the flagship decoder cell), recompute-backward.
 
@@ -622,32 +685,38 @@ def fused_ln_lstm(xs: jax.Array, wx: jax.Array, wh: jax.Array,
     [H]``, no linear bias (the LN betas take that role), forget bias added
     after the LN, dropout on the candidate. Dropout comes as streamed
     ``masks`` or as in-kernel PRNG draws (``dropout_seed`` + static
-    ``keep_prob`` — no mask buffer in HBM). Returns ``(hs, (cT, hT))``
-    with ``hs`` stored in ``residual_dtype``.
+    ``keep_prob`` — no mask buffer in HBM). ``x_bias [B, 4H]``: optional
+    per-example bias added to every step's gate pre-activations — the
+    projection of time-invariant inputs (z, class embedding), hoisted
+    out of the per-step matmul. Returns ``(hs, (cT, hT))`` with ``hs``
+    stored in ``residual_dtype``.
     """
     hs, cT, hT, _ = _lnlstm_fwd_call(xs, wx, wh, ln_gamma, ln_beta,
                                      lnc_gamma, lnc_beta, c0, h0,
                                      forget_bias, masks, dropout_seed,
-                                     keep_prob, residual_dtype)
+                                     keep_prob, residual_dtype, x_bias)
     return hs, (cT, hT)
 
 
 def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
-                     masks, seed, keep_prob, residual_dtype):
+                     masks, seed, keep_prob, residual_dtype, x_bias):
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz, h)
+    bt = _tile_for(bsz, h, x_bias)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
+    xb_mode, xb_arg, xb_spec, xb_scr_shape = _xb_args(
+        x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lnlstm_fwd_kernel, forget_bias=forget_bias,
-                               mask_mode=mode, keep_prob=keep_prob)
+                               mask_mode=mode, keep_prob=keep_prob,
+                               xb_mode=xb_mode)
     hs, cs, cT, hT = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), whole(wx.shape), whole(wh.shape),
+        in_specs=[step((bt, d)), xb_spec, whole(wx.shape), whole(wh.shape),
                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
                   whole(bc2.shape), tile((bt, h)), tile((bt, h)), mask_spec,
                   seed_spec],
@@ -662,47 +731,52 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
-    )(xs, wx, wh, gam, bet, gc2, bc2, c0, h0, mask_arg, seed_arg)
+    )(xs, xb_arg, wx, wh, gam, bet, gc2, bc2, c0, h0, mask_arg, seed_arg)
     return hs, cT, hT, cs
 
 
 def _fused_ln_lstm_fwd(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
-                       masks, dropout_seed, keep_prob, residual_dtype):
+                       masks, dropout_seed, keep_prob, residual_dtype,
+                       x_bias):
     hs, cT, hT, cs = _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0,
                                       forget_bias, masks, dropout_seed,
-                                      keep_prob, residual_dtype)
+                                      keep_prob, residual_dtype, x_bias)
     return (hs, (cT, hT)), (xs, wx, wh, gam, bet, gc, bc, h0, hs, cs,
-                            masks, dropout_seed)
+                            masks, dropout_seed, x_bias)
 
 
 def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
-    xs, wx, wh, gam, bet, gc, bc, h0, hs, cs, masks, seed = res
+    xs, wx, wh, gam, bet, gc, bc, h0, hs, cs, masks, seed, x_bias = res
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz, h)
+    bt = _tile_for(bsz, h, x_bias)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
+    xb_mode, xb_arg, xb_spec, xb_scr_shape = _xb_args(
+        x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lnlstm_bwd_kernel, forget_bias=forget_bias,
-                               mask_mode=mode, keep_prob=keep_prob)
-    (dxs_rev, dwx, dwh, dgam, dbet, dgc2, dbc2,
+                               mask_mode=mode, keep_prob=keep_prob,
+                               xb_mode=xb_mode)
+    (dxs_rev, dxb, dwx, dwh, dgam, dbet, dgc2, dbc2,
      dc0, dh0) = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), whole(wx.shape), whole(wh.shape),
+        in_specs=[step((bt, d)), xb_spec, whole(wx.shape), whole(wh.shape),
                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
                   whole(bc2.shape), step((bt, h)), step((bt, h)), mask_spec,
                   seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h))],
-        out_specs=(step((bt, d)), whole(wx.shape), whole(wh.shape),
+        out_specs=(step((bt, d)), xb_spec, whole(wx.shape), whole(wh.shape),
                    whole(gam.shape), whole(bet.shape), whole(gc2.shape),
                    whole(bc2.shape), tile((bt, h)), tile((bt, h))),
         out_shape=(
             _sds((t, bsz, d), jnp.float32, xs),
+            _sds(xb_arg.shape, jnp.float32, xs),
             _sds(wx.shape, jnp.float32, xs),
             _sds(wh.shape, jnp.float32, xs),
             _sds(gam.shape, jnp.float32, xs),
@@ -713,16 +787,19 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
             _sds((bsz, h), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
-                        pltpu.VMEM((bt, h), jnp.float32)],
+                        pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM(xb_scr_shape, jnp.float32)],
         interpret=_interpret_default(),
-    )(rev(xs), wx, wh, gam, bet, gc2, bc2, rev(cs), rev(h_prev),
+    )(rev(xs), xb_arg, wx, wh, gam, bet, gc2, bc2, rev(cs), rev(h_prev),
       rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
       rev(dhs), dcT, dhT)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
+    dxb_out = dxb.astype(x_bias.dtype) if x_bias is not None else None
     # cotangent dtypes must match the primals (wx/wh may be pre-cast bf16)
     return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
             dwh.astype(wh.dtype), dgam, dbet, dgc2.reshape(-1),
-            dbc2.reshape(-1), dc0, dh0, dmasks, _seed_cotangent(seed))
+            dbc2.reshape(-1), dc0, dh0, dmasks, _seed_cotangent(seed),
+            dxb_out)
 
 
 fused_ln_lstm.defvjp(_fused_ln_lstm_fwd, _fused_ln_lstm_bwd)

@@ -62,8 +62,15 @@ def _block_diag4(w: jax.Array) -> jax.Array:
     return jax.scipy.linalg.block_diag(*w)
 
 
+def _concat_extra(xs: jax.Array, extra: jax.Array) -> jax.Array:
+    """Broadcast time-invariant features over T and concatenate to xs."""
+    t = xs.shape[0]
+    return jnp.concatenate(
+        [xs, jnp.broadcast_to(extra[None], (t, *extra.shape))], axis=-1)
+
+
 def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
-               residual_dtype=None):
+               residual_dtype=None, x_extra=None):
     """Dispatch to the Pallas recompute-backward kernels (ops.pallas_fused).
 
     Covers all three cells (LSTM / LayerNormLSTM / HyperLSTM). ``reverse``
@@ -96,6 +103,18 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
     cd = cell.compute_dtype
     cast = (lambda w: w.astype(cd)) if cd else (lambda w: w)
     wx, wh = cast(params["wx"]), cast(params["wh"])
+    xb = None
+    if x_extra is not None:
+        # time-invariant inputs (z, class embedding): project ONCE into a
+        # per-example [B, 4H] bias instead of streaming them through every
+        # step's xs — the kernel's per-step matmul shrinks from
+        # [*, d+E] @ [d+E, 4H] to [*, d] @ [d, 4H] and no [T, B, E]
+        # broadcast ever exists in HBM
+        d_s = xs.shape[-1]
+        wx_e = cast(params["wx"][d_s:])
+        wx = cast(params["wx"][:d_s])
+        xb = jnp.dot(x_extra.astype(wx_e.dtype), wx_e,
+                     preferred_element_type=jnp.float32)
     rd = residual_dtype if residual_dtype is not None else jnp.float32
     if isinstance(cell, HyperLSTMCell):
         if not cell.use_layer_norm:
@@ -122,11 +141,11 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
         hs, fin = PF.fused_ln_lstm(
             xs, wx, wh, params["ln_gamma"], params["ln_beta"],
             params["lnc_gamma"], params["lnc_beta"], c0, h0,
-            cell.forget_bias, masks, seed, keep, rd)
+            cell.forget_bias, masks, seed, keep, rd, xb)
     else:
         c0, h0 = carry0
         hs, fin = PF.fused_lstm(xs, wx, params["b"], wh, c0, h0,
-                                cell.forget_bias, masks, seed, keep, rd)
+                                cell.forget_bias, masks, seed, keep, rd, xb)
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return fin, hs
@@ -146,7 +165,8 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
             hoist: bool = False,
             rdrop_gen: Optional[Tuple[jax.Array, float]] = None,
             remat: bool = False, fused: bool = False,
-            residual_dtype=None) -> Tuple[Any, jax.Array]:
+            residual_dtype=None,
+            x_extra: Optional[jax.Array] = None) -> Tuple[Any, jax.Array]:
     """Scan ``cell`` over time-major inputs ``xs`` of shape ``[T, B, D]``.
 
     Returns ``(final_carry, hs)`` with ``hs`` of shape ``[T, B, H]``.
@@ -180,20 +200,34 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
     ``residual_dtype`` (fused path only): storage dtype for the kernels'
     saved streams — bfloat16 halves residual HBM footprint/bandwidth at
     ~0.4% relative gradient noise; None keeps float32.
+
+    ``x_extra`` (``[B, E]``, optional): TIME-INVARIANT input features
+    (the decoder's z and class embedding). The cell's ``wx`` must cover
+    ``xs.width + E`` rows. On the LSTM/LayerNorm fused path these are
+    projected once into a per-example gate bias (no ``[T, B, E]``
+    broadcast in HBM, narrower per-step matmuls); elsewhere they are
+    broadcast and concatenated — identical semantics either way.
     """
+    from sketch_rnn_tpu.ops.cells import HyperLSTMCell
+
+    use_fused = fused and fused_supported(cell)
+    if x_extra is not None and not (use_fused
+                                    and not isinstance(cell, HyperLSTMCell)):
+        xs = _concat_extra(xs, x_extra)
+        x_extra = None
     if carry0 is None:
         carry0 = cell.initial_carry(xs.shape[1])
     carry0 = _match_vma(carry0, xs)
     if rdrop_masks is not None and rdrop_gen is not None:
         raise ValueError("pass rdrop_masks or rdrop_gen, not both")
 
-    if fused and fused_supported(cell):
+    if use_fused:
         # Pallas recompute-backward kernel (ops.pallas_fused): measured
         # 1.6-2.3x faster fwd+bwd than this scan per cell at T=250 B=128
         # H=512 on v5e (scripts/bench_kernel.py); remat is moot there
         # (the kernels save only the carry streams and recompute gates)
         return _run_fused(cell, params, xs, carry0, rdrop_masks, reverse,
-                          rdrop_gen, residual_dtype)
+                          rdrop_gen, residual_dtype, x_extra)
 
     inputs = cell.precompute_inputs(params, xs) if hoist else xs
     stepper = cell.step_pre if hoist else cell
