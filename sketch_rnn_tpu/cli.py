@@ -152,6 +152,21 @@ def cmd_sample(args) -> int:
         print("[cli] --interpolate/--reconstruct need a conditional "
               "(encoder) model", file=sys.stderr)
         return 2
+    temps = None
+    if args.temperatures:
+        if args.interpolate or args.reconstruct:
+            print("[cli] --temperatures cannot combine with "
+                  "--interpolate/--reconstruct", file=sys.stderr)
+            return 2
+        try:
+            temps = [float(t) for t in args.temperatures.split(",") if t]
+        except ValueError:
+            print(f"[cli] bad --temperatures {args.temperatures!r}; "
+                  f"expected comma-separated floats", file=sys.stderr)
+            return 2
+        if not temps:
+            print("[cli] --temperatures is empty", file=sys.stderr)
+            return 2
     model, state, scale, meta = _restore(hps, args.workdir)
     key = jax.random.key(args.seed)
     z = None
@@ -183,6 +198,28 @@ def cmd_sample(args) -> int:
                 labels = np.asarray(batch["labels"][:n], np.int32)
     if labels is None and hps.num_classes > 0:
         labels = np.full((n,), args.label, np.int32)
+    if temps is not None:
+        # the notebook's temperature-sweep figure: one grid row of n
+        # samples per temperature, SAME latents in every row so the rows
+        # differ only by tau (conditional models: one prior z batch drawn
+        # up front; the per-row keys still vary the in-row MDN draws).
+        # The compiled sampler is reused across rows — temperature is a
+        # runtime scalar.
+        kz, key = jax.random.split(key)
+        if hps.conditional:
+            z = jax.random.normal(kz, (n, hps.z_size))
+        sketches = []
+        for i, tau in enumerate(temps):
+            sk, _ = sample(model, state.params, hps,
+                           jax.random.fold_in(key, i), n=n,
+                           temperature=tau, z=z, labels=labels,
+                           scale_factor=scale, greedy=args.greedy)
+            sketches += sk
+        if mh.is_primary():
+            svg_grid(sketches, cols=n, path=args.output)
+            print(f"[cli] wrote {len(temps)} temperature rows "
+                  f"({temps}) x {n} sketches to {args.output}")
+        return 0
     sketches, lengths = sample(model, state.params, hps, key, n=n,
                                temperature=args.temperature, z=z,
                                labels=labels, scale_factor=scale,
@@ -230,6 +267,9 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p)
     p.add_argument("-n", type=int, default=10, help="number of sketches")
     p.add_argument("--temperature", type=float, default=0.5)
+    p.add_argument("--temperatures", default="",
+                   help="comma-separated sweep (e.g. 0.2,0.5,0.8,1.0): "
+                        "one grid row of n sketches per temperature")
     p.add_argument("--greedy", action="store_true")
     mode = p.add_mutually_exclusive_group()
     mode.add_argument("--interpolate", action="store_true",

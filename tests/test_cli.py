@@ -55,6 +55,21 @@ def test_cli_reconstruct_sample(tmp_path, capsys):
     assert open(out).read().startswith("<svg")
 
 
+def test_cli_temperature_sweep(tmp_path, capsys):
+    wd = str(tmp_path / "work")
+    main(["train", "--synthetic", f"--workdir={wd}", f"--hparams={HP}"])
+    out = str(tmp_path / "t.svg")
+    assert main(["sample", "--synthetic", f"--workdir={wd}", "-n", "2",
+                 "--temperatures=0.3,0.8", f"--output={out}"]) == 0
+    assert "2 temperature rows" in capsys.readouterr().out
+    assert open(out).read().startswith("<svg")
+    # malformed sweep strings are usage errors, not tracebacks
+    assert main(["sample", "--synthetic", f"--workdir={wd}",
+                 "--temperatures=0.3,,abc"]) == 2
+    assert main(["sample", "--synthetic", f"--workdir={wd}",
+                 "--temperatures=0.3", "--reconstruct"]) == 2
+
+
 def test_cli_reconstruct_and_interpolate_exclusive(tmp_path):
     # argparse rejects the combination at parse time (SystemExit 2),
     # before any checkpoint restore
