@@ -53,16 +53,26 @@ def _cell_flops(kind: str, input_size: int, hidden: int, hps: HParams) -> int:
 
 
 def flops_per_stroke(hps: HParams, train: bool = True) -> float:
-    """Model FLOPs per stroke point (one timestep of one sequence).
+    """Actual FLOPs executed per stroke point (one timestep of one
+    sequence) — an implementation accounting, not a canonical-model one.
 
     Forward: encoder (2 directions over the full sequence, when
     conditional) + decoder cell + the 6M+3 output projection. Training
     multiplies by 3 (backward ~= 2x forward) plus one extra forward when
     ``hps.remat`` recomputes activations in the backward pass.
+
+    On the fused LSTM/LayerNorm decoder path, the time-invariant inputs
+    (z, class embedding) are projected ONCE per sequence as a gate bias
+    (ops/rnn.py x_extra), so the per-step decoder input width is just the
+    stroke-5 — counting the full width there would overstate MFU by ~6%
+    at the flagship config.
     """
     from sketch_rnn_tpu.models.vae import SketchRNN
 
     dec_in = SketchRNN(hps).decoder_input_size
+    if (hps.fused_rnn and hps.dec_model in ("lstm", "layer_norm")
+            and not hps.use_input_dropout):
+        dec_in = 5  # extras ride as a per-sequence bias, amortized ~0
     fwd = (_cell_flops(hps.dec_model, dec_in, hps.dec_rnn_size, hps)
            + 2 * hps.dec_rnn_size * (6 * hps.num_mixture + 3))
     if hps.conditional:
