@@ -92,24 +92,18 @@ def _batch_tile(b: int, h: int) -> int:
     kernel). Bigger tiles cut the grid-step count, which dominates for
     small-H cells: the H=256 encoder at B=4096 measured 56.6 ms fwd+bwd
     at tile 128 vs 46.2 ms at tile 512 (tile 1024 exceeds VMEM).
+
+    The ``x_bias`` path adds two ``[tile, 4H]`` f32 blocks (the bias
+    operand and the in-output dxb accumulator) on top of this budget;
+    verified to fit on v5e at both cap-boundary shapes (H=512/tile 256
+    — the flagship — and H=256/tile 512, whose smaller weights leave
+    the headroom).
     """
     cap = max(8, 131072 // max(h, 1))
     for cand in (512, 256, 128, 64, 32, 16, 8):
         if cand <= cap and b % cand == 0:
             return cand
     return b
-
-
-def _tile_for(b: int, h: int, x_bias) -> int:
-    """Batch tile accounting for the optional per-example bias.
-
-    ``x_bias`` adds ~3 more ``[tile, 4H]`` f32 buffers to the backward's
-    working set (the bias tile, its gradient accumulator and output), so
-    its effective hidden size is ~1.5x — at the flagship decoder shape
-    tile 256 with a bias exceeds the 16M scoped VMEM by ~0.7M while 128
-    fits.
-    """
-    return _batch_tile(b, h + h // 2 if x_bias is not None else h)
 
 
 def _cast(x, w_ref):
@@ -234,7 +228,7 @@ def _lstm_fwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref,
 def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
                      mask_ref, seed_ref, dhs_ref, dcT_ref, dhT_ref,
                      dx_ref, dxb_ref, dwx_ref, db_ref, dwh_ref, dc0_ref,
-                     dh0_ref, dc_scr, dh_scr, dxb_scr,
+                     dh0_ref, dc_scr, dh_scr,
                      *, forget_bias, mask_mode, keep_prob, xb_mode):
     """Reverse-time inner grid: program (ib, it) handles step T-1-it."""
     ib = pl.program_id(0)
@@ -251,7 +245,10 @@ def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
     def _():
         dc_scr[:] = dcT_ref[:]
         dh_scr[:] = dhT_ref[:]
-        dxb_scr[:] = jnp.zeros_like(dxb_scr)
+        # dxb accumulates IN the (VMEM-resident, revisited) output block,
+        # like the weight grads — a separate scratch would cost another
+        # [bt, 4H] of VMEM and push the tile size down
+        dxb_ref[...] = jnp.zeros_like(dxb_ref)
 
     # ---- recompute the forward step (the whole point of this kernel) ----
     x = x_ref[0]
@@ -287,7 +284,7 @@ def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
     ], axis=-1)
 
     if xb_mode:
-        dxb_scr[:] += d_pre
+        dxb_ref[...] += d_pre
     d_pre_c = _cast(d_pre, wx_ref)
     dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
                         preferred_element_type=jnp.float32)
@@ -304,7 +301,6 @@ def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
     def _():
         dc0_ref[:] = dc_scr[:]
         dh0_ref[:] = dh_scr[:]
-        dxb_ref[...] = dxb_scr[:].astype(dxb_ref.dtype)
 
 
 def _specs(bt, h, mask_mode, mask_shape):
@@ -347,10 +343,8 @@ def _xb_args(x_bias, bt, tile, whole):
     gate pre-activations.
     """
     if x_bias is None:
-        dummy = jnp.zeros((1, 1), jnp.float32)
-        return False, dummy, whole((1, 1)), dummy.shape
-    return (True, x_bias, tile((bt, x_bias.shape[-1])),
-            (bt, x_bias.shape[-1]))
+        return False, jnp.zeros((1, 1), jnp.float32), whole((1, 1))
+    return True, x_bias, tile((bt, x_bias.shape[-1]))
 
 
 def _seed_cotangent(seed):
@@ -402,13 +396,12 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
                    keep_prob, residual_dtype, x_bias):
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _tile_for(bsz, h, x_bias)
+    bt = _batch_tile(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
-    xb_mode, xb_arg, xb_spec, xb_scr_shape = _xb_args(
-        x_bias, bt, tile, whole)
+    xb_mode, xb_arg, xb_spec = _xb_args(x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lstm_fwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob,
@@ -448,15 +441,14 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _tile_for(bsz, h, x_bias)
+    bt = _batch_tile(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
-    xb_mode, xb_arg, xb_spec, xb_scr_shape = _xb_args(
-        x_bias, bt, tile, whole)
+    xb_mode, xb_arg, xb_spec = _xb_args(x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lstm_bwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob,
@@ -479,8 +471,7 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
             _sds((bsz, h), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
-                        pltpu.VMEM((bt, h), jnp.float32),
-                        pltpu.VMEM(xb_scr_shape, jnp.float32)],
+                        pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
     )(rev(xs), xb_arg, wx, b2, wh, rev(cs), rev(h_prev),
       rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
@@ -604,7 +595,7 @@ def _lnlstm_bwd_kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
                        dhs_ref, dcT_ref, dhT_ref,
                        dx_ref, dxb_ref, dwx_ref, dwh_ref, dgam_ref,
                        dbet_ref, dgc_ref, dbc_ref, dc0_ref, dh0_ref,
-                       dc_scr, dh_scr, dxb_scr, *, forget_bias, mask_mode,
+                       dc_scr, dh_scr, *, forget_bias, mask_mode,
                        keep_prob, xb_mode):
     ib = pl.program_id(0)
     it = pl.program_id(1)
@@ -623,7 +614,10 @@ def _lnlstm_bwd_kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
     def _():
         dc_scr[:] = dcT_ref[:]
         dh_scr[:] = dhT_ref[:]
-        dxb_scr[:] = jnp.zeros_like(dxb_scr)
+        # dxb accumulates IN the (VMEM-resident, revisited) output block,
+        # like the weight grads — a separate scratch would cost another
+        # [bt, 4H] of VMEM and push the tile size down
+        dxb_ref[...] = jnp.zeros_like(dxb_ref)
 
     x = x_ref[0]
     h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
@@ -647,7 +641,7 @@ def _lnlstm_bwd_kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
                                         gam, gc, dgam_ref, dbet_ref,
                                         dgc_ref, dbc_ref)
     if xb_mode:
-        dxb_scr[:] += d_pre
+        dxb_ref[...] += d_pre
 
     d_pre_c = _cast(d_pre, wx_ref)
     dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
@@ -664,7 +658,6 @@ def _lnlstm_bwd_kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
     def _():
         dc0_ref[:] = dc_scr[:]
         dh0_ref[:] = dh_scr[:]
-        dxb_ref[...] = dxb_scr[:].astype(dxb_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(9, 12, 13))
@@ -702,13 +695,12 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
                      masks, seed, keep_prob, residual_dtype, x_bias):
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _tile_for(bsz, h, x_bias)
+    bt = _batch_tile(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
-    xb_mode, xb_arg, xb_spec, xb_scr_shape = _xb_args(
-        x_bias, bt, tile, whole)
+    xb_mode, xb_arg, xb_spec = _xb_args(x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lnlstm_fwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob,
@@ -750,15 +742,14 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _tile_for(bsz, h, x_bias)
+    bt = _batch_tile(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
-    xb_mode, xb_arg, xb_spec, xb_scr_shape = _xb_args(
-        x_bias, bt, tile, whole)
+    xb_mode, xb_arg, xb_spec = _xb_args(x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lnlstm_bwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob,
@@ -787,8 +778,7 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
             _sds((bsz, h), jnp.float32, xs),
         ),
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
-                        pltpu.VMEM((bt, h), jnp.float32),
-                        pltpu.VMEM(xb_scr_shape, jnp.float32)],
+                        pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
     )(rev(xs), xb_arg, wx, wh, gam, bet, gc2, bc2, rev(cs), rev(h_prev),
       rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
