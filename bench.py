@@ -208,8 +208,17 @@ def main() -> int:
         cell_batch = batch_per_chip
         if cell == "hyper" and (resid == "float32" or not fused):
             cell_batch = min(batch_per_chip, 2048)
-        r = bench_train(cell, steps, cell_batch, seq_len, dtype,
-                        remat, depth, fused=fused, resid_dtype=resid)
+        try:
+            r = bench_train(cell, steps, cell_batch, seq_len, dtype,
+                            remat, depth, fused=fused, resid_dtype=resid)
+        except Exception as e:  # transient tunnel/compile hiccups: the
+            # driver runs this once per round, so one retry is cheap
+            # insurance against losing the round's record
+            print(f"# bench_train({cell}) failed ({e!r}); retrying once",
+                  file=sys.stderr)
+            time.sleep(10)
+            r = bench_train(cell, steps, cell_batch, seq_len, dtype,
+                            remat, depth, fused=fused, resid_dtype=resid)
         results[cell] = r
         _hist_append(r)
         print(f"# {json.dumps(r)}", file=sys.stderr)
