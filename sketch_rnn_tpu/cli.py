@@ -29,7 +29,32 @@ import numpy as np
 from sketch_rnn_tpu.config import HParams, get_default_hparams
 
 
+# BASELINE.md's five benchmark configs as one-flag presets (applied before
+# --hparams, so explicit overrides still win). Preset 5's mesh covers all
+# available chips by default (mesh_shape=(-1,)).
+PRESETS = {
+    # 1: unconditional decoder-only LSTM, M=20 GMM, single category
+    "uncond_lstm": "conditional=false,dec_model=lstm",
+    # 2: full seq2seq VAE (bi-LSTM enc 256, dec 512, Nz=128), plain LSTM
+    "vae": "conditional=true,dec_model=lstm",
+    # 3: the decoder cell variants (LayerNorm-LSTM / HyperLSTM)
+    "layer_norm": "conditional=true,dec_model=layer_norm",
+    "hyper": "conditional=true,dec_model=hyper",
+    # 4: class-conditional, 75 categories (data_set must list 75 files)
+    "classes75": "conditional=true,dec_model=layer_norm,num_classes=75",
+    # 5: 345-category QuickDraw, data-parallel over the device mesh,
+    #    production perf config
+    "quickdraw345_dp": ("conditional=true,dec_model=layer_norm,"
+                        "num_classes=345,compute_dtype=bfloat16,"
+                        "fused_rnn=true,fused_residual_dtype=bfloat16,"
+                        "remat=true"),
+}
+
+
 def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", default="", choices=[""] + list(PRESETS),
+                   help="BASELINE.md benchmark config preset "
+                        "(hparams base; --hparams overrides on top)")
     p.add_argument("--hparams", default="",
                    help="comma-separated key=value overrides")
     p.add_argument("--workdir", default="workdir",
@@ -47,6 +72,8 @@ def _resolve_hps(args) -> HParams:
     meta_hps = _workdir_hps(args.workdir)
     if meta_hps is not None:
         base = meta_hps
+    if args.preset:
+        base = base.parse(PRESETS[args.preset])
     if args.data_dir:
         base = base.replace(data_dir=args.data_dir)
     return base.parse(args.hparams)

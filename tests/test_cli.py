@@ -79,6 +79,17 @@ def test_cli_reconstruct_and_interpolate_exclusive(tmp_path):
     assert e.value.code == 2
 
 
+def test_cli_preset_uncond(tmp_path, capsys):
+    # BASELINE config 1 as a one-flag preset; --hparams overrides on top
+    wd = str(tmp_path / "work")
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 "--preset=uncond_lstm", f"--hparams={HP}"]) == 0
+    assert main(["eval", "--synthetic", f"--workdir={wd}",
+                 "--split=valid"]) == 0
+    ev = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert ev["kl_raw"] == 0.0  # unconditional: no latent, no KL
+
+
 def test_cli_rejects_unknown_hparam(tmp_path):
     with pytest.raises(ValueError, match="unknown hparam"):
         main(["train", "--synthetic", f"--workdir={tmp_path}",
