@@ -347,6 +347,21 @@ def _xb_args(x_bias, bt, tile, whole):
     return True, x_bias, tile((bt, x_bias.shape[-1]))
 
 
+def _xb_pair_args(x_bias, x_bias_hyper, bt, tile, whole):
+    """Resolve the hyper kernel's TWO bias operands (main + aux LSTM).
+
+    Shared by the fwd and bwd wrappers so their pallas_call operand lists
+    cannot desynchronize.
+    """
+    xb_mode, xb_arg, xb_spec = _xb_args(x_bias, bt, tile, whole)
+    if x_bias_hyper is not None:
+        xbh_arg, xbh_spec = x_bias_hyper, tile((bt,
+                                                x_bias_hyper.shape[-1]))
+    else:
+        xbh_arg, xbh_spec = xb_arg, xb_spec
+    return xb_mode, xb_arg, xb_spec, xbh_arg, xbh_spec
+
+
 def _seed_cotangent(seed):
     if seed is None:
         return None
@@ -842,8 +857,13 @@ def _hyper_recompute(x, h, c, hc, hh, wx_ref, b_ref, wh_ref, wxhx_ref,
                      wxhh_ref, bh_ref, whh_ref, whzx_ref, bhzx_ref,
                      whzh_ref, bhzh_ref, whzb_ref, zdx_ref, zdh_ref,
                      zdb_ref, gam_ref, bet_ref, gc_ref, bc_ref, m,
-                     forget_bias, want_residuals):
-    """One forward step from (x, carries); shared by fwd and bwd kernels."""
+                     forget_bias, want_residuals, xb=None, xbh=None):
+    """One forward step from (x, carries); shared by fwd and bwd kernels.
+
+    ``xb``/``xbh``: optional per-example projections of time-invariant
+    inputs — added to the main input projection BEFORE the hyper scaling
+    (it is part of ``xh``) and to the aux LSTM's pre-activations.
+    """
     hyper_pre = (jnp.dot(_cast(x, wxhx_ref), wxhx_ref[:],
                          preferred_element_type=jnp.float32)
                  + jnp.dot(_cast(h, wxhh_ref), wxhh_ref[:],
@@ -851,12 +871,16 @@ def _hyper_recompute(x, h, c, hc, hh, wx_ref, b_ref, wh_ref, wxhx_ref,
                  + bh_ref[0]
                  + jnp.dot(_cast(hh, whh_ref), whh_ref[:],
                            preferred_element_type=jnp.float32))
+    if xbh is not None:
+        hyper_pre = hyper_pre + xbh
     hi, hg, hf, ho, new_hc = _lstm_gates(hyper_pre, hc, None,
                                          forget_bias=forget_bias)
     new_hh = jnp.tanh(new_hc) * ho
 
     xp = jnp.dot(_cast(x, wx_ref), wx_ref[:],
                  preferred_element_type=jnp.float32)
+    if xb is not None:
+        xp = xp + xb
     hp = jnp.dot(_cast(h, wh_ref), wh_ref[:],
                  preferred_element_type=jnp.float32)
     zx = jnp.dot(_cast(new_hh, whzx_ref), whzx_ref[:],
@@ -880,7 +904,8 @@ def _hyper_recompute(x, h, c, hc, hh, wx_ref, b_ref, wh_ref, wxhx_ref,
     return ln, aux
 
 
-def _hyper_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
+def _hyper_fwd_kernel(x_ref, xb_ref, xbh_ref, wx_ref, b_ref, wh_ref,
+                      wxhx_ref, wxhh_ref,
                       bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref,
                       bhzh_ref, whzb_ref, zdx_ref, zdh_ref, zdb_ref,
                       gam_ref, bet_ref, gc_ref, bc_ref,
@@ -888,7 +913,7 @@ def _hyper_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
                       hs_ref, cs_ref, hycs_ref, hyhs_ref,
                       cT_ref, hT_ref, hcT_ref, hhT_ref,
                       c_scr, h_scr, hc_scr, hh_scr,
-                      *, forget_bias, mask_mode, keep_prob):
+                      *, forget_bias, mask_mode, keep_prob, xb_mode):
     ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -907,7 +932,9 @@ def _hyper_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         x_ref[0], h, c, hc, hh, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref, bhzh_ref, whzb_ref,
         zdx_ref, zdh_ref, zdb_ref, gam_ref, bet_ref, gc_ref, bc_ref, m,
-        forget_bias, want_residuals=False)
+        forget_bias, want_residuals=False,
+        xb=xb_ref[...] if xb_mode else None,
+        xbh=xbh_ref[...] if xb_mode else None)
     new_hc, new_hh = aux[4], aux[5]
 
     # PRE-step states: the backward's residuals (possibly bf16 storage)
@@ -928,19 +955,21 @@ def _hyper_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         hhT_ref[:] = new_hh
 
 
-def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
+def _hyper_bwd_kernel(x_ref, xb_ref, xbh_ref, wx_ref, b_ref, wh_ref,
+                      wxhx_ref, wxhh_ref,
                       bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref,
                       bhzh_ref, whzb_ref, zdx_ref, zdh_ref, zdb_ref,
                       gam_ref, bet_ref, gc_ref, bc_ref,
                       cs_ref, hp_ref, hycs_ref, hyhp_ref, mask_ref, seed_ref,
                       dhs_ref, dcT_ref, dhT_ref, dhcT_ref, dhhT_ref,
-                      dx_ref, dwx_ref, db_ref, dwh_ref, dwxhx_ref,
+                      dx_ref, dxb_ref, dxbh_ref, dwx_ref, db_ref, dwh_ref,
+                      dwxhx_ref,
                       dwxhh_ref, dbh_ref, dwhh_ref, dwhzx_ref, dbhzx_ref,
                       dwhzh_ref, dbhzh_ref, dwhzb_ref, dzdx_ref, dzdh_ref,
                       dzdb_ref, dgam_ref, dbet_ref, dgc_ref, dbc_ref,
                       dc0_ref, dh0_ref, dhc0_ref, dhh0_ref,
                       dc_scr, dh_scr, dhc_scr, dhh_scr,
-                      *, forget_bias, mask_mode, keep_prob):
+                      *, forget_bias, mask_mode, keep_prob, xb_mode):
     """Reverse-time inner grid: program (ib, it) handles step T-1-it."""
     ib = pl.program_id(0)
     it = pl.program_id(1)
@@ -960,6 +989,10 @@ def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         dh_scr[:] = dhT_ref[:]
         dhc_scr[:] = dhcT_ref[:]
         dhh_scr[:] = dhhT_ref[:]
+        # bias grads accumulate IN their (VMEM-resident, revisited)
+        # output blocks, like the weight grads
+        dxb_ref[...] = jnp.zeros_like(dxb_ref)
+        dxbh_ref[...] = jnp.zeros_like(dxbh_ref)
 
     # ---- recompute the forward step ----
     x = x_ref[0]
@@ -974,7 +1007,9 @@ def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
         x, h_prev, c_prev, hc_prev, hh_prev, wx_ref, b_ref, wh_ref,
         wxhx_ref, wxhh_ref, bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref,
         bhzh_ref, whzb_ref, zdx_ref, zdh_ref, zdb_ref, gam_ref, bet_ref,
-        gc_ref, bc_ref, m, forget_bias, want_residuals=True)
+        gc_ref, bc_ref, m, forget_bias, want_residuals=True,
+        xb=xb_ref[...] if xb_mode else None,
+        xbh=xbh_ref[...] if xb_mode else None)
     (hi, hg, hf, ho, new_hc, new_hh, xp, hp_, zx, zh, zb, sx, sh) = aux
     gam, gc = gam_ref[...], gc_ref[...]
 
@@ -991,6 +1026,8 @@ def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
     dsh = d_pre * hp_
     dhp = d_pre * sh
     db_ref[0] += jnp.sum(d_pre, axis=0)                       # dsb == d_pre
+    if xb_mode:
+        dxb_ref[...] += dxp       # xb is part of xh, pre-scaling
 
     # ---- scale projections (dense block-diagonal) ----
     dsx_c, dsh_c, dsb_c = (_cast(dsx, zdx_ref), _cast(dsh, zdh_ref),
@@ -1041,6 +1078,8 @@ def _hyper_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, wxhx_ref, wxhh_ref,
     ], axis=-1)
     dhc_scr[:] = dhc * hf
 
+    if xb_mode:
+        dxbh_ref[...] += dh_pre
     dh_pre_c = _cast(dh_pre, wxhx_ref)
     dbh_ref[0] += jnp.sum(dh_pre, axis=0)
     dwxhx_ref[:] += jnp.dot(_cast(x, wxhx_ref).T, dh_pre_c,
@@ -1092,8 +1131,15 @@ def fused_hyper_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array,
                      masks: Optional[jax.Array] = None,
                      dropout_seed: Optional[jax.Array] = None,
                      keep_prob: float = 1.0,
-                     residual_dtype=jnp.float32):
+                     residual_dtype=jnp.float32,
+                     x_bias: Optional[jax.Array] = None,
+                     x_bias_hyper: Optional[jax.Array] = None):
     """Fused HyperLSTM (layer-norm variant), recompute-backward.
+
+    ``x_bias [B, 4H]`` / ``x_bias_hyper [B, 4HH]``: optional per-example
+    projections of time-invariant inputs onto the main gates (added to
+    the input projection BEFORE the hyper scaling) and the aux LSTM's
+    pre-activations — pass both or neither.
 
     Matches :class:`ops.cells.HyperLSTMCell` with ``use_layer_norm=True``
     (the only variant ``make_cell`` builds). Weight layout:
@@ -1116,14 +1162,16 @@ def fused_hyper_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array,
         xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
         b_hz_h, w_hz_b, zd_x, zd_h, zd_b, ln_gamma, ln_beta, lnc_gamma,
         lnc_beta, c0, h0, hc0, hh0, forget_bias, masks, dropout_seed,
-        keep_prob, residual_dtype)
+        keep_prob, residual_dtype, x_bias, x_bias_hyper)
     return hs, fin
 
 
 def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
                     w_hz_h, b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet,
                     gc, bc, c0, h0, hc0, hh0, forget_bias, masks, seed,
-                    keep_prob, residual_dtype):
+                    keep_prob, residual_dtype, x_bias, x_bias_hyper):
+    if (x_bias is None) != (x_bias_hyper is None):
+        raise ValueError("pass both x_bias and x_bias_hyper or neither")
     t, bsz, d = xs.shape
     h = wh.shape[0]
     hh_size = whh.shape[0]
@@ -1137,12 +1185,17 @@ def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
 
+    (xb_mode, xb_arg, xb_spec, xbh_arg,
+     xbh_spec) = _xb_pair_args(x_bias, x_bias_hyper, bt, tile, whole)
+
     kernel = functools.partial(_hyper_fwd_kernel, forget_bias=forget_bias,
-                               mask_mode=mode, keep_prob=keep_prob)
+                               mask_mode=mode, keep_prob=keep_prob,
+                               xb_mode=xb_mode)
     hs, cs, hycs, hyhs, cT, hT, hcT, hhT = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+        in_specs=[step((bt, d)), xb_spec, xbh_spec,
+                  whole(wx.shape), whole(b2.shape),
                   whole(wh.shape), whole(wxh_x.shape), whole(wxh_h.shape),
                   whole(bh2.shape), whole(whh.shape), whole(w_hz_x.shape),
                   whole(bhzx2.shape), whole(w_hz_h.shape),
@@ -1170,30 +1223,32 @@ def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
                         pltpu.VMEM((bt, hh_size), jnp.float32),
                         pltpu.VMEM((bt, hh_size), jnp.float32)],
         interpret=_interpret_default(),
-    )(xs, wx, b2, wh, wxh_x, wxh_h, bh2, whh, w_hz_x, bhzx2, w_hz_h,
-      bhzh2, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc2, bc2, c0, h0, hc0,
-      hh0, mask_arg, seed_arg)
+    )(xs, xb_arg, xbh_arg, wx, b2, wh, wxh_x, wxh_h, bh2, whh, w_hz_x,
+      bhzx2, w_hz_h, bhzh2, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc2, bc2,
+      c0, h0, hc0, hh0, mask_arg, seed_arg)
     return hs, ((cT, hT), (hcT, hhT)), (cs, hycs, hyhs)
 
 
 def _fused_hyper_fwd(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
                      w_hz_h, b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet,
                      gc, bc, c0, h0, hc0, hh0, forget_bias, masks,
-                     dropout_seed, keep_prob, residual_dtype):
+                     dropout_seed, keep_prob, residual_dtype, x_bias,
+                     x_bias_hyper):
     hs, fin, (cs, hycs, hyhs) = _hyper_fwd_call(
         xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
         b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, c0, h0, hc0,
-        hh0, forget_bias, masks, dropout_seed, keep_prob, residual_dtype)
+        hh0, forget_bias, masks, dropout_seed, keep_prob, residual_dtype,
+        x_bias, x_bias_hyper)
     res = (xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h,
            b_hz_h, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, h0, hh0,
-           hs, cs, hycs, hyhs, masks, dropout_seed)
+           hs, cs, hycs, hyhs, masks, dropout_seed, x_bias, x_bias_hyper)
     return (hs, fin), res
 
 
 def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     (xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x, w_hz_h, b_hz_h,
      w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc, bc, h0, hh0, hs, cs, hycs,
-     hyhs, masks, seed) = res
+     hyhs, masks, seed, x_bias, x_bias_hyper) = res
     dhs, ((dcT, dhT), (dhcT, dhhT)) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
@@ -1212,14 +1267,19 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
 
+    (xb_mode, xb_arg, xb_spec, xbh_arg,
+     xbh_spec) = _xb_pair_args(x_bias, x_bias_hyper, bt, tile, whole)
+
     kernel = functools.partial(_hyper_bwd_kernel, forget_bias=forget_bias,
-                               mask_mode=mode, keep_prob=keep_prob)
-    (dxs_rev, dwx, db2, dwh, dwxhx, dwxhh, dbh2, dwhh, dwhzx, dbhzx2,
-     dwhzh, dbhzh2, dwhzb, dzdx, dzdh, dzdb, dgam, dbet, dgc2, dbc2,
-     dc0, dh0, dhc0, dhh0) = pl.pallas_call(
+                               mask_mode=mode, keep_prob=keep_prob,
+                               xb_mode=xb_mode)
+    (dxs_rev, dxb, dxbh, dwx, db2, dwh, dwxhx, dwxhh, dbh2, dwhh, dwhzx,
+     dbhzx2, dwhzh, dbhzh2, dwhzb, dzdx, dzdh, dzdb, dgam, dbet, dgc2,
+     dbc2, dc0, dh0, dhc0, dhh0) = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+        in_specs=[step((bt, d)), xb_spec, xbh_spec,
+                  whole(wx.shape), whole(b2.shape),
                   whole(wh.shape), whole(wxh_x.shape), whole(wxh_h.shape),
                   whole(bh2.shape), whole(whh.shape), whole(w_hz_x.shape),
                   whole(bhzx2.shape), whole(w_hz_h.shape),
@@ -1230,7 +1290,8 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
                   step((bt, hh_size)), step((bt, hh_size)), mask_spec,
                   seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h)),
                   tile((bt, hh_size)), tile((bt, hh_size))],
-        out_specs=(step((bt, d)), whole(wx.shape), whole(b2.shape),
+        out_specs=(step((bt, d)), xb_spec, xbh_spec,
+                   whole(wx.shape), whole(b2.shape),
                    whole(wh.shape), whole(wxh_x.shape), whole(wxh_h.shape),
                    whole(bh2.shape), whole(whh.shape), whole(w_hz_x.shape),
                    whole(bhzx2.shape), whole(w_hz_h.shape),
@@ -1241,6 +1302,8 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
                    tile((bt, hh_size)), tile((bt, hh_size))),
         out_shape=(
             _sds((t, bsz, d), jnp.float32, xs),
+            _sds(xb_arg.shape, jnp.float32, xs),
+            _sds(xbh_arg.shape, jnp.float32, xs),
             _sds(wx.shape, jnp.float32, xs),
             _sds(b2.shape, jnp.float32, xs),
             _sds(wh.shape, jnp.float32, xs),
@@ -1270,9 +1333,9 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
                         pltpu.VMEM((bt, hh_size), jnp.float32),
                         pltpu.VMEM((bt, hh_size), jnp.float32)],
         interpret=_interpret_default(),
-    )(rev(xs), wx, b2, wh, wxh_x, wxh_h, bh2, whh, w_hz_x, bhzx2, w_hz_h,
-      bhzh2, w_hz_b, zd_x, zd_h, zd_b, gam, bet, gc2, bc2, rev(cs),
-      rev(h_prev), rev(hycs), rev(hyh_prev),
+    )(rev(xs), xb_arg, xbh_arg, wx, b2, wh, wxh_x, wxh_h, bh2, whh,
+      w_hz_x, bhzx2, w_hz_h, bhzh2, w_hz_b, zd_x, zd_h, zd_b, gam, bet,
+      gc2, bc2, rev(cs), rev(h_prev), rev(hycs), rev(hyh_prev),
       rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
       rev(dhs), dcT, dhT, dhcT, dhhT)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
@@ -1286,7 +1349,10 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
             dwhzb.astype(w_hz_b.dtype), dzdx.astype(zd_x.dtype),
             dzdh.astype(zd_h.dtype), dzdb.astype(zd_b.dtype),
             dgam, dbet, dgc2.reshape(-1), dbc2.reshape(-1),
-            dc0, dh0, dhc0, dhh0, dmasks, _seed_cotangent(seed))
+            dc0, dh0, dhc0, dhh0, dmasks, _seed_cotangent(seed),
+            dxb.astype(x_bias.dtype) if x_bias is not None else None,
+            dxbh.astype(x_bias_hyper.dtype)
+            if x_bias_hyper is not None else None)
 
 
 fused_hyper_lstm.defvjp(_fused_hyper_fwd, _fused_hyper_bwd)

@@ -123,10 +123,21 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
                 "(the only one make_cell builds)")
         (c0, h0), (hc0, hh0) = carry0
         hyper = params["hyper"]
-        d = hyper["wx"].shape[0] - cell.hidden_size
+        d_in = hyper["wx"].shape[0] - cell.hidden_size
+        xbh = None
+        if x_extra is not None:
+            # the aux LSTM also consumes [x; h]: its x-part splits into a
+            # per-step stroke projection and a per-example extra bias
+            d_s = xs.shape[-1]
+            wxh_e = cast(hyper["wx"][d_s:d_in])
+            xbh = jnp.dot(x_extra.astype(wxh_e.dtype), wxh_e,
+                          preferred_element_type=jnp.float32)
+            wxh_x = cast(hyper["wx"][:d_s])
+        else:
+            wxh_x = cast(hyper["wx"][:d_in])
         hs, fin = PF.fused_hyper_lstm(
             xs, wx, params["b"], wh,
-            cast(hyper["wx"][:d]), cast(hyper["wx"][d:]), hyper["b"],
+            wxh_x, cast(hyper["wx"][d_in:]), hyper["b"],
             cast(hyper["wh"]),
             cast(params["w_hz_x"]), params["b_hz_x"],
             cast(params["w_hz_h"]), params["b_hz_h"],
@@ -135,7 +146,8 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
             _block_diag4(params["w_zd_b"]),
             params["ln_gamma"], params["ln_beta"],
             params["lnc_gamma"], params["lnc_beta"],
-            c0, h0, hc0, hh0, cell.forget_bias, masks, seed, keep, rd)
+            c0, h0, hc0, hh0, cell.forget_bias, masks, seed, keep, rd,
+            xb, xbh)
     elif isinstance(cell, LayerNormLSTMCell):
         c0, h0 = carry0
         hs, fin = PF.fused_ln_lstm(
@@ -202,17 +214,15 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
     ~0.4% relative gradient noise; None keeps float32.
 
     ``x_extra`` (``[B, E]``, optional): TIME-INVARIANT input features
-    (the decoder's z and class embedding). The cell's ``wx`` must cover
-    ``xs.width + E`` rows. On the LSTM/LayerNorm fused path these are
-    projected once into a per-example gate bias (no ``[T, B, E]``
-    broadcast in HBM, narrower per-step matmuls); elsewhere they are
-    broadcast and concatenated — identical semantics either way.
+    (the decoder's z and class embedding). The cell's input weights must
+    cover ``xs.width + E`` rows. On the fused path these are projected
+    once into per-example gate biases (no ``[T, B, E]`` broadcast in
+    HBM, narrower per-step matmuls; the hyper cell gets a second bias
+    for its aux LSTM); on the scan path they are broadcast and
+    concatenated — identical semantics either way.
     """
-    from sketch_rnn_tpu.ops.cells import HyperLSTMCell
-
     use_fused = fused and fused_supported(cell)
-    if x_extra is not None and not (use_fused
-                                    and not isinstance(cell, HyperLSTMCell)):
+    if x_extra is not None and not use_fused:
         xs = _concat_extra(xs, x_extra)
         x_extra = None
     if carry0 is None:

@@ -61,17 +61,17 @@ def flops_per_stroke(hps: HParams, train: bool = True) -> float:
     multiplies by 3 (backward ~= 2x forward) plus one extra forward when
     ``hps.remat`` recomputes activations in the backward pass.
 
-    On the fused LSTM/LayerNorm decoder path, the time-invariant inputs
-    (z, class embedding) are projected ONCE per sequence as a gate bias
-    (ops/rnn.py x_extra), so the per-step decoder input width is just the
-    stroke-5 — counting the full width there would overstate MFU by ~6%
-    at the flagship config.
+    On the fused decoder path (all three cells), the time-invariant
+    inputs (z, class embedding) are projected ONCE per sequence as gate
+    biases (ops/rnn.py x_extra; the hyper cell's aux LSTM gets its own),
+    so the per-step decoder input width is just the stroke-5 — counting
+    the full width there would overstate MFU by ~6% at the flagship
+    config.
     """
     from sketch_rnn_tpu.models.vae import SketchRNN
 
     dec_in = SketchRNN(hps).decoder_input_size
-    if (hps.fused_rnn and hps.dec_model in ("lstm", "layer_norm")
-            and not hps.use_input_dropout):
+    if hps.fused_rnn and not hps.use_input_dropout:
         dec_in = 5  # extras ride as a per-sequence bias, amortized ~0
     fwd = (_cell_flops(hps.dec_model, dec_in, hps.dec_rnn_size, hps)
            + 2 * hps.dec_rnn_size * (6 * hps.num_mixture + 3))

@@ -463,6 +463,51 @@ def test_x_extra_matches_concat(cell_cls, use_mask):
                                    err_msg=f"{ka} vs {kb}")
 
 
+@pytest.mark.parametrize("use_mask", [False, True])
+def test_hyper_x_extra_matches_concat(use_mask):
+    # hyper: BOTH the main gates and the aux LSTM get a per-example bias
+    E = 8
+    cell = HyperLSTMCell(H, hyper_size=HYPER_HH, embed_size=HYPER_E)
+    params = cell.init_params(jax.random.key(0), D + E)
+    for i, k in enumerate(("w_hz_x", "w_hz_h", "w_zd_x", "w_zd_h",
+                           "w_zd_b")):
+        params[k] = params[k] + 0.05 * jax.random.normal(
+            jax.random.key(100 + i), params[k].shape)
+    xs = jax.random.normal(jax.random.key(1), (T, B, D))
+    extra = jax.random.normal(jax.random.key(2), (B, E))
+    carry0 = ((jax.random.normal(jax.random.key(3), (B, H)) * 0.3,
+               jax.random.normal(jax.random.key(4), (B, H)) * 0.3),
+              (jax.random.normal(jax.random.key(5), (B, HYPER_HH)) * 0.3,
+               jax.random.normal(jax.random.key(6), (B, HYPER_HH)) * 0.3))
+    masks = (make_dropout_masks(jax.random.key(9), 0.8, T, B, H)
+             if use_mask else None)
+    wtgt = jax.random.normal(jax.random.key(7), (T, B, H)) * 0.1
+
+    def make_loss(fused):
+        def f(params_, xs_, extra_):
+            fin, hs = run_rnn(cell, params_, xs_, carry0=carry0,
+                              rdrop_masks=masks, fused=fused,
+                              x_extra=extra_)
+            return (jnp.sum(hs * wtgt)
+                    + sum(0.3 * jnp.sum(l)
+                          for l in jax.tree_util.tree_leaves(fin)))
+        return f
+
+    vf, gf = jax.value_and_grad(make_loss(True), argnums=(0, 1, 2))(
+        params, xs, extra)
+    vs, gs = jax.value_and_grad(make_loss(False), argnums=(0, 1, 2))(
+        params, xs, extra)
+    np.testing.assert_allclose(float(vf), float(vs), rtol=1e-4)
+    for (ka, a), (kb, b) in zip(
+            sorted(jax.tree_util.tree_flatten_with_path(gf)[0],
+                   key=lambda kv: str(kv[0])),
+            sorted(jax.tree_util.tree_flatten_with_path(gs)[0],
+                   key=lambda kv: str(kv[0]))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=1e-3,
+                                   err_msg=f"{ka} vs {kb}")
+
+
 def test_x_extra_model_decode_matches_concat_eval():
     # conditional model, fused on: decode routes z through the bias path;
     # the scan path concatenates — same loss in eval mode
