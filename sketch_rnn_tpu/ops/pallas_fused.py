@@ -29,6 +29,14 @@ Mixed precision: pass ``wx``/``wh`` already cast (e.g. bfloat16); the
 kernel casts activations to the weight dtype per matmul and accumulates
 in float32 — the same contract as ``ops.linear.matmul``.
 
+Measured negative result (v5e): unrolling TWO time steps per grid
+program (halving the grid's time axis) made the latency-bound H=256
+encoder SLOWER — 51.1 vs 45.7 ms fwd+bwd at B=4096/tile 512. Pallas
+already overlaps block DMAs across grid steps, and in-kernel unrolling
+neither shortens the sequential matmul dependency chain nor removes
+any real overhead; it just doubles the live block working set. The
+(batch-tile, single-time-step) grid is the right shape.
+
 ``residual_dtype`` (static, default float32) sets the storage dtype of
 the saved streams — ``hs`` (which is ALSO the kernel's output, so the
 model downstream of the RNN sees bf16-rounded activations) and the
