@@ -72,24 +72,30 @@ def sample_from_mixture(mp: mdn.MixtureParams, key: jax.Array,
 
 
 def make_sampler(model, hps: HParams, max_len: Optional[int] = None,
-                 greedy: bool = False):
+                 greedy: bool = False, mesh=None):
     """Cached wrapper around :func:`_build_sampler`.
 
     The compiled sampler is memoized on the model instance so repeated
     ``sample()`` calls (per temperature, per interpolation frame) reuse one
     XLA program instead of re-tracing.
+
+    ``mesh``: shard generation over the mesh's ``data`` axis — each
+    device runs the whole autoregressive while_loop on its own batch
+    shard (the loop body is collective-free, so shards draw and
+    early-exit independently); per-shard PRNG streams fold in the axis
+    index. The batch must be divisible by the axis size.
     """
     cache = getattr(model, "_sampler_cache", None)
     if cache is None:
         cache = model._sampler_cache = {}
-    ckey = (int(max_len or hps.max_seq_len), bool(greedy))
+    ckey = (int(max_len or hps.max_seq_len), bool(greedy), mesh)
     if ckey not in cache:
-        cache[ckey] = _build_sampler(model, hps, max_len, greedy)
+        cache[ckey] = _build_sampler(model, hps, max_len, greedy, mesh)
     return cache[ckey]
 
 
 def _build_sampler(model, hps: HParams, max_len: Optional[int] = None,
-                   greedy: bool = False):
+                   greedy: bool = False, mesh=None):
     """Build the jitted batched sampler.
 
     Returns ``fn(params, key, batch_size, z, labels, temperature) ->
@@ -103,9 +109,8 @@ def _build_sampler(model, hps: HParams, max_len: Optional[int] = None,
     """
     t_max = int(max_len or hps.max_seq_len)
 
-    @functools.partial(jax.jit, static_argnames=("batch_size",))
-    def sampler(params, key, batch_size: int, z=None, labels=None,
-                temperature=1.0):
+    def _sample_shard(params, key, batch_size: int, z=None, labels=None,
+                      temperature=1.0):
         carry0 = model.decoder_initial_carry(params, z, batch_size)
         prev0 = jnp.broadcast_to(START_TOKEN, (batch_size, 5))
         done0 = jnp.zeros((batch_size,), bool)
@@ -133,33 +138,66 @@ def _build_sampler(model, hps: HParams, max_len: Optional[int] = None,
             out = lax.dynamic_update_index_in_dim(out, stroke, t, axis=0)
             return (t + 1, carry, stroke, new_done, length, out, key)
 
-        _, _, _, done, length, out, _ = lax.while_loop(
-            cond, body, (jnp.int32(0), carry0, prev0, done0, len0, out0, key))
+        # under shard_map the folded key (and z-derived carry) vary over
+        # the data axis while the zero/broadcast parts do not; widen so
+        # the while_loop carry types match (no-op off-mesh)
+        from sketch_rnn_tpu.ops.rnn import _match_vma
+        init = _match_vma(
+            (jnp.int32(0), carry0, prev0, done0, len0, out0, key), key)
+        _, _, _, done, length, out, _ = lax.while_loop(cond, body, init)
         # sketches that never drew p3 run the full buffer
         length = jnp.where(done, length, t_max)
         return jnp.transpose(out, (1, 0, 2)), length
 
-    return sampler
+    if mesh is None:
+        return jax.jit(_sample_shard, static_argnames=("batch_size",))
+
+    from jax.sharding import PartitionSpec as P
+
+    from sketch_rnn_tpu.parallel.mesh import DATA_AXIS, check_batch_divisible
+
+    n_dev = mesh.shape[DATA_AXIS]
+
+    @functools.partial(jax.jit, static_argnames=("batch_size",))
+    def sharded(params, key, batch_size: int, z=None, labels=None,
+                temperature=1.0):
+        check_batch_divisible(batch_size, mesh)
+
+        def per_device(params, key, z, labels, temperature):
+            key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
+            return _sample_shard(params, key, batch_size // n_dev, z,
+                                 labels, temperature)
+
+        # z/labels may be None (empty pytrees) — their specs are unused
+        return jax.shard_map(
+            per_device, mesh=mesh,
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+            out_specs=P(DATA_AXIS),
+        )(params, key, z, labels, temperature)
+
+    return sharded
 
 
 def sample(model, params, hps: HParams, key: jax.Array, n: int = 1,
            temperature: float = 1.0, z: Optional[jax.Array] = None,
            labels: Optional[jax.Array] = None,
            max_len: Optional[int] = None, greedy: bool = False,
-           scale_factor: float = 1.0) -> Tuple[list, np.ndarray]:
+           scale_factor: float = 1.0, mesh=None) -> Tuple[list, np.ndarray]:
     """Convenience wrapper: draw ``n`` sketches, return host stroke-3 list.
 
     For conditional models with no ``z`` given, draws z ~ N(0, I) (the
     prior), matching the reference's unconditional-generation mode of a
     trained VAE. Offsets are multiplied back by ``scale_factor`` so the
-    output is in data units.
+    output is in data units. ``mesh``: shard generation over the data
+    axis (see :func:`make_sampler`).
     """
     kz, ks = jax.random.split(key)
     if hps.conditional and z is None:
         z = jax.random.normal(kz, (n, hps.z_size), jnp.float32)
     if hps.num_classes > 0 and labels is None:
         labels = jnp.zeros((n,), jnp.int32)
-    sampler = make_sampler(model, hps, max_len=max_len, greedy=greedy)
+    sampler = make_sampler(model, hps, max_len=max_len, greedy=greedy,
+                           mesh=mesh)
     strokes5, lengths = sampler(params, ks, n, z, labels,
                                 jnp.float32(temperature))
     strokes5 = np.asarray(strokes5)
