@@ -134,7 +134,7 @@ def test_sampler_sharded_over_mesh():
     # shards draw independently: with distinct z, sketches differ
     assert not np.array_equal(s5[0], s5[2])
     # batch must be divisible by the axis size
-    with pytest.raises(ValueError, match="divide"):
+    with pytest.raises(ValueError, match="divisible"):
         sampler(params, jax.random.key(2), 12,
                 jax.random.normal(jax.random.key(3), (12, hps.z_size)),
                 None, jnp.float32(0.8))
