@@ -537,11 +537,10 @@ def test_bf16_residuals_train_and_match_f32(cell_kind):
     # bfloat16 residual storage: forward values must match the f32-residual
     # kernel to bf16 rounding (the forward math is identical — only the
     # saved streams are rounded), gradients to ~1% (backward recomputes
-    # from rounded residuals), and a train step must still learn
+    # from rounded residuals)
     from sketch_rnn_tpu.config import HParams
     from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
     from sketch_rnn_tpu.models.vae import SketchRNN
-    from sketch_rnn_tpu.train import make_train_state, make_train_step
 
     hps16 = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=16,
                     dec_rnn_size=128, z_size=6, num_mixture=3,
@@ -567,15 +566,8 @@ def test_bf16_residuals_train_and_match_f32(cell_kind):
     n32 = jnp.sqrt(sum(jnp.sum(l.astype(jnp.float32) ** 2)
                        for l in jax.tree_util.tree_leaves(g32)))
     assert float(n16) == pytest.approx(float(n32), rel=5e-2)
-
-    state = make_train_state(m16, hps16, jax.random.key(0))
-    step = make_train_step(m16, hps16, mesh=None)
-    losses = []
-    for i in range(6):
-        state, metrics = step(state, batch, jax.random.key(i))
-        losses.append(float(metrics["loss"]))
-        assert np.isfinite(losses[-1])
-    assert losses[-1] < losses[0]
+    # (training convergence with bf16 residuals is covered by
+    # test_train.py::test_mesh_train_fused_production_config)
 
 
 def test_model_loss_matches_scan_path_eval():
