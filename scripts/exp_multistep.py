@@ -1,0 +1,98 @@
+"""Experiment: quantify per-dispatch overhead and the multi-step scan win.
+
+Three timings at the flagship config (layer_norm, fused, bf16, B=4096):
+  A. single-step calls, cached device batch (no host feed)
+  B. single-step calls, prefetch feeder (the bench.py path)
+  C. K-step lax.scan inside one jit, stacked fresh batches per call
+
+If (A ~= B) >> compute, the tunnel's per-launch RPC dominates and C
+should close the gap by ~K x fewer launches.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sketch_rnn_tpu.config import get_default_hparams
+from sketch_rnn_tpu.data.loader import synthetic_loader
+from sketch_rnn_tpu.data.prefetch import prefetch_batches
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+from sketch_rnn_tpu.train import make_train_state, make_train_step
+from sketch_rnn_tpu.train.schedules import kl_weight_schedule, lr_schedule
+
+STEPS = 24
+K = 8
+
+hps = get_default_hparams().replace(
+    dec_model="layer_norm", batch_size=4096, max_seq_len=250,
+    compute_dtype="bfloat16", remat=True, fused_rnn=True,
+    fused_residual_dtype="bfloat16")
+model = SketchRNN(hps)
+mesh = make_mesh(hps)
+loader, _ = synthetic_loader(hps, 4096, seed=0)
+state = make_train_state(model, hps, jax.random.key(0))
+step = make_train_step(model, hps, mesh)
+key = jax.random.key(1)
+
+# ---- A: cached device batch ------------------------------------------------
+batch = shard_batch(loader.random_batch(), mesh)
+for i in range(3):
+    state, metrics = step(state, batch, jax.random.fold_in(key, i))
+    float(metrics["loss"])
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        state, metrics = step(state, batch, jax.random.fold_in(key, i))
+    float(metrics["loss"])
+    best = min(best, time.perf_counter() - t0)
+per = best / STEPS
+print(f"A cached-batch : {best:.3f}s / {STEPS} = {per*1e3:.1f} ms/step "
+      f"({hps.batch_size*hps.max_seq_len/per/1e6:.2f}M strokes/s)")
+
+# ---- B: feeder path (bench.py) --------------------------------------------
+feeder = prefetch_batches(loader, mesh, depth=2)
+try:
+    for i in range(2):
+        state, metrics = step(state, feeder.get(), jax.random.fold_in(key, i))
+        float(metrics["loss"])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            state, metrics = step(state, feeder.get(),
+                                  jax.random.fold_in(key, 100 + i))
+        float(metrics["loss"])
+        best = min(best, time.perf_counter() - t0)
+finally:
+    feeder.close()
+per = best / STEPS
+print(f"B feeder       : {best:.3f}s / {STEPS} = {per*1e3:.1f} ms/step "
+      f"({hps.batch_size*hps.max_seq_len/per/1e6:.2f}M strokes/s)")
+
+# ---- C: K-step scan, stacked fresh batches --------------------------------
+from sketch_rnn_tpu.train.step import make_multi_train_step
+
+multi = make_multi_train_step(model, hps, mesh, steps_per_call=K)
+feeder = prefetch_batches(loader, mesh, depth=2, stack=K)
+try:
+    for i in range(2):
+        state, metrics = multi(state, feeder.get(), jax.random.fold_in(key, i))
+        float(metrics["loss"])
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(STEPS // K):
+            state, metrics = multi(state, feeder.get(),
+                                   jax.random.fold_in(key, 200 + i))
+        float(metrics["loss"])
+        best = min(best, time.perf_counter() - t0)
+finally:
+    feeder.close()
+per = best / STEPS
+print(f"C scan K={K}    : {best:.3f}s / {STEPS} = {per*1e3:.1f} ms/step "
+      f"({hps.batch_size*hps.max_seq_len/per/1e6:.2f}M strokes/s)")

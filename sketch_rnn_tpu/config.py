@@ -75,6 +75,13 @@ class HParams:
     eval_every: int = 500
     log_every: int = 20
     prefetch_depth: int = 2            # input-pipeline overlap (0 = sync feed)
+    steps_per_call: int = 1            # micro-steps per jitted train call:
+    #   K>1 runs K optimizer steps as ONE lax.scan'd XLA program fed a
+    #   stacked [K, ...] batch — one host->device dispatch per K steps.
+    #   Classic TPU host-loop amortization: when per-launch latency is
+    #   comparable to step compute (remote/tunneled runtimes, small
+    #   models), dispatch cost drops by K x. Logging/eval granularity
+    #   coarsens to every K steps.
 
     # --- TPU / parallelism (component 18) ---
     compute_dtype: str = "float32"     # "bfloat16" for MXU-friendly matmuls
@@ -110,6 +117,9 @@ class HParams:
             raise ValueError(
                 f"fused_residual_dtype must be 'float32' or 'bfloat16', "
                 f"got {self.fused_residual_dtype!r}")
+        if self.steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {self.steps_per_call}")
 
     # -- overrides ---------------------------------------------------------
 

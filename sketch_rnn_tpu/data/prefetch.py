@@ -121,17 +121,35 @@ class SyncFeeder:
         pass
 
 
-def prefetch_batches(loader, mesh=None, depth: int = 2):
+def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1):
     """Feeder over ``loader.random_batch()`` with the device transfer
     (sharded onto ``mesh`` when given) done on the producer thread;
-    ``depth <= 0`` returns a synchronous feeder with the same interface."""
+    ``depth <= 0`` returns a synchronous feeder with the same interface.
+
+    ``stack=K`` (for ``steps_per_call=K`` multi-step training) assembles
+    K consecutive batches per ``get()`` and stacks them on a new leading
+    axis — one transfer and one dispatch feed K micro-steps. The loader's
+    RNG sequence is identical to K single gets, so K-step training sees
+    exactly the batches K single steps would have.
+    """
+    if stack < 1:
+        raise ValueError(f"stack must be >= 1, got {stack}")
+
+    def host_batch():
+        if stack == 1:
+            return loader.random_batch()
+        import numpy as np
+
+        parts = [loader.random_batch() for _ in range(stack)]
+        return {k: np.stack([p[k] for p in parts]) for k in parts[0]}
+
     if mesh is not None:
         from sketch_rnn_tpu.parallel.mesh import shard_batch
 
         def producer():
-            return shard_batch(loader.random_batch(), mesh)
+            return shard_batch(host_batch(), mesh, stacked=stack > 1)
     else:
-        producer = loader.random_batch
+        producer = host_batch
     if depth <= 0:
         return SyncFeeder(producer)
     return Prefetcher(producer, depth=depth)

@@ -64,6 +64,12 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def stacked_batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for ``[K, batch, ...]`` stacked multi-step batches: the
+    micro-step axis replicated, the batch axis split over ``axis``."""
+    return NamedSharding(mesh, P(None, axis))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully replicated sharding (parameters, PRNG keys, scalars)."""
     return NamedSharding(mesh, P())
@@ -79,7 +85,8 @@ def check_batch_divisible(batch_size: int, mesh: Mesh,
 
 
 def shard_batch(batch: Dict[str, Any], mesh: Mesh,
-                axis: str = DATA_AXIS) -> Dict[str, jax.Array]:
+                axis: str = DATA_AXIS, stacked: bool = False
+                ) -> Dict[str, jax.Array]:
     """Move a host numpy batch onto the mesh, split along ``axis``.
 
     One sharded transfer per step — the only host→device boundary in the
@@ -87,8 +94,11 @@ def shard_batch(batch: Dict[str, Any], mesh: Mesh,
     execution each process passes its LOCAL shard of the global batch
     (``1/process_count`` of the rows, see ``parallel.multihost``) and the
     global array is assembled without any cross-host data movement.
+    ``stacked=True`` handles ``[K, batch, ...]`` multi-step batches
+    (micro-step axis replicated, batch axis split).
     """
-    sharding = batch_sharding(mesh, axis)
+    sharding = (stacked_batch_sharding if stacked
+                else batch_sharding)(mesh, axis)
     if jax.process_count() > 1:
         return jax.tree_util.tree_map(
             lambda x: jax.make_array_from_process_local_data(sharding, x),

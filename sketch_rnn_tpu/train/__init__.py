@@ -5,7 +5,11 @@ SURVEY.md §2 components 11-14 and §5 auxiliary subsystems.
 
 from sketch_rnn_tpu.train.schedules import kl_weight_schedule, lr_schedule
 from sketch_rnn_tpu.train.state import TrainState, make_optimizer, make_train_state
-from sketch_rnn_tpu.train.step import make_eval_step, make_train_step
+from sketch_rnn_tpu.train.step import (
+    make_eval_step,
+    make_multi_train_step,
+    make_train_step,
+)
 from sketch_rnn_tpu.train.checkpoint import (
     latest_checkpoint,
     restore_checkpoint,
@@ -20,6 +24,7 @@ __all__ = [
     "make_optimizer",
     "make_train_state",
     "make_train_step",
+    "make_multi_train_step",
     "make_eval_step",
     "save_checkpoint",
     "restore_checkpoint",

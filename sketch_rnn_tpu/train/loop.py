@@ -30,7 +30,11 @@ from sketch_rnn_tpu.train.checkpoint import (
 )
 from sketch_rnn_tpu.train.metrics import MetricsWriter
 from sketch_rnn_tpu.train.state import TrainState, make_train_state
-from sketch_rnn_tpu.train.step import make_eval_step, make_train_step
+from sketch_rnn_tpu.train.step import (
+    make_eval_step,
+    make_multi_train_step,
+    make_train_step,
+)
 from sketch_rnn_tpu.utils.debug import check_finite
 from sketch_rnn_tpu.utils.profiling import Throughput
 
@@ -110,7 +114,12 @@ def train(hps: HParams,
         state, scale_factor, meta = restore_checkpoint(workdir, state)
         print(f"[train] resumed from step {meta['step']}", flush=True)
 
-    train_step = make_train_step(model, hps, mesh)
+    # steps_per_call > 1: K optimizer steps per jitted call (one dispatch,
+    # one stacked transfer) — host-loop amortization for remote runtimes;
+    # K == 1 builds the plain single-step fn
+    spc = hps.steps_per_call
+    train_step = make_multi_train_step(model, hps, mesh)
+    single_step = None  # built lazily for a non-K-aligned final remainder
     eval_step = make_eval_step(model, hps, mesh)
     # multi-host: only the primary process writes metrics and checkpoints.
     # workdir MUST be shared storage in multi-host runs — every host
@@ -134,10 +143,15 @@ def train(hps: HParams,
     # happen on a producer thread, hidden behind the previous step's
     # device compute (SURVEY §7 "input pipeline that doesn't starve 8
     # chips"); prefetch_depth=0 gives the synchronous feed
-    feeder = prefetch_batches(train_loader, mesh, hps.prefetch_depth)
+    feeder = prefetch_batches(train_loader, mesh, hps.prefetch_depth,
+                              stack=spc)
+    # with K-step calls the loop only observes every K-th step, so cadence
+    # triggers on crossing a multiple rather than landing on one (for K=1
+    # the two are identical)
+    crossed = lambda prev, every: step // every > prev // every
     try:
         while step < num_steps:
-            if profile_span and step == profile_span[0]:
+            if profile_span and not trace_active and step >= profile_span[0]:
                 jax.profiler.start_trace(f"{workdir}/trace")
                 trace_active = True
             batch = feeder.get()
@@ -145,15 +159,29 @@ def train(hps: HParams,
             # continues the stream instead of replaying the pre-checkpoint
             # keys
             step_key = jax.random.fold_in(root_key, step)
-            state, metrics = train_step(state, batch, step_key)
-            step += 1
-            if trace_active and step == profile_span[1]:
+            prev = step
+            remaining = num_steps - step
+            if spc == 1 or remaining >= spc:
+                state, metrics = train_step(state, batch, step_key)
+                step += spc
+            else:
+                # final non-K-aligned remainder: replay the stacked micro-
+                # batches through a single-step program with the same
+                # per-micro-step keys the K-step call would have used
+                if single_step is None:
+                    single_step = make_train_step(model, hps, mesh)
+                for i in range(remaining):
+                    b_i = jax.tree_util.tree_map(lambda x: x[i], batch)
+                    state, metrics = single_step(
+                        state, b_i, jax.random.fold_in(step_key, i))
+                step += remaining
+            if trace_active and step >= profile_span[1]:
                 jax.block_until_ready(metrics["loss"])
                 jax.profiler.stop_trace()
                 trace_active = False
                 profile_span = None
 
-            if step % hps.log_every == 0 or step == num_steps:
+            if crossed(prev, hps.log_every) or step == num_steps:
                 scalars = {k: float(v) for k, v in metrics.items()}
                 rates = throughput.update(step)
                 if rates:
@@ -164,12 +192,12 @@ def train(hps: HParams,
                 writer.log_console(step, scalars)
                 check_finite(scalars, step)
 
-            if valid_loader is not None and step % hps.eval_every == 0:
+            if valid_loader is not None and crossed(prev, hps.eval_every):
                 ev = evaluate(state.params, valid_loader, eval_step, mesh)
                 eval_writer.write(step, ev)
                 eval_writer.log_console(step, ev)
 
-            if write_dir and step % hps.save_every == 0:
+            if write_dir and crossed(prev, hps.save_every):
                 save_checkpoint(write_dir, state, scale_factor, hps)
     finally:
         feeder.close()

@@ -41,6 +41,7 @@ from sketch_rnn_tpu.parallel.mesh import (
     batch_sharding,
     check_batch_divisible,
     replicated_sharding,
+    stacked_batch_sharding,
 )
 from sketch_rnn_tpu.train.schedules import kl_weight_schedule, lr_schedule
 from sketch_rnn_tpu.train.state import TrainState, make_optimizer
@@ -65,10 +66,10 @@ def _vma_check(hps: HParams) -> bool:
     return not (hps.fused_rnn and _interpret_default())
 
 
-def make_train_step(model, hps: HParams,
-                    mesh: Optional[Mesh] = None) -> StepFn:
-    """Build the jitted ``(state, batch, key) -> (state, metrics)`` step."""
-    tx = make_optimizer(hps)
+def _make_single_step_core(model, hps: HParams, mesh: Optional[Mesh],
+                           tx) -> StepFn:
+    """The un-jitted ``(state, batch, key) -> (state, metrics)`` step body;
+    shared by the single-step and K-micro-step (scan) jitted wrappers."""
 
     def grads_and_metrics(params, batch, key, kl_w, axis_name):
         if axis_name is not None:
@@ -104,7 +105,7 @@ def make_train_step(model, hps: HParams,
                                                kl_w, None)
             return finish(state, grads, metrics)
 
-        return jax.jit(step_fn, donate_argnums=0)
+        return step_fn
 
     check_batch_divisible(hps.batch_size, mesh)
     sharded = jax.shard_map(
@@ -121,6 +122,15 @@ def make_train_step(model, hps: HParams,
         grads, metrics = sharded(state.params, batch, key, kl_w)
         return finish(state, grads, metrics)
 
+    return step_fn
+
+
+def make_train_step(model, hps: HParams,
+                    mesh: Optional[Mesh] = None) -> StepFn:
+    """Build the jitted ``(state, batch, key) -> (state, metrics)`` step."""
+    step_fn = _make_single_step_core(model, hps, mesh, make_optimizer(hps))
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=0)
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
     return jax.jit(
@@ -131,6 +141,52 @@ def make_train_step(model, hps: HParams,
         out_shardings=(repl, repl),
         donate_argnums=0,
     )
+
+
+def make_multi_train_step(model, hps: HParams,
+                          mesh: Optional[Mesh] = None,
+                          steps_per_call: Optional[int] = None) -> StepFn:
+    """Build a jitted K-micro-step train call (host-loop amortization).
+
+    ``(state, batches, key) -> (state, last_metrics)`` where ``batches``
+    is a stacked pytree with leading axis ``K = steps_per_call`` (one
+    fresh batch per micro-step, see ``data.prefetch.prefetch_batches``'s
+    ``stack``). The K optimizer steps run as ONE ``lax.scan`` inside one
+    XLA program: one dispatch + one host->device transfer per K steps,
+    which removes per-launch latency from the step-time critical path —
+    the TPU-native answer to remote-runtime dispatch overhead (the
+    reference pays a ``sess.run`` boundary EVERY step, SURVEY §3.1).
+
+    Micro-step ``i`` uses ``fold_in(key, i)``; schedules read the live
+    ``state.step`` carried through the scan, so K calls of this are
+    step-for-step equivalent (same schedules, same per-step key
+    discipline) to K single-step calls with keys ``fold_in(key, i)``.
+    Returned metrics are the LAST micro-step's (what the loop would have
+    logged at that step anyway).
+    """
+    k = hps.steps_per_call if steps_per_call is None else steps_per_call
+    if k == 1:
+        return make_train_step(model, hps, mesh)
+    tx = make_optimizer(hps)
+    single = _make_single_step_core(model, hps, mesh, tx)
+
+    def multi_fn(state: TrainState, batches: Batch, key: jax.Array):
+        def body(st, xs):
+            batch_i, i = xs
+            st, metrics = single(st, batch_i, jax.random.fold_in(key, i))
+            return st, metrics
+
+        state, stacked = jax.lax.scan(body, state, (batches, jnp.arange(k)))
+        return state, jax.tree_util.tree_map(lambda v: v[-1], stacked)
+
+    if mesh is None:
+        return jax.jit(multi_fn, donate_argnums=0)
+    repl = replicated_sharding(mesh)
+    stacked_data = stacked_batch_sharding(mesh)
+    return jax.jit(multi_fn,
+                   in_shardings=(repl, stacked_data, repl),
+                   out_shardings=(repl, repl),
+                   donate_argnums=0)
 
 
 def make_eval_step(model, hps: HParams,

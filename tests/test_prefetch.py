@@ -97,3 +97,26 @@ def test_train_with_and_without_prefetch_identical():
     for x, y in zip(jax.tree_util.tree_leaves(a.params),
                     jax.tree_util.tree_leaves(b.params)):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_prefetch_stack_matches_sequential_batches():
+    """stack=K yields the same batches (same loader RNG order) as K
+    sequential random_batch() calls, stacked on a new leading axis."""
+    stacked_loader, _ = make_loader(seed=5)
+    seq_loader, _ = make_loader(seed=5)
+    feeder = prefetch_batches(stacked_loader, mesh=None, depth=1, stack=3)
+    try:
+        got = feeder.get()
+    finally:
+        feeder.close()
+    want = [seq_loader.random_batch() for _ in range(3)]
+    for k in want[0]:
+        assert got[k].shape == (3,) + want[0][k].shape
+        for i in range(3):
+            np.testing.assert_array_equal(np.asarray(got[k][i]), want[i][k])
+
+
+def test_prefetch_stack_rejects_bad_k():
+    loader, _ = make_loader()
+    with pytest.raises(ValueError, match="stack"):
+        prefetch_batches(loader, mesh=None, depth=1, stack=0)

@@ -560,3 +560,70 @@ def test_e2e_overfit_tiny_corpus(tmp_path):
             first = float(m["recon"])
     last = float(m["recon"])
     assert last < 0.55 * first, f"no overfit: {first:.3f} -> {last:.3f}"
+
+
+# -- multi-step train calls (steps_per_call) --------------------------------
+
+
+def test_multi_step_equals_k_single_steps():
+    """One K=3 scan call must be step-for-step identical to 3 single-step
+    calls on the same micro-batches with keys fold_in(call_key, i)."""
+    from sketch_rnn_tpu.data.prefetch import prefetch_batches
+    from sketch_rnn_tpu.train import make_multi_train_step
+
+    hps = tiny_hps(steps_per_call=3)
+    model = SketchRNN(hps)
+    loader = make_loader(hps)
+    mesh = make_mesh(hps)
+    feeder = prefetch_batches(loader, mesh, depth=1, stack=3)
+    try:
+        stacked = feeder.get()
+    finally:
+        feeder.close()
+    key = jax.random.key(7)
+
+    s_multi = make_train_state(model, hps, jax.random.key(0))
+    s_multi, m_multi = make_multi_train_step(model, hps, mesh)(
+        s_multi, stacked, key)
+
+    s_single = make_train_state(model, hps, jax.random.key(0))
+    single = make_train_step(model, hps, mesh)
+    for i in range(3):
+        b = jax.tree_util.tree_map(lambda x: x[i], stacked)
+        s_single, m_single = single(s_single, b,
+                                    jax.random.fold_in(key, i))
+
+    assert int(s_multi.step) == int(s_single.step) == 3
+    for a, b in zip(jax.tree_util.tree_leaves(s_multi.params),
+                    jax.tree_util.tree_leaves(s_single.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6)
+    # returned metrics are the LAST micro-step's
+    assert float(m_multi["loss"]) == pytest.approx(
+        float(m_single["loss"]), rel=1e-5)
+
+
+def test_multi_step_k1_is_single_step():
+    from sketch_rnn_tpu.train import make_multi_train_step
+
+    hps = tiny_hps()  # steps_per_call defaults to 1
+    model = SketchRNN(hps)
+    loader = make_loader(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_multi_train_step(model, hps, mesh=None)
+    state, metrics = step(state, loader.get_batch(0), jax.random.key(1))
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_train_loop_steps_per_call_with_remainder(tmp_path):
+    """num_steps=5 with K=2: two K-calls + a 1-step remainder replay;
+    cadence triggers fire on crossings and the final state is step 5."""
+    hps = tiny_hps(steps_per_call=2, num_steps=5, log_every=2,
+                   eval_every=4, save_every=4)
+    loader = make_loader(hps)
+    valid = make_loader(hps, n=16, seed=9)
+    state = train(hps, loader, valid_loader=valid,
+                  workdir=str(tmp_path), seed=0, use_mesh=True)
+    assert int(state.step) == 5
+    assert latest_checkpoint(str(tmp_path)) is not None
