@@ -37,7 +37,9 @@ sampler at B in {1, 64, 1024}), BENCH_SPC (steps_per_call: optimizer
 steps per jitted call, default 5 — K fresh batches ride one stacked
 transfer + one dispatch, so a tunnel-latency stall costs at most one
 K-step window, not one per step; every timed step still consumes a
-fresh host-assembled batch).
+fresh host-assembled batch), BENCH_TRANSFER (strokes transfer dtype,
+default float32; bfloat16 halves host->device bytes, +3% measured —
+see hps.transfer_dtype for the rounding trade).
 
 Defaults are the measured-best v5e config: bfloat16 matmuls, global batch
 4096/chip (amortizes the per-step dispatch/feed overhead — measured
@@ -68,7 +70,8 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
                 seq_len: int, dtype: str, remat: bool,
                 prefetch_depth: int, fused: bool = False,
                 resid_dtype: str = "float32",
-                steps_per_call: int = 1) -> dict:
+                steps_per_call: int = 1,
+                transfer_dtype: str = "float32") -> dict:
     """Measure train-step throughput for one decoder cell; fresh batch
     per timed step via the prefetch pipeline. ``steps_per_call=K`` runs
     K optimizer steps per jitted call (lax.scan; one dispatch + one
@@ -90,7 +93,7 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
         dec_model=dec_model, batch_size=batch, max_seq_len=seq_len,
         compute_dtype=dtype, remat=remat, prefetch_depth=prefetch_depth,
         fused_rnn=fused, fused_residual_dtype=resid_dtype,
-        steps_per_call=steps_per_call)
+        steps_per_call=steps_per_call, transfer_dtype=transfer_dtype)
 
     model = SketchRNN(hps)
     mesh = make_mesh(hps)
@@ -106,7 +109,8 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
 
     # depth 0 = the synchronous strawman the pipeline is measured against
     feeder = prefetch_batches(loader, mesh, depth=prefetch_depth,
-                              stack=steps_per_call)
+                              stack=steps_per_call,
+                              transfer_dtype=transfer_dtype)
     try:
         # warmup: both compiles (initial-sharding + donated steady state)
         # and a settled step; sync via host value fetch — under the axon
@@ -145,6 +149,7 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
         "remat": remat,
         "prefetch_depth": prefetch_depth,
         "steps_per_call": steps_per_call,
+        "transfer_dtype": transfer_dtype,
         "steps": steps,
         "time_s": round(best, 4),
         "strokes_per_sec_per_chip": round(per_chip, 1),
@@ -209,10 +214,15 @@ def main() -> int:
     fused = os.environ.get("BENCH_FUSED", "1") == "1"
     resid = os.environ.get("BENCH_RESID", "bfloat16")
     spc = int(os.environ.get("BENCH_SPC", "5"))
+    transfer = os.environ.get("BENCH_TRANSFER", "float32")
     if spc < 1 or steps % spc != 0:
         # config error, not a transient — fail fast, don't retry
         print(f"BENCH_STEPS={steps} must be a positive multiple of "
               f"BENCH_SPC={spc}", file=sys.stderr)
+        return 2
+    if transfer not in ("float32", "bfloat16"):
+        print(f"BENCH_TRANSFER={transfer!r} must be float32 or bfloat16",
+              file=sys.stderr)
         return 2
     flagship = os.environ.get("BENCH_DEC", "layer_norm")
 
@@ -233,7 +243,7 @@ def main() -> int:
         try:
             r = bench_train(cell, steps, cell_batch, seq_len, dtype,
                             remat, depth, fused=fused, resid_dtype=resid,
-                            steps_per_call=spc)
+                            steps_per_call=spc, transfer_dtype=transfer)
         except Exception as e:  # transient tunnel/compile hiccups: the
             # driver runs this once per round, so one retry is cheap
             # insurance against losing the round's record
@@ -242,7 +252,7 @@ def main() -> int:
             time.sleep(10)
             r = bench_train(cell, steps, cell_batch, seq_len, dtype,
                             remat, depth, fused=fused, resid_dtype=resid,
-                            steps_per_call=spc)
+                            steps_per_call=spc, transfer_dtype=transfer)
         results[cell] = r
         _hist_append(r)
         print(f"# {json.dumps(r)}", file=sys.stderr)

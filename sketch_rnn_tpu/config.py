@@ -84,6 +84,13 @@ class HParams:
     #   coarsens to every K steps.
 
     # --- TPU / parallelism (component 18) ---
+    transfer_dtype: str = "float32"    # host->device dtype of the TRAIN
+    #   batch's strokes: "bfloat16" halves the per-step transfer bytes
+    #   (measured +3% flagship throughput in a fast tunnel window, more
+    #   when transfer-bound). Loss math stays f32 (the model upcasts on
+    #   entry); the semantic delta is bf16 rounding of the inputs and
+    #   MDN targets — smaller than the augmentation jitter, but not
+    #   bit-parity: eval sweeps always feed float32.
     compute_dtype: str = "float32"     # "bfloat16" for MXU-friendly matmuls
     fused_rnn: bool = False            # Pallas recompute-backward kernels for
     #   ALL three cells (ops/pallas_fused.py): measured fwd+bwd at the
@@ -113,6 +120,10 @@ class HParams:
             raise ValueError(
                 f"compute_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.compute_dtype!r}")
+        if self.transfer_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"transfer_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.transfer_dtype!r}")
         if self.fused_residual_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"fused_residual_dtype must be 'float32' or 'bfloat16', "

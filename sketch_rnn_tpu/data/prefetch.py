@@ -121,7 +121,8 @@ class SyncFeeder:
         pass
 
 
-def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1):
+def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
+                     transfer_dtype: Optional[str] = None):
     """Feeder over ``loader.random_batch()`` with the device transfer
     (sharded onto ``mesh`` when given) done on the producer thread;
     ``depth <= 0`` returns a synchronous feeder with the same interface.
@@ -131,17 +132,35 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1):
     axis — one transfer and one dispatch feed K micro-steps. The loader's
     RNG sequence is identical to K single gets, so K-step training sees
     exactly the batches K single steps would have.
+
+    ``transfer_dtype="bfloat16"`` casts the strokes array host-side so
+    the transfer moves half the bytes (``hps.transfer_dtype``; the model
+    upcasts on entry — see config.py for the rounding trade).
     """
     if stack < 1:
         raise ValueError(f"stack must be >= 1, got {stack}")
+    if transfer_dtype not in (None, "float32", "bfloat16"):
+        # mirror HParams' validation for direct callers: an arbitrary
+        # dtype (e.g. int8) would silently truncate the stroke deltas
+        raise ValueError(f"transfer_dtype must be 'float32' or "
+                         f"'bfloat16', got {transfer_dtype!r}")
+    cast = None
+    if transfer_dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        cast = jnp.dtype(transfer_dtype)
 
     def host_batch():
-        if stack == 1:
-            return loader.random_batch()
         import numpy as np
 
-        parts = [loader.random_batch() for _ in range(stack)]
-        return {k: np.stack([p[k] for p in parts]) for k in parts[0]}
+        if stack == 1:
+            out = dict(loader.random_batch())
+        else:
+            parts = [loader.random_batch() for _ in range(stack)]
+            out = {k: np.stack([p[k] for p in parts]) for k in parts[0]}
+        if cast is not None:
+            out["strokes"] = out["strokes"].astype(cast)
+        return out
 
     if mesh is not None:
         from sketch_rnn_tpu.parallel.mesh import shard_batch

@@ -114,6 +114,7 @@ class SketchRNN:
                ) -> Tuple[jax.Array, jax.Array]:
         """Time-major strokes ``[T, B, 5]`` -> (mu, presig), each [B, Nz]."""
         hps = self.hps
+        x_tm = x_tm.astype(jnp.float32)  # robust to bf16-transferred strokes
         gen_f = gen_b = None
         if train and hps.use_recurrent_dropout and key is not None:
             # masks are drawn inside the scan (rdrop_gen) so no [T, B, H]
@@ -245,7 +246,10 @@ class SketchRNN:
         GSPMD, so data parallelism must be explicit SPMD.
         """
         hps = self.hps
-        strokes = jnp.transpose(batch["strokes"], (1, 0, 2))  # [T+1, B, 5]
+        # upcast on entry: strokes may arrive bfloat16 (hps.transfer_dtype
+        # halves host->device bytes); all loss math stays float32
+        strokes = jnp.transpose(batch["strokes"], (1, 0, 2)
+                                ).astype(jnp.float32)  # [T+1, B, 5]
         x_in = strokes[:-1]
         x_target = strokes[1:]
         seq_len = batch["seq_len"]

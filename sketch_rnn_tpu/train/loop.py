@@ -144,7 +144,7 @@ def train(hps: HParams,
     # device compute (SURVEY §7 "input pipeline that doesn't starve 8
     # chips"); prefetch_depth=0 gives the synchronous feed
     feeder = prefetch_batches(train_loader, mesh, hps.prefetch_depth,
-                              stack=spc)
+                              stack=spc, transfer_dtype=hps.transfer_dtype)
     # with K-step calls the loop only observes every K-th step, so cadence
     # triggers on crossing a multiple rather than landing on one (for K=1
     # the two are identical)

@@ -127,3 +127,21 @@ def test_decoder_initial_carry_from_z():
     # distinct z -> distinct initial state
     carry2 = model.decoder_initial_carry(params, 2.0 * z, 4)
     assert not np.allclose(np.asarray(carry[0][0]), np.asarray(carry2[0][0]))
+
+
+def test_loss_accepts_bf16_strokes():
+    """hps.transfer_dtype feeds bf16 strokes; the model must upcast on
+    entry so the loss stays f32 and close to the f32-fed value."""
+    import jax.numpy as jnp
+
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    batch = make_batch(hps)
+    key = jax.random.key(1)
+    t32, m32 = model.loss(params, batch, key, kl_weight=0.5, train=False)
+    b16 = dict(batch)
+    b16["strokes"] = batch["strokes"].astype(jnp.bfloat16)
+    t16, m16 = model.loss(params, b16, key, kl_weight=0.5, train=False)
+    assert t16.dtype == jnp.float32
+    assert float(t16) == pytest.approx(float(t32), rel=2e-2)

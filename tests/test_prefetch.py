@@ -120,3 +120,25 @@ def test_prefetch_stack_rejects_bad_k():
     loader, _ = make_loader()
     with pytest.raises(ValueError, match="stack"):
         prefetch_batches(loader, mesh=None, depth=1, stack=0)
+
+
+def test_prefetch_transfer_dtype_casts_strokes_only():
+    import jax.numpy as jnp
+
+    loader, _ = make_loader(seed=7)
+    ref_loader, _ = make_loader(seed=7)
+    feeder = prefetch_batches(loader, mesh=None, depth=1,
+                              transfer_dtype="bfloat16")
+    try:
+        got = feeder.get()
+    finally:
+        feeder.close()
+    want = ref_loader.random_batch()
+    assert got["strokes"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(got["strokes"], np.float32),
+        want["strokes"].astype(jnp.bfloat16).astype(np.float32))
+    # non-stroke fields keep their exact dtype/values
+    assert got["seq_len"].dtype == want["seq_len"].dtype
+    np.testing.assert_array_equal(np.asarray(got["seq_len"]),
+                                  want["seq_len"])
