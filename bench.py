@@ -122,15 +122,27 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
             float(metrics["loss"])
 
         best = float("inf")
-        # 4 trials: the tunneled chip shows occasional 2x dispatch-stall
-        # variance; best-of-n is the honest steady-state number
-        for trial in range(4):
+        # adaptive best-of-n: the tunneled chip shows WINDOW-scale (minutes)
+        # slowdowns of up to 2x that hit whole trials, not single steps —
+        # keep trialing (up to BENCH_TRIALS) until 3 consecutive trials stop
+        # improving the best by >2%, so one bad window cannot set the record
+        max_trials = int(os.environ.get("BENCH_TRIALS", "8"))
+        no_improve = 0
+        for trial in range(max_trials):
             t0 = time.perf_counter()
             for i in range(calls):
                 state, metrics = step(state, feeder.get(),
                                       jax.random.fold_in(key, 100 + i))
             float(metrics["loss"])  # drains the chained steps
-            best = min(best, time.perf_counter() - t0)
+            t = time.perf_counter() - t0
+            print(f"#   trial {trial}: {t:.3f}s", file=sys.stderr)
+            if t < best * 0.98:
+                best, no_improve = t, 0
+            else:
+                best = min(best, t)
+                no_improve += 1
+            if trial >= 3 and no_improve >= 3:
+                break
     finally:
         feeder.close()
 

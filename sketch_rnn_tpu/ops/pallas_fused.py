@@ -91,7 +91,7 @@ def _sds(shape, dtype, ref):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _batch_tile(b: int, h: int) -> int:
+def _batch_tile(b: int, h: int, xb_bwd: bool = False) -> int:
     """Largest VMEM-friendly divisor of the batch for the outer grid.
 
     Scaled inversely with the hidden size: the per-step working set is
@@ -101,13 +101,17 @@ def _batch_tile(b: int, h: int) -> int:
     small-H cells: the H=256 encoder at B=4096 measured 56.6 ms fwd+bwd
     at tile 128 vs 46.2 ms at tile 512 (tile 1024 exceeds VMEM).
 
-    The ``x_bias`` path adds two ``[tile, 4H]`` f32 blocks (the bias
-    operand and the in-output dxb accumulator) on top of this budget;
-    verified to fit on v5e at both cap-boundary shapes (H=512/tile 256
-    — the flagship — and H=256/tile 512, whose smaller weights leave
-    the headroom).
+    ``xb_bwd``: the x_bias path adds two ``[tile, 4H]`` f32 blocks to
+    the BACKWARD kernel (the bias operand and the in-output dxb
+    accumulator), which puts the H=512/tile-256 backward right AT the
+    16M scoped-VMEM line — it compiled or OOM'd (by 3.5-4M) depending
+    on surrounding graph context (measured both on the same v5e), so
+    the backward halves its budget for a deterministic margin. The
+    forward has no grad accumulators and keeps the full budget; fwd
+    and bwd are separate pallas_calls, so asymmetric tiles are fine
+    (residual layout in HBM is tile-independent).
     """
-    cap = max(8, 131072 // max(h, 1))
+    cap = max(8, (65536 if xb_bwd else 131072) // max(h, 1))
     for cand in (512, 256, 128, 64, 32, 16, 8):
         if cand <= cap and b % cand == 0:
             return cand
@@ -464,7 +468,7 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz, h)
+    bt = _batch_tile(bsz, h, xb_bwd=x_bias is not None)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
@@ -765,7 +769,7 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     dhs, (dcT, dhT) = grads
     t, bsz, d = xs.shape
     h = wh.shape[0]
-    bt = _batch_tile(bsz, h)
+    bt = _batch_tile(bsz, h, xb_bwd=x_bias is not None)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)

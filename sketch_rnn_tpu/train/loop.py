@@ -35,7 +35,7 @@ from sketch_rnn_tpu.train.step import (
     make_multi_train_step,
     make_train_step,
 )
-from sketch_rnn_tpu.utils.debug import check_finite
+from sketch_rnn_tpu.utils.debug import check_finite, param_count
 from sketch_rnn_tpu.utils.profiling import Throughput
 
 
@@ -110,6 +110,10 @@ def train(hps: HParams,
     root_key = jax.random.key(seed)
     root_key, init_key = jax.random.split(root_key)
     state = make_train_state(model, hps, init_key)
+    if is_primary():
+        print(f"[train] model: enc={hps.enc_model} dec={hps.dec_model} "
+              f"params={param_count(state.params):,} "
+              f"devices={mesh.size if mesh is not None else 1}", flush=True)
     if workdir and resume and latest_checkpoint(workdir) is not None:
         state, scale_factor, meta = restore_checkpoint(workdir, state)
         print(f"[train] resumed from step {meta['step']}", flush=True)

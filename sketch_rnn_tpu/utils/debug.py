@@ -36,3 +36,8 @@ def find_nonfinite(tree: Any, prefix: str = "") -> List[str]:
             frac = float(np.mean(~np.isfinite(arr)))
             out.append(f"{name} ({frac:.1%} non-finite)")
     return out
+
+
+def param_count(tree: Any) -> int:
+    """Total number of scalar parameters in a pytree."""
+    return sum(int(np.prod(np.shape(x))) for x in jax.tree_util.tree_leaves(tree))

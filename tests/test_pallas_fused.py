@@ -634,3 +634,17 @@ def test_masks_traced_under_jit():
     assert np.isfinite(float(v))
     for leaf in jax.tree_util.tree_leaves(g):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_batch_tile_xb_bwd_budget():
+    """The x_bias backward adds two [tile, 4H] f32 blocks; at H=512 the
+    tile-256 backward sat exactly AT the 16M scoped-VMEM line and
+    compiled or OOM'd depending on graph context (measured on v5e) —
+    the backward must halve its tile budget, the forward keeps full."""
+    from sketch_rnn_tpu.ops.pallas_fused import _batch_tile
+
+    assert _batch_tile(4096, 512) == 256            # fwd, flagship decoder
+    assert _batch_tile(4096, 512, xb_bwd=True) == 128
+    assert _batch_tile(1024, 512, xb_bwd=True) == 128
+    assert _batch_tile(4096, 256) == 512            # encoder (no x_bias)
+    assert _batch_tile(4096, 256, xb_bwd=True) == 256
