@@ -834,11 +834,12 @@ fused_ln_lstm.defvjp(_fused_ln_lstm_fwd, _fused_ln_lstm_bwd)
 #   pre  = s_x * (x @ wx) + s_h * (h @ wh) + s_b + b
 #   then per-gate LN -> gates -> cell LN -> h, exactly LayerNormLSTM.
 #
-# The cell's per-gate [e, h] scale projections (a [4, e, h] einsum) become
-# ONE dense block-diagonal [4e, 4H] matmul per path — an MXU-shaped op
-# instead of 12 tiny ones. The wrapper (ops/rnn.py) builds the dense
-# matrix with traced jnp ops, so autodiff slices the dense gradient back
-# to the [4, e, h] blocks for free.
+# The cell's per-gate [e, h] scale projections run in BLOCK form (four
+# small matmuls per path, see _block_scale): an earlier dense
+# block-diagonal [4e, 4H] design made them one MXU matmul each, but its
+# f32 gradient accumulators cost 4x the VMEM and pushed the x_bias
+# backward over the 16M scoped-VMEM line; the kernel is latency-bound,
+# so the smaller matmuls cost nothing measurable.
 #
 # Residuals are only the four carry streams (c, h, hyper_c, hyper_h) —
 # [T, B, 2(H+HH)] total, the same footprint scan AD needs for its carries
@@ -853,16 +854,63 @@ import os as _os
 _HYPER_MAX_TILE = int(_os.environ.get("SRT_HYPER_TILE", "64"))
 
 
-def _hyper_batch_tile(b: int) -> int:
+def _hyper_batch_tile(b: int, xb_bwd: bool = False) -> int:
     """Largest divisor of ``b`` that fits the hyper kernel's VMEM cap.
 
     Must DIVIDE the batch — the grid is ``b // bt`` programs, so a
     non-divisor would silently drop the trailing rows.
+
+    ``xb_bwd``: with the x_bias path the BACKWARD adds four bias blocks
+    (xb/dxb ``[tile, 4H]`` + xbh/dxbh ``[tile, 4HH]`` f32) and measured
+    0.6-1.9M OVER the 16M scoped-VMEM line at tile 64 on v5e (it
+    compiled in some whole-model graphs and OOM'd standalone — the same
+    at-the-line flakiness as ``_batch_tile``), so the backward halves
+    the cap; the forward keeps the full tile.
     """
-    for cand in range(min(b, _HYPER_MAX_TILE), 0, -1):
+    cap = max(1, _HYPER_MAX_TILE // 2) if xb_bwd else _HYPER_MAX_TILE
+    for cand in range(min(b, cap), 0, -1):
         if b % cand == 0:
             return cand
     return b
+
+
+def _block_scale(z, zd_ref):
+    """``[bt, 4e] x [4, e, h] -> [bt, 4H]`` per-gate scale projection.
+
+    The cell's scale projections are four independent ``[e, h]`` blocks;
+    the kernel multiplies each gate's slice by its own block (4 small
+    MXU matmuls). An earlier design expanded them to one dense
+    block-diagonal ``[4e, 4H]`` matmul — fewer, bigger matmuls, but the
+    dense gradient accumulators cost 4x the VMEM ([4e, 4H] f32 vs
+    [4, e, h]) and pushed the x_bias backward 0.6-2M over the 16M
+    scoped-VMEM line (v5e, measured); the kernels are latency- not
+    MXU-bound, so the small matmuls cost nothing measurable.
+    """
+    e = zd_ref.shape[1]
+    return jnp.concatenate(
+        [jnp.dot(_cast(z[:, j * e:(j + 1) * e], zd_ref), zd_ref[j],
+                 preferred_element_type=jnp.float32) for j in range(4)],
+        axis=-1)
+
+
+def _block_unscale(ds, zd_ref):
+    """Backward of :func:`_block_scale` w.r.t. z: ``[bt, 4H] -> [bt, 4e]``."""
+    h = zd_ref.shape[2]
+    return jnp.concatenate(
+        [jnp.dot(_cast(ds[:, j * h:(j + 1) * h], zd_ref), zd_ref[j].T,
+                 preferred_element_type=jnp.float32) for j in range(4)],
+        axis=-1)
+
+
+def _block_scale_grad(z, ds, zd_ref, dzd_ref):
+    """Accumulate ``dzd[j] += z_j^T @ ds_j`` into the [4, e, h] grad ref."""
+    e = zd_ref.shape[1]
+    h = zd_ref.shape[2]
+    for j in range(4):
+        dzd_ref[j] += jnp.dot(
+            _cast(z[:, j * e:(j + 1) * e], zd_ref).T,
+            _cast(ds[:, j * h:(j + 1) * h], zd_ref),
+            preferred_element_type=jnp.float32)
 
 
 def _hyper_recompute(x, h, c, hc, hh, wx_ref, b_ref, wh_ref, wxhx_ref,
@@ -901,12 +949,9 @@ def _hyper_recompute(x, h, c, hc, hh, wx_ref, b_ref, wh_ref, wxhx_ref,
                  preferred_element_type=jnp.float32) + bhzh_ref[0]
     zb = jnp.dot(_cast(new_hh, whzb_ref), whzb_ref[:],
                  preferred_element_type=jnp.float32)
-    sx = jnp.dot(_cast(zx, zdx_ref), zdx_ref[:],
-                 preferred_element_type=jnp.float32)
-    sh = jnp.dot(_cast(zh, zdh_ref), zdh_ref[:],
-                 preferred_element_type=jnp.float32)
-    sb = jnp.dot(_cast(zb, zdb_ref), zdb_ref[:],
-                 preferred_element_type=jnp.float32)
+    sx = _block_scale(zx, zdx_ref)
+    sh = _block_scale(zh, zdh_ref)
+    sb = _block_scale(zb, zdb_ref)
     pre = sx * xp + sh * hp + sb + b_ref[0]
 
     ln = _ln_gates(pre, c, m, gam_ref[...], bet_ref[...], gc_ref[...],
@@ -1041,18 +1086,13 @@ def _hyper_bwd_kernel(x_ref, xb_ref, xbh_ref, wx_ref, b_ref, wh_ref,
     if xb_mode:
         dxb_ref[...] += dxp       # xb is part of xh, pre-scaling
 
-    # ---- scale projections (dense block-diagonal) ----
-    dsx_c, dsh_c, dsb_c = (_cast(dsx, zdx_ref), _cast(dsh, zdh_ref),
-                           _cast(d_pre, zdb_ref))
-    dzx = jnp.dot(dsx_c, zdx_ref[:].T, preferred_element_type=jnp.float32)
-    dzh = jnp.dot(dsh_c, zdh_ref[:].T, preferred_element_type=jnp.float32)
-    dzb = jnp.dot(dsb_c, zdb_ref[:].T, preferred_element_type=jnp.float32)
-    dzdx_ref[:] += jnp.dot(_cast(zx, zdx_ref).T, dsx_c,
-                           preferred_element_type=jnp.float32)
-    dzdh_ref[:] += jnp.dot(_cast(zh, zdh_ref).T, dsh_c,
-                           preferred_element_type=jnp.float32)
-    dzdb_ref[:] += jnp.dot(_cast(zb, zdb_ref).T, dsb_c,
-                           preferred_element_type=jnp.float32)
+    # ---- per-gate scale projections (block form, see _block_scale) ----
+    dzx = _block_unscale(dsx, zdx_ref)
+    dzh = _block_unscale(dsh, zdh_ref)
+    dzb = _block_unscale(d_pre, zdb_ref)
+    _block_scale_grad(zx, dsx, zdx_ref, dzdx_ref)
+    _block_scale_grad(zh, dsh, zdh_ref, dzdh_ref)
+    _block_scale_grad(zb, d_pre, zdb_ref, dzdb_ref)
 
     # ---- hyper_h -> z projections ----
     dzx_c = _cast(dzx, whzx_ref)
@@ -1162,9 +1202,8 @@ def fused_hyper_lstm(xs: jax.Array, wx: jax.Array, b: jax.Array,
       weight split row-wise) and its own recurrent weights.
     - ``w_hz_p [HH, 4e]`` (+ ``b_hz_p [4e]`` for p ∈ {x, h}): hyper_h →
       per-gate embeddings.
-    - ``zd_p [4e, 4H]``: DENSE block-diagonal expansion of the cell's
-      ``[4, e, h]`` scale projections (built by the caller with traced
-      jnp ops so the dense cotangent autodiffs back to the blocks).
+    - ``zd_p [4, e, h]``: the cell's per-gate scale projections, in the
+      cell's own block layout (multiplied per gate inside the kernel).
     - per-gate LN ``ln_gamma/ln_beta [4, H]``, cell LN ``[H]``.
 
     Returns ``(hs [T, B, H], ((cT, hT), (hcT, hhT)))`` — the same carry
@@ -1265,7 +1304,7 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     t, bsz, d = xs.shape
     h = wh.shape[0]
     hh_size = whh.shape[0]
-    bt = _hyper_batch_tile(bsz)
+    bt = _hyper_batch_tile(bsz, xb_bwd=x_bias is not None)
     mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     bh2 = bh.reshape(1, -1).astype(jnp.float32)

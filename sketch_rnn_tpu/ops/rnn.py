@@ -52,16 +52,6 @@ def _match_vma(carry, ref: jax.Array):
     return jax.tree_util.tree_map(widen, carry)
 
 
-def _block_diag4(w: jax.Array) -> jax.Array:
-    """``[4, e, h] -> [4e, 4h]`` block-diagonal expansion.
-
-    The HyperLSTM kernel runs the per-gate scale projections as ONE dense
-    MXU matmul; traced, so autodiff slices the dense cotangent back to
-    the blocks automatically.
-    """
-    return jax.scipy.linalg.block_diag(*w)
-
-
 def _concat_extra(xs: jax.Array, extra: jax.Array) -> jax.Array:
     """Broadcast time-invariant features over T and concatenate to xs."""
     t = xs.shape[0]
@@ -142,8 +132,7 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
             cast(params["w_hz_x"]), params["b_hz_x"],
             cast(params["w_hz_h"]), params["b_hz_h"],
             cast(params["w_hz_b"]),
-            _block_diag4(params["w_zd_x"]), _block_diag4(params["w_zd_h"]),
-            _block_diag4(params["w_zd_b"]),
+            params["w_zd_x"], params["w_zd_h"], params["w_zd_b"],
             params["ln_gamma"], params["ln_beta"],
             params["lnc_gamma"], params["lnc_beta"],
             c0, h0, hc0, hh0, cell.forget_bias, masks, seed, keep, rd,
