@@ -1,0 +1,69 @@
+"""Download QuickDraw sketch-rnn ``.npz`` files (stroke-3 format).
+
+The reference trains on the public QuickDraw dataset; the canonical
+per-category files live at
+
+    https://storage.googleapis.com/quickdraw_dataset/sketchrnn/<cat>.npz
+
+each holding ``train``/``valid``/``test`` arrays of int16 stroke-3
+sequences — exactly what ``sketch_rnn_tpu.data.load_dataset`` reads.
+
+Usage:
+    python scripts/fetch_quickdraw.py cat dog owl --out data/
+    python -m sketch_rnn_tpu.cli train --data_dir=data \
+        --hparams='data_set=cat.npz;dog.npz;owl.npz,num_classes=3,...'
+
+This environment has no network egress, so the script is untestable
+here; it is deliberately a thin stdlib-only downloader (urllib, atomic
+rename, resume-skip) with nothing environment-specific to go stale.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.request
+
+BASE = "https://storage.googleapis.com/quickdraw_dataset/sketchrnn"
+
+
+def fetch(category: str, out_dir: str, overwrite: bool = False) -> str:
+    """Download one category's ``.npz``; returns the local path."""
+    name = category if category.endswith(".npz") else f"{category}.npz"
+    dest = os.path.join(out_dir, name)
+    if os.path.exists(dest) and not overwrite:
+        print(f"[fetch] {dest} exists, skipping")
+        return dest
+    url = f"{BASE}/{urllib.request.quote(name)}"
+    tmp = dest + ".part"
+    print(f"[fetch] {url} -> {dest}")
+    urllib.request.urlretrieve(url, tmp)
+    os.replace(tmp, dest)  # atomic: no truncated .npz on interrupt
+    return dest
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("categories", nargs="+",
+                    help="QuickDraw category names, e.g. cat dog owl")
+    ap.add_argument("--out", default="data", help="output directory")
+    ap.add_argument("--overwrite", action="store_true")
+    args = ap.parse_args(argv)
+    os.makedirs(args.out, exist_ok=True)
+    failed = []
+    for cat in args.categories:
+        try:
+            fetch(cat, args.out, overwrite=args.overwrite)
+        except Exception as e:  # noqa: BLE001 — report, keep downloading
+            print(f"[fetch] FAILED {cat}: {e}", file=sys.stderr)
+            failed.append(cat)
+    if failed:
+        print(f"[fetch] {len(failed)} of {len(args.categories)} failed: "
+              f"{' '.join(failed)}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

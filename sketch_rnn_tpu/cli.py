@@ -156,14 +156,37 @@ def cmd_eval(args) -> int:
     from sketch_rnn_tpu.train.loop import evaluate
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
+    if args.per_class and hps.num_classes <= 0:
+        print("[cli] --per_class needs a multi-class model "
+              "(num_classes > 0)", file=sys.stderr)
+        return 2
+    if args.per_class and mh.process_count() > 1:
+        # per-class GLOBAL example counts are not derivable locally under
+        # host striping; a mismatched per-class batch count would deadlock
+        # the SPMD sweep (see DataLoader.filter_by_label)
+        print("[cli] --per_class is single-host only", file=sys.stderr)
+        return 2
     model, state, scale, meta = _restore(hps, args.workdir)
     _, valid_l, test_l, _ = _load_data(hps, args, scale_factor=scale)
     loader = {"valid": valid_l, "test": test_l}[args.split]
     mesh = make_mesh(hps)
-    ev = evaluate(state.params, loader, make_eval_step(model, hps, mesh),
-                  mesh)
-    print(json.dumps({"split": args.split, "step": meta["step"],
-                      **{k: round(v, 6) for k, v in sorted(ev.items())}}))
+    eval_step = make_eval_step(model, hps, mesh)
+    ev = evaluate(state.params, loader, eval_step, mesh)
+    out = {"split": args.split, "step": meta["step"],
+           **{k: round(v, 6) for k, v in sorted(ev.items())}}
+    if args.per_class:
+        # reference-paper parity surface: per-category losses. Classes
+        # with no examples in the split report null.
+        per = {}
+        for c in range(hps.num_classes):
+            sub = loader.filter_by_label(c)
+            if sub.num_eval_batches == 0:
+                per[str(c)] = None
+                continue
+            evc = evaluate(state.params, sub, eval_step, mesh)
+            per[str(c)] = {k: round(v, 6) for k, v in sorted(evc.items())}
+        out["per_class"] = per
+    print(json.dumps(out))
     return 0
 
 
@@ -288,6 +311,10 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("eval", help="evaluate a checkpoint")
     _add_common(p)
     p.add_argument("--split", choices=("valid", "test"), default="valid")
+    p.add_argument("--per_class", action="store_true",
+                   help="also report metrics per class (the reference "
+                        "paper's per-category loss tables); multi-class "
+                        "models, single host only")
     p.set_defaults(fn=cmd_eval)
 
     p = sub.add_parser("sample", help="draw sketches from a checkpoint")

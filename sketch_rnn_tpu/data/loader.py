@@ -169,6 +169,20 @@ class DataLoader:
         b = self.hps.batch_size
         return (self._max_local_len + b - 1) // b
 
+    def filter_by_label(self, label: int) -> "DataLoader":
+        """New loader over this one's class-``label`` examples only.
+
+        For per-class eval sweeps (the reference paper reports losses per
+        QuickDraw category). Shares the (already normalized) stroke arrays
+        — do not call ``normalize`` on the result. Augmentation is off:
+        the filtered view exists for deterministic eval. Single-host only:
+        the per-class GLOBAL count is not derivable locally under host
+        striping, so multi-host callers must guard (see cli.cmd_eval).
+        """
+        sel = np.flatnonzero(self.labels == label)
+        return DataLoader([self.strokes[i] for i in sel], self.hps,
+                          labels=self.labels[sel], augment=False)
+
     def random_batch(self) -> Dict[str, np.ndarray]:
         idx = self.rng.choice(len(self.strokes), self.hps.batch_size,
                               replace=len(self.strokes) < self.hps.batch_size)

@@ -131,3 +131,27 @@ def test_graft_entry_dryrun_multichip_clean_subprocess():
         f"dryrun failed in clean env:\nstdout: {proc.stdout}\n"
         f"stderr: {proc.stderr}")
     assert "8 devices OK" in proc.stdout
+
+
+def test_cli_eval_per_class(tmp_path, capsys):
+    wd = str(tmp_path / "workpc")
+    hp = HP + ",num_classes=3"
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 f"--hparams={hp}"]) == 0
+    assert main(["eval", "--synthetic", f"--workdir={wd}",
+                 "--split=valid", "--per_class"]) == 0
+    ev = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    per = ev["per_class"]
+    assert set(per) == {"0", "1", "2"}
+    present = [v for v in per.values() if v is not None]
+    assert present, "synthetic valid split should contain some class"
+    for v in present:
+        assert np.isfinite(v["recon"])
+
+
+def test_cli_eval_per_class_needs_classes(tmp_path, capsys):
+    wd = str(tmp_path / "worknc")
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 f"--hparams={HP}"]) == 0
+    assert main(["eval", "--synthetic", f"--workdir={wd}",
+                 "--per_class"]) == 2

@@ -165,3 +165,22 @@ def test_load_dataset_host_sharding(tmp_path, hps):
 def test_missing_file_raises(hps, tmp_path):
     with pytest.raises(FileNotFoundError):
         load_dataset(hps, data_dir=str(tmp_path))
+
+
+def test_filter_by_label():
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+
+    hps = HParams(batch_size=4, max_seq_len=64, num_classes=3)
+    seqs, labels = make_synthetic_strokes(30, num_classes=3, min_len=8,
+                                          max_len=60, seed=4)
+    dl = DataLoader(seqs, hps, labels=labels)
+    total = 0
+    for c in range(3):
+        sub = dl.filter_by_label(c)
+        total += len(sub)
+        assert np.all(sub.labels == c)
+        assert all(np.shares_memory(a, b) for a, b in
+                   zip(sub.strokes, [seqs[i] for i in
+                                     np.flatnonzero(labels == c)]))
+    assert total == len(dl)
