@@ -91,7 +91,8 @@ def _sds(shape, dtype, ref):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _batch_tile(b: int, h: int, xb_bwd: bool = False) -> int:
+def _batch_tile(b: int, h: int, xb_bwd: bool = False,
+                budget: int = 131072) -> int:
     """Largest VMEM-friendly divisor of the batch for the outer grid.
 
     Scaled inversely with the hidden size: the per-step working set is
@@ -111,8 +112,8 @@ def _batch_tile(b: int, h: int, xb_bwd: bool = False) -> int:
     and bwd are separate pallas_calls, so asymmetric tiles are fine
     (residual layout in HBM is tile-independent).
     """
-    cap = max(8, (65536 if xb_bwd else 131072) // max(h, 1))
-    for cand in (512, 256, 128, 64, 32, 16, 8):
+    cap = max(8, (budget // 2 if xb_bwd else budget) // max(h, 1))
+    for cand in (1024, 512, 256, 128, 64, 32, 16, 8):
         if cand <= cap and b % cand == 0:
             return cand
     return b
@@ -185,6 +186,38 @@ def _ln_bwd_input(dy, gamma, xhat, r):
 # ===========================================================================
 # vanilla LSTM
 # ===========================================================================
+
+
+def _lstm_step_bwd_math(x, h_prev, c_prev, dh, dc_in, m, wx_ref, b_ref,
+                        wh_ref, xb, *, forget_bias):
+    """Shared LSTM backward step: recompute the forward from (x, carries),
+    then the gate backward. Returns ``(d_pre [bt, 4H], dc_next)`` — the
+    pre-activation gradient and the cell-carry gradient for step t-1.
+    Used by both the full and the sequence-only backward kernels so the
+    gate math cannot drift between them."""
+    pre = (jnp.dot(_cast(x, wx_ref), wx_ref[:],
+                   preferred_element_type=jnp.float32)
+           + b_ref[0]
+           + jnp.dot(_cast(h_prev, wh_ref), wh_ref[:],
+                     preferred_element_type=jnp.float32))
+    if xb is not None:
+        pre = pre + xb
+    i, g_u, f, o, new_c = _lstm_gates(pre, c_prev, m,
+                                      forget_bias=forget_bias)
+    tanh_c = jnp.tanh(new_c)
+    dc = dc_in + dh * o * (1.0 - tanh_c * tanh_c)
+    do = dh * tanh_c
+    df = dc * c_prev
+    g = g_u * m if m is not None else g_u
+    di = dc * g
+    dg_u = dc * i * m if m is not None else dc * i
+    d_pre = jnp.concatenate([
+        di * i * (1.0 - i),
+        dg_u * (1.0 - g_u * g_u),
+        df * f * (1.0 - f),
+        do * o * (1.0 - o),
+    ], axis=-1)
+    return d_pre, dc * f
 
 
 def _lstm_gates(pre, c_prev, mask, *, forget_bias):
@@ -262,38 +295,17 @@ def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
         # [bt, 4H] of VMEM and push the tile size down
         dxb_ref[...] = jnp.zeros_like(dxb_ref)
 
-    # ---- recompute the forward step (the whole point of this kernel) ----
+    # ---- recompute the forward step + gate backward (shared math) ----
     x = x_ref[0]
     h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
     c_prev = cs_ref[0].astype(jnp.float32)
-    pre = (jnp.dot(_cast(x, wx_ref), wx_ref[:],
-                   preferred_element_type=jnp.float32)
-           + b_ref[0]
-           + jnp.dot(_cast(h_prev, wh_ref), wh_ref[:],
-                     preferred_element_type=jnp.float32))
-    if xb_mode:
-        pre = pre + xb_ref[...]
     # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
     m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
                    pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
-    i, g_u, f, o, new_c = _lstm_gates(pre, c_prev, m,
-                                      forget_bias=forget_bias)
-    tanh_c = jnp.tanh(new_c)
-
-    # ---- backward gate math ----
     dh = dh_scr[:] + dhs_ref[0].astype(jnp.float32)
-    dc = dc_scr[:] + dh * o * (1.0 - tanh_c * tanh_c)
-    do = dh * tanh_c
-    df = dc * c_prev
-    g = g_u * m if m is not None else g_u
-    di = dc * g
-    dg_u = dc * i * m if m is not None else dc * i
-    d_pre = jnp.concatenate([
-        di * i * (1.0 - i),
-        dg_u * (1.0 - g_u * g_u),
-        df * f * (1.0 - f),
-        do * o * (1.0 - o),
-    ], axis=-1)
+    d_pre, dc_next = _lstm_step_bwd_math(
+        x, h_prev, c_prev, dh, dc_scr[:], m, wx_ref, b_ref, wh_ref,
+        xb_ref[...] if xb_mode else None, forget_bias=forget_bias)
 
     if xb_mode:
         dxb_ref[...] += d_pre
@@ -307,7 +319,7 @@ def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
                         preferred_element_type=jnp.float32)
     dwh_ref[:] += jnp.dot(_cast(h_prev, wh_ref).T, d_pre_c,
                           preferred_element_type=jnp.float32)
-    dc_scr[:] = dc * f
+    dc_scr[:] = dc_next
 
     @pl.when(it == nt - 1)
     def _():
@@ -331,14 +343,22 @@ def _specs(bt, h, mask_mode, mask_shape):
     return step, tile, whole, mask_spec, seed_spec
 
 
-def _mask_args(masks, seed, t):
-    """Resolve the dropout mode and its two (possibly dummy) operands."""
+def _mask_args(masks, seed):
+    """Resolve the dropout mode and its two (possibly dummy) operands.
+
+    The non-streamed dummy is ``[1, 1]``, NOT ``[t, 1, 1]``: Mosaic pads
+    a block's two minor dims to the (8, 128) tile, so a ``[250, 1, 1]``
+    whole-block dummy would cost 250*8*128*4 = 1.3M of VMEM for an
+    operand the kernel never reads — measured as the difference between
+    the seq-LSTM backward fitting (15M) and OOMing (16.11M) at tile
+    1024 inside the full training graph.
+    """
     if masks is not None and seed is not None:
         raise ValueError("pass masks or dropout_seed, not both")
     mode = "streamed" if masks is not None else \
         ("prng" if seed is not None else "none")
     mask_arg = masks if masks is not None \
-        else jnp.zeros((t, 1, 1), jnp.float32)
+        else jnp.zeros((1, 1), jnp.float32)
     seed_arg = (jnp.asarray(seed, jnp.int32).reshape(1, 1)
                 if seed is not None else jnp.zeros((1, 1), jnp.int32))
     return mode, mask_arg, seed_arg
@@ -424,7 +444,7 @@ def _lstm_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz, h)
-    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
@@ -469,7 +489,7 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz, h, xb_bwd=x_bias is not None)
-    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
@@ -512,6 +532,205 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
 
 
 fused_lstm.defvjp(_fused_lstm_fwd, _fused_lstm_bwd)
+
+
+# ===========================================================================
+# sequence-only vanilla LSTM (the encoder's kernel)
+# ===========================================================================
+#
+# The bidirectional encoder never uses the kernel's final carry (it
+# gathers each sequence's last VALID state from hs) and its initial
+# carries are constant zeros, so this variant drops the cT/hT outputs,
+# the dcT/dhT cotangent operands and the dc0/dh0 gradient outputs.
+# That removes four [tile, H] f32 blocks from the backward's VMEM
+# budget — which is what lets the tile grow to 1024 at H=256
+# (_batch_tile_seq): the full kernel's backward at tile 1024 measured
+# 2.38M OVER the 16M scoped-VMEM limit, and halving the grid's batch
+# axis is a direct win for the latency-bound encoder recurrence.
+
+
+def _batch_tile_seq(b: int, h: int) -> int:
+    """Batch tile for the sequence-only kernels: double the full
+    kernels' budget (no final-carry / carry-grad / input-grad blocks
+    in VMEM)."""
+    return _batch_tile(b, h, budget=262144)
+
+
+def _lstm_seq_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref,
+                         mask_ref, seed_ref, hs_ref, cs_ref,
+                         c_scr, h_scr, *, forget_bias, mask_mode, keep_prob):
+    ib = pl.program_id(0)
+    it = pl.program_id(1)
+
+    @pl.when(it == 0)
+    def _():
+        c_scr[:] = c0_ref[:]
+        h_scr[:] = h0_ref[:]
+
+    c, h = c_scr[:], h_scr[:]
+    pre = (jnp.dot(_cast(x_ref[0], wx_ref), wx_ref[:],
+                   preferred_element_type=jnp.float32)
+           + b_ref[0]
+           + jnp.dot(_cast(h, wh_ref), wh_ref[:],
+                     preferred_element_type=jnp.float32))
+    m = _step_mask(mask_ref, seed_ref, it, ib, pl.num_programs(0),
+                   c.shape, keep_prob, mask_mode)
+    _, _, _, o, new_c = _lstm_gates(pre, c, m, forget_bias=forget_bias)
+    new_h = jnp.tanh(new_c) * o
+    cs_ref[0] = c.astype(cs_ref.dtype)
+    c_scr[:] = new_c
+    h_scr[:] = new_h
+    hs_ref[0] = new_h.astype(hs_ref.dtype)
+
+
+def _lstm_seq_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
+                         mask_ref, seed_ref, dhs_ref,
+                         dwx_ref, db_ref, dwh_ref,
+                         dc_scr, dh_scr, *, forget_bias, mask_mode,
+                         keep_prob):
+    """Reverse-time grid; carries start from ZERO cotangents (no final
+    carry was produced); the initial-carry AND input gradients are
+    dropped (encoder contract: xs is data, carries are constants — only
+    the weights are differentiated)."""
+    ib = pl.program_id(0)
+    it = pl.program_id(1)
+
+    @pl.when((ib == 0) & (it == 0))
+    def _():
+        dwx_ref[:] = jnp.zeros_like(dwx_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+        dwh_ref[:] = jnp.zeros_like(dwh_ref)
+
+    @pl.when(it == 0)
+    def _():
+        dc_scr[:] = jnp.zeros_like(dc_scr)
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+
+    x = x_ref[0]
+    h_prev = hp_ref[0].astype(jnp.float32)
+    c_prev = cs_ref[0].astype(jnp.float32)
+    nt = pl.num_programs(1)
+    m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
+                   pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
+    dh = dh_scr[:] + dhs_ref[0].astype(jnp.float32)
+    d_pre, dc_next = _lstm_step_bwd_math(
+        x, h_prev, c_prev, dh, dc_scr[:], m, wx_ref, b_ref, wh_ref, None,
+        forget_bias=forget_bias)
+
+    d_pre_c = _cast(d_pre, wx_ref)
+    dwx_ref[:] += jnp.dot(_cast(x, wx_ref).T, d_pre_c,
+                          preferred_element_type=jnp.float32)
+    db_ref[0] += jnp.sum(d_pre, axis=0)
+    dh_scr[:] = jnp.dot(d_pre_c, wh_ref[:].T,
+                        preferred_element_type=jnp.float32)
+    dwh_ref[:] += jnp.dot(_cast(h_prev, wh_ref).T, d_pre_c,
+                          preferred_element_type=jnp.float32)
+    dc_scr[:] = dc_next
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 9, 10))
+def fused_lstm_seq(xs: jax.Array, wx: jax.Array, b: jax.Array,
+                   wh: jax.Array, c0: jax.Array, h0: jax.Array,
+                   forget_bias: float = 1.0,
+                   masks: Optional[jax.Array] = None,
+                   dropout_seed: Optional[jax.Array] = None,
+                   keep_prob: float = 1.0,
+                   residual_dtype=jnp.float32) -> jax.Array:
+    """Sequence-only fused LSTM: returns ``hs`` alone (no final carry).
+
+    For recurrences where only the WEIGHTS are differentiated — the
+    bidirectional encoder: xs is the data batch, carries are constant
+    zeros, the final state is gathered from ``hs``. The xs/c0/h0
+    cotangents are defined as ZERO (dropping their gradient blocks is
+    what buys the doubled backward batch tile) — passing differentiated
+    inputs or carries here silently loses their gradients, so callers
+    must guard (ops.rnn's ``need_final=False`` contract does).
+    Same argument semantics as :func:`fused_lstm` otherwise.
+    """
+    hs, _ = _lstm_seq_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks,
+                               dropout_seed, keep_prob, residual_dtype)
+    return hs
+
+
+def _lstm_seq_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks, seed,
+                       keep_prob, residual_dtype):
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    bt = _batch_tile_seq(bsz, h)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed)
+    b2 = b.reshape(1, -1).astype(jnp.float32)
+    step, tile, whole, mask_spec, seed_spec = _specs(
+        bt, h, mode, mask_arg.shape)
+
+    kernel = functools.partial(_lstm_seq_fwd_kernel,
+                               forget_bias=forget_bias, mask_mode=mode,
+                               keep_prob=keep_prob)
+    hs, cs = pl.pallas_call(
+        kernel,
+        grid=(bsz // bt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape), tile((bt, h)), tile((bt, h)), mask_spec,
+                  seed_spec],
+        out_specs=(step((bt, h)), step((bt, h))),
+        out_shape=(
+            _sds((t, bsz, h), residual_dtype, xs),
+            _sds((t, bsz, h), residual_dtype, xs),
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, h), jnp.float32)],
+        interpret=_interpret_default(),
+    )(xs, wx, b2, wh, c0, h0, mask_arg, seed_arg)
+    return hs, cs
+
+
+def _fused_lstm_seq_fwd(xs, wx, b, wh, c0, h0, forget_bias, masks,
+                        dropout_seed, keep_prob, residual_dtype):
+    hs, cs = _lstm_seq_fwd_call(xs, wx, b, wh, c0, h0, forget_bias, masks,
+                                dropout_seed, keep_prob, residual_dtype)
+    return hs, (xs, wx, b, wh, c0, h0, hs, cs, masks, dropout_seed)
+
+
+def _fused_lstm_seq_bwd(forget_bias, keep_prob, residual_dtype, res, dhs):
+    xs, wx, b, wh, c0, h0, hs, cs, masks, seed = res
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    bt = _batch_tile_seq(bsz, h)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed)
+    b2 = b.reshape(1, -1).astype(jnp.float32)
+    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
+    rev = lambda a: jnp.flip(a, axis=0)
+    step, tile, whole, mask_spec, seed_spec = _specs(
+        bt, h, mode, mask_arg.shape)
+
+    kernel = functools.partial(_lstm_seq_bwd_kernel,
+                               forget_bias=forget_bias, mask_mode=mode,
+                               keep_prob=keep_prob)
+    dwx, db2, dwh = pl.pallas_call(
+        kernel,
+        grid=(bsz // bt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape), step((bt, h)), step((bt, h)), mask_spec,
+                  seed_spec, step((bt, h))],
+        out_specs=(whole(wx.shape), whole(b2.shape), whole(wh.shape)),
+        out_shape=(
+            _sds(wx.shape, jnp.float32, xs),
+            _sds(b2.shape, jnp.float32, xs),
+            _sds(wh.shape, jnp.float32, xs),
+        ),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
+                        pltpu.VMEM((bt, h), jnp.float32)],
+        interpret=_interpret_default(),
+    )(rev(xs), wx, b2, wh, rev(cs), rev(h_prev),
+      rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
+      rev(dhs))
+    dmasks = jnp.zeros_like(masks) if masks is not None else None
+    return (jnp.zeros_like(xs), dwx.astype(wx.dtype),
+            db2.reshape(-1).astype(b.dtype), dwh.astype(wh.dtype),
+            jnp.zeros_like(c0), jnp.zeros_like(h0), dmasks,
+            _seed_cotangent(seed))
+
+
+fused_lstm_seq.defvjp(_fused_lstm_seq_fwd, _fused_lstm_seq_bwd)
 
 
 # ===========================================================================
@@ -723,7 +942,7 @@ def _lnlstm_fwd_call(xs, wx, wh, gam, bet, gc, bc, c0, h0, forget_bias,
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz, h)
-    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
@@ -770,7 +989,7 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     t, bsz, d = xs.shape
     h = wh.shape[0]
     bt = _batch_tile(bsz, h, xb_bwd=x_bias is not None)
-    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
     h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
     rev = lambda a: jnp.flip(a, axis=0)
@@ -1227,7 +1446,7 @@ def _hyper_fwd_call(xs, wx, b, wh, wxh_x, wxh_h, bh, whh, w_hz_x, b_hz_x,
     h = wh.shape[0]
     hh_size = whh.shape[0]
     bt = _hyper_batch_tile(bsz)
-    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     bh2 = bh.reshape(1, -1).astype(jnp.float32)
     bhzx2 = b_hz_x.reshape(1, -1).astype(jnp.float32)
@@ -1305,7 +1524,7 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     h = wh.shape[0]
     hh_size = whh.shape[0]
     bt = _hyper_batch_tile(bsz, xb_bwd=x_bias is not None)
-    mode, mask_arg, seed_arg = _mask_args(masks, seed, t)
+    mode, mask_arg, seed_arg = _mask_args(masks, seed)
     b2 = b.reshape(1, -1).astype(jnp.float32)
     bh2 = bh.reshape(1, -1).astype(jnp.float32)
     bhzx2 = b_hz_x.reshape(1, -1).astype(jnp.float32)

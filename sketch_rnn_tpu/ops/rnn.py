@@ -60,7 +60,7 @@ def _concat_extra(xs: jax.Array, extra: jax.Array) -> jax.Array:
 
 
 def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
-               residual_dtype=None, x_extra=None):
+               residual_dtype=None, x_extra=None, seq_only=False):
     """Dispatch to the Pallas recompute-backward kernels (ops.pallas_fused).
 
     Covers all three cells (LSTM / LayerNormLSTM / HyperLSTM). ``reverse``
@@ -145,8 +145,18 @@ def _run_fused(cell, params, xs, carry0, rdrop_masks, reverse, rdrop_gen,
             cell.forget_bias, masks, seed, keep, rd, xb)
     else:
         c0, h0 = carry0
-        hs, fin = PF.fused_lstm(xs, wx, params["b"], wh, c0, h0,
-                                cell.forget_bias, masks, seed, keep, rd, xb)
+        if seq_only and xb is None:
+            # encoder fast path: no final carry, no input/initial-carry
+            # grads (xs is data, carries are constant zeros) -> the seq
+            # kernel's backward fits twice the batch tile
+            # (ops.pallas_fused._batch_tile_seq)
+            hs = PF.fused_lstm_seq(xs, wx, params["b"], wh, c0, h0,
+                                   cell.forget_bias, masks, seed, keep, rd)
+            fin = None
+        else:
+            hs, fin = PF.fused_lstm(xs, wx, params["b"], wh, c0, h0,
+                                    cell.forget_bias, masks, seed, keep,
+                                    rd, xb)
     if reverse:
         hs = jnp.flip(hs, axis=0)
     return fin, hs
@@ -167,7 +177,8 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
             rdrop_gen: Optional[Tuple[jax.Array, float]] = None,
             remat: bool = False, fused: bool = False,
             residual_dtype=None,
-            x_extra: Optional[jax.Array] = None) -> Tuple[Any, jax.Array]:
+            x_extra: Optional[jax.Array] = None,
+            need_final: bool = True) -> Tuple[Any, jax.Array]:
     """Scan ``cell`` over time-major inputs ``xs`` of shape ``[T, B, D]``.
 
     Returns ``(final_carry, hs)`` with ``hs`` of shape ``[T, B, H]``.
@@ -209,11 +220,20 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
     HBM, narrower per-step matmuls; the hyper cell gets a second bias
     for its aux LSTM); on the scan path they are broadcast and
     concatenated — identical semantics either way.
+
+    ``need_final=False`` declares that the caller uses only ``hs`` (not
+    the returned final carry) and that NEITHER ``xs`` NOR ``carry0`` is
+    differentiated (encoder contract: inputs are the data batch); with
+    default (zero) carries the fused LSTM path then runs the
+    sequence-only kernel, which drops the input/carry gradient blocks
+    from its backward and fits double the batch tile. The returned
+    final carry may be ``None`` in that case.
     """
     use_fused = fused and fused_supported(cell)
     if x_extra is not None and not use_fused:
         xs = _concat_extra(xs, x_extra)
         x_extra = None
+    zero_carry = carry0 is None
     if carry0 is None:
         carry0 = cell.initial_carry(xs.shape[1])
     carry0 = _match_vma(carry0, xs)
@@ -226,7 +246,8 @@ def run_rnn(cell, params, xs: jax.Array, carry0: Optional[Any] = None,
         # H=512 on v5e (scripts/bench_kernel.py); remat is moot there
         # (the kernels save only the carry streams and recompute gates)
         return _run_fused(cell, params, xs, carry0, rdrop_masks, reverse,
-                          rdrop_gen, residual_dtype, x_extra)
+                          rdrop_gen, residual_dtype, x_extra,
+                          seq_only=not need_final and zero_carry)
 
     inputs = cell.precompute_inputs(params, xs) if hoist else xs
     stepper = cell.step_pre if hoist else cell
@@ -314,15 +335,19 @@ def bidirectional_rnn(cell_fwd, cell_bwd, params_fwd, params_bwd,
         rev_idx = jnp.where(idx < seq_len[None, :],
                             seq_len[None, :] - 1 - idx, idx)  # [T, B]
         xs_rev = jnp.take_along_axis(xs, rev_idx[:, :, None], axis=0)
+        # need_final=False: the final-valid state comes from hs (gather
+        # below), carries are the default zeros -> the fused LSTM path
+        # takes the sequence-only kernel with the doubled batch tile
         _, hs_f = run_rnn(cell_fwd, params_fwd, xs,
                           rdrop_masks=rdrop_masks_fwd,
                           rdrop_gen=rdrop_gen_fwd, remat=remat, fused=fused,
-                          residual_dtype=residual_dtype)
+                          residual_dtype=residual_dtype, need_final=False)
         # dropout masks are i.i.d. per step, so they need no matching reversal
         _, hs_b_rev = run_rnn(cell_bwd, params_bwd, xs_rev,
                               rdrop_masks=rdrop_masks_bwd,
                               rdrop_gen=rdrop_gen_bwd, remat=remat,
-                              fused=fused, residual_dtype=residual_dtype)
+                              fused=fused, residual_dtype=residual_dtype,
+                              need_final=False)
         # forward state at the last valid step
         last = jnp.clip(seq_len - 1, 0, t - 1)            # [B]
         h_f = jnp.take_along_axis(

@@ -648,3 +648,53 @@ def test_batch_tile_xb_bwd_budget():
     assert _batch_tile(1024, 512, xb_bwd=True) == 128
     assert _batch_tile(4096, 256) == 512            # encoder (no x_bias)
     assert _batch_tile(4096, 256, xb_bwd=True) == 256
+
+
+def test_seq_lstm_matches_full_kernel():
+    """fused_lstm_seq (the encoder's weights-only-gradient variant) must
+    equal fused_lstm in outputs and all WEIGHT gradients (its xs/carry
+    cotangents are zero by contract), including the in-kernel PRNG
+    dropout and bf16-residual modes."""
+    import jax.numpy as jnp
+    from sketch_rnn_tpu.ops.pallas_fused import fused_lstm, fused_lstm_seq
+
+    k = jax.random.key(3)
+    ks = jax.random.split(k, 6)
+    T, B, D, H = 10, 8, 5, 12
+    xs = jax.random.normal(ks[0], (T, B, D))
+    wx = jax.random.normal(ks[1], (D, 4 * H)) * 0.3
+    b = jax.random.normal(ks[2], (4 * H,)) * 0.1
+    wh = jax.random.normal(ks[3], (H, 4 * H)) * 0.2
+    c0 = jnp.zeros((B, H))
+    h0 = jnp.zeros((B, H))
+    seed = jnp.int32(7)
+
+    for rd in (jnp.float32, jnp.bfloat16):
+        def loss_full(args):
+            xs, wx, b, wh = args
+            hs, _ = fused_lstm(xs, wx, b, wh, c0, h0, dropout_seed=seed,
+                               keep_prob=0.9, residual_dtype=rd)
+            return jnp.sum(jnp.sin(hs.astype(jnp.float32)))
+
+        def loss_seq(args):
+            xs, wx, b, wh = args
+            hs = fused_lstm_seq(xs, wx, b, wh, c0, h0, dropout_seed=seed,
+                                keep_prob=0.9, residual_dtype=rd)
+            return jnp.sum(jnp.sin(hs.astype(jnp.float32)))
+
+        v1, g1 = jax.value_and_grad(loss_full)((xs, wx, b, wh))
+        v2, g2 = jax.value_and_grad(loss_seq)((xs, wx, b, wh))
+        assert float(v1) == float(v2)
+        # weight grads match; the xs cotangent is zero BY CONTRACT
+        for a, bb in zip(g1[1:], g2[1:]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=1e-5, atol=1e-6)
+        assert not np.any(np.asarray(g2[0]))
+
+
+def test_batch_tile_seq_doubles_budget():
+    from sketch_rnn_tpu.ops.pallas_fused import _batch_tile, _batch_tile_seq
+
+    assert _batch_tile_seq(4096, 256) == 1024   # encoder: 2x the full 512
+    assert _batch_tile(4096, 256) == 512
+    assert _batch_tile_seq(4096, 512) == 512
