@@ -627,3 +627,40 @@ def test_train_loop_steps_per_call_with_remainder(tmp_path):
                   workdir=str(tmp_path), seed=0, use_mesh=True)
     assert int(state.step) == 5
     assert latest_checkpoint(str(tmp_path)) is not None
+
+
+def test_train_loop_profile_trace(tmp_path):
+    """--profile captures a jax.profiler trace of steps ~10-20 (normal
+    in-loop stop path; the error path is covered by the test below)."""
+    hps = tiny_hps(num_steps=25, log_every=10, eval_every=100,
+                   save_every=100)
+    loader = make_loader(hps, n=32)
+    state = train(hps, loader, workdir=str(tmp_path), seed=0,
+                  use_mesh=False, profile=True)
+    assert int(state.step) == 25
+    trace_dir = os.path.join(str(tmp_path), "trace")
+    assert os.path.isdir(trace_dir) and os.listdir(trace_dir)
+
+
+def test_train_loop_profile_trace_closed_on_error(tmp_path, monkeypatch):
+    """A raise while a --profile trace is open must close the session in
+    train()'s finally (ADVICE r1: a leaked session poisons any later
+    start_trace in the process)."""
+    import sketch_rnn_tpu.train.loop as L
+
+    hps = tiny_hps(num_steps=30, log_every=10, eval_every=1000,
+                   save_every=12)
+    loader = make_loader(hps, n=32)
+
+    def boom(*a, **k):
+        raise RuntimeError("save failed")
+
+    monkeypatch.setattr(L, "save_checkpoint", boom)
+    # save fires at step 12, inside the (10, 20) profile span
+    with pytest.raises(RuntimeError, match="save failed"):
+        train(hps, loader, workdir=str(tmp_path), seed=0,
+              use_mesh=False, profile=True)
+    # the finally path must have closed the trace: a fresh session then
+    # starts (and stops) cleanly instead of raising "already started"
+    jax.profiler.start_trace(os.path.join(str(tmp_path), "t2"))
+    jax.profiler.stop_trace()
