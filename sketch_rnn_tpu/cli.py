@@ -145,6 +145,7 @@ def cmd_train(args) -> int:
           f"scale={scale:.4f}, devices={jax.device_count()}", flush=True)
     train(hps, train_l, valid_l, test_l, scale_factor=scale,
           workdir=args.workdir, seed=args.seed,
+          resume=not getattr(args, "no_resume", False),
           profile=getattr(args, "profile", False))
     return 0
 
@@ -306,6 +307,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler device trace of steps "
                         "~10-20 into <workdir>/trace (view with XProf)")
+    p.add_argument("--no_resume", action="store_true",
+                   help="start fresh even when <workdir> holds "
+                        "checkpoints (default: resume from latest — the "
+                        "reference's resume-from-latest contract)")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint")

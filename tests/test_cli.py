@@ -155,3 +155,19 @@ def test_cli_eval_per_class_needs_classes(tmp_path, capsys):
                  f"--hparams={HP}"]) == 0
     assert main(["eval", "--synthetic", f"--workdir={wd}",
                  "--per_class"]) == 2
+
+
+def test_cli_train_no_resume(tmp_path, capsys):
+    wd = str(tmp_path / "worknr")
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 f"--hparams={HP}"]) == 0
+    # resume (default): continues from step 3 -> no new training happens
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 f"--hparams={HP}"]) == 0
+    out = capsys.readouterr().out
+    assert "resumed from step 3" in out
+    # --no_resume: starts at step 0 and retrains to 3
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 "--no_resume", f"--hparams={HP}"]) == 0
+    out = capsys.readouterr().out
+    assert "resumed" not in out
