@@ -12,9 +12,12 @@ from sketch_rnn_tpu.data.loader import (
     load_dataset,
     make_synthetic_strokes,
 )
+from sketch_rnn_tpu.data.quickdraw import convert_ndjson, drawing_to_stroke3
 
 __all__ = [
     "DataLoader",
+    "convert_ndjson",
+    "drawing_to_stroke3",
     "augment_strokes",
     "calculate_normalizing_scale_factor",
     "load_dataset",

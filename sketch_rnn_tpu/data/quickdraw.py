@@ -1,0 +1,182 @@
+"""QuickDraw raw ``.ndjson`` -> stroke-3 conversion (dataset creation).
+
+The reference trains on per-category ``.npz`` files of stroke-3 int16
+sequences; Google distributes QuickDraw as ``.ndjson`` (one JSON drawing
+per line, each stroke ``[[x...], [y...]]`` in 0-255 canvas coordinates).
+The canonical sketch-rnn dataset was produced from the raw drawings by
+(1) Ramer-Douglas-Peucker simplification at epsilon=2.0 and (2) delta
+encoding with pen-lift bits — this module reimplements that pipeline so
+users can build training sets for categories (or custom collections)
+that have no prebuilt ``.npz`` (SURVEY.md §2 component 1 tooling; the
+"Simplified Drawing files" described by the public quickdraw dataset
+docs already have step (1) applied — pass ``epsilon=0`` for those).
+
+Everything is pure numpy; no network access is required or attempted
+(pair with ``scripts/fetch_quickdraw.py`` for the prebuilt ``.npz``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+def rdp(points: np.ndarray, epsilon: float) -> np.ndarray:
+    """Ramer-Douglas-Peucker polyline simplification.
+
+    ``points``: ``[N, 2]`` float array. Returns the simplified ``[M, 2]``
+    subsequence (endpoints always kept). Iterative (explicit stack), so
+    pathological polylines cannot hit Python's recursion limit.
+    """
+    pts = np.asarray(points, dtype=np.float64)
+    n = len(pts)
+    if n <= 2 or epsilon <= 0:
+        return np.asarray(points)
+    keep = np.zeros(n, dtype=bool)
+    keep[0] = keep[-1] = True
+    stack = [(0, n - 1)]
+    while stack:
+        lo, hi = stack.pop()
+        if hi <= lo + 1:
+            continue
+        seg = pts[hi] - pts[lo]
+        mid = pts[lo + 1:hi]
+        rel = mid - pts[lo]
+        seg_len = np.hypot(*seg)
+        if seg_len == 0.0:
+            # degenerate chord: fall back to distance from the point
+            d = np.hypot(rel[:, 0], rel[:, 1])
+        else:
+            # perpendicular distance to the chord (2-D cross product;
+            # np.cross on 2-D vectors is deprecated in numpy 2)
+            d = np.abs(seg[0] * rel[:, 1] - seg[1] * rel[:, 0]) / seg_len
+        i = int(np.argmax(d))
+        if d[i] > epsilon:
+            split = lo + 1 + i
+            keep[split] = True
+            stack.append((lo, split))
+            stack.append((split, hi))
+    return np.asarray(points)[keep]
+
+
+def _align_to_box(strokes: List[np.ndarray], box: float = 255.0
+                  ) -> List[np.ndarray]:
+    """Translate the drawing to the origin and uniformly scale its larger
+    dimension to ``box`` — the canonical QuickDraw normalization applied
+    BEFORE RDP, which is what makes epsilon=2.0 resolution-independent
+    (raw captures come in arbitrary device coordinates)."""
+    allpts = np.concatenate(strokes, axis=0)
+    lo = allpts.min(axis=0)
+    span = float((allpts - lo).max())
+    scale = box / span if span > 0 else 1.0
+    return [(s - lo) * scale for s in strokes]
+
+
+def drawing_to_stroke3(drawing: Sequence[Sequence[Sequence[float]]],
+                       epsilon: float = 2.0,
+                       max_points: Optional[int] = None) -> np.ndarray:
+    """One ndjson ``drawing`` (list of ``[[xs], [ys]]`` strokes) ->
+    stroke-3 ``[N, 3]`` float32 (dx, dy, pen_lift).
+
+    Matches the canonical preprocessing: align the drawing to the origin
+    and uniformly scale it into the 0-255 box, then per-stroke RDP at
+    ``epsilon`` (2.0, resolution-independent thanks to the scaling; 0
+    skips BOTH steps for pre-simplified files, which are already in the
+    0-255 box), delta encoding from the first point, ``pen_lift=1`` on
+    each stroke's last point. ``max_points`` truncates (the loader's
+    ``max_seq_len`` filter would otherwise drop very long drawings
+    entirely).
+    """
+    raw_strokes: List[np.ndarray] = []
+    for stroke in drawing:
+        xy = np.stack([np.asarray(stroke[0], np.float64),
+                       np.asarray(stroke[1], np.float64)], axis=1)
+        if len(xy):
+            raw_strokes.append(xy)
+    if not raw_strokes:
+        return np.zeros((0, 3), np.float32)
+    if epsilon > 0:
+        raw_strokes = _align_to_box(raw_strokes)
+    pts: List[np.ndarray] = []
+    pens: List[np.ndarray] = []
+    for xy in raw_strokes:
+        xy = rdp(xy, epsilon)
+        pen = np.zeros(len(xy))
+        pen[-1] = 1.0
+        pts.append(xy)
+        pens.append(pen)
+    xy = np.concatenate(pts, axis=0)
+    pen = np.concatenate(pens, axis=0)
+    deltas = np.diff(xy, axis=0, prepend=xy[:1])
+    out = np.concatenate([deltas, pen[:, None]], axis=1).astype(np.float32)
+    # the first row's delta is 0,0 by construction; the canonical data
+    # starts at the first real movement, so drop a leading no-op point
+    # unless it also lifts the pen
+    if len(out) > 1 and out[0, 0] == 0 and out[0, 1] == 0 and out[0, 2] == 0:
+        out = out[1:]
+    if max_points is not None:
+        out = out[:max_points]
+        if len(out):
+            out[-1, 2] = 1.0
+    return out
+
+
+def iter_ndjson(lines: Iterable[str],
+                recognized_only: bool = True):
+    """Yield ``(word, stroke3-ready drawing)`` from ndjson lines.
+
+    ``recognized_only`` keeps only drawings the QuickDraw classifier
+    recognized (the canonical datasets do the same).
+    """
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        if recognized_only and not rec.get("recognized", True):
+            continue
+        yield rec.get("word", ""), rec["drawing"]
+
+
+def convert_ndjson(in_path: str, out_path: str,
+                   epsilon: float = 2.0,
+                   max_points: int = 250,
+                   num_valid: int = 2500,
+                   num_test: int = 2500,
+                   limit: Optional[int] = None,
+                   seed: int = 0) -> dict:
+    """Convert one category ``.ndjson`` file to a sketch-rnn ``.npz``.
+
+    Writes ``train``/``valid``/``test`` object arrays of int16 stroke-3
+    sequences (the exact layout ``data.loader.load_dataset`` reads and
+    the reference's prebuilt files use). Returns split sizes.
+    """
+    seqs: List[np.ndarray] = []
+    with open(in_path) as f:
+        for _, drawing in iter_ndjson(f):
+            s3 = drawing_to_stroke3(drawing, epsilon=epsilon,
+                                    max_points=max_points)
+            if len(s3) < 2:
+                continue
+            seqs.append(np.round(s3).astype(np.int16))
+            if limit is not None and len(seqs) >= limit:
+                break
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(seqs))
+    seqs = [seqs[i] for i in order]
+    n_eval = num_valid + num_test
+    if len(seqs) <= n_eval:
+        raise ValueError(
+            f"{in_path}: only {len(seqs)} usable drawings, need more than "
+            f"num_valid+num_test={n_eval}")
+    splits = {
+        "valid": seqs[:num_valid],
+        "test": seqs[num_valid:n_eval],
+        "train": seqs[n_eval:],
+    }
+    np.savez_compressed(
+        out_path,
+        **{k: np.array(v, dtype=object) for k, v in splits.items()})
+    return {k: len(v) for k, v in splits.items()}

@@ -1,0 +1,128 @@
+"""QuickDraw ndjson -> stroke-3 conversion tests (data/quickdraw.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.data.quickdraw import (
+    convert_ndjson,
+    drawing_to_stroke3,
+    iter_ndjson,
+    rdp,
+)
+
+
+def test_rdp_drops_collinear_keeps_corners():
+    # a right angle sampled densely: all interior collinear points drop
+    xs = np.linspace(0, 10, 11)
+    leg1 = np.stack([xs, np.zeros(11)], axis=1)
+    leg2 = np.stack([np.full(10, 10.0), np.linspace(1, 10, 10)], axis=1)
+    line = np.concatenate([leg1, leg2])
+    out = rdp(line, epsilon=0.5)
+    np.testing.assert_array_equal(out, [[0, 0], [10, 0], [10, 10]])
+
+
+def test_rdp_epsilon_zero_is_identity():
+    pts = np.array([[0, 0], [1, 0.4], [2, 0], [3, 0.4]])
+    np.testing.assert_array_equal(rdp(pts, 0.0), pts)
+
+
+def test_rdp_keeps_significant_deviation():
+    pts = np.array([[0.0, 0], [5, 3], [10, 0]])
+    out = rdp(pts, epsilon=1.0)
+    np.testing.assert_array_equal(out, pts)
+
+
+def test_rdp_degenerate_closed_chord():
+    # first == last point: must not divide by zero, keeps the far point
+    pts = np.array([[0.0, 0], [5, 5], [0, 0]])
+    out = rdp(pts, epsilon=1.0)
+    assert [5, 5] in out.tolist()
+
+
+def test_drawing_to_stroke3_deltas_and_pen():
+    drawing = [[[0, 10, 10], [0, 0, 10]],      # L-stroke
+               [[20, 30], [20, 20]]]           # second stroke
+    s3 = drawing_to_stroke3(drawing, epsilon=0)
+    # deltas reconstruct the absolute points; pen lifts end each stroke
+    assert s3.shape == (4, 3)
+    np.testing.assert_array_equal(s3[:, 2], [0, 1, 0, 1])
+    abs_pts = np.cumsum(s3[:, :2], axis=0)
+    np.testing.assert_allclose(abs_pts[1], [10, 10])   # end of stroke 1
+    np.testing.assert_allclose(abs_pts[3], [30, 20])   # end of stroke 2
+
+
+def test_drawing_to_stroke3_max_points_truncates_with_pen_end():
+    drawing = [[list(range(50)), [0] * 50]]
+    s3 = drawing_to_stroke3(drawing, epsilon=0, max_points=10)
+    assert len(s3) == 10
+    assert s3[-1, 2] == 1.0
+
+
+def test_iter_ndjson_filters_unrecognized():
+    lines = [
+        json.dumps({"word": "cat", "recognized": True,
+                    "drawing": [[[0, 1], [0, 1]]]}),
+        json.dumps({"word": "cat", "recognized": False,
+                    "drawing": [[[0, 1], [0, 1]]]}),
+        "",
+    ]
+    got = list(iter_ndjson(lines))
+    assert len(got) == 1 and got[0][0] == "cat"
+
+
+def test_convert_ndjson_roundtrips_into_loader(tmp_path):
+    # synthesize an ndjson category, convert, then load through the real
+    # dataset path
+    rng = np.random.default_rng(0)
+    path = tmp_path / "cat.ndjson"
+    with open(path, "w") as f:
+        for _ in range(30):
+            n = int(rng.integers(4, 20))
+            xs = np.cumsum(rng.integers(-5, 6, n)) + 128
+            ys = np.cumsum(rng.integers(-5, 6, n)) + 128
+            f.write(json.dumps({
+                "word": "cat", "recognized": True,
+                "drawing": [[xs.tolist(), ys.tolist()]]}) + "\n")
+    sizes = convert_ndjson(str(path), str(tmp_path / "cat.npz"),
+                           epsilon=0.5, num_valid=5, num_test=5)
+    assert sizes == {"train": 20, "valid": 5, "test": 5}
+
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.data.loader import load_dataset
+    hps = HParams(batch_size=4, max_seq_len=32)
+    train_l, valid_l, test_l, scale = load_dataset(
+        hps, data_dir=str(tmp_path))
+    assert len(train_l) > 0 and scale > 0
+    batch = train_l.random_batch()
+    assert batch["strokes"].shape == (4, 33, 5)
+
+
+def test_convert_ndjson_too_small_raises(tmp_path):
+    path = tmp_path / "cat.ndjson"
+    with open(path, "w") as f:
+        f.write(json.dumps({"word": "cat", "recognized": True,
+                            "drawing": [[[0, 1, 2], [0, 1, 2]]]}) + "\n")
+    with pytest.raises(ValueError, match="usable drawings"):
+        convert_ndjson(str(path), str(tmp_path / "cat.npz"),
+                       num_valid=5, num_test=5)
+
+
+def test_drawing_to_stroke3_resolution_independent():
+    """Raw captures at any resolution normalize into the 0-255 box before
+    RDP, so a uniformly scaled drawing converts identically (canonical
+    epsilon=2.0 is defined in box coordinates)."""
+    rng = np.random.default_rng(2)
+    n = 40
+    xs = np.cumsum(rng.integers(-9, 10, n)).astype(float)
+    ys = np.cumsum(rng.integers(-9, 10, n)).astype(float)
+    base = [[xs.tolist(), ys.tolist()]]
+    scaled = [[(xs * 6.5).tolist(), (ys * 6.5).tolist()]]
+    a = drawing_to_stroke3(base, epsilon=2.0)
+    b = drawing_to_stroke3(scaled, epsilon=2.0)
+    np.testing.assert_allclose(a, b, atol=1e-9)
+    # and the offsets live in box units: per-axis extent <= 255
+    abs_pts = np.cumsum(a[:, :2], axis=0)
+    assert float(np.ptp(abs_pts, axis=0).max()) <= 255.0 + 1e-6
