@@ -1,0 +1,67 @@
+"""Summarize BENCH_HISTORY.jsonl: best recorded number per configuration.
+
+The tunneled chip's minutes-scale slowdown windows make single runs
+unreliable (NOTES.md); this prints the best-ever and latest record per
+(kind, decoder, key knobs) so regressions and records are visible at a
+glance.
+
+Usage: python scripts/bench_summary.py [path-to-history]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def key_of(r: dict):
+    if r.get("kind") == "sampler":
+        return ("sampler", r.get("dec_model"), f"B={r.get('batch_size')}")
+    return ("train", r.get("dec_model"),
+            f"B={r.get('batch_size')} T={r.get('seq_len')} "
+            f"{r.get('dtype')} fused={r.get('fused_rnn')} "
+            f"resid={r.get('resid_dtype')}")
+
+
+def metric_of(r: dict):
+    return r.get("strokes_per_sec_per_chip") or r.get("sketches_per_sec")
+
+
+def main(argv=None) -> int:
+    path = (argv or sys.argv[1:])
+    path = path[0] if path else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_HISTORY.jsonl")
+    best: dict = {}
+    latest: dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = json.loads(line)
+            v = metric_of(r)
+            if v is None:
+                continue
+            k = key_of(r)
+            latest[k] = r
+            if k not in best or v > metric_of(best[k]):
+                best[k] = r
+    for k in sorted(best):
+        b, l = best[k], latest[k]
+        when = time.strftime("%m-%d %H:%M",
+                             time.localtime(b.get("wall_time", 0)))
+        extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""
+        print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+              f"best={metric_of(b):>12,.0f} ({when}{extra})  "
+              f"latest={metric_of(l):>12,.0f}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head
+        sys.exit(0)

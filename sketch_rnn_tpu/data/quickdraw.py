@@ -76,7 +76,8 @@ def _align_to_box(strokes: List[np.ndarray], box: float = 255.0
 
 def drawing_to_stroke3(drawing: Sequence[Sequence[Sequence[float]]],
                        epsilon: float = 2.0,
-                       max_points: Optional[int] = None) -> np.ndarray:
+                       max_points: Optional[int] = None,
+                       quantize: bool = False) -> np.ndarray:
     """One ndjson ``drawing`` (list of ``[[xs], [ys]]`` strokes) ->
     stroke-3 ``[N, 3]`` float32 (dx, dy, pen_lift).
 
@@ -87,7 +88,11 @@ def drawing_to_stroke3(drawing: Sequence[Sequence[Sequence[float]]],
     0-255 box), delta encoding from the first point, ``pen_lift=1`` on
     each stroke's last point. ``max_points`` truncates (the loader's
     ``max_seq_len`` filter would otherwise drop very long drawings
-    entirely).
+    entirely). ``quantize=True`` rounds the ABSOLUTE coordinates to
+    integers before diffing, so deltas are exact integer differences
+    (the canonical int16 layout) with no cumulative rounding drift —
+    rounding per-point deltas instead would random-walk the
+    reconstructed positions by several pixels over a long sketch.
     """
     raw_strokes: List[np.ndarray] = []
     for stroke in drawing:
@@ -108,6 +113,8 @@ def drawing_to_stroke3(drawing: Sequence[Sequence[Sequence[float]]],
         pts.append(xy)
         pens.append(pen)
     xy = np.concatenate(pts, axis=0)
+    if quantize:
+        xy = np.round(xy)
     pen = np.concatenate(pens, axis=0)
     deltas = np.diff(xy, axis=0, prepend=xy[:1])
     out = np.concatenate([deltas, pen[:, None]], axis=1).astype(np.float32)
@@ -157,10 +164,10 @@ def convert_ndjson(in_path: str, out_path: str,
     with open(in_path) as f:
         for _, drawing in iter_ndjson(f):
             s3 = drawing_to_stroke3(drawing, epsilon=epsilon,
-                                    max_points=max_points)
+                                    max_points=max_points, quantize=True)
             if len(s3) < 2:
                 continue
-            seqs.append(np.round(s3).astype(np.int16))
+            seqs.append(s3.astype(np.int16))
             if limit is not None and len(seqs) >= limit:
                 break
     rng = np.random.default_rng(seed)
@@ -176,7 +183,15 @@ def convert_ndjson(in_path: str, out_path: str,
         "test": seqs[num_valid:n_eval],
         "train": seqs[n_eval:],
     }
+    def obj_array(v):
+        # np.array(v, dtype=object) would build a 3-D object array when
+        # every sequence happens to share a length (e.g. max_points
+        # truncation) — the canonical layout is a 1-D object array of
+        # int16 [N, 3] arrays
+        out = np.empty(len(v), dtype=object)
+        out[:] = v
+        return out
+
     np.savez_compressed(
-        out_path,
-        **{k: np.array(v, dtype=object) for k, v in splits.items()})
+        out_path, **{k: obj_array(v) for k, v in splits.items()})
     return {k: len(v) for k, v in splits.items()}

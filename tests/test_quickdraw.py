@@ -126,3 +126,39 @@ def test_drawing_to_stroke3_resolution_independent():
     # and the offsets live in box units: per-axis extent <= 255
     abs_pts = np.cumsum(a[:, :2], axis=0)
     assert float(np.ptp(abs_pts, axis=0).max()) <= 255.0 + 1e-6
+
+
+def test_quantize_exact_integer_deltas_no_drift():
+    """quantize=True rounds ABSOLUTE coords before diffing: deltas are
+    exact integers and reconstructed positions equal the rounded
+    originals (no cumulative drift)."""
+    rng = np.random.default_rng(3)
+    n = 200
+    xs = np.cumsum(rng.random(n) * 3.7)
+    ys = np.cumsum(rng.random(n) * 2.3)
+    s3 = drawing_to_stroke3([[xs.tolist(), ys.tolist()]], epsilon=0,
+                            quantize=True)
+    np.testing.assert_array_equal(s3[:, :2], np.round(s3[:, :2]))
+    recon = np.cumsum(s3[:, :2], axis=0)
+    want = np.stack([np.round(xs), np.round(ys)], axis=1)
+    # reconstruction starts at the (dropped) first point's rounded pos
+    np.testing.assert_allclose(recon + want[0], want[1:] if len(recon) ==
+                               n - 1 else want, atol=0)
+
+
+def test_convert_npz_is_1d_object_array_even_when_uniform(tmp_path):
+    path = tmp_path / "u.ndjson"
+    rng = np.random.default_rng(4)
+    with open(path, "w") as f:
+        for _ in range(12):
+            xs = (np.cumsum(rng.integers(-5, 6, 30)) + 128).tolist()
+            ys = (np.cumsum(rng.integers(-5, 6, 30)) + 128).tolist()
+            f.write(json.dumps({"word": "u", "recognized": True,
+                                "drawing": [[xs, ys]]}) + "\n")
+    convert_ndjson(str(path), str(tmp_path / "u.npz"), epsilon=0,
+                   max_points=8, num_valid=3, num_test=3)
+    npz = np.load(tmp_path / "u.npz", allow_pickle=True, encoding="latin1")
+    for split in ("train", "valid", "test"):
+        arr = npz[split]
+        assert arr.ndim == 1 and arr.dtype == object
+        assert all(a.dtype == np.int16 and a.shape[1] == 3 for a in arr)
