@@ -13,8 +13,6 @@ from __future__ import annotations
 import time
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 
 from sketch_rnn_tpu.config import get_default_hparams
 from sketch_rnn_tpu.data.loader import synthetic_loader
@@ -22,7 +20,6 @@ from sketch_rnn_tpu.data.prefetch import prefetch_batches
 from sketch_rnn_tpu.models.vae import SketchRNN
 from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
 from sketch_rnn_tpu.train import make_train_state, make_train_step
-from sketch_rnn_tpu.train.schedules import kl_weight_schedule, lr_schedule
 
 STEPS = 24
 K = 8

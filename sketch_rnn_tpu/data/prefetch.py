@@ -154,7 +154,9 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
         import numpy as np
 
         if stack == 1:
-            out = dict(loader.random_batch())
+            out = loader.random_batch()
+            if cast is not None:
+                out = dict(out)  # don't mutate the loader's dict
         else:
             parts = [loader.random_batch() for _ in range(stack)]
             out = {k: np.stack([p[k] for p in parts]) for k in parts[0]}
