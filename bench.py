@@ -125,9 +125,15 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
         # adaptive best-of-n: the tunneled chip shows WINDOW-scale (minutes)
         # slowdowns of up to 2x that hit whole trials, not single steps —
         # keep trialing (up to BENCH_TRIALS) until 3 consecutive trials stop
-        # improving the best by >2%, so one bad window cannot set the record
+        # improving the best by >2%, so one bad window cannot set the record.
+        # A wall-clock budget bounds the loop in a DEAD window (a run was
+        # observed where 8 trials would have taken >25 min): after at
+        # least 2 trials, stop once the budget is spent — a slow-window
+        # number beats a timed-out run with no record at all.
         max_trials = int(os.environ.get("BENCH_TRIALS", "8"))
+        budget_s = float(os.environ.get("BENCH_TIME_BUDGET", "480"))
         no_improve = 0
+        loop_t0 = time.perf_counter()
         for trial in range(max_trials):
             t0 = time.perf_counter()
             for i in range(calls):
@@ -142,6 +148,10 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
                 best = min(best, t)
                 no_improve += 1
             if trial >= 3 and no_improve >= 3:
+                break
+            if trial >= 1 and time.perf_counter() - loop_t0 > budget_s:
+                print(f"#   time budget ({budget_s:.0f}s) spent after "
+                      f"trial {trial}; stopping", file=sys.stderr)
                 break
     finally:
         feeder.close()
