@@ -19,10 +19,14 @@ import time
 def key_of(r: dict):
     if r.get("kind") == "sampler":
         return ("sampler", r.get("dec_model"), f"B={r.get('batch_size')}")
+    # steps_per_call / transfer_dtype change what is being measured (feed
+    # amortization), so K=5 rows must not pool with K=1 rows; old rows
+    # predate the knobs and default to 1 / float32
     return ("train", r.get("dec_model"),
             f"B={r.get('batch_size')} T={r.get('seq_len')} "
             f"{r.get('dtype')} fused={r.get('fused_rnn')} "
-            f"resid={r.get('resid_dtype')}")
+            f"resid={r.get('resid_dtype')} K={r.get('steps_per_call', 1)} "
+            f"xfer={r.get('transfer_dtype', 'float32')}")
 
 
 def metric_of(r: dict):
