@@ -80,7 +80,8 @@ def _hist_append(record: dict) -> None:
 
 def _hist_best_strokes(dec_model: str, batch: int, seq_len: int,
                        dtype: str, remat: bool, fused: bool,
-                       resid_dtype: str, device_kind: str) -> float | None:
+                       resid_dtype: str, device_kind: str,
+                       n_chips: int) -> float | None:
     """Best recorded strokes/sec/chip for this *physical* config.
 
     Pools across the feed-side knobs (steps_per_call, transfer_dtype,
@@ -111,9 +112,13 @@ def _hist_best_strokes(dec_model: str, batch: int, seq_len: int,
                     or bool(r.get("remat")) != remat
                     or bool(r.get("fused_rnn")) != fused
                     or r.get("resid_dtype") != resid_dtype
-                    # a row from a different accelerator generation would
-                    # set an unreachable (or uselessly low) target
-                    or r.get("device_kind") != device_kind):
+                    # a row from a different accelerator generation or
+                    # chip count would set an unreachable (or uselessly
+                    # low) target: batch_size is GLOBAL, so the same
+                    # global batch at a different n_chips is a different
+                    # per-chip workload
+                    or r.get("device_kind") != device_kind
+                    or r.get("n_chips") != n_chips):
                 continue
             v = r.get("strokes_per_sec_per_chip")
             if v is not None and (best is None or v > best):
@@ -199,7 +204,8 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
         # window still yields a record rather than a timeout.
         kind = jax.devices()[0].device_kind
         hist_best = _hist_best_strokes(dec_model, batch, seq_len, dtype,
-                                       remat, fused, resid_dtype, kind)
+                                       remat, fused, resid_dtype, kind,
+                                       n_chips)
         strokes_per_trial = steps * hps.batch_size * hps.max_seq_len
         # time_s above which best-of is implausibly slow vs history:
         # per_chip = strokes_per_trial / t / n_chips, solved for t at
@@ -251,6 +257,10 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
     per_chip = strokes_per_sec / n_chips
     mfu = F.mfu(per_chip, hps, kind, train=True)
     return {
+        # False = the run never reached 70% of this config's historical
+        # best (slow-window record): summaries and regression triage must
+        # not read it as the build's speed
+        "plausible": best <= plaus_t,
         "kind": "train",
         "fused_rnn": fused,
         "resid_dtype": resid_dtype,

@@ -58,9 +58,12 @@ def main(argv=None) -> int:
         when = time.strftime("%m-%d %H:%M",
                              time.localtime(b.get("wall_time", 0)))
         extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""
+        # records the bench itself flagged as never reaching 70% of the
+        # historical best are slow-window artifacts, not the build's speed
+        slow = " [slow-window]" if l.get("plausible") is False else ""
         print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
               f"best={metric_of(b):>12,.0f} ({when}{extra})  "
-              f"latest={metric_of(l):>12,.0f}")
+              f"latest={metric_of(l):>12,.0f}{slow}")
     return 0
 
 

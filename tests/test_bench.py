@@ -23,7 +23,7 @@ def _write_hist(path, rows):
 _BASE = {
     "kind": "train", "dec_model": "layer_norm", "batch_size": 4096,
     "seq_len": 250, "dtype": "bfloat16", "remat": True, "fused_rnn": True,
-    "resid_dtype": "bfloat16", "device_kind": "TPU v5 lite",
+    "resid_dtype": "bfloat16", "device_kind": "TPU v5 lite", "n_chips": 1,
 }
 
 
@@ -44,6 +44,9 @@ def test_hist_best_pools_across_feed_knobs(tmp_path, monkeypatch):
         # a faster accelerator generation must NOT set the target
         {**_BASE, "device_kind": "TPU v6 lite",
          "strokes_per_sec_per_chip": 9.9e6},
+        # same global batch on a different chip count is a different
+        # per-chip workload — must NOT pool
+        {**_BASE, "n_chips": 8, "strokes_per_sec_per_chip": 9.9e6},
         # sampler rows and junk lines are skipped
         {"kind": "sampler", "batch_size": 1, "sketches_per_sec": 77},
     ])
@@ -51,7 +54,7 @@ def test_hist_best_pools_across_feed_knobs(tmp_path, monkeypatch):
         f.write("not json\n")
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
     best = bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
-                                    True, True, "bfloat16", "TPU v5 lite")
+                                    True, True, "bfloat16", "TPU v5 lite", 1)
     assert best == 4.0e6
 
 
@@ -60,13 +63,13 @@ def test_hist_best_missing_file_and_no_match(tmp_path, monkeypatch):
         bench, "_hist_path", lambda: str(tmp_path / "absent.jsonl"))
     assert bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
                                     True, True, "bfloat16",
-                                    "TPU v5 lite") is None
+                                    "TPU v5 lite", 1) is None
     hist = tmp_path / "BENCH_HISTORY.jsonl"
     _write_hist(hist, [{**_BASE, "strokes_per_sec_per_chip": 1.0}])
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
     assert bench._hist_best_strokes("hyper", 4096, 250, "bfloat16",
                                     True, True, "bfloat16",
-                                    "TPU v5 lite") is None
+                                    "TPU v5 lite", 1) is None
 
 
 def test_bench_train_rejects_non_divisible_steps():
