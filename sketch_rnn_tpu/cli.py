@@ -154,18 +154,13 @@ def cmd_eval(args) -> int:
     from sketch_rnn_tpu.parallel import multihost as mh
     from sketch_rnn_tpu.parallel.mesh import make_mesh
     from sketch_rnn_tpu.train import make_eval_step
-    from sketch_rnn_tpu.train.loop import evaluate
+    from sketch_rnn_tpu.train.loop import evaluate, evaluate_per_class
+    from sketch_rnn_tpu.train.step import make_per_class_eval_step
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
     if args.per_class and hps.num_classes <= 0:
         print("[cli] --per_class needs a multi-class model "
               "(num_classes > 0)", file=sys.stderr)
-        return 2
-    if args.per_class and mh.process_count() > 1:
-        # per-class GLOBAL example counts are not derivable locally under
-        # host striping; a mismatched per-class batch count would deadlock
-        # the SPMD sweep (see DataLoader.filter_by_label)
-        print("[cli] --per_class is single-host only", file=sys.stderr)
         return 2
     model, state, scale, meta = _restore(hps, args.workdir)
     _, valid_l, test_l, _ = _load_data(hps, args, scale_factor=scale)
@@ -176,17 +171,17 @@ def cmd_eval(args) -> int:
     out = {"split": args.split, "step": meta["step"],
            **{k: round(v, 6) for k, v in sorted(ev.items())}}
     if args.per_class:
-        # reference-paper parity surface: per-category losses. Classes
-        # with no examples in the split report null.
-        per = {}
-        for c in range(hps.num_classes):
-            sub = loader.filter_by_label(c)
-            if sub.num_eval_batches == 0:
-                per[str(c)] = None
-                continue
-            evc = evaluate(state.params, sub, eval_step, mesh)
-            per[str(c)] = {k: round(v, 6) for k, v in sorted(evc.items())}
-        out["per_class"] = per
+        # reference-paper parity surface: per-category losses. One masked
+        # sweep over the standard eval batches — multi-host safe (the
+        # batch schedule is identical on every host), unlike the old
+        # filter_by_label loop. Classes with no examples report null.
+        pc_step = make_per_class_eval_step(model, hps, mesh)
+        per = evaluate_per_class(state.params, loader, pc_step,
+                                 hps.num_classes, mesh)
+        out["per_class"] = {
+            str(c): (None if r is None
+                     else {k: round(v, 6) for k, v in sorted(r.items())})
+            for c, r in per.items()}
     print(json.dumps(out))
     return 0
 

@@ -88,6 +88,7 @@ class DataLoader:
         #   host's full local corpus (hosts holding a striping remainder
         #   would otherwise never evaluate it when the common length is an
         #   exact batch multiple).
+        self.num_hosts = num_hosts
         if global_size is not None and num_hosts > 1:
             self._common_len = global_size // num_hosts
             self._max_local_len = -(-global_size // num_hosts)
@@ -172,13 +173,21 @@ class DataLoader:
     def filter_by_label(self, label: int) -> "DataLoader":
         """New loader over this one's class-``label`` examples only.
 
-        For per-class eval sweeps (the reference paper reports losses per
-        QuickDraw category). Shares the (already normalized) stroke arrays
-        — do not call ``normalize`` on the result. Augmentation is off:
-        the filtered view exists for deterministic eval. Single-host only:
+        For single-host per-class inspection (multi-host per-class EVAL
+        uses ``train.loop.evaluate_per_class``, which sweeps the standard
+        batches with a class mask instead). Shares the (already
+        normalized) stroke arrays — do not call ``normalize`` on the
+        result. Augmentation is off: the filtered view exists for
+        deterministic eval. Single-host only, enforced here (ADVICE r2):
         the per-class GLOBAL count is not derivable locally under host
-        striping, so multi-host callers must guard (see cli.cmd_eval).
+        striping, so a striped filtered loader would launch mismatched
+        SPMD batch counts across hosts and deadlock the sweep.
         """
+        if self.num_hosts > 1:
+            raise RuntimeError(
+                f"filter_by_label on a host-striped loader "
+                f"(num_hosts={self.num_hosts}) would deadlock the SPMD "
+                f"eval sweep; use train.loop.evaluate_per_class instead")
         sel = np.flatnonzero(self.labels == label)
         return DataLoader([self.strokes[i] for i in sel], self.hps,
                           labels=self.labels[sel], augment=False)

@@ -226,6 +226,35 @@ class SketchRNN:
 
     # -- loss --------------------------------------------------------------
 
+    def _forward(self, params: Params, batch: Dict[str, jax.Array],
+                 key: jax.Array, train: bool):
+        """Shared forward preamble of :meth:`loss` and
+        :meth:`eval_metrics_per_class`: batch-major strokes -> mixture
+        params (+ posterior). ONE home for the entry-path recipe
+        (time-major transpose, float32 upcast of possibly-bf16
+        transferred strokes, the kenc/kz/kdec key split) so the two
+        sweeps draw identical z for the same ``(batch, key)`` — the
+        per-class/overall consistency test depends on that invariant.
+
+        Returns ``(mp, x_target, labels, mu, presig)``; the posterior
+        terms are None for non-conditional models.
+        """
+        hps = self.hps
+        strokes = jnp.transpose(batch["strokes"], (1, 0, 2)
+                                ).astype(jnp.float32)  # [T+1, B, 5]
+        x_in, x_target = strokes[:-1], strokes[1:]
+        seq_len = batch["seq_len"]
+        labels = batch.get("labels") if hps.num_classes > 0 else None
+        kenc, kz, kdec = jax.random.split(key, 3)
+        mu = presig = z = None
+        if hps.conditional:
+            mu, presig = self.encode(params, x_target, seq_len,
+                                     key=kenc, train=train)
+            z = self.sample_z(mu, presig, kz)
+        raw = self.decode(params, x_in, z, labels, key=kdec, train=train)
+        mp = mdn.get_mixture_params(raw, hps.num_mixture)
+        return mp, x_target, labels, mu, presig
+
     def loss(self, params: Params, batch: Dict[str, jax.Array],
              key: jax.Array, kl_weight: jax.Array, train: bool = True,
              axis_name: Optional[str] = None
@@ -246,31 +275,16 @@ class SketchRNN:
         GSPMD, so data parallelism must be explicit SPMD.
         """
         hps = self.hps
-        # upcast on entry: strokes may arrive bfloat16 (hps.transfer_dtype
-        # halves host->device bytes); all loss math stays float32
-        strokes = jnp.transpose(batch["strokes"], (1, 0, 2)
-                                ).astype(jnp.float32)  # [T+1, B, 5]
-        x_in = strokes[:-1]
-        x_target = strokes[1:]
-        seq_len = batch["seq_len"]
-        labels = batch.get("labels") if hps.num_classes > 0 else None
         # optional [B] example weights (eval sweeps zero out wrap-filled
         # duplicate rows; absent in training batches -> uniform)
         weights = batch.get("weights")
-
-        kenc, kz, kdec = jax.random.split(key, 3)
-        z = None
+        mp, x_target, labels, mu, presig = self._forward(
+            params, batch, key, train)
         if hps.conditional:
-            mu, presig = self.encode(params, x_target, seq_len,
-                                     key=kenc, train=train)
-            z = self.sample_z(mu, presig, kz)
             kl_raw = mdn.kl_loss(mu, presig, weights=weights,
                                  axis_name=axis_name)
         else:
             kl_raw = jnp.float32(0.0)
-
-        raw = self.decode(params, x_in, z, labels, key=kdec, train=train)
-        mp = mdn.get_mixture_params(raw, hps.num_mixture)
         # canonical asymmetry: pen CE unmasked in training, masked in eval
         offset_nll, pen_ce = mdn.reconstruction_loss(
             mp, x_target, hps.max_seq_len, mask_pen=not train,
@@ -294,3 +308,75 @@ class SketchRNN:
             "kl_weight": jnp.asarray(kl_weight, jnp.float32),
         }
         return total, metrics
+
+    def eval_metrics_per_class(self, params: Params,
+                               batch: Dict[str, jax.Array], key: jax.Array,
+                               axis_name: Optional[str] = None
+                               ) -> Dict[str, jax.Array]:
+        """Eval metrics split by class label in ONE forward pass.
+
+        Returns the same metric keys as the eval-mode :meth:`loss` but as
+        ``[num_classes]`` vectors, plus ``weight_sum`` — the GLOBAL
+        per-class count of real (weight>0) rows in this batch. Per-class
+        reductions are masked matmuls against a ``[C, B]`` class mask over
+        the per-example loss sums, so the cost over a whole-split sweep is
+        one standard sweep regardless of C — and, unlike
+        ``DataLoader.filter_by_label``, the batch schedule is the standard
+        eval sweep (identical on every host), which makes per-class eval
+        safe under multi-host striping (VERDICT r2 #4; the paper's
+        per-category tables are the parity surface).
+
+        Semantics mirror eval: no dropout, pen CE masked, KL weight 1 with
+        the free-bits floor applied to each batch's per-class KL mean.
+        Note the floor is nonlinear, so its input partition matters: here
+        it sees each standard batch's class-c rows, whereas a
+        ``filter_by_label`` sweep feeds it full batches of class c — when
+        a class's KL straddles ``kl_tolerance`` the floored ``kl`` /
+        ``loss`` can differ slightly between the two paths (``kl_raw``,
+        ``offset_nll``, ``pen_ce``, ``recon`` are linear and exact either
+        way). Classes absent from the batch report zeros at
+        ``weight_sum`` 0 — hosts must drop them from weighted averages.
+        """
+        hps = self.hps
+        if hps.num_classes <= 0:
+            raise ValueError("per-class eval needs num_classes > 0")
+        labels = batch["labels"]
+        weights = batch.get("weights")
+        w = (jnp.ones(labels.shape, jnp.float32) if weights is None
+             else weights.astype(jnp.float32))
+        mp, x_target, _, mu, presig = self._forward(
+            params, batch, key, train=False)
+        kl_ex = (mdn.kl_per_example(mu, presig) if hps.conditional
+                 else jnp.zeros(labels.shape, jnp.float32))   # [B]
+        nll_ex, pen_ex = mdn.reconstruction_sums(mp, x_target,
+                                                 mask_pen=True)  # [B] each
+
+        cls = jnp.arange(hps.num_classes)
+        mask = (labels[None, :] == cls[:, None]) * w[None, :]   # [C, B]
+
+        def gsum(v):
+            return (jax.lax.psum(v, axis_name) if axis_name else v)
+
+        cnt = gsum(mask.sum(axis=-1))                           # [C]
+        safe = jnp.maximum(cnt, 1.0)
+        offset_nll = gsum(mask @ nll_ex) / (hps.max_seq_len * safe)
+        pen_ce = gsum(mask @ pen_ex) / (hps.max_seq_len * safe)
+        kl_raw = gsum(mask @ kl_ex) / safe
+        recon = offset_nll + pen_ce
+        if hps.conditional:
+            kl_floored = mdn.kl_cost_with_floor(kl_raw, hps.kl_tolerance)
+            total = recon + kl_floored
+        else:
+            kl_floored = jnp.zeros_like(kl_raw)
+            total = recon
+        ones = jnp.ones_like(cnt)
+        return {
+            "loss": total,
+            "recon": recon,
+            "offset_nll": offset_nll,
+            "pen_ce": pen_ce,
+            "kl": kl_floored,
+            "kl_raw": kl_raw,
+            "kl_weight": ones,
+            "weight_sum": cnt,
+        }

@@ -91,6 +91,26 @@ def gmm_nll(dx: jax.Array, dy: jax.Array, mp: MixtureParams) -> jax.Array:
     return -jax.nn.logsumexp(comp, axis=-1)
 
 
+def reconstruction_sums(mp: MixtureParams, target: jax.Array,
+                        mask_pen: bool = False
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Per-example time-summed ``(offset_nll, pen_ce)``, each ``[B]``.
+
+    The pre-normalization numerators of :func:`reconstruction_loss`,
+    kept per-example so callers can take arbitrary weighted reductions
+    over the batch axis (the per-class eval sweep reduces them against a
+    ``[C, B]`` class mask in one matmul instead of re-running the
+    forward pass per class).
+    """
+    dx, dy, pen = target[..., 0], target[..., 1], target[..., 2:5]
+    fs = 1.0 - pen[..., 2]  # 0 from the first end-of-sketch row onward
+    nll = gmm_nll(dx, dy, mp) * fs
+    pen_ce = -jnp.sum(pen * jax.nn.log_softmax(mp.pen_logits, -1), axis=-1)
+    if mask_pen:
+        pen_ce = pen_ce * fs
+    return jnp.sum(nll, axis=0), jnp.sum(pen_ce, axis=0)
+
+
 def reconstruction_loss(mp: MixtureParams, target: jax.Array,
                         max_seq_len: int, mask_pen: bool = False,
                         weights: Optional[jax.Array] = None,
@@ -111,23 +131,24 @@ def reconstruction_loss(mp: MixtureParams, target: jax.Array,
     ``shard_map``, numerators AND normalizers are psum'd over that mesh
     axis, so the returned scalars are exactly the global-batch values.
     """
-    t, b = target.shape[0], target.shape[1]
-    dx, dy, pen = target[..., 0], target[..., 1], target[..., 2:5]
-    fs = 1.0 - pen[..., 2]  # 0 from the first end-of-sketch row onward
-    nll = gmm_nll(dx, dy, mp) * fs
-    pen_ce = -jnp.sum(pen * jax.nn.log_softmax(mp.pen_logits, -1), axis=-1)
-    if mask_pen:
-        pen_ce = pen_ce * fs
+    b = target.shape[1]
+    nll, pen_ce = reconstruction_sums(mp, target, mask_pen)  # each [B]
     if weights is None:
         denom = max_seq_len * _global_sum(jnp.float32(b), axis_name)
     else:
         w = weights.astype(jnp.float32)
-        nll = nll * w[None, :]
-        pen_ce = pen_ce * w[None, :]
+        nll = nll * w
+        pen_ce = pen_ce * w
         denom = max_seq_len * jnp.maximum(
             _global_sum(jnp.sum(w), axis_name), 1.0)
     return (_global_sum(jnp.sum(nll), axis_name) / denom,
             _global_sum(jnp.sum(pen_ce), axis_name) / denom)
+
+
+def kl_per_example(mu: jax.Array, presig: jax.Array) -> jax.Array:
+    """KL(q(z|x) || N(0, I)) per example (mean over latent dims), ``[B]``."""
+    return -0.5 * jnp.mean(1.0 + presig - jnp.square(mu) - jnp.exp(presig),
+                           axis=-1)
 
 
 def kl_loss(mu: jax.Array, presig: jax.Array,
@@ -138,8 +159,7 @@ def kl_loss(mu: jax.Array, presig: jax.Array,
     ``weights`` (``[B]``, optional): weighted mean over the batch axis;
     ``axis_name``: global-batch mean across a mesh axis (see
     :func:`reconstruction_loss`)."""
-    per = -0.5 * jnp.mean(1.0 + presig - jnp.square(mu) - jnp.exp(presig),
-                          axis=-1)                       # [B]
+    per = kl_per_example(mu, presig)                     # [B]
     if weights is None:
         num = _global_sum(jnp.sum(per), axis_name)
         den = _global_sum(jnp.float32(per.shape[0]), axis_name)

@@ -8,6 +8,7 @@ from sketch_rnn_tpu.train.state import TrainState, make_optimizer, make_train_st
 from sketch_rnn_tpu.train.step import (
     make_eval_step,
     make_multi_train_step,
+    make_per_class_eval_step,
     make_train_step,
 )
 from sketch_rnn_tpu.train.checkpoint import (
@@ -15,7 +16,7 @@ from sketch_rnn_tpu.train.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
-from sketch_rnn_tpu.train.loop import evaluate, train
+from sketch_rnn_tpu.train.loop import evaluate, evaluate_per_class, train
 
 __all__ = [
     "lr_schedule",
@@ -26,9 +27,11 @@ __all__ = [
     "make_train_step",
     "make_multi_train_step",
     "make_eval_step",
+    "make_per_class_eval_step",
     "save_checkpoint",
     "restore_checkpoint",
     "latest_checkpoint",
     "train",
     "evaluate",
+    "evaluate_per_class",
 ]

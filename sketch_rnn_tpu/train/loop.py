@@ -77,6 +77,52 @@ def evaluate(params, loader: DataLoader, eval_step,
     return {k: v / max(weight_total, 1.0) for k, v in totals.items()}
 
 
+def evaluate_per_class(params, loader: DataLoader, per_class_step,
+                       num_classes: int, mesh=None,
+                       key: Optional[jax.Array] = None
+                       ) -> Dict[int, Optional[Dict[str, float]]]:
+    """Per-class eval metrics over a full sweep of ``loader``.
+
+    One standard sweep (the reference paper's per-category tables,
+    VERDICT r2 #4): every batch runs ONE forward pass whose per-class
+    reductions come back as ``[num_classes]`` vectors; batch vectors are
+    combined weighted by the global per-class real-row counts. The batch
+    schedule is identical on every host — per-class eval therefore works
+    under multi-host striping, where a ``filter_by_label`` sweep would
+    deadlock (its per-class batch count differs across hosts).
+
+    Returns ``{class_id: metrics dict}`` with ``None`` for classes with
+    no examples in the split.
+    """
+    if key is None:
+        key = jax.random.key(0)
+    n = loader.num_eval_batches
+    if n == 0:
+        raise ValueError(
+            f"eval split has no common batches ({len(loader)} local "
+            f"examples, batch_size={loader.hps.batch_size}): some host's "
+            f"stripe is empty; enlarge the split or reduce host count")
+    totals: Dict[str, np.ndarray] = {}
+    counts = np.zeros((num_classes,), np.float64)
+    for i in range(n):
+        batch = loader.get_batch(i)
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        metrics = dict(per_class_step(params, batch,
+                                      jax.random.fold_in(key, i)))
+        cnt = np.asarray(metrics.pop("weight_sum"), np.float64)  # [C]
+        counts += cnt
+        for k, v in metrics.items():
+            totals[k] = totals.get(k, 0.0) + np.asarray(v, np.float64) * cnt
+    out: Dict[int, Optional[Dict[str, float]]] = {}
+    for c in range(num_classes):
+        if counts[c] == 0:
+            out[c] = None
+        else:
+            out[c] = {k: float(v[c] / counts[c]) for k, v in totals.items()}
+    return out
+
+
 def train(hps: HParams,
           train_loader: DataLoader,
           valid_loader: Optional[DataLoader] = None,

@@ -161,8 +161,14 @@ def make_multi_train_step(model, hps: HParams,
     ``state.step`` carried through the scan, so K calls of this are
     step-for-step equivalent (same schedules, same per-step key
     discipline) to K single-step calls with keys ``fold_in(key, i)``.
-    Returned metrics are the LAST micro-step's (what the loop would have
-    logged at that step anyway).
+    Returned metrics are the MEAN over the K micro-steps (a divergence
+    spike inside the window surfaces at the next log line instead of
+    only when it happens to land on micro-step K), plus
+    ``grad_norm_max`` — the window's worst-case gradient norm, the
+    earliest instability signal. ``lr`` stays the last micro-step's
+    value (the schedule's current point; a K-mean would be a value no
+    step used). Aggregation happens inside the jitted program — the
+    scan's stacked metrics never leave the device.
     """
     k = hps.steps_per_call if steps_per_call is None else steps_per_call
     if k == 1:
@@ -177,7 +183,15 @@ def make_multi_train_step(model, hps: HParams,
             return st, metrics
 
         state, stacked = jax.lax.scan(body, state, (batches, jnp.arange(k)))
-        return state, jax.tree_util.tree_map(lambda v: v[-1], stacked)
+        metrics = jax.tree_util.tree_map(
+            lambda v: jnp.mean(v, axis=0), stacked)
+        metrics["grad_norm_max"] = jnp.max(stacked["grad_norm"])
+        # schedule values stay the last micro-step's (the state.step the
+        # log line is attributed to); a K-mean would be a value no step
+        # actually used
+        metrics["lr"] = stacked["lr"][-1]
+        metrics["kl_weight"] = stacked["kl_weight"][-1]
+        return state, metrics
 
     if mesh is None:
         return jax.jit(multi_fn, donate_argnums=0)
@@ -222,6 +236,42 @@ def make_eval_step(model, hps: HParams,
             ws = jax.lax.psum(ws, axis_name)
         metrics["weight_sum"] = ws
         return metrics
+
+    if mesh is None:
+        return jax.jit(eval_fn)
+
+    sharded = jax.shard_map(
+        lambda params, batch, key: eval_fn(params, batch, key, DATA_AXIS),
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P()),
+        out_specs=P(),
+        check_vma=_vma_check(hps),
+    )
+    repl = replicated_sharding(mesh)
+    data = batch_sharding(mesh)
+    return jax.jit(sharded, in_shardings=(repl, data, repl),
+                   out_shardings=repl)
+
+
+def make_per_class_eval_step(model, hps: HParams,
+                             mesh: Optional[Mesh] = None) -> EvalFn:
+    """Jitted per-class eval: ``[num_classes]`` metric vectors per batch.
+
+    Same sweep discipline as :func:`make_eval_step` — the batch schedule
+    is the STANDARD eval sweep, identical on every host, so per-class
+    eval is multi-host safe (``DataLoader.filter_by_label`` is not: the
+    per-class global batch count is not derivable locally under host
+    striping). Per-class reduction happens inside the forward program
+    (``model.eval_metrics_per_class``), psum'd over the mesh axis.
+    """
+
+    def eval_fn(params, batch: Batch, key: jax.Array,
+                axis_name: Optional[str] = None) -> Metrics:
+        if axis_name is not None:
+            # decorrelate per-shard z draws, as in make_eval_step
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+        return model.eval_metrics_per_class(params, batch, key,
+                                            axis_name=axis_name)
 
     if mesh is None:
         return jax.jit(eval_fn)

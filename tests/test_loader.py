@@ -184,3 +184,19 @@ def test_filter_by_label():
                    zip(sub.strokes, [seqs[i] for i in
                                      np.flatnonzero(labels == c)]))
     assert total == len(dl)
+
+
+def test_filter_by_label_rejects_host_striped_loader():
+    """ADVICE r2: a striped loader's per-class batch count differs across
+    hosts, so filtering one must raise at the API layer, not deadlock the
+    SPMD sweep later."""
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+
+    hps = HParams(batch_size=4, max_seq_len=64, num_classes=3)
+    seqs, labels = make_synthetic_strokes(30, num_classes=3, min_len=8,
+                                          max_len=60, seed=4)
+    dl = DataLoader(seqs[0::2], hps, labels=labels[0::2],
+                    global_size=30, num_hosts=2)
+    with pytest.raises(RuntimeError, match="host-striped"):
+        dl.filter_by_label(0)

@@ -146,6 +146,7 @@ def test_mesh_train_matches_single_device():
                                    rtol=2e-4, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_mesh_kl_metrics_match_single_device():
     """The psum'd-global KL path: with dropout off the encoder (and thus
     mu/presig, kl_raw and the free-bits floor) is deterministic, so the
@@ -169,6 +170,7 @@ def test_mesh_kl_metrics_match_single_device():
     np.testing.assert_allclose(float(m2["kl"]), float(m1["kl"]), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_mesh_train_with_dropout_learns():
     """With dropout on, the sharded step still trains (finite metrics,
     decreasing loss); exact single-device parity is impossible by design
@@ -189,6 +191,7 @@ def test_mesh_train_with_dropout_learns():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_mesh_train_fused_production_config():
     """The PRODUCTION config — fused Pallas kernels + bf16 residuals +
     mesh DP — must compile and train under shard_map (pallas_call cannot
@@ -492,6 +495,7 @@ def test_train_fails_fast_on_unevaluable_valid_split(tmp_path):
 # -- end-to-end loop --------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_train_loop_end_to_end_with_resume(tmp_path):
     hps = tiny_hps(num_steps=6, save_every=3, eval_every=3, log_every=2)
     loader = make_loader(hps, n=32, augment=True)
@@ -562,9 +566,68 @@ def test_e2e_overfit_tiny_corpus(tmp_path):
     assert last < 0.55 * first, f"no overfit: {first:.3f} -> {last:.3f}"
 
 
+# -- per-class eval (masked sweep) ------------------------------------------
+
+
+def test_per_class_eval_matches_filter_by_label():
+    """On a deterministic (non-conditional) model the masked per-class
+    sweep must reproduce the filter_by_label per-class sweep exactly:
+    both are weighted means over the same class examples."""
+    from sketch_rnn_tpu.train import make_per_class_eval_step
+    from sketch_rnn_tpu.train.loop import evaluate_per_class
+
+    hps = tiny_hps(num_classes=3, conditional=False, kl_tolerance=0.0)
+    model = SketchRNN(hps)
+    loader = make_loader(hps, n=48, seed=3)
+    eval_step = make_eval_step(model, hps, mesh=None)
+    pc_step = make_per_class_eval_step(model, hps, mesh=None)
+    state = make_train_state(model, hps, jax.random.key(0))
+
+    per = evaluate_per_class(state.params, loader, pc_step,
+                             hps.num_classes, mesh=None)
+    for c in range(hps.num_classes):
+        sub = loader.filter_by_label(c)
+        if sub.num_eval_batches == 0:
+            assert per[c] is None
+            continue
+        ref = evaluate(state.params, sub, eval_step, mesh=None)
+        for k in ("offset_nll", "pen_ce", "recon", "loss"):
+            assert per[c][k] == pytest.approx(ref[k], rel=2e-4), \
+                f"class {c} metric {k}"
+
+
+def test_per_class_eval_mesh_consistent_with_overall():
+    """On the 8-device mesh (conditional model, stochastic z): per-class
+    metrics combined weighted by class counts must equal the overall
+    eval sweep for every linear metric — both sweeps share the same
+    batch schedule and key discipline, so even the z draws coincide."""
+    from sketch_rnn_tpu.train import make_per_class_eval_step
+    from sketch_rnn_tpu.train.loop import evaluate_per_class
+
+    hps = tiny_hps(num_classes=3)
+    model = SketchRNN(hps)
+    loader = make_loader(hps, n=48, seed=4)
+    mesh = make_mesh(hps)
+    eval_step = make_eval_step(model, hps, mesh)
+    pc_step = make_per_class_eval_step(model, hps, mesh)
+    state = make_train_state(model, hps, jax.random.key(0))
+
+    overall = evaluate(state.params, loader, eval_step, mesh)
+    per = evaluate_per_class(state.params, loader, pc_step,
+                             hps.num_classes, mesh)
+    counts = np.array([np.sum(loader.labels == c)
+                       for c in range(hps.num_classes)], np.float64)
+    assert counts.sum() == len(loader)
+    for k in ("offset_nll", "pen_ce", "kl_raw", "recon"):
+        combined = sum(per[c][k] * counts[c] for c in range(hps.num_classes)
+                       if per[c] is not None) / counts.sum()
+        assert combined == pytest.approx(overall[k], rel=2e-4), k
+
+
 # -- multi-step train calls (steps_per_call) --------------------------------
 
 
+@pytest.mark.slow
 def test_multi_step_equals_k_single_steps():
     """One K=3 scan call must be step-for-step identical to 3 single-step
     calls on the same micro-batches with keys fold_in(call_key, i)."""
@@ -588,21 +651,31 @@ def test_multi_step_equals_k_single_steps():
 
     s_single = make_train_state(model, hps, jax.random.key(0))
     single = make_train_step(model, hps, mesh)
+    singles = []
     for i in range(3):
         b = jax.tree_util.tree_map(lambda x: x[i], stacked)
         s_single, m_single = single(s_single, b,
                                     jax.random.fold_in(key, i))
+        singles.append(m_single)
 
     assert int(s_multi.step) == int(s_single.step) == 3
     for a, b in zip(jax.tree_util.tree_leaves(s_multi.params),
                     jax.tree_util.tree_leaves(s_single.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
-    # returned metrics are the LAST micro-step's
+    # returned metrics are the K-MEAN over micro-steps, plus the window's
+    # max grad_norm; lr is the last micro-step's schedule value
     assert float(m_multi["loss"]) == pytest.approx(
-        float(m_single["loss"]), rel=1e-5)
+        np.mean([float(m["loss"]) for m in singles]), rel=1e-5)
+    assert float(m_multi["grad_norm"]) == pytest.approx(
+        np.mean([float(m["grad_norm"]) for m in singles]), rel=1e-5)
+    assert float(m_multi["grad_norm_max"]) == pytest.approx(
+        max(float(m["grad_norm"]) for m in singles), rel=1e-5)
+    assert float(m_multi["lr"]) == pytest.approx(
+        float(singles[-1]["lr"]), rel=1e-6)
 
 
+@pytest.mark.slow
 def test_multi_step_k1_is_single_step():
     from sketch_rnn_tpu.train import make_multi_train_step
 
@@ -616,6 +689,7 @@ def test_multi_step_k1_is_single_step():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_train_loop_steps_per_call_with_remainder(tmp_path):
     """num_steps=5 with K=2: two K-calls + a 1-step remainder replay;
     cadence triggers fire on crossings and the final state is step 5."""
@@ -629,6 +703,7 @@ def test_train_loop_steps_per_call_with_remainder(tmp_path):
     assert latest_checkpoint(str(tmp_path)) is not None
 
 
+@pytest.mark.slow
 def test_train_loop_profile_trace(tmp_path):
     """--profile captures a jax.profiler trace of steps ~10-20 (normal
     in-loop stop path; the error path is covered by the test below)."""
@@ -642,6 +717,7 @@ def test_train_loop_profile_trace(tmp_path):
     assert os.path.isdir(trace_dir) and os.listdir(trace_dir)
 
 
+@pytest.mark.slow
 def test_train_loop_profile_trace_closed_on_error(tmp_path, monkeypatch):
     """A raise while a --profile trace is open must close the session in
     train()'s finally (ADVICE r1: a leaked session poisons any later
