@@ -17,6 +17,7 @@ HP = ("batch_size=8,max_seq_len=48,enc_rnn_size=12,dec_rnn_size=16,"
       "num_steps=3,save_every=3,eval_every=50,log_every=2")
 
 
+@pytest.mark.slow
 def test_cli_train_eval_sample(tmp_path, capsys):
     wd = str(tmp_path / "work")
     assert main(["train", "--synthetic", f"--workdir={wd}",
@@ -36,6 +37,7 @@ def test_cli_train_eval_sample(tmp_path, capsys):
     assert open(out).read().startswith("<svg")
 
 
+@pytest.mark.slow
 def test_cli_interpolate_sample(tmp_path):
     wd = str(tmp_path / "work")
     main(["train", "--synthetic", f"--workdir={wd}", f"--hparams={HP}"])
@@ -45,6 +47,7 @@ def test_cli_interpolate_sample(tmp_path):
     assert os.path.exists(out)
 
 
+@pytest.mark.slow
 def test_cli_reconstruct_sample(tmp_path, capsys):
     wd = str(tmp_path / "work")
     main(["train", "--synthetic", f"--workdir={wd}", f"--hparams={HP}"])
@@ -55,6 +58,7 @@ def test_cli_reconstruct_sample(tmp_path, capsys):
     assert open(out).read().startswith("<svg")
 
 
+@pytest.mark.slow
 def test_cli_temperature_sweep(tmp_path, capsys):
     wd = str(tmp_path / "work")
     main(["train", "--synthetic", f"--workdir={wd}", f"--hparams={HP}"])
@@ -107,11 +111,13 @@ def test_graft_entry_compiles():
     assert lowered is not None
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun_multichip_clean_subprocess():
     """Exercise the dryrun exactly as the driver does: a plain environment
     with NO pre-set JAX_PLATFORMS / XLA_FLAGS (conftest.py pre-configures
@@ -133,6 +139,7 @@ def test_graft_entry_dryrun_multichip_clean_subprocess():
     assert "8 devices OK" in proc.stdout
 
 
+@pytest.mark.slow
 def test_cli_eval_per_class(tmp_path, capsys):
     wd = str(tmp_path / "workpc")
     hp = HP + ",num_classes=3"
@@ -149,6 +156,7 @@ def test_cli_eval_per_class(tmp_path, capsys):
         assert np.isfinite(v["recon"])
 
 
+@pytest.mark.slow
 def test_cli_eval_per_class_needs_classes(tmp_path, capsys):
     wd = str(tmp_path / "worknc")
     assert main(["train", "--synthetic", f"--workdir={wd}",
@@ -157,6 +165,7 @@ def test_cli_eval_per_class_needs_classes(tmp_path, capsys):
                  "--per_class"]) == 2
 
 
+@pytest.mark.slow
 def test_cli_train_no_resume(tmp_path, capsys):
     wd = str(tmp_path / "worknr")
     assert main(["train", "--synthetic", f"--workdir={wd}",

@@ -33,6 +33,7 @@ def finite(tree):
 
 
 @pytest.mark.parametrize("dec_model", ["lstm", "layer_norm", "hyper"])
+@pytest.mark.slow
 def test_loss_and_grads_all_cells(dec_model):
     hps = tiny_hps(dec_model=dec_model)
     model = SketchRNN(hps)

@@ -21,6 +21,9 @@ import sys
 import numpy as np
 import pytest
 
+# interpret-mode / subprocess heavy: excluded from the quick loop
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -17,6 +17,9 @@ from sketch_rnn_tpu.ops.cells import (HyperLSTMCell, LayerNormLSTMCell,
 from sketch_rnn_tpu.ops.pallas_fused import fused_lstm, fused_ln_lstm
 from sketch_rnn_tpu.ops.rnn import make_dropout_masks, run_rnn
 
+# interpret-mode / subprocess heavy: excluded from the quick loop
+pytestmark = pytest.mark.slow
+
 T, B, H, D = 5, 8, 128, 16
 BIG_B = 24  # > _batch_tile(24)=8 -> 3 batch tiles
 HYPER_HH, HYPER_E = 32, 8

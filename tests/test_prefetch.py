@@ -80,6 +80,7 @@ def test_prefetch_close_unblocks_full_queue():
         feeder.get()
 
 
+@pytest.mark.slow
 def test_train_with_and_without_prefetch_identical():
     from sketch_rnn_tpu.train.loop import train
     hps = HParams(**TINY, num_steps=4, save_every=100, eval_every=100,
