@@ -1,0 +1,37 @@
+"""Shared measurement utilities for the scripts/ probes.
+
+Two disciplines every on-chip measurement must follow, kept in ONE
+place so the probe scripts cannot drift:
+
+- ``drain``: under the axon remote runtime ``jax.block_until_ready``
+  does not reliably drain the pipeline — a timed loop without a host
+  value fetch measures dispatch enqueue only (bench.py's
+  ``float(metrics['loss'])`` discipline). Fetch the smallest leaf so
+  the transfer itself stays off the measurement.
+- ``hist_append``: all records land in the repo-root
+  BENCH_HISTORY.jsonl with bench.py's wall_time stamping.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def drain(out) -> float:
+    """Force completion of ``out``'s program via a tiny host fetch."""
+    leaves = jax.tree_util.tree_leaves(out)
+    leaf = min(leaves, key=lambda l: getattr(l, "size", 1))
+    return float(jnp.ravel(leaf)[0])
+
+
+def hist_append(record: dict) -> None:
+    """Append ``record`` to the repo's BENCH_HISTORY.jsonl."""
+    bench._hist_append(record)

@@ -18,7 +18,10 @@ import time
 
 def key_of(r: dict):
     if r.get("kind") == "sampler":
-        return ("sampler", r.get("dec_model"), f"B={r.get('batch_size')}")
+        # full_len rows (r3+) force max_len loop steps; earlier rows let
+        # the untrained model early-exit after a few steps — not comparable
+        return ("sampler", r.get("dec_model"),
+                f"B={r.get('batch_size')} full={bool(r.get('full_len'))}")
     # steps_per_call / transfer_dtype change what is being measured (feed
     # amortization), so K=5 rows must not pool with K=1 rows; old rows
     # predate the knobs and default to 1 / float32
@@ -46,6 +49,12 @@ def main(argv=None) -> int:
             if not line:
                 continue
             r = json.loads(line)
+            # diagnostic rows (profile_breakdown, sampler_latency,
+            # probe_*) are not best-of configs; without this guard a
+            # breakdown row's strokes_per_sec_per_chip prints as a
+            # phantom train config with None knobs
+            if r.get("kind") not in ("train", "sampler"):
+                continue
             v = metric_of(r)
             if v is None:
                 continue

@@ -1,0 +1,175 @@
+"""Probe: bfloat16 gate transcendentals in the seq-LSTM forward kernel.
+
+The r3 breakdown + dual-chain/tile probes establish the RNN kernels are
+throughput-bound with per-grid-step time split roughly evenly between
+the recurrent matmul, the hs/cs stores, and VPU gate math (3 sigmoid +
+2 tanh over [tile, H] per step). If the VPU evaluates bfloat16
+transcendentals at twice the f32 rate, casting the gate inputs to bf16
+(keeping the cell-state accumulation in f32) should shave ~20% off the
+step; if the VPU is f32-native, this is neutral and the lever closes.
+
+Forward-only A/B at the encoder shape, K calls per dispatch, plus a
+numerics check (bf16 gates vs f32 reference drift over T=250).
+Usage: python scripts/probe_bf16_gates.py [--reps 7]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain, hist_append  # noqa: E402
+from sketch_rnn_tpu.ops.pallas_fused import (  # noqa: E402
+    _batch_tile_seq,
+    _cast,
+    _interpret_default,
+    _sds,
+)
+
+
+def _seq_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, hs_ref, cs_ref,
+                    c_scr, h_scr, *, forget_bias, bf16_gates):
+    it = pl.program_id(1)
+
+    @pl.when(it == 0)
+    def _():
+        c_scr[:] = jnp.zeros_like(c_scr)
+        h_scr[:] = jnp.zeros_like(h_scr)
+
+    c, h = c_scr[:], h_scr[:]
+    pre = (jnp.dot(_cast(x_ref[0], wx_ref), wx_ref[:],
+                   preferred_element_type=jnp.float32)
+           + b_ref[0]
+           + jnp.dot(_cast(h, wh_ref), wh_ref[:],
+                     preferred_element_type=jnp.float32))
+    hdim = c.shape[-1]
+    if bf16_gates:
+        # dtype-matched manual gates: Mosaic's jax.nn.sigmoid lowering
+        # broadcasts an f32 constant into the bf16 vector and fails
+        # verification, so spell out 1/(1+exp(-x)) with bf16 constants
+        pre = pre.astype(jnp.bfloat16)
+        one = jnp.bfloat16(1.0)
+        sig = lambda v: one / (one + jnp.exp(-v))
+        i = sig(pre[:, :hdim])
+        g = jnp.tanh(pre[:, hdim:2 * hdim])
+        f = sig(pre[:, 2 * hdim:3 * hdim] + jnp.bfloat16(forget_bias))
+        o = sig(pre[:, 3 * hdim:])
+    else:
+        i = jax.nn.sigmoid(pre[:, :hdim])
+        g = jnp.tanh(pre[:, hdim:2 * hdim])
+        f = jax.nn.sigmoid(pre[:, 2 * hdim:3 * hdim] + forget_bias)
+        o = jax.nn.sigmoid(pre[:, 3 * hdim:])
+    if bf16_gates:
+        # cell accumulation stays f32: only the transcendental evals and
+        # their products run in bf16
+        new_c = c * f.astype(jnp.float32) + (i * g).astype(jnp.float32)
+        new_h = jnp.tanh(new_c).astype(jnp.bfloat16) * o
+        new_h = new_h.astype(jnp.float32)
+    else:
+        new_c = c * f + i * g
+        new_h = jnp.tanh(new_c) * o
+    cs_ref[0] = c.astype(cs_ref.dtype)
+    c_scr[:] = new_c
+    h_scr[:] = new_h
+    hs_ref[0] = new_h.astype(hs_ref.dtype)
+
+
+def seq_fwd(xs, wx, b, wh, bf16_gates, bt):
+    t, bsz, d = xs.shape
+    h = wh.shape[0]
+    b2 = b.reshape(1, -1).astype(jnp.float32)
+    step = lambda s: pl.BlockSpec((1, *s), lambda ib, it: (it, ib, 0))
+    whole = lambda s: pl.BlockSpec(s, lambda ib, it: (0,) * len(s))
+    kernel = functools.partial(_seq_fwd_kernel, forget_bias=1.0,
+                               bf16_gates=bf16_gates)
+    hs, cs = pl.pallas_call(
+        kernel,
+        grid=(bsz // bt, t),
+        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape)],
+        out_specs=(step((bt, h)), step((bt, h))),
+        out_shape=(_sds((t, bsz, h), jnp.bfloat16, xs),
+                   _sds((t, bsz, h), jnp.bfloat16, xs)),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32) for _ in range(2)],
+        interpret=_interpret_default(),
+    )(xs, wx, b2, wh)
+    return hs
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=7)
+    args = ap.parse_args()
+    T, B, H, D, K = 250, 4096, 256, 5, 8
+    bt = _batch_tile_seq(B, H)
+    k = jax.random.split(jax.random.key(0), 4)
+    xs_k = jax.random.normal(k[0], (K, T, B, D), jnp.float32)
+    mkw = lambda key, s: (jax.random.normal(key, s, jnp.float32)
+                          * 0.1).astype(jnp.bfloat16)
+    wx, wh = mkw(k[1], (D, 4 * H)), mkw(k[2], (H, 4 * H))
+    b = jnp.zeros((4 * H,), jnp.float32)
+
+    def arm(bf16_gates):
+        @jax.jit
+        def run():
+            def body(_, xs):
+                hs = seq_fwd(xs, wx, b, wh, bf16_gates, bt)
+                return 0.0, hs[0, 0, 0].astype(jnp.float32)
+            _, outs = jax.lax.scan(body, 0.0, xs_k)
+            return outs
+        return run
+
+    run_f32, run_bf16 = arm(False), arm(True)
+
+    # numerics: drift of bf16 gates vs f32 reference at T=250
+    hs_ref = seq_fwd(xs_k[0], wx, b, wh, False, bt)
+    hs_b = seq_fwd(xs_k[0], wx, b, wh, True, bt)
+    err = np.abs(np.asarray(hs_b, np.float32)
+                 - np.asarray(hs_ref, np.float32))
+    rel = float(err.max() / (np.abs(np.asarray(hs_ref, np.float32)).max()
+                             + 1e-9))
+    print(f"# bf16-gates max abs err {err.max():.4f} (rel {rel:.4f})",
+          file=sys.stderr)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        drain(fn())
+        return time.perf_counter() - t0
+
+    timed(run_f32), timed(run_bf16)
+    ts_f, ts_b = [], []
+    for _ in range(args.reps):
+        ts_f.append(timed(run_f32))
+        ts_b.append(timed(run_bf16))
+    mf = statistics.median(ts_f) * 1e3 / K
+    mb = statistics.median(ts_b) * 1e3 / K
+    rec = {
+        "kind": "probe_bf16_gates",
+        "T": T, "B": B, "H": H, "tile": bt,
+        "calls_per_dispatch": K, "reps": args.reps,
+        "f32_gates_ms": round(mf, 2),
+        "bf16_gates_ms": round(mb, 2),
+        "speedup": round(mf / mb, 3),
+        "max_abs_err": round(float(err.max()), 5),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(rec, indent=2))
+    hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

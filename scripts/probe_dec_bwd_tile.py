@@ -1,0 +1,130 @@
+"""Probe: decoder (H=512, x_bias) backward tile 256 vs the forced 128.
+
+NOTES r2: the ln/lstm x-bias backward at H=512/tile-256 sat AT the 16M
+scoped-VMEM line — compiling or OOMing by 3.5-4M depending on the
+surrounding graph — so ``_batch_tile(xb_bwd=True)`` halves the budget
+(tile 128) for a deterministic margin. VERDICT r3 candidate lever: with
+the probe discipline (standalone jit(grad) on the REAL chip proves
+nothing about other graph contexts — NOTES), re-measure whether tile
+256 (a) still compiles standalone, (b) is actually faster, to decide
+whether a smarter budget rule is worth pursuing. A negative on either
+closes the lever.
+
+Times jit(grad) of a decoder-shaped fused_ln_lstm (T=250, B=4096,
+H=512, D=5 + xb) with the production tile (128) and with the halving
+suppressed (256), interleaved in one process, K calls per dispatch.
+Usage: python scripts/probe_dec_bwd_tile.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import sketch_rnn_tpu.ops.pallas_fused as PF  # noqa: E402
+from scripts._measure import drain, hist_append  # noqa: E402
+
+
+def build(tile_override: bool):
+    """Build jit(K x value_and_grad(loss of fused_ln_lstm)) with or
+    without the xb backward budget halving."""
+    T, B, H, D, K = 250, 4096, 512, 5, 4
+    k = jax.random.split(jax.random.key(0), 10)
+    xs_k = jax.random.normal(k[0], (K, T, B, D), jnp.float32)
+    mkw = lambda key, s: (jax.random.normal(key, s, jnp.float32)
+                          * 0.05).astype(jnp.bfloat16)
+    wx = mkw(k[1], (D, 4 * H))
+    wh = mkw(k[2], (H, 4 * H))
+    gam = jnp.ones((4, H), jnp.float32)
+    bet = jnp.zeros((4, H), jnp.float32)
+    gc = jnp.ones((H,), jnp.float32)
+    bc = jnp.zeros((H,), jnp.float32)
+    c0 = jnp.zeros((B, H), jnp.float32)
+    h0 = jnp.zeros((B, H), jnp.float32)
+    xb = jax.random.normal(k[3], (B, 4 * H), jnp.float32) * 0.05
+
+    def loss(wx, wh, xb, xs):
+        hs, _ = PF.fused_ln_lstm(xs, wx, wh, gam, bet, gc, bc, c0, h0,
+                                 1.0, None, None, 1.0, jnp.bfloat16, xb)
+        return jnp.sum(hs.astype(jnp.float32) ** 2) * 1e-6
+
+    grad = jax.value_and_grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def run():
+        def body(_, xs):
+            v, gs = grad(wx, wh, xb, xs)
+            return 0.0, v + sum(jnp.ravel(g)[0].astype(jnp.float32)
+                                for g in gs)
+        _, outs = jax.lax.scan(body, 0.0, xs_k)
+        return outs
+
+    return run, K
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    orig = PF._batch_tile
+    run_128, K = build(False)
+
+    def no_halving(b, h, xb_bwd=False, budget=131072):
+        return orig(b, h, xb_bwd=False, budget=budget)
+
+    PF._batch_tile = no_halving
+    try:
+        run_256, _ = build(True)
+    finally:
+        PF._batch_tile = orig
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        drain(fn())
+        return time.perf_counter() - t0
+
+    # compile both first; a 256-tile OOM surfaces here as the negative
+    try:
+        timed(run_256)
+    except Exception as e:
+        print(f"# tile 256 FAILED to compile/run standalone: {e!r}",
+              file=sys.stderr)
+        rec = {"kind": "probe_dec_bwd_tile", "tile256": "compile_fail",
+               "device_kind": jax.devices()[0].device_kind}
+        print(json.dumps(rec))
+        return 0
+    timed(run_128)
+
+    ts_128, ts_256 = [], []
+    for _ in range(args.reps):
+        ts_128.append(timed(run_128))
+        ts_256.append(timed(run_256))
+    m128 = statistics.median(ts_128) * 1e3 / K
+    m256 = statistics.median(ts_256) * 1e3 / K
+    rec = {
+        "kind": "probe_dec_bwd_tile",
+        "T": 250, "B": 4096, "H": 512, "D": 5,
+        "calls_per_dispatch": K,
+        "reps": args.reps,
+        "tile128_ms": round(m128, 2),
+        "tile256_ms": round(m256, 2),
+        "speedup": round(m128 / m256, 3),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(rec, indent=2))
+    hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

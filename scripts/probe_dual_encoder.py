@@ -1,0 +1,219 @@
+"""Probe: dual-chain encoder kernel — both bi-LSTM directions per grid step.
+
+Hypothesis (from the r3 step-time breakdown + NOTES' negative results):
+the encoder kernels are bound by the SERIAL dependency chain of the
+per-step ``h @ wh`` matmul — each grid step's recurrent matmul waits on
+the previous step's result, so the MXU idles most of each ~15-20 us grid
+step (the matmul itself is ~3 us at tile 1024 x H 256). Time-unrolling
+two DEPENDENT steps per program measured SLOWER (NOTES: 51.1 vs
+45.7 ms) because it lengthens the in-body serial chain. But the
+encoder's forward and backward DIRECTIONS are two INDEPENDENT chains
+over the same data — interleaving them in one kernel lets each
+direction's matmul issue while the other's is still in flight, for up
+to 2x on a latency-bound kernel at unchanged tile size.
+
+This probe times the forward pass (sequence-only contract, no dropout):
+  A. two ``fused_lstm_seq``-style single-direction calls (production)
+  B. one dual-chain call doing both directions per grid step
+interleaved A/B/A/B in one process so a tunnel window shift cannot bias
+the comparison, checks numerical parity, and prints the verdict.
+
+Results land in NOTES.md / BENCH_HISTORY (kind=probe_dual_encoder).
+Usage: python scripts/probe_dual_encoder.py [--reps 7]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain, hist_append  # noqa: E402
+from sketch_rnn_tpu.ops.pallas_fused import (  # noqa: E402
+    _batch_tile_seq,
+    _cast,
+    _interpret_default,
+    _lstm_gates,
+    _sds,
+)
+
+
+def _dual_seq_fwd_kernel(xf_ref, xb_ref, wxf_ref, bf_ref, whf_ref,
+                         wxb_ref, bb_ref, whb_ref,
+                         hsf_ref, csf_ref, hsb_ref, csb_ref,
+                         cf_scr, hf_scr, cb_scr, hb_scr, *, forget_bias):
+    """One grid step advances BOTH directions one time step.
+
+    The two directions' recurrent matmuls are data-independent, so the
+    second can issue while the first is in flight — the point of the
+    probe. Zero initial carries (encoder contract)."""
+    it = pl.program_id(1)
+
+    @pl.when(it == 0)
+    def _():
+        cf_scr[:] = jnp.zeros_like(cf_scr)
+        hf_scr[:] = jnp.zeros_like(hf_scr)
+        cb_scr[:] = jnp.zeros_like(cb_scr)
+        hb_scr[:] = jnp.zeros_like(hb_scr)
+
+    def one(x_ref, wx_ref, b_ref, wh_ref, c_scr, h_scr, hs_ref, cs_ref):
+        c, h = c_scr[:], h_scr[:]
+        pre = (jnp.dot(_cast(x_ref[0], wx_ref), wx_ref[:],
+                       preferred_element_type=jnp.float32)
+               + b_ref[0]
+               + jnp.dot(_cast(h, wh_ref), wh_ref[:],
+                         preferred_element_type=jnp.float32))
+        _, _, _, o, new_c = _lstm_gates(pre, c, None,
+                                        forget_bias=forget_bias)
+        new_h = jnp.tanh(new_c) * o
+        cs_ref[0] = c.astype(cs_ref.dtype)
+        c_scr[:] = new_c
+        h_scr[:] = new_h
+        hs_ref[0] = new_h.astype(hs_ref.dtype)
+
+    one(xf_ref, wxf_ref, bf_ref, whf_ref, cf_scr, hf_scr, hsf_ref, csf_ref)
+    one(xb_ref, wxb_ref, bb_ref, whb_ref, cb_scr, hb_scr, hsb_ref, csb_ref)
+
+
+def dual_seq_fwd(xs_f, xs_b, wx_f, b_f, wh_f, wx_b, b_b, wh_b,
+                 forget_bias=1.0, residual_dtype=jnp.bfloat16, bt=None):
+    t, bsz, d = xs_f.shape
+    h = wh_f.shape[0]
+    bt = bt or _batch_tile_seq(bsz, h)
+    b2f = b_f.reshape(1, -1).astype(jnp.float32)
+    b2b = b_b.reshape(1, -1).astype(jnp.float32)
+    step = lambda s: pl.BlockSpec((1, *s), lambda ib, it: (it, ib, 0))
+    tile = lambda s: pl.BlockSpec(s, lambda ib, it: (ib, 0))
+    whole = lambda s: pl.BlockSpec(s, lambda ib, it: (0,) * len(s))
+
+    kernel = functools.partial(_dual_seq_fwd_kernel,
+                               forget_bias=forget_bias)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(bsz // bt, t),
+        in_specs=[step((bt, d)), step((bt, d)),
+                  whole(wx_f.shape), whole(b2f.shape), whole(wh_f.shape),
+                  whole(wx_b.shape), whole(b2b.shape), whole(wh_b.shape)],
+        out_specs=(step((bt, h)), step((bt, h)),
+                   step((bt, h)), step((bt, h))),
+        out_shape=tuple(_sds((t, bsz, h), residual_dtype, xs_f)
+                        for _ in range(4)),
+        scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32)
+                        for _ in range(4)],
+        interpret=_interpret_default(),
+    )(xs_f, xs_b, wx_f, b2f, wh_f, wx_b, b2b, wh_b)
+    return outs  # hs_f, cs_f, hs_b, cs_b
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=7)
+    ap.add_argument("--t", type=int, default=250)
+    ap.add_argument("--b", type=int, default=4096)
+    ap.add_argument("--h", type=int, default=256)
+    ap.add_argument("--d", type=int, default=5)
+    ap.add_argument("--tile", type=int, default=0,
+                    help="dual-kernel batch tile override (0 = same as "
+                         "the single kernel's _batch_tile_seq)")
+    args = ap.parse_args()
+    from sketch_rnn_tpu.ops.pallas_fused import fused_lstm_seq
+
+    T, B, H, D = args.t, args.b, args.h, args.d
+    K = 8  # kernel calls per jit dispatch: the tunnel's per-call latency
+    # (up to ~130 ms in slow windows) would otherwise swamp the ~20 ms
+    # arm difference being measured; K distinct input slices prevent CSE
+    k = jax.random.split(jax.random.key(0), 8)
+    xs_k = jax.random.normal(k[0], (K, T, B, D), jnp.float32)
+    xs_f = xs_k[0]
+    xs_b = jnp.flip(xs_f, axis=0)
+    mk = lambda key, s: (jax.random.normal(key, s, jnp.float32)
+                         * 0.1).astype(jnp.bfloat16)
+    wx_f, wx_b = mk(k[1], (D, 4 * H)), mk(k[2], (D, 4 * H))
+    wh_f, wh_b = mk(k[3], (H, 4 * H)), mk(k[4], (H, 4 * H))
+    b_f = jnp.zeros((4 * H,), jnp.float32)
+    b_b = jnp.zeros((4 * H,), jnp.float32)
+    zc = jnp.zeros((B, H), jnp.float32)
+    bt = args.tile or None
+
+    @jax.jit
+    def single_k():
+        def body(_, xf):
+            xb = jnp.flip(xf, axis=0)
+            hf = fused_lstm_seq(xf, wx_f, b_f, wh_f, zc, zc, 1.0, None,
+                                None, 1.0, jnp.bfloat16)
+            hb = fused_lstm_seq(xb, wx_b, b_b, wh_b, zc, zc, 1.0, None,
+                                None, 1.0, jnp.bfloat16)
+            return 0.0, (hf[0, 0, 0] + hb[0, 0, 0]).astype(jnp.float32)
+        _, outs = jax.lax.scan(body, 0.0, xs_k)
+        return outs
+
+    @jax.jit
+    def dual_k():
+        def body(_, xf):
+            xb = jnp.flip(xf, axis=0)
+            hf, _, hb, _ = dual_seq_fwd(xf, xb, wx_f, b_f, wh_f,
+                                        wx_b, b_b, wh_b, bt=bt)
+            return 0.0, (hf[0, 0, 0] + hb[0, 0, 0]).astype(jnp.float32)
+        _, outs = jax.lax.scan(body, 0.0, xs_k)
+        return outs
+
+    single, dual = single_k, dual_k
+
+    # parity first (single unscanned calls)
+    hf_s = fused_lstm_seq(xs_f, wx_f, b_f, wh_f, zc, zc, 1.0, None, None,
+                          1.0, jnp.bfloat16)
+    hb_s = fused_lstm_seq(xs_b, wx_b, b_b, wh_b, zc, zc, 1.0, None, None,
+                          1.0, jnp.bfloat16)
+    hf_d, _, hb_d, _ = dual_seq_fwd(xs_f, xs_b, wx_f, b_f, wh_f,
+                                    wx_b, b_b, wh_b, bt=bt)
+    np.testing.assert_allclose(np.asarray(hf_d, np.float32),
+                               np.asarray(hf_s, np.float32),
+                               atol=1e-2, rtol=1e-2)
+    np.testing.assert_allclose(np.asarray(hb_d, np.float32),
+                               np.asarray(hb_s, np.float32),
+                               atol=1e-2, rtol=1e-2)
+    print("# parity OK", file=sys.stderr)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        drain(fn())
+        return time.perf_counter() - t0
+
+    # interleaved A/B so a window shift hits both arms equally
+    ts_s, ts_d = [], []
+    timed(single), timed(dual)  # settle
+    for _ in range(args.reps):
+        ts_s.append(timed(single))
+        ts_d.append(timed(dual))
+    ms = statistics.median(ts_s) * 1e3 / K
+    md = statistics.median(ts_d) * 1e3 / K
+    rec = {
+        "kind": "probe_dual_encoder",
+        "T": T, "B": B, "H": H, "D": D,
+        "tile": args.tile or _batch_tile_seq(B, H),
+        "reps": args.reps,
+        "calls_per_dispatch": K,
+        "single_2calls_ms": round(ms, 2),
+        "dual_ms": round(md, 2),
+        "speedup": round(ms / md, 3),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(rec, indent=2))
+    hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

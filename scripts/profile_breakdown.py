@@ -1,0 +1,253 @@
+"""Step-time breakdown of the flagship training step on the real chip.
+
+VERDICT r2 #2: MFU ~0.27 means ~73% of the chip's peak is unused and
+nothing committed says where the time goes. This script measures a
+LADDER of progressively reduced programs on the real TPU and distills
+per-phase shares of the full fed step:
+
+1. ``fed``     — the real thing: full train step, fresh host batch per
+                 step through the prefetch pipeline (what bench.py runs).
+2. ``cached``  — full train step on a device-resident batch: the compute
+                 program alone. feed share = fed - cached (≈0 when the
+                 pipeline overlaps perfectly).
+3. ``stub_mdn``— same step but the 6M+3 MDN head + GMM-NLL replaced by a
+                 trivial masked reduction of the decoder outputs;
+                 MDN share ≈ cached - stub_mdn. (Grads still flow
+                 through the full decoder/encoder.)
+4. ``no_enc``  — stub-MDN step with ``conditional=False`` (encoder, KL
+                 and the z pathway removed); encoder share ≈
+                 stub_mdn - no_enc. Caveat: the z gate-bias (x_extra)
+                 path of the decoder kernel also disappears, so this
+                 attributes the (small) xb cost to the encoder.
+5. ``update``  — optimizer-only program (clip + adam + apply) on
+                 realistic gradient pytrees.
+6. decoder share = no_enc - update (the remainder: decoder fwd+bwd and
+                 glue — input slicing, transposes, schedules).
+
+Each rung is the median of ``--reps`` timed K-step calls after warmup,
+so a single dispatch stall cannot skew a share. Run in a good window
+(compare against BENCH_HISTORY's steady-state band; the script prints
+the implied strokes/s so you can tell); ``--json`` appends the record
+to BENCH_HISTORY.jsonl. Usage::
+
+    python scripts/profile_breakdown.py [--steps 10] [--reps 5] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain as _drain  # noqa: E402
+from scripts._measure import hist_append  # noqa: E402
+
+
+def _median_time(fn, *args, reps: int, warmup: int = 2) -> float:
+    """Median wall time of ``fn(*args)`` (host-drained) over ``reps``."""
+    for _ in range(warmup):
+        _drain(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _drain(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10,
+                    help="micro-steps per timed call (lax.scan K)")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--seq_len", type=int, default=250)
+    ap.add_argument("--dec", default="layer_norm")
+    ap.add_argument("--json", action="store_true",
+                    help="also append the record to BENCH_HISTORY.jsonl")
+    args = ap.parse_args()
+
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.data.loader import synthetic_loader
+    from sketch_rnn_tpu.data.prefetch import prefetch_batches
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.ops import mdn
+    from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+    from sketch_rnn_tpu.train import make_train_state
+    from sketch_rnn_tpu.train.state import make_optimizer
+    from sketch_rnn_tpu.train.step import make_multi_train_step
+    from sketch_rnn_tpu.utils import flops as F
+
+    K = args.steps
+    base = get_default_hparams().replace(
+        dec_model=args.dec, batch_size=args.batch, max_seq_len=args.seq_len,
+        compute_dtype="bfloat16", fused_rnn=True,
+        fused_residual_dtype="bfloat16", steps_per_call=K)
+    mesh = make_mesh(base)
+    loader, _ = synthetic_loader(base, min(args.batch, 4096), seed=0)
+
+    def stacked_batch(hps):
+        feeder = prefetch_batches(loader, mesh, depth=1, stack=K)
+        try:
+            return feeder.get()
+        finally:
+            feeder.close()
+
+    def timed_step(hps, loss_override=None, label=""):
+        """Median time of one K-step call on a CACHED device batch."""
+        model = SketchRNN(hps)
+        if loss_override is not None:
+            model.loss = loss_override.__get__(model, SketchRNN)
+        state = make_train_state(model, hps, jax.random.key(0))
+        step = make_multi_train_step(model, hps, mesh)
+        batch = stacked_batch(hps)
+        key = jax.random.key(1)
+
+        def run(state, batch):
+            state, m = step(state, batch, key)
+            return state, m["loss"]
+
+        # donated state: re-thread it through the reps like the loop does
+        for _ in range(2):
+            state, loss = run(state, batch)
+        float(loss)
+        ts = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            state, loss = run(state, batch)
+            float(loss)  # host fetch: the only reliable drain (see _drain)
+            ts.append(time.perf_counter() - t0)
+        t = statistics.median(ts) / K
+        print(f"#   {label:10s} {t * 1e3:8.2f} ms/step", file=sys.stderr)
+        return t
+
+    # -- 1. fed: the real pipeline (fresh batch per step) -------------------
+    hps = base
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_multi_train_step(model, hps, mesh)
+    key = jax.random.key(1)
+    feeder = prefetch_batches(loader, mesh, depth=2, stack=K)
+    try:
+        for i in range(2):
+            state, m = step(state, feeder.get(), jax.random.fold_in(key, i))
+        float(m["loss"])
+        ts = []
+        for i in range(args.reps):
+            t0 = time.perf_counter()
+            state, m = step(state, feeder.get(),
+                            jax.random.fold_in(key, 100 + i))
+            float(m["loss"])  # host fetch drain
+            ts.append(time.perf_counter() - t0)
+    finally:
+        feeder.close()
+    fed = statistics.median(ts) / K
+    print(f"#   {'fed':10s} {fed * 1e3:8.2f} ms/step", file=sys.stderr)
+
+    # -- 2. cached: same program, device-resident batch ---------------------
+    cached = timed_step(hps, label="cached")
+
+    # -- 3. stub MDN head: trivial masked reduction over decoder outputs ----
+    def loss_stub(self, params, batch, key, kl_weight, train=True,
+                  axis_name=None):
+        hps_, weights = self.hps, batch.get("weights")
+        mp, x_target, labels, mu, presig = self._forward(
+            params, batch, key, train)
+        if hps_.conditional:
+            kl_raw = mdn.kl_loss(mu, presig, weights=weights,
+                                 axis_name=axis_name)
+        else:
+            kl_raw = jnp.float32(0.0)
+        # same output tensor, trivial head: keeps decoder/encoder grads and
+        # the KL path; removes log_softmax/logsumexp GMM math. Sums must
+        # be psum'd-global like the real loss so metrics replicate across
+        # shards (shard_map out_specs P() requires it)
+        b = mdn._global_sum(jnp.float32(x_target.shape[1]), axis_name)
+        r = mdn._global_sum(sum(jnp.sum(x) for x in mp), axis_name) \
+            / (hps_.max_seq_len * b)
+        total = r + kl_weight * kl_raw
+        # kl_weight key: the K-step aggregator pins it from the metrics
+        return total, {"loss": total,
+                       "kl_weight": jnp.asarray(kl_weight, jnp.float32)}
+
+    stub_mdn = timed_step(hps, loss_override=loss_stub, label="stub_mdn")
+
+    # -- 4. no encoder (and no z pathway) -----------------------------------
+    no_enc = timed_step(hps.replace(conditional=False),
+                        loss_override=loss_stub, label="no_enc")
+
+    # -- 5. optimizer update alone (K-scanned like the real step, so the
+    # per-call tunnel dispatch is amortized identically) --------------------
+    import optax
+
+    tx = make_optimizer(hps)
+    state = make_train_state(SketchRNN(hps), hps, jax.random.key(0))
+    grads = jax.tree_util.tree_map(lambda p: jnp.ones_like(p), state.params)
+
+    @jax.jit
+    def update_k(opt_state, params, grads):
+        def body(c, _):
+            params, opt_state = c
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), ()
+
+        (params, opt_state), _ = jax.lax.scan(
+            body, (params, opt_state), None, length=K)
+        return params, opt_state
+
+    upd = _median_time(update_k, state.opt_state, state.params, grads,
+                       reps=args.reps) / K
+    print(f"#   {'update':10s} {upd * 1e3:8.2f} ms/step", file=sys.stderr)
+
+    # -- 6. per-call dispatch floor (context for reading the rungs) ---------
+    add = jax.jit(lambda x: x + 1.0)
+    disp = _median_time(add, jnp.float32(1.0), reps=max(args.reps, 10))
+    print(f"#   {'dispatch':10s} {disp * 1e3:8.2f} ms/call "
+          f"({disp / K * 1e3:.2f} ms amortized over K={K})",
+          file=sys.stderr)
+
+    # -- distill -------------------------------------------------------------
+    shares = {
+        "feed": fed - cached,
+        "mdn_head_loss": cached - stub_mdn,
+        "encoder": stub_mdn - no_enc,
+        "decoder_and_glue": no_enc - upd,
+        "optimizer_update": upd,
+    }
+    kind = jax.devices()[0].device_kind
+    strokes = args.batch * args.seq_len / fed
+    rec = {
+        "kind": "profile_breakdown",
+        "dec_model": args.dec,
+        "batch_size": args.batch,
+        "seq_len": args.seq_len,
+        "steps_per_call": K,
+        "reps": args.reps,
+        "device_kind": kind,
+        "fed_ms": round(fed * 1e3, 2),
+        "cached_ms": round(cached * 1e3, 2),
+        "stub_mdn_ms": round(stub_mdn * 1e3, 2),
+        "no_enc_ms": round(no_enc * 1e3, 2),
+        "update_ms": round(upd * 1e3, 2),
+        "dispatch_ms_per_call": round(disp * 1e3, 2),
+        "strokes_per_sec_per_chip": round(strokes, 1),
+        "mfu": F.mfu(strokes, base, kind, train=True),
+        "shares_ms": {k: round(v * 1e3, 2) for k, v in shares.items()},
+        "shares_pct": {k: round(100 * v / fed, 1) for k, v in shares.items()},
+    }
+    print(json.dumps(rec, indent=2))
+    if args.json:
+        hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

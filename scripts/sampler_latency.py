@@ -1,0 +1,128 @@
+"""Diagnose the B=1 sampler's per-step cost floor (VERDICT r2 #5).
+
+BENCH_HISTORY shows B=1 sampling at ~66-77 sketches/s (~13-15 ms per
+250-step sketch, ~55-60 us/decode-step) without saying whether that is
+(a) per-call dispatch overhead of the tunneled runtime, (b) while_loop
+machinery, or (c) the actual per-step compute at B=1. This script
+separates them:
+
+1. ``dispatch`` — round-trip time of a trivial jitted program (scalar
+   add): the floor any single call pays under the axon tunnel.
+2. ``max_len sweep`` — sampler calls at several max_len values with the
+   end-of-sketch pen logit biased to -1e9 (an UNTRAINED model otherwise
+   draws p3 within a few steps and the early-exit fires — the first
+   version of this script measured exactly that artifact), so the loop
+   verifiably runs max_len steps (asserted via the returned lengths):
+   a linear fit gives per-step cost (slope) vs fixed per-call overhead
+   (intercept). If the intercept ~= dispatch, the while_loop itself
+   adds nothing and the per-step slope is the real target.
+3. ``B sweep at fixed len`` — per-step cost at B in {1, 8, 64, 1024}:
+   if the B=1 slope equals the B=64 slope the step is latency-bound
+   (weight streaming / loop latency), not compute-bound — batching is
+   free speedup and a K-unrolled body would only help the latency part.
+
+Appends a ``kind: "sampler_latency"`` record to BENCH_HISTORY.jsonl with
+the decision inputs. Usage: ``python scripts/sampler_latency.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain as _drain  # noqa: E402
+from scripts._measure import hist_append  # noqa: E402
+
+
+def _t(fn, reps=10, warmup=2):
+    for _ in range(warmup):
+        _drain(fn())
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _drain(fn())
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main() -> int:
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.sample.sampler import make_sampler
+
+    hps = get_default_hparams().replace(
+        dec_model=os.environ.get("BENCH_DEC", "layer_norm"))
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    # suppress the end-of-sketch pen state so the while_loop provably
+    # runs to max_len (verified against the returned lengths below)
+    params["out_b"] = params["out_b"].at[2].set(-1e9)
+
+    # 1. dispatch floor
+    one = jnp.float32(1.0)
+    add = jax.jit(lambda x: x + 1.0)
+    dispatch = _t(lambda: add(one), reps=20)
+    print(f"# dispatch (trivial jit call): {dispatch * 1e6:.0f} us",
+          file=sys.stderr)
+
+    def run_full(sampler, b, L):
+        z = jax.random.normal(jax.random.key(1), (b, hps.z_size))
+        _, lengths = sampler(params, jax.random.key(2), b, z, None, 0.7)
+        assert int(np.min(np.asarray(lengths))) == L, \
+            f"early exit at {np.asarray(lengths).min()} < {L}"
+        return _t(lambda: sampler(params, jax.random.key(2), b, z,
+                                  None, 0.7))
+
+    # 2. max_len sweep at B=1
+    lens = (50, 100, 200, 400)
+    times = []
+    for L in lens:
+        sampler = make_sampler(model, hps, max_len=L)
+        t = run_full(sampler, 1, L)
+        times.append(t)
+        print(f"# B=1 max_len={L}: {t * 1e3:.2f} ms "
+              f"({t / L * 1e6:.1f} us/step)", file=sys.stderr)
+    slope, intercept = np.polyfit(lens, times, 1)
+    print(f"# fit: {slope * 1e6:.1f} us/step + {intercept * 1e3:.2f} ms/call",
+          file=sys.stderr)
+
+    # 3. per-step cost vs batch at fixed length
+    L = 200
+    per_step = {}
+    for b in (1, 8, 64, 1024):
+        sampler = make_sampler(model, hps, max_len=L)
+        t = run_full(sampler, b, L)
+        per_step[b] = (t - dispatch) / L
+        print(f"# B={b} max_len={L}: {t * 1e3:.2f} ms "
+              f"({(t - dispatch) / L * 1e6:.1f} us/step net of dispatch)",
+              file=sys.stderr)
+
+    rec = {
+        "kind": "sampler_latency",
+        "dec_model": hps.dec_model,
+        "device_kind": jax.devices()[0].device_kind,
+        "dispatch_us": round(dispatch * 1e6, 1),
+        "per_step_us_fit": round(slope * 1e6, 2),
+        "per_call_ms_fit": round(intercept * 1e3, 3),
+        "full_len": True,
+        "max_len_sweep_ms": {str(L): round(t * 1e3, 3)
+                             for L, t in zip(lens, times)},
+        "per_step_us_by_batch_net_dispatch": {
+            str(b): round(v * 1e6, 2) for b, v in per_step.items()},
+    }
+    print(json.dumps(rec, indent=2))
+    hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

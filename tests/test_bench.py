@@ -24,6 +24,7 @@ _BASE = {
     "kind": "train", "dec_model": "layer_norm", "batch_size": 4096,
     "seq_len": 250, "dtype": "bfloat16", "remat": True, "fused_rnn": True,
     "resid_dtype": "bfloat16", "device_kind": "TPU v5 lite", "n_chips": 1,
+    "prefetch_depth": 2,
 }
 
 
@@ -47,6 +48,9 @@ def test_hist_best_pools_across_feed_knobs(tmp_path, monkeypatch):
         # same global batch on a different chip count is a different
         # per-chip workload — must NOT pool
         {**_BASE, "n_chips": 8, "strokes_per_sec_per_chip": 9.9e6},
+        # synchronous-feed (depth 0) rows are a different measurement —
+        # and conversely a depth-0 run must not be gated on depth-2 bests
+        {**_BASE, "prefetch_depth": 0, "strokes_per_sec_per_chip": 9.9e6},
         # sampler rows and junk lines are skipped
         {"kind": "sampler", "batch_size": 1, "sketches_per_sec": 77},
     ])
@@ -54,7 +58,7 @@ def test_hist_best_pools_across_feed_knobs(tmp_path, monkeypatch):
         f.write("not json\n")
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
     best = bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
-                                    True, True, "bfloat16", "TPU v5 lite", 1)
+                                    True, True, "bfloat16", "TPU v5 lite", 1, 2)
     assert best == 4.0e6
 
 
@@ -63,13 +67,13 @@ def test_hist_best_missing_file_and_no_match(tmp_path, monkeypatch):
         bench, "_hist_path", lambda: str(tmp_path / "absent.jsonl"))
     assert bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
                                     True, True, "bfloat16",
-                                    "TPU v5 lite", 1) is None
+                                    "TPU v5 lite", 1, 2) is None
     hist = tmp_path / "BENCH_HISTORY.jsonl"
     _write_hist(hist, [{**_BASE, "strokes_per_sec_per_chip": 1.0}])
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
     assert bench._hist_best_strokes("hyper", 4096, 250, "bfloat16",
                                     True, True, "bfloat16",
-                                    "TPU v5 lite", 1) is None
+                                    "TPU v5 lite", 1, 2) is None
 
 
 def test_bench_train_rejects_non_divisible_steps():
