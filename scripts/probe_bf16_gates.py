@@ -36,6 +36,7 @@ from sketch_rnn_tpu.ops.pallas_fused import (  # noqa: E402
     _batch_tile_seq,
     _cast,
     _interpret_default,
+    _lstm_gates,
     _sds,
 )
 
@@ -55,11 +56,13 @@ def _seq_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, hs_ref, cs_ref,
            + b_ref[0]
            + jnp.dot(_cast(h, wh_ref), wh_ref[:],
                      preferred_element_type=jnp.float32))
-    hdim = c.shape[-1]
     if bf16_gates:
         # dtype-matched manual gates: Mosaic's jax.nn.sigmoid lowering
         # broadcasts an f32 constant into the bf16 vector and fails
-        # verification, so spell out 1/(1+exp(-x)) with bf16 constants
+        # verification, so spell out 1/(1+exp(-x)) with bf16 constants.
+        # Cell accumulation stays f32: only the transcendental evals and
+        # their products run in bf16.
+        hdim = c.shape[-1]
         pre = pre.astype(jnp.bfloat16)
         one = jnp.bfloat16(1.0)
         sig = lambda v: one / (one + jnp.exp(-v))
@@ -67,19 +70,14 @@ def _seq_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, hs_ref, cs_ref,
         g = jnp.tanh(pre[:, hdim:2 * hdim])
         f = sig(pre[:, 2 * hdim:3 * hdim] + jnp.bfloat16(forget_bias))
         o = sig(pre[:, 3 * hdim:])
-    else:
-        i = jax.nn.sigmoid(pre[:, :hdim])
-        g = jnp.tanh(pre[:, hdim:2 * hdim])
-        f = jax.nn.sigmoid(pre[:, 2 * hdim:3 * hdim] + forget_bias)
-        o = jax.nn.sigmoid(pre[:, 3 * hdim:])
-    if bf16_gates:
-        # cell accumulation stays f32: only the transcendental evals and
-        # their products run in bf16
         new_c = c * f.astype(jnp.float32) + (i * g).astype(jnp.float32)
         new_h = jnp.tanh(new_c).astype(jnp.bfloat16) * o
         new_h = new_h.astype(jnp.float32)
     else:
-        new_c = c * f + i * g
+        # the f32 arm IS the production recipe — reuse it so the
+        # baseline cannot drift from the kernel it A/Bs against
+        _, _, _, o, new_c = _lstm_gates(pre, c, None,
+                                        forget_bias=forget_bias)
         new_h = jnp.tanh(new_c) * o
     cs_ref[0] = c.astype(cs_ref.dtype)
     c_scr[:] = new_c

@@ -76,34 +76,52 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=5)
     args = ap.parse_args()
 
-    orig = PF._batch_tile
-    run_128, K = build(False)
-
-    def no_halving(b, h, xb_bwd=False, budget=131072):
-        return orig(b, h, xb_bwd=False, budget=budget)
-
-    PF._batch_tile = no_halving
-    try:
-        run_256, _ = build(True)
-    finally:
-        PF._batch_tile = orig
-
     def timed(fn):
         t0 = time.perf_counter()
         drain(fn())
         return time.perf_counter() - t0
 
-    # compile both first; a 256-tile OOM surfaces here as the negative
+    orig = PF._batch_tile
+    run_128, K = build(False)
+    timed(run_128)  # compile the production arm (tile 128)
+
+    # fused_ln_lstm reads the module-global _batch_tile at TRACE time
+    # (build() only constructs lazy jit closures), so the patch must
+    # stay in place through run_256's FIRST invocation — the first
+    # version of this probe restored it before tracing and A/B'd the
+    # production program against itself. The call log proves the
+    # patched tile was actually used.
+    tile_calls = []
+
+    def no_halving(b, h, xb_bwd=False, budget=131072):
+        bt = orig(b, h, xb_bwd=False, budget=budget)
+        tile_calls.append((b, h, xb_bwd, bt))
+        return bt
+
+    PF._batch_tile = no_halving
     try:
-        timed(run_256)
-    except Exception as e:
-        print(f"# tile 256 FAILED to compile/run standalone: {e!r}",
-              file=sys.stderr)
-        rec = {"kind": "probe_dec_bwd_tile", "tile256": "compile_fail",
-               "device_kind": jax.devices()[0].device_kind}
-        print(json.dumps(rec))
-        return 0
-    timed(run_128)
+        run_256, _ = build(True)
+        # compile INSIDE the patched region; a 256-tile OOM surfaces
+        # here as the measured negative
+        try:
+            timed(run_256)
+        except Exception as e:
+            print(f"# tile 256 FAILED to compile/run standalone: {e!r}",
+                  file=sys.stderr)
+            rec = {"kind": "probe_dec_bwd_tile",
+                   "tile256": "compile_fail",
+                   "device_kind": jax.devices()[0].device_kind}
+            print(json.dumps(rec))
+            hist_append(rec)
+            return 0
+    finally:
+        PF._batch_tile = orig
+    # the discriminating call is the backward's (incoming xb_bwd=True,
+    # which production would halve to 128): it must have returned 256
+    assert any(bt == 256 for (_, h, xb, bt) in tile_calls
+               if h == 512 and xb), \
+        f"patched trace never produced a 256 backward tile ({tile_calls})"
+    print(f"# patched-arm _batch_tile calls: {tile_calls}", file=sys.stderr)
 
     ts_128, ts_256 = [], []
     for _ in range(args.reps):

@@ -30,6 +30,33 @@ def make_striped_loader(hps: HParams, host_id: int,
                       global_size=CORPUS_SIZE, num_hosts=num_hosts, seed=0)
 
 
+PC_CLASSES = 3
+
+
+def make_striped_class_loader(hps: HParams, host_id: int,
+                              num_hosts: int) -> DataLoader:
+    """Labeled (3-class) variant of the striped corpus for the
+    multi-host per-class eval check (VERDICT r2 #4)."""
+    seqs, labels = make_synthetic_strokes(CORPUS_SIZE,
+                                          num_classes=PC_CLASSES,
+                                          min_len=8, max_len=20, seed=1)
+    return DataLoader(seqs[host_id::num_hosts], hps,
+                      labels=labels[host_id::num_hosts],
+                      global_size=CORPUS_SIZE, num_hosts=num_hosts, seed=0)
+
+
+def dump_per_class(per: dict, path: str) -> None:
+    """Flatten an ``evaluate_per_class`` result to a keyed npz."""
+    flat = {}
+    for c, m in per.items():
+        if m is None:
+            flat[f"{c}/__none__"] = np.float64(1.0)
+        else:
+            for k, v in m.items():
+                flat[f"{c}/{k}"] = np.float64(v)
+    np.savez(path, **flat)
+
+
 def step_keys(n: int) -> Iterator:
     import jax
 

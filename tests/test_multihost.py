@@ -105,3 +105,40 @@ def test_two_process_dp_matches_single_process(tmp_path, fused):
         np.testing.assert_allclose(
             loaded[0][k], ref[k], rtol=2e-6, atol=2e-7,
             err_msg=f"multi-process vs single-process mismatch: {k}")
+
+    # (4) per-class eval across hosts: identical across processes
+    # (bitwise — same global computation), and equal to a SINGLE-process
+    # sweep over the unstriped corpus up to summation order (the striped
+    # sweep's global batches interleave rows differently, so sums
+    # reassociate; the deterministic non-conditional config makes that
+    # the ONLY difference)
+    from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+    from sketch_rnn_tpu.train import make_per_class_eval_step
+    from sketch_rnn_tpu.train.loop import evaluate_per_class
+    from tests._multihost_common import CORPUS_SIZE, PC_CLASSES
+
+    pcs = [np.load(os.path.join(outdir, f"pc_{r}.npz"))
+           for r in range(nproc)]
+    assert set(pcs[0].files) == set(pcs[1].files) and len(pcs[0].files) > 3
+    for k in pcs[0].files:
+        np.testing.assert_array_equal(pcs[0][k], pcs[1][k],
+                                      err_msg=f"pc cross-process: {k}")
+
+    pc_hps = hps.replace(num_classes=PC_CLASSES, conditional=False)
+    seqs, labels = make_synthetic_strokes(CORPUS_SIZE,
+                                          num_classes=PC_CLASSES,
+                                          min_len=8, max_len=20, seed=1)
+    full_loader = DataLoader(seqs, pc_hps, labels=labels, seed=0)
+    pc_model = SketchRNN(pc_hps)
+    pc_params = pc_model.init_params(jax.random.key(7))
+    pc_step = make_per_class_eval_step(pc_model, pc_hps, mesh)
+    per_ref = evaluate_per_class(pc_params, full_loader, pc_step,
+                                 PC_CLASSES, mesh)
+    for k in pcs[0].files:
+        c, metric = k.split("/", 1)
+        if metric == "__none__":
+            assert per_ref[int(c)] is None
+            continue
+        np.testing.assert_allclose(
+            float(pcs[0][k]), per_ref[int(c)][metric], rtol=1e-5,
+            err_msg=f"pc multi vs single: {k}")
