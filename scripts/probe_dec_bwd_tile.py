@@ -109,6 +109,8 @@ def main() -> int:
             print(f"# tile 256 FAILED to compile/run standalone: {e!r}",
                   file=sys.stderr)
             rec = {"kind": "probe_dec_bwd_tile",
+                   "T": 250, "B": 4096, "H": 512, "D": 5,
+                   "calls_per_dispatch": K,
                    "tile256": "compile_fail",
                    "device_kind": jax.devices()[0].device_kind}
             print(json.dumps(rec))

@@ -76,6 +76,33 @@ def test_hist_best_missing_file_and_no_match(tmp_path, monkeypatch):
                                     "TPU v5 lite", 1, 2) is None
 
 
+def test_should_stop_policy_matrix():
+    """The trial-loop stop policy (VERDICT r2 #1): the early-stop and
+    trial cap are honored only while best-of is plausible; below the
+    70%-of-history line only the budget stops the loop."""
+    stop = bench._should_stop
+    P, IMP = 10.0, 100.0  # best_t values: plausible / implausible vs plaus_t=20
+
+    # plausible: classic early-stop after >=4 trials with 3 non-improving
+    assert stop(4, 3, P, 20.0, 60.0, 480.0, 8) == "early-stop"
+    assert stop(3, 3, P, 20.0, 60.0, 480.0, 8) is None   # too few trials
+    assert stop(4, 2, P, 20.0, 60.0, 480.0, 8) is None   # still improving
+    # plausible: trial cap
+    assert stop(8, 0, P, 20.0, 60.0, 480.0, 8) == "max-trials"
+    # implausible: early-stop and cap are DISABLED...
+    assert stop(6, 5, IMP, 20.0, 60.0, 480.0, 8) is None
+    assert stop(12, 9, IMP, 20.0, 60.0, 480.0, 8) is None
+    # ...only the budget stops it, and labels the slow window
+    assert stop(12, 9, IMP, 20.0, 500.0, 480.0, 8) == "budget-implausible"
+    # budget in the plausible regime keeps the plain label
+    assert stop(3, 1, P, 20.0, 500.0, 480.0, 8) == "budget"
+    # budget never fires before 2 trials (a record needs a best-of)
+    assert stop(1, 1, IMP, 20.0, 500.0, 480.0, 8) is None
+    # no history -> plaus_t is +inf -> always plausible
+    inf = float("inf")
+    assert stop(4, 3, IMP, inf, 60.0, 480.0, 8) == "early-stop"
+
+
 def test_bench_train_rejects_non_divisible_steps():
     """ADVICE r2: steps % steps_per_call != 0 must raise, not silently
     run fewer optimizer steps while computing throughput over `steps`."""
