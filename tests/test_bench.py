@@ -103,6 +103,34 @@ def test_should_stop_policy_matrix():
     assert stop(4, 3, IMP, inf, 60.0, 480.0, 8) == "early-stop"
 
 
+def test_bench_summary_skips_diagnostic_rows(tmp_path, capsys):
+    """Diagnostic rows carrying metric keys must not print as phantom
+    train configurations (r3 review finding) — every non-train/sampler
+    kind is filtered even when its record holds a metric key the
+    summary would otherwise pick up."""
+    from scripts import bench_summary
+
+    hist = tmp_path / "h.jsonl"
+    _write_hist(hist, [
+        {**_BASE, "steps_per_call": 5, "transfer_dtype": "bfloat16",
+         "strokes_per_sec_per_chip": 4.0e6, "mfu": 0.27},
+        {"kind": "profile_breakdown", "dec_model": "layer_norm",
+         "batch_size": 4096, "seq_len": 250,
+         "strokes_per_sec_per_chip": 2.2e6},
+        # a probe row that (hypothetically) gained a metric key must
+        # still be filtered by kind, not by accident of schema
+        {"kind": "probe_dual_encoder", "speedup": 0.997,
+         "strokes_per_sec_per_chip": 2.2e6},
+        {"kind": "sampler", "dec_model": "layer_norm", "batch_size": 64,
+         "full_len": True, "sketches_per_sec": 3500.0},
+    ])
+    assert bench_summary.main([str(hist)]) == 0
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l.strip()]
+    assert len(lines) == 2  # one train row + one sampler row
+    assert not any("2,200,000" in l for l in lines)
+
+
 def test_bench_train_rejects_non_divisible_steps():
     """ADVICE r2: steps % steps_per_call != 0 must raise, not silently
     run fewer optimizer steps while computing throughput over `steps`."""
