@@ -77,8 +77,9 @@ def main() -> int:
     def run_full(sampler, b, L):
         z = jax.random.normal(jax.random.key(1), (b, hps.z_size))
         _, lengths = sampler(params, jax.random.key(2), b, z, None, 0.7)
-        assert int(np.min(np.asarray(lengths))) == L, \
-            f"early exit at {np.asarray(lengths).min()} < {L}"
+        executed = int(np.min(np.asarray(lengths)))
+        if executed != L:  # survives python -O, unlike assert
+            raise RuntimeError(f"early exit at {executed} < {L}")
         return _t(lambda: sampler(params, jax.random.key(2), b, z,
                                   None, 0.7))
 

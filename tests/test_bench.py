@@ -62,6 +62,36 @@ def test_hist_best_pools_across_feed_knobs(tmp_path, monkeypatch):
     assert best == 4.0e6
 
 
+def test_hist_best_legacy_rows_default_resid_dtype(tmp_path, monkeypatch):
+    """Rows predating the resid_dtype knob ran the then-default float32
+    residuals; they must still arm the plausibility gate for float32
+    queries and must NOT pool into bfloat16 ones (ADVICE r3)."""
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    legacy = {k: v for k, v in _BASE.items() if k != "resid_dtype"}
+    _write_hist(hist, [{**legacy, "strokes_per_sec_per_chip": 3.0e6}])
+    monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
+    args = ("layer_norm", 4096, 250, "bfloat16", True, True)
+    tail = ("TPU v5 lite", 1, 2)
+    assert bench._hist_best_strokes(*args, "float32", *tail) == 3.0e6
+    assert bench._hist_best_strokes(*args, "bfloat16", *tail) is None
+
+
+def test_hist_best_ignores_resid_dtype_when_not_fused(tmp_path,
+                                                      monkeypatch):
+    """resid_dtype only affects the fused kernels; on the scan path a
+    row must pool regardless of its (inert) resid label — else the gate
+    silently disarms for non-fused configs (r4 review finding)."""
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    legacy = {k: v for k, v in _BASE.items() if k != "resid_dtype"}
+    _write_hist(hist, [{**legacy, "fused_rnn": False,
+                        "strokes_per_sec_per_chip": 2.0e6}])
+    monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
+    best = bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
+                                    True, False, "bfloat16",
+                                    "TPU v5 lite", 1, 2)
+    assert best == 2.0e6
+
+
 def test_hist_best_missing_file_and_no_match(tmp_path, monkeypatch):
     monkeypatch.setattr(
         bench, "_hist_path", lambda: str(tmp_path / "absent.jsonl"))
