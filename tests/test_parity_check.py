@@ -1,0 +1,79 @@
+"""End-to-end proof of the parity harness (VERDICT r3 #3).
+
+The harness must work the day real data appears, with zero code
+changes — so the whole path (synthetic npz on disk -> preset train ->
+eval sweep -> table row -> reference comparison / exit code) is
+exercised here on a generated corpus. Marked slow: it runs two tiny
+trainings through the real ``train()`` loop.
+"""
+
+import json
+
+import pytest
+
+from scripts import parity_check
+from sketch_rnn_tpu.data.loader import write_synthetic_npz
+
+_TINY = ("batch_size=8,max_seq_len=32,enc_rnn_size=16,dec_rnn_size=16,"
+         "z_size=4,num_mixture=2,enc_model=lstm,fused_rnn=false,"
+         "compute_dtype=float32,save_every=2,eval_every=1000")
+
+
+def _run(tmp_path, capsys, extra):
+    data = tmp_path / "data"
+    data.mkdir()
+    write_synthetic_npz(str(data / "cat.npz"), num_train=24, num_valid=16,
+                        num_test=16, max_len=28)
+    rc = parity_check.main([
+        "--data_dir", str(data), "--steps", "2", "--hparams", _TINY,
+        "--workdir_root", str(tmp_path / "wd"), "--split", "valid",
+        *extra])
+    out = capsys.readouterr().out
+    return rc, json.loads(out.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_end_to_end_on_synthetic_npz(tmp_path, capsys):
+    rc, table = _run(tmp_path, capsys, ["--configs", "uncond_lstm"])
+    assert rc == 0
+    (row,) = table["rows"]
+    assert row["config"] == "uncond_lstm" and row["steps"] == 2
+    assert row["recon"] > 0 and row["kl"] == 0.0  # unconditional: no KL
+    assert "within_tol" not in row  # no reference metrics supplied
+
+
+@pytest.mark.slow
+def test_reference_comparison_gates_exit_code(tmp_path, capsys):
+    """A reference table that cannot match (recon=0) must FAIL the run;
+    resume makes the second config invocation reuse the first's
+    checkpoint rather than retraining."""
+    ref = tmp_path / "ref.json"
+    ref.write_text(json.dumps({"vae": {"recon": 1e-9, "kl": 1e9}}))
+    rc, table = _run(tmp_path, capsys,
+                     ["--configs", "vae", "--reference_json", str(ref)])
+    assert rc == 1
+    (row,) = table["rows"]
+    assert row["within_tol"] is False
+    assert "d_recon_rel" in row and "d_kl_abs" in row
+
+
+def test_compare_row_pure():
+    row = {"config": "vae", "recon": 1.00, "kl": 0.40}
+    ref = {"vae": {"recon": 1.02, "kl": 0.42}}
+    out = parity_check.compare_row(row, ref, tol=0.05)
+    assert out["within_tol"] is True
+    assert out["d_recon_rel"] == pytest.approx(-0.02 / 1.02)
+    assert out["d_kl_abs"] == pytest.approx(-0.02)
+    # outside tolerance
+    out = parity_check.compare_row(row, {"vae": {"recon": 2.0}}, tol=0.05)
+    assert out["within_tol"] is False
+    # unknown config: row passes through untouched
+    out = parity_check.compare_row(row, {"other": {"recon": 1.0}}, 0.05)
+    assert "within_tol" not in out or out["within_tol"] is None
+
+
+def test_unknown_config_rejected(tmp_path, capsys):
+    rc = parity_check.main(["--synthetic", "--configs", "nope"])
+    assert rc == 2
+    rc = parity_check.main(["--configs", "vae"])  # no data source
+    assert rc == 2
