@@ -155,7 +155,9 @@ def cmd_eval(args) -> int:
     from sketch_rnn_tpu.parallel.mesh import make_mesh
     from sketch_rnn_tpu.train import make_eval_step
     from sketch_rnn_tpu.train.loop import evaluate, evaluate_per_class
-    from sketch_rnn_tpu.train.step import make_per_class_eval_step
+    from sketch_rnn_tpu.train.step import (make_multi_eval_step,
+                                           make_multi_per_class_eval_step,
+                                           make_per_class_eval_step)
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
     if args.per_class and hps.num_classes <= 0:
@@ -167,7 +169,10 @@ def cmd_eval(args) -> int:
     loader = {"valid": valid_l, "test": test_l}[args.split]
     mesh = make_mesh(hps)
     eval_step = make_eval_step(model, hps, mesh)
-    ev = evaluate(state.params, loader, eval_step, mesh)
+    eval_k = hps.eval_steps_per_call
+    multi = (None if eval_k == 1
+             else (make_multi_eval_step(model, hps, mesh), eval_k))
+    ev = evaluate(state.params, loader, eval_step, mesh, multi=multi)
     out = {"split": args.split, "step": meta["step"],
            **{k: round(v, 6) for k, v in sorted(ev.items())}}
     if args.per_class:
@@ -176,8 +181,11 @@ def cmd_eval(args) -> int:
         # batch schedule is identical on every host), unlike the old
         # filter_by_label loop. Classes with no examples report null.
         pc_step = make_per_class_eval_step(model, hps, mesh)
+        pc_multi = (None if eval_k == 1 else
+                    (make_multi_per_class_eval_step(model, hps, mesh),
+                     eval_k))
         per = evaluate_per_class(state.params, loader, pc_step,
-                                 hps.num_classes, mesh)
+                                 hps.num_classes, mesh, multi=pc_multi)
         out["per_class"] = {
             str(c): (None if r is None
                      else {k: round(v, 6) for k, v in sorted(r.items())})

@@ -82,6 +82,11 @@ class HParams:
     #   comparable to step compute (remote/tunneled runtimes, small
     #   models), dispatch cost drops by K x. Logging/eval granularity
     #   coarsens to every K steps.
+    eval_steps_per_call: int = 8       # eval-sweep analogue of
+    #   steps_per_call: the sweep scans K eval batches per jitted call
+    #   (one dispatch + one host fetch per K batches). Same per-index
+    #   keys and weighting as the per-batch sweep; results agree to
+    #   ~1e-6 float reassociation noise. 1 restores the per-batch path.
 
     # --- TPU / parallelism (component 18) ---
     transfer_dtype: str = "float32"    # host->device dtype of the TRAIN
@@ -131,6 +136,9 @@ class HParams:
         if self.steps_per_call < 1:
             raise ValueError(
                 f"steps_per_call must be >= 1, got {self.steps_per_call}")
+        if self.eval_steps_per_call < 1:
+            raise ValueError(f"eval_steps_per_call must be >= 1, got "
+                             f"{self.eval_steps_per_call}")
 
     # -- overrides ---------------------------------------------------------
 

@@ -203,18 +203,10 @@ def make_multi_train_step(model, hps: HParams,
                    donate_argnums=0)
 
 
-def make_eval_step(model, hps: HParams,
-                   mesh: Optional[Mesh] = None) -> EvalFn:
-    """Jitted eval: dropout off, pen CE masked, KL un-annealed (weight=1).
-
-    Mirrors the reference's weight-tied eval graph (SURVEY §3.4) — here
-    simply the same pure loss with ``train=False`` compiled as a second
-    XLA program. Returned metrics use the eval normalization that is the
-    parity surface: recon-NLL, KL (floored) and total with full KL weight.
-    On a mesh the sweep runs under ``shard_map`` like training; psum'd
-    global sums make every weighted metric exactly the global-batch value
-    regardless of how the zero-weight wrap rows fall across shards.
-    """
+def _make_eval_core(model, hps: HParams, mesh: Optional[Mesh]):
+    """Un-jitted ``(params, batch, key) -> metrics`` eval body (shard_map'd
+    over the mesh when given); shared by the single-batch and the
+    K-batch (scan) jitted wrappers so the two cannot drift."""
 
     def eval_fn(params, batch: Batch, key: jax.Array,
                 axis_name: Optional[str] = None) -> Metrics:
@@ -238,19 +230,97 @@ def make_eval_step(model, hps: HParams,
         return metrics
 
     if mesh is None:
-        return jax.jit(eval_fn)
-
-    sharded = jax.shard_map(
+        return eval_fn
+    return jax.shard_map(
         lambda params, batch, key: eval_fn(params, batch, key, DATA_AXIS),
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P()),
         out_specs=P(),
         check_vma=_vma_check(hps),
     )
+
+
+def _jit_single_eval(core, mesh: Optional[Mesh]) -> EvalFn:
+    if mesh is None:
+        return jax.jit(core)
     repl = replicated_sharding(mesh)
-    data = batch_sharding(mesh)
-    return jax.jit(sharded, in_shardings=(repl, data, repl),
+    return jax.jit(core, in_shardings=(repl, batch_sharding(mesh), repl),
                    out_shardings=repl)
+
+
+def _jit_multi_eval(core, mesh: Optional[Mesh]):
+    """K-batch eval call: ``(params, batches, key, idx) -> metrics`` with
+    every metric stacked ``[K, ...]``.
+
+    ``batches`` is a stacked pytree (leading axis K), ``idx`` the
+    absolute batch indices ``[K]``; batch ``idx[j]`` uses
+    ``fold_in(key, idx[j])`` — exactly the key the single-batch sweep
+    would use, so the two paths agree up to XLA reassociation noise
+    (~1e-6; the scan compiles as a different program). One
+    dispatch + one host fetch per K batches amortizes the tunneled
+    runtime's 10-130 ms per-call launch cost the same way
+    ``make_multi_train_step`` does for training (VERDICT r3 #5).
+    """
+
+    def multi_fn(params, batches: Batch, key: jax.Array, idx: jax.Array):
+        def body(_, xs):
+            batch_i, i = xs
+            return None, core(params, batch_i, jax.random.fold_in(key, i))
+
+        _, stacked = jax.lax.scan(body, None, (batches, idx))
+        return stacked
+
+    if mesh is None:
+        return jax.jit(multi_fn)
+    repl = replicated_sharding(mesh)
+    return jax.jit(multi_fn,
+                   in_shardings=(repl, stacked_batch_sharding(mesh),
+                                 repl, repl),
+                   out_shardings=repl)
+
+
+def make_eval_step(model, hps: HParams,
+                   mesh: Optional[Mesh] = None) -> EvalFn:
+    """Jitted eval: dropout off, pen CE masked, KL un-annealed (weight=1).
+
+    Mirrors the reference's weight-tied eval graph (SURVEY §3.4) — here
+    simply the same pure loss with ``train=False`` compiled as a second
+    XLA program. Returned metrics use the eval normalization that is the
+    parity surface: recon-NLL, KL (floored) and total with full KL weight.
+    On a mesh the sweep runs under ``shard_map`` like training; psum'd
+    global sums make every weighted metric exactly the global-batch value
+    regardless of how the zero-weight wrap rows fall across shards.
+    """
+    return _jit_single_eval(_make_eval_core(model, hps, mesh), mesh)
+
+
+def make_multi_eval_step(model, hps: HParams,
+                         mesh: Optional[Mesh] = None):
+    """K-batch jitted eval (see :func:`_jit_multi_eval`); pair it with
+    ``hps.eval_steps_per_call`` as ``evaluate``'s ``multi=`` argument."""
+    return _jit_multi_eval(_make_eval_core(model, hps, mesh), mesh)
+
+
+def _make_per_class_core(model, hps: HParams, mesh: Optional[Mesh]):
+    """Un-jitted per-class eval body (see :func:`_make_eval_core`)."""
+
+    def eval_fn(params, batch: Batch, key: jax.Array,
+                axis_name: Optional[str] = None) -> Metrics:
+        if axis_name is not None:
+            # decorrelate per-shard z draws, as in make_eval_step
+            key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+        return model.eval_metrics_per_class(params, batch, key,
+                                            axis_name=axis_name)
+
+    if mesh is None:
+        return eval_fn
+    return jax.shard_map(
+        lambda params, batch, key: eval_fn(params, batch, key, DATA_AXIS),
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P()),
+        out_specs=P(),
+        check_vma=_vma_check(hps),
+    )
 
 
 def make_per_class_eval_step(model, hps: HParams,
@@ -264,26 +334,10 @@ def make_per_class_eval_step(model, hps: HParams,
     striping). Per-class reduction happens inside the forward program
     (``model.eval_metrics_per_class``), psum'd over the mesh axis.
     """
+    return _jit_single_eval(_make_per_class_core(model, hps, mesh), mesh)
 
-    def eval_fn(params, batch: Batch, key: jax.Array,
-                axis_name: Optional[str] = None) -> Metrics:
-        if axis_name is not None:
-            # decorrelate per-shard z draws, as in make_eval_step
-            key = jax.random.fold_in(key, jax.lax.axis_index(axis_name))
-        return model.eval_metrics_per_class(params, batch, key,
-                                            axis_name=axis_name)
 
-    if mesh is None:
-        return jax.jit(eval_fn)
-
-    sharded = jax.shard_map(
-        lambda params, batch, key: eval_fn(params, batch, key, DATA_AXIS),
-        mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P()),
-        out_specs=P(),
-        check_vma=_vma_check(hps),
-    )
-    repl = replicated_sharding(mesh)
-    data = batch_sharding(mesh)
-    return jax.jit(sharded, in_shardings=(repl, data, repl),
-                   out_shardings=repl)
+def make_multi_per_class_eval_step(model, hps: HParams,
+                                   mesh: Optional[Mesh] = None):
+    """K-batch jitted per-class eval (metrics stacked ``[K, C]``)."""
+    return _jit_multi_eval(_make_per_class_core(model, hps, mesh), mesh)
