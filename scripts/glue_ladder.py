@@ -1,0 +1,288 @@
+"""Non-kernel ("glue") time attribution for the cached compute step.
+
+The r4 roofline (scripts/roofline.py) measured the phase kernels
+directly and found they do NOT add up to the r3 ladder's attribution:
+encoder kernels 2x27.4 = 54.9 ms and decoder(+xb) kernels 97.7 ms vs
+ladder shares of 123 and 110.6 ms — because profile_breakdown's
+``no_enc`` rung sets ``conditional=False``, which ALSO removes the
+decoder's x_bias path and thereby switches the decoder backward to the
+cheaper non-xb tile (256 vs 128): the difference rung credited to "the
+encoder" silently contained real decoder cost plus every piece of
+conditional-path glue (length-aware reversal gathers, final-state
+gathers, posterior heads, z sampling, xb projection).
+
+This script pins the glue honestly, all K-chained and timed by
+DIFFERENTIAL (t(K2)-t(K1)) so dispatch stalls and loop-invariant setup
+cancel:
+
+1. ``full``       — cached full train step (window consistency check
+                    vs the committed ~258 ms).
+2. ``stub_mdn``   — MDN head replaced by a trivial reduction.
+3. ``no_enc_xb``  — stub-MDN with ``conditional=False`` but
+                    ``num_classes=75``: the class embedding keeps the
+                    decoder's x_bias path (and its tile-128 backward)
+                    ALIVE, so stub_mdn - no_enc_xb is the honest
+                    encoder+encoder-glue share; no_enc_xb itself is the
+                    honest decoder(+xb)+input-glue share.
+4. ``enc_path``   — ``model.encode`` fwd+bwd alone (kernels + reversal
+                    gather + final-state gathers + mu/presig heads):
+                    minus the measured kernels = encoder glue.
+5. micro rungs    — the two take_along_axis patterns (input reversal
+                    fwd+bwd, final-state gather fwd+bwd) that are the
+                    main glue suspects.
+
+Usage::
+
+    python scripts/glue_ladder.py [--reps 5] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain, hist_append  # noqa: E402
+
+
+def _median(fn, *args, reps, warmup=2):
+    for _ in range(warmup):
+        drain(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        drain(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--seq_len", type=int, default=250)
+    ap.add_argument("--k1", type=int, default=2)
+    ap.add_argument("--k2", type=int, default=8)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    reps, K1, K2 = args.reps, args.k1, args.k2
+
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.ops import mdn
+    from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+    from sketch_rnn_tpu.train import make_train_state
+    from sketch_rnn_tpu.train.step import make_multi_train_step
+
+    base = get_default_hparams().replace(
+        batch_size=args.batch, max_seq_len=args.seq_len,
+        compute_dtype="bfloat16", fused_rnn=True,
+        fused_residual_dtype="bfloat16", remat=True)
+    B, T = args.batch, args.seq_len
+    key = jax.random.key(0)
+
+    def device_batch(hps, k=None):
+        """Synthetic cached batch, stacked [k, ...] when k is given."""
+        kk = jax.random.fold_in(key, 9)
+        sh = (B, T + 1, 5) if k is None else (k, B, T + 1, 5)
+        strokes = jax.random.normal(kk, sh, jnp.float32) * 0.1
+        pen = jnp.zeros(sh[:-1] + (3,), jnp.float32).at[..., 0].set(1.0)
+        strokes = jnp.concatenate([strokes[..., :2], pen], axis=-1)
+        seq_len = jnp.full(sh[:-2], T - 10, jnp.int32)
+        batch = {"strokes": strokes, "seq_len": seq_len,
+                 "weights": jnp.ones(sh[:-2], jnp.float32)}
+        if hps.num_classes > 0:
+            batch["labels"] = jnp.zeros(sh[:-2], jnp.int32)
+        return batch
+
+    def step_ms(hps, loss_override=None, label=""):
+        """Per-step ms of the cached K-step train call, K-differential."""
+        model = SketchRNN(hps)
+        if loss_override is not None:
+            model.loss = loss_override.__get__(model, SketchRNN)
+        mesh = make_mesh(hps)
+
+        def at(k):
+            step = make_multi_train_step(model, hps, mesh,
+                                         steps_per_call=k)
+            batch = shard_batch(device_batch(hps, k), mesh, stacked=True)
+            state = make_train_state(model, hps, jax.random.key(0))
+            kk = jax.random.key(1)
+
+            # donated state: rethread through warmup + reps
+            def run(state):
+                state, m = step(state, batch, kk)
+                return state, m["loss"]
+
+            for _ in range(2):
+                state, loss = run(state)
+            float(loss)
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                state, loss = run(state)
+                float(loss)
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+
+        ms = (at(K2) - at(K1)) / (K2 - K1) * 1e3
+        print(f"#   {label:12s} {ms:8.2f} ms/step", file=sys.stderr)
+        return ms
+
+    # the same stub profile_breakdown uses: keeps decoder/encoder grads
+    # and the KL path, removes the GMM head math
+    def loss_stub(self, params, batch, key, kl_weight, train=True,
+                  axis_name=None):
+        hps_ = self.hps
+        weights = batch.get("weights")
+        mp, x_target, labels, mu, presig = self._forward(
+            params, batch, key, train)
+        if hps_.conditional:
+            kl_raw = mdn.kl_loss(mu, presig, weights=weights,
+                                 axis_name=axis_name)
+        else:
+            kl_raw = jnp.float32(0.0)
+        b = mdn._global_sum(jnp.float32(x_target.shape[1]), axis_name)
+        r = mdn._global_sum(sum(jnp.sum(x) for x in mp), axis_name) \
+            / (hps_.max_seq_len * b)
+        total = r + kl_weight * kl_raw
+        return total, {"loss": total,
+                       "kl_weight": jnp.asarray(kl_weight, jnp.float32)}
+
+    # encoder-only training rung: z/KL path live, decoder dead-coded —
+    # the in-situ complement of no_enc_xb. If enc_only + no_enc_xb falls
+    # well short of stub_mdn, the gap is an interaction cost that
+    # belongs to NEITHER phase alone (scheduling/memory pressure).
+    def loss_enc_only(self, params, batch, key, kl_weight, train=True,
+                      axis_name=None):
+        hps_ = self.hps
+        weights = batch.get("weights")
+        strokes = jnp.transpose(batch["strokes"], (1, 0, 2)
+                                ).astype(jnp.float32)
+        x_in = strokes[:-1]
+        kenc, kz, _ = jax.random.split(key, 3)
+        mu, presig = self.encode(params, x_in, batch["seq_len"],
+                                 key=kenc, train=train)
+        kl_raw = mdn.kl_loss(mu, presig, weights=weights,
+                             axis_name=axis_name)
+        z = self.sample_z(mu, presig, kz)
+        b = mdn._global_sum(jnp.float32(x_in.shape[1]), axis_name)
+        total = kl_weight * kl_raw + mdn._global_sum(
+            jnp.sum(z), axis_name) / b * 1e-3
+        return total, {"loss": total,
+                       "kl_weight": jnp.asarray(kl_weight, jnp.float32)}
+
+    full = step_ms(base, label="full")
+    full_nodrop = step_ms(base.replace(use_recurrent_dropout=False),
+                          label="full_nodrop")
+    stub = step_ms(base, loss_override=loss_stub, label="stub_mdn")
+    enc_only = step_ms(base, loss_override=loss_enc_only, label="enc_only")
+    # conditional off BUT class-conditional on: the class embedding keeps
+    # the decoder x_bias path (and its halved backward tile) alive
+    noenc_xb = step_ms(base.replace(conditional=False, num_classes=75),
+                       loss_override=loss_stub, label="no_enc_xb")
+    # legacy rung for comparison: x_bias path also gone (the r3 ladder's
+    # attribution error is noenc_xb - noenc_plain)
+    noenc_plain = step_ms(base.replace(conditional=False),
+                          loss_override=loss_stub, label="no_enc_plain")
+
+    # ---- encoder path alone (kernels + reversal + gathers + heads) -----
+    model = SketchRNN(base)
+    params = model.init_params(jax.random.key(0))
+    x_tm = jax.random.normal(jax.random.fold_in(key, 3), (T, B, 5),
+                             jnp.float32) * 0.1
+    seq_len = jnp.full((B,), T - 10, jnp.int32)
+
+    def enc_loss(params, x):
+        mu, presig = model.encode(params, x, seq_len,
+                                  key=jax.random.key(2), train=True)
+        return jnp.sum(mu) + jnp.sum(presig)
+
+    def chain(fn, x0, k):
+        def body(c, _):
+            x, acc = c
+            s = fn(x)
+            return (x + (s * 1e-24).astype(x.dtype), acc + s), None
+        f = jax.jit(functools.partial(
+            lambda c, n: jax.lax.scan(body, c, None, length=n), n=k))
+        return _median(f, (x0, jnp.float32(0.0)), reps=reps)
+
+    def enc_call(x):
+        g = jax.grad(enc_loss)(params, x)
+        return g["mu_w"][0, 0]
+
+    enc_path = (chain(enc_call, x_tm, K2) - chain(enc_call, x_tm, K1)) \
+        / (K2 - K1) * 1e3
+    print(f"#   {'enc_path':12s} {enc_path:8.2f} ms (fwd+bwd, both dirs "
+          f"incl. reversal/gathers/heads)", file=sys.stderr)
+
+    # ---- micro rungs: the two gather patterns --------------------------
+    idx = jnp.arange(T)[:, None]
+    rev_idx = jnp.where(idx < seq_len[None, :],
+                        seq_len[None, :] - 1 - idx, idx)
+
+    def rev_loss(x):
+        xr = jnp.take_along_axis(x, rev_idx[:, :, None], axis=0)
+        return jnp.sum(xr * 1.0001)
+
+    def rev_call(x):
+        return jax.grad(rev_loss)(x)[0, 0, 0]
+
+    rev_ms = (chain(rev_call, x_tm, K2) - chain(rev_call, x_tm, K1)) \
+        / (K2 - K1) * 1e3
+
+    hs = jax.random.normal(jax.random.fold_in(key, 4), (T, B, 256),
+                           jnp.bfloat16) * 0.1
+    last = jnp.clip(seq_len - 1, 0, T - 1)
+
+    def gather_loss(h):
+        hf = jnp.take_along_axis(
+            h, last[None, :, None].repeat(h.shape[-1], -1), axis=0)[0]
+        return jnp.sum(hf.astype(jnp.float32))
+
+    def gather_call(h):
+        return jax.grad(gather_loss)(h)[0, 0, 0].astype(jnp.float32)
+
+    gather_ms = (chain(gather_call, hs, K2) - chain(gather_call, hs, K1)) \
+        / (K2 - K1) * 1e3
+    print(f"#   {'xs_rev':12s} {rev_ms:8.2f} ms fwd+bwd   "
+          f"{'h_gather':12s} {gather_ms:8.2f} ms fwd+bwd (one dir)",
+          file=sys.stderr)
+
+    enc_share = stub - noenc_xb
+    rec = {
+        "kind": "glue_ladder",
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_size": B, "seq_len": T, "reps": reps,
+        "k_pair": [K1, K2],
+        "full_ms": round(full, 2),
+        "full_nodrop_ms": round(full_nodrop, 2),
+        "stub_mdn_ms": round(stub, 2),
+        "enc_only_ms": round(enc_only, 2),
+        "no_enc_xb_ms": round(noenc_xb, 2),
+        "no_enc_plain_ms": round(noenc_plain, 2),
+        "enc_path_ms": round(enc_path, 2),
+        "xs_rev_gather_ms": round(rev_ms, 2),
+        "h_gather_ms": round(gather_ms, 2),
+        "mdn_share_ms": round(full - stub, 2),
+        "honest_encoder_share_ms": round(enc_share, 2),
+        "r3_ladder_attribution_error_ms": round(noenc_xb - noenc_plain, 2),
+    }
+    print(json.dumps(rec, indent=2))
+    if args.json:
+        hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,456 @@
+"""Per-phase roofline reconciliation on the real chip (VERDICT r3 #1).
+
+The claim "MFU 0.27-0.30 is the structural ceiling for this
+architecture on v5e" was asserted from three closed probe negatives;
+this script turns it into arithmetic. For each phase of the cached
+compute step (encoder = ``fused_lstm_seq`` x2 directions, decoder =
+``fused_ln_lstm`` + x_bias) it measures, on the real chip:
+
+1. **The standalone kernels** (fwd, and fwd+bwd via ``jax.grad``),
+   chained K deep inside a ``lax.scan`` with a data dependency between
+   iterations, timed at two K values — the differential kills both the
+   per-call dispatch stall and any loop-invariant setup, the scan
+   bounds residual liveness to one call.
+2. **Scan replicas of the per-grid-step compute** outside Pallas: the
+   kernel's exact per-step math (reusing ``pallas_fused``'s gate
+   functions), split into matmul-only and gates-only arms, scanned
+   with ``unroll=8`` so the XLA loop-carry HBM traffic amortizes to
+   noise. Replica-step x grid-count predicts the kernel's compute
+   floor; the matmul/gates split attributes it to MXU vs VPU.
+3. **An HBM stream anchor** (bf16 read reduction) to price the
+   kernels' residual-stream bytes from the analytic model
+   (``utils/roofline.py``).
+
+The reconciliation table then shows, per phase and pass:
+``measured ~= grid x replica_step + HBM + unexplained``, with the
+padded-pass MXU model as the "if only matmuls mattered" floor. The
+conclusion (written to ARCHITECTURE.md) is whichever term carries the
+time. Also isolates the in-kernel PRNG dropout cost (decoder measured
+with and without seed).
+
+Timing discipline: host-value drain after every call
+(``scripts/_measure.drain``); every quoted number is a median over
+``--reps`` differential pairs. Run in a good window and sanity-check
+the phase sums against the committed ladder (README "Where the step
+time goes": encoder ~123 ms, decoder ~108-111 ms, cached ~258 ms).
+
+Usage::
+
+    python scripts/roofline.py [--reps 5] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain, hist_append  # noqa: E402
+
+
+def _median_time(fn, *args, reps: int, warmup: int = 2) -> float:
+    for _ in range(warmup):
+        drain(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        drain(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def _scan_step_time(body, carry, reps: int, l1: int = 512,
+                    l2: int = 2560) -> float:
+    """Per-iteration seconds of ``lax.scan(body, carry)`` by length
+    differential (dispatch + warmup constants cancel); unroll=8 keeps
+    the XLA loop-carry HBM round-trip amortized below the signal."""
+    def at(length):
+        f = jax.jit(functools.partial(
+            lambda c, n: jax.lax.scan(body, c, None, length=n, unroll=8),
+            n=length))
+        return _median_time(f, carry, reps=reps)
+    return (at(l2) - at(l1)) / (l2 - l1)
+
+
+def _chain_call_time(make_body, init, reps: int, k1: int = 2,
+                     k2: int = 8) -> float:
+    """Per-call seconds of a kernel invocation chained inside lax.scan
+    (sequential by construction, memory bounded to one call), by K
+    differential."""
+    body = make_body()
+
+    def at(k):
+        f = jax.jit(functools.partial(
+            lambda c, n: jax.lax.scan(body, c, None, length=n), n=k))
+        return _median_time(f, init, reps=reps)
+    return (at(k2) - at(k1)) / (k2 - k1)
+
+
+class _Acc:
+    """Ref-shim for ``_ln_lstm_bwd_gates``'s ``ref[j] += v`` parameter
+    accumulation, so the replica reuses the kernel's exact backward math
+    (op parity by construction). Accumulated values are folded into the
+    scan carry by the caller so XLA cannot dead-code the sums."""
+
+    def __init__(self):
+        self.d = {}
+
+    def __getitem__(self, j):
+        return self.d.get(j, 0.0)
+
+    def __setitem__(self, j, v):
+        self.d[j] = v
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--seq_len", type=int, default=250)
+    ap.add_argument("--enc_ms", type=float, default=123.0,
+                    help="ladder-measured encoder share (context row)")
+    ap.add_argument("--dec_ms", type=float, default=110.6,
+                    help="ladder-measured decoder share (context row)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.ops import pallas_fused as PF
+    from sketch_rnn_tpu.utils import flops as F
+    from sketch_rnn_tpu.utils import roofline as R
+
+    hps = get_default_hparams().replace(
+        batch_size=args.batch, max_seq_len=args.seq_len,
+        compute_dtype="bfloat16", fused_rnn=True,
+        fused_residual_dtype="bfloat16")
+    kind = jax.devices()[0].device_kind
+    peak = F.peak_flops_per_chip(kind)
+    if peak is None:
+        print(f"unknown device kind {kind!r}: no peak FLOP/s; the "
+              f"reconciliation needs the real chip", file=sys.stderr)
+        return 2
+    reps = args.reps
+    rd = jnp.bfloat16
+    key = jax.random.key(0)
+
+    enc = R.encoder_geometry(hps)
+    dec = R.decoder_geometry(hps)
+
+    # ---- anchors ----------------------------------------------------------
+    # size differential: a single absolute timing would fold the tunnel's
+    # 10-130 ms dispatch stall into a ~1.5 ms reduction and report GB/s
+    # off by 10-100x (the first run of this script measured "11 GB/s")
+    red = jax.jit(lambda x: jnp.sum(x, dtype=jnp.float32))
+    big = jnp.ones((1024, 1024, 1024), jnp.bfloat16)   # 2 GiB
+    small = jnp.ones((256, 1024, 1024), jnp.bfloat16)  # 0.5 GiB
+    t_big = _median_time(red, big, reps=reps)
+    t_small = _median_time(red, small, reps=reps)
+    hbm_gbps = (big.size - small.size) * 2 / (t_big - t_small) / 1e9
+    del big, small
+    print(f"# HBM stream anchor: {hbm_gbps:.0f} GB/s", file=sys.stderr)
+
+    # ---- shared test tensors ---------------------------------------------
+    def w(shape, scale, dtype=jnp.bfloat16, k=1):
+        return (scale * jax.random.normal(jax.random.fold_in(key, k),
+                                          shape)).astype(dtype)
+
+    eh, dh_, d5 = hps.enc_rnn_size, hps.dec_rnn_size, 5
+    # encoder (vanilla LSTM, H=256)
+    e_wx, e_wh = w((d5, 4 * eh), 0.3, k=1), w((eh, 4 * eh), 0.05, k=2)
+    e_b2 = jnp.zeros((1, 4 * eh), jnp.float32)
+    e_x = w((enc.tile_fwd, d5), 1.0, k=3)
+    # decoder (LayerNorm LSTM, H=512) + x_bias
+    l_wx, l_wh = w((d5, 4 * dh_), 0.3, k=4), w((dh_, 4 * dh_), 0.05, k=5)
+    l_gam = jnp.ones((4, dh_), jnp.float32)
+    l_bet = jnp.zeros((4, dh_), jnp.float32)
+    l_gc2 = jnp.ones((1, dh_), jnp.float32)
+    l_bc2 = jnp.zeros((1, dh_), jnp.float32)
+    l_x_f = w((dec.tile_fwd, d5), 1.0, k=6)
+    l_x_b = w((dec.tile_bwd, d5), 1.0, k=7)
+    l_xb_f = w((dec.tile_fwd, 4 * dh_), 0.1, jnp.float32, k=8)
+    l_xb_b = w((dec.tile_bwd, 4 * dh_), 0.1, jnp.float32, k=9)
+
+    bf = jnp.bfloat16
+
+    # ---- encoder replicas (per grid step, tile batch) ---------------------
+    def enc_full_fwd(c, _):
+        cc, hh = c
+        pre = (jnp.dot(e_x, e_wx, preferred_element_type=jnp.float32)
+               + e_b2[0]
+               + jnp.dot(hh.astype(bf), e_wh,
+                         preferred_element_type=jnp.float32))
+        _, _, _, o, nc = PF._lstm_gates(pre, cc, None, forget_bias=1.0)
+        return (nc, jnp.tanh(nc) * o), None
+
+    def enc_mxu_fwd(hh, _):
+        pre = (jnp.dot(e_x, e_wx, preferred_element_type=jnp.float32)
+               + jnp.dot(hh.astype(bf), e_wh,
+                         preferred_element_type=jnp.float32))
+        return pre[:, :eh] * 0.05, None
+
+    def enc_vpu_fwd(c, _):
+        cc, hh = c
+        pre = jnp.concatenate([hh, hh, hh, hh], axis=-1) + e_b2[0]
+        _, _, _, o, nc = PF._lstm_gates(pre, cc, None, forget_bias=1.0)
+        return (nc, jnp.tanh(nc) * o), None
+
+    def enc_full_bwd(c, _):
+        dc, dh, dwx, db, dwh = c
+        h_prev, c_prev = dh * 0.5, dc * 0.5
+        d_pre, dc_next = PF._lstm_step_bwd_math(
+            e_x, h_prev, c_prev, dh, dc, None, e_wx, e_b2, e_wh, None,
+            forget_bias=1.0)
+        d_pre_c = d_pre.astype(bf)
+        dwx = dwx + jnp.dot(e_x.T, d_pre_c,
+                            preferred_element_type=jnp.float32)
+        db = db + jnp.sum(d_pre, axis=0)
+        dh_next = jnp.dot(d_pre_c, e_wh.T,
+                          preferred_element_type=jnp.float32)
+        dwh = dwh + jnp.dot(h_prev.astype(bf).T, d_pre_c,
+                            preferred_element_type=jnp.float32)
+        return (dc_next, dh_next * 0.05, dwx, db, dwh), None
+
+    z = lambda *s: jnp.zeros(s, jnp.float32)
+    e_carry2 = (z(enc.tile_fwd, eh), z(enc.tile_fwd, eh))
+    t_e_full_f = _scan_step_time(enc_full_fwd, e_carry2, reps=reps)
+    t_e_mxu_f = _scan_step_time(enc_mxu_fwd, z(enc.tile_fwd, eh), reps=reps)
+    t_e_vpu_f = _scan_step_time(enc_vpu_fwd, e_carry2, reps=reps)
+    t_e_full_b = _scan_step_time(
+        enc_full_bwd,
+        (z(enc.tile_bwd, eh), z(enc.tile_bwd, eh), z(d5, 4 * eh),
+         z(4 * eh), z(eh, 4 * eh)), reps=reps)
+    print(f"# enc replica us/step: full_f {t_e_full_f * 1e6:.2f} "
+          f"mxu_f {t_e_mxu_f * 1e6:.2f} vpu_f {t_e_vpu_f * 1e6:.2f} "
+          f"full_b {t_e_full_b * 1e6:.2f}", file=sys.stderr)
+
+    # ---- decoder replicas -------------------------------------------------
+    def dec_full_fwd(c, _):
+        cc, hh = c
+        pre = (jnp.dot(l_x_f, l_wx, preferred_element_type=jnp.float32)
+               + jnp.dot(hh.astype(bf), l_wh,
+                         preferred_element_type=jnp.float32)
+               + l_xb_f)
+        nc, nh = PF._ln_gates(pre, cc, None, l_gam, l_bet, l_gc2, l_bc2,
+                              forget_bias=1.0, want_residuals=False)
+        return (nc, nh), None
+
+    def dec_mxu_fwd(hh, _):
+        pre = (jnp.dot(l_x_f, l_wx, preferred_element_type=jnp.float32)
+               + jnp.dot(hh.astype(bf), l_wh,
+                         preferred_element_type=jnp.float32))
+        return pre[:, :dh_] * 0.05, None
+
+    def dec_vpu_fwd(c, _):
+        cc, hh = c
+        pre = jnp.concatenate([hh, hh, hh, hh], axis=-1) + l_xb_f
+        nc, nh = PF._ln_gates(pre, cc, None, l_gam, l_bet, l_gc2, l_bc2,
+                              forget_bias=1.0, want_residuals=False)
+        return (nc, nh), None
+
+    def dec_full_bwd(c, _):
+        (dc, dh, dwx, dwh, dxb, dgam, dbet, dgc, dbc) = c
+        h_prev, c_prev = dh * 0.5, dc * 0.5
+        pre = (jnp.dot(l_x_b, l_wx, preferred_element_type=jnp.float32)
+               + jnp.dot(h_prev.astype(bf), l_wh,
+                         preferred_element_type=jnp.float32)
+               + l_xb_b)
+        ln_res = PF._ln_gates(pre, c_prev, None, l_gam, l_bet, l_gc2,
+                              l_bc2, forget_bias=1.0, want_residuals=True)
+        a_gam, a_bet, a_gc, a_bc = _Acc(), _Acc(), _Acc(), _Acc()
+        d_pre, dc_next = PF._ln_lstm_bwd_gates(
+            dh, dc, c_prev, None, ln_res, l_gam, l_gc2,
+            a_gam, a_bet, a_gc, a_bc)
+        d_pre_c = d_pre.astype(bf)
+        dxb = dxb + d_pre
+        dx = jnp.dot(d_pre_c, l_wx.T, preferred_element_type=jnp.float32)
+        dwx = dwx + jnp.dot(l_x_b.T, d_pre_c,
+                            preferred_element_type=jnp.float32)
+        dh_next = (jnp.dot(d_pre_c, l_wh.T,
+                           preferred_element_type=jnp.float32)
+                   + dx[:, :1] * 0.0)  # keep dx live
+        dwh = dwh + jnp.dot(h_prev.astype(bf).T, d_pre_c,
+                            preferred_element_type=jnp.float32)
+        dgam = dgam + jnp.stack([a_gam[j] for j in range(4)])
+        dbet = dbet + jnp.stack([a_bet[j] for j in range(4)])
+        dgc, dbc = dgc + a_gc[0], dbc + a_bc[0]
+        return (dc_next, dh_next * 0.05, dwx, dwh, dxb,
+                dgam, dbet, dgc, dbc), None
+
+    d_carry2 = (z(dec.tile_fwd, dh_), z(dec.tile_fwd, dh_))
+    t_d_full_f = _scan_step_time(dec_full_fwd, d_carry2, reps=reps)
+    t_d_mxu_f = _scan_step_time(dec_mxu_fwd, z(dec.tile_fwd, dh_),
+                                reps=reps)
+    t_d_vpu_f = _scan_step_time(dec_vpu_fwd, d_carry2, reps=reps)
+    t_d_full_b = _scan_step_time(
+        dec_full_bwd,
+        (z(dec.tile_bwd, dh_), z(dec.tile_bwd, dh_), z(d5, 4 * dh_),
+         z(dh_, 4 * dh_), z(dec.tile_bwd, 4 * dh_), z(4, dh_), z(4, dh_),
+         z(dh_), z(dh_)), reps=reps)
+    print(f"# dec replica us/step: full_f {t_d_full_f * 1e6:.2f} "
+          f"mxu_f {t_d_mxu_f * 1e6:.2f} vpu_f {t_d_vpu_f * 1e6:.2f} "
+          f"full_b {t_d_full_b * 1e6:.2f}", file=sys.stderr)
+
+    # ---- standalone kernels (one encoder direction; x2 in the table) ------
+    B, T = hps.batch_size, hps.max_seq_len
+    e_xs = w((T, B, d5), 1.0, k=10)
+    e_c0 = z(B, eh)
+    l_xs = w((T, B, d5), 1.0, k=11)
+    l_c0 = z(B, dh_)
+    l_xb = w((B, 4 * dh_), 0.1, jnp.float32, k=12)
+    seed = jnp.asarray(7, jnp.int32)
+    keep = hps.recurrent_dropout_keep
+
+    def _dep(x, s):
+        # data dependency between chained calls; adds one elementwise
+        # pass over xs (~40 MB/call, <1% of a kernel call's traffic)
+        return x + (s * 1e-24).astype(x.dtype)
+
+    def enc_fwd_body():
+        def body(c, _):
+            xs, acc = c
+            hs = PF.fused_lstm_seq(xs, e_wx, e_b2[0], e_wh, e_c0, e_c0,
+                                   1.0, None, None, 1.0, rd)
+            s = jnp.sum(hs[0, 0, :8].astype(jnp.float32))
+            return (_dep(xs, s), acc + s), None
+        return body
+
+    def enc_fb_body():
+        def loss(ws, xs):
+            hs = PF.fused_lstm_seq(xs, ws[0], ws[1], ws[2], e_c0, e_c0,
+                                   1.0, None, None, 1.0, rd)
+            return jnp.sum(hs.astype(jnp.float32))
+
+        def body(c, _):
+            xs, acc = c
+            g = jax.grad(loss)((e_wx, e_b2[0], e_wh), xs)
+            s = g[1][0].astype(jnp.float32)
+            return (_dep(xs, s), acc + s), None
+        return body
+
+    def dec_fwd_body(with_dropout=True):
+        sd = seed if with_dropout else None
+        kp = keep if with_dropout else 1.0
+
+        def body(c, _):
+            xs, acc = c
+            hs, (cT, hT) = PF.fused_ln_lstm(
+                xs, l_wx, l_wh, l_gam, l_bet, l_gc2[0], l_bc2[0],
+                l_c0, l_c0, 1.0, None, sd, kp, rd, l_xb)
+            s = jnp.sum(hs[0, 0, :8].astype(jnp.float32)) + cT[0, 0]
+            return (_dep(xs, s), acc + s), None
+        return body
+
+    def dec_fb_body(with_dropout=True):
+        sd = seed if with_dropout else None
+        kp = keep if with_dropout else 1.0
+
+        def loss(ws, xs):
+            hs, (cT, hT) = PF.fused_ln_lstm(
+                xs, ws[0], ws[1], l_gam, l_bet, l_gc2[0], l_bc2[0],
+                l_c0, l_c0, 1.0, None, sd, kp, rd, ws[2])
+            return (jnp.sum(hs.astype(jnp.float32)) + jnp.sum(cT)
+                    + jnp.sum(hT))
+
+        def body(c, _):
+            xs, acc = c
+            g = jax.grad(loss)((l_wx, l_wh, l_xb), xs)
+            s = g[0][0, 0].astype(jnp.float32)
+            return (_dep(xs, s), acc + s), None
+        return body
+
+    zero = jnp.float32(0.0)
+    k_e_f = _chain_call_time(enc_fwd_body, (e_xs, zero), reps=reps)
+    k_e_fb = _chain_call_time(enc_fb_body, (e_xs, zero), reps=reps)
+    k_d_f = _chain_call_time(dec_fwd_body, (l_xs, zero), reps=reps)
+    k_d_fb = _chain_call_time(dec_fb_body, (l_xs, zero), reps=reps)
+    k_d_fb_nodrop = _chain_call_time(
+        functools.partial(dec_fb_body, False), (l_xs, zero), reps=reps)
+    print(f"# kernels ms/call: enc_f {k_e_f * 1e3:.2f} "
+          f"enc_fb {k_e_fb * 1e3:.2f} dec_f {k_d_f * 1e3:.2f} "
+          f"dec_fb {k_d_fb * 1e3:.2f} "
+          f"dec_fb_nodrop {k_d_fb_nodrop * 1e3:.2f}", file=sys.stderr)
+
+    # ---- reconciliation ---------------------------------------------------
+    def phase_row(geom, t_full_f, t_mxu_f, t_full_b, meas_f, meas_fb):
+        mxu_f, mxu_b = geom.mxu_seconds(peak)
+        hbm_f, hbm_b = geom.hbm_seconds(hbm_gbps)
+        comp_f = geom.grid_fwd * t_full_f
+        comp_b = geom.grid_bwd * t_full_b
+        meas_b = meas_fb - meas_f
+        return {
+            "grid_fwd": geom.grid_fwd, "grid_bwd": geom.grid_bwd,
+            "tile_fwd": geom.tile_fwd, "tile_bwd": geom.tile_bwd,
+            "measured_fwd_ms": meas_f * 1e3,
+            "measured_bwd_ms": meas_b * 1e3,
+            "replica_compute_fwd_ms": comp_f * 1e3,
+            "replica_compute_bwd_ms": comp_b * 1e3,
+            "replica_mxu_fwd_ms": geom.grid_fwd * t_mxu_f * 1e3,
+            "replica_vpu_fwd_ms": geom.grid_fwd * (t_full_f - t_mxu_f) * 1e3,
+            "mxu_padded_model_fwd_ms": mxu_f * 1e3,
+            "mxu_padded_model_bwd_ms": mxu_b * 1e3,
+            "hbm_fwd_ms": hbm_f * 1e3,
+            "hbm_bwd_ms": hbm_b * 1e3,
+            "unexplained_fwd_ms": (meas_f - comp_f - hbm_f) * 1e3,
+            "unexplained_bwd_ms": (meas_b - comp_b - hbm_b) * 1e3,
+        }
+
+    enc_row = phase_row(enc, t_e_full_f, t_e_mxu_f, t_e_full_b,
+                        2 * k_e_f, 2 * k_e_fb)
+    dec_row = phase_row(dec, t_d_full_f, t_d_mxu_f, t_d_full_b,
+                        k_d_f, k_d_fb)
+
+    rec = {
+        "kind": "roofline",
+        "device_kind": kind,
+        "peak_tflops": peak / 1e12,
+        "hbm_anchor_gbps": round(hbm_gbps, 1),
+        "batch_size": B, "seq_len": T,
+        "reps": reps,
+        "ladder_enc_ms": args.enc_ms,
+        "ladder_dec_ms": args.dec_ms,
+        "dropout_cost_dec_fb_ms": round((k_d_fb - k_d_fb_nodrop) * 1e3, 2),
+        "encoder": {k: round(v, 2) if isinstance(v, float) else v
+                    for k, v in enc_row.items()},
+        "decoder": {k: round(v, 2) if isinstance(v, float) else v
+                    for k, v in dec_row.items()},
+        "replica_us_per_step": {
+            "enc_full_fwd": round(t_e_full_f * 1e6, 2),
+            "enc_mxu_fwd": round(t_e_mxu_f * 1e6, 2),
+            "enc_vpu_fwd": round(t_e_vpu_f * 1e6, 2),
+            "enc_full_bwd": round(t_e_full_b * 1e6, 2),
+            "dec_full_fwd": round(t_d_full_f * 1e6, 2),
+            "dec_mxu_fwd": round(t_d_mxu_f * 1e6, 2),
+            "dec_vpu_fwd": round(t_d_vpu_f * 1e6, 2),
+            "dec_full_bwd": round(t_d_full_b * 1e6, 2),
+        },
+    }
+    for name, row, ladder in (("encoder", enc_row, args.enc_ms),
+                              ("decoder", dec_row, args.dec_ms)):
+        tot = row["measured_fwd_ms"] + row["measured_bwd_ms"]
+        print(f"\n== {name}: measured fwd {row['measured_fwd_ms']:.1f} + "
+              f"bwd {row['measured_bwd_ms']:.1f} = {tot:.1f} ms "
+              f"(ladder share {ladder:.1f} ms)", file=sys.stderr)
+        for p in ("fwd", "bwd"):
+            print(f"   {p}: measured {row[f'measured_{p}_ms']:6.1f} = "
+                  f"compute {row[f'replica_compute_{p}_ms']:6.1f} "
+                  f"+ hbm {row[f'hbm_{p}_ms']:5.1f} "
+                  f"+ unexplained {row[f'unexplained_{p}_ms']:6.1f}   "
+                  f"[mxu-padded model {row[f'mxu_padded_model_{p}_ms']:5.1f}]",
+                  file=sys.stderr)
+    print(json.dumps(rec, indent=2))
+    if args.json:
+        hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

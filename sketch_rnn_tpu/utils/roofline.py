@@ -1,0 +1,162 @@
+"""Per-phase analytic roofline for the fused RNN kernels.
+
+VERDICT r3 #1: the claim "MFU 0.27-0.30 is the structural ceiling on
+v5e" rested on three closed probe negatives, not arithmetic. This
+module is the arithmetic half of the reconciliation: for the encoder
+(``fused_lstm_seq`` x2 directions) and decoder (``fused_ln_lstm`` with
+x_bias) phases it derives, from the SAME tile functions the kernels
+use,
+
+- the grid geometry (steps, batch tiles),
+- the per-grid-step matmul set and its MXU time under a padded-pass
+  model (operands are padded to the 128x128 systolic tile, so a
+  ``[bt, 5] @ [5, 4H]`` input projection costs a full K=128 pass),
+- the whole-phase HBM bytes (residual streams at ``residual_dtype``,
+  cotangents at the primal dtype, weight grads).
+
+``scripts/roofline.py`` supplies the measured half (scan replicas of
+the per-step compute split into matmul-only / gates-only arms, the
+standalone kernels, and an HBM stream anchor) and prints the
+reconciliation table recorded in ARCHITECTURE.md. Keeping the
+arithmetic importable and pure lets tests pin the geometry on CPU —
+if a tile function or kernel shape changes, the model changes with it
+or the tests fail.
+
+SURVEY.md §2 component 5 (the performance core); no reference
+file:line cites are possible (the /root/reference mount is empty —
+see SURVEY.md provenance header).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+from sketch_rnn_tpu.config import HParams
+
+MXU_LANE = 128  # systolic array edge: K and N pad to this
+MXU_SUBLANE = 8  # M (the streaming dim) packs in sublanes of 8
+
+
+def _ceil_to(x: int, q: int) -> int:
+    return -(-x // q) * q
+
+
+@dataclass(frozen=True)
+class Matmul:
+    """One ``[m, k] @ [k, n]`` inside a grid step."""
+    m: int
+    k: int
+    n: int
+
+    @property
+    def flops(self) -> int:
+        return 2 * self.m * self.k * self.n
+
+    @property
+    def padded_flops(self) -> int:
+        """FLOP-equivalents the MXU actually spends: K and N rounded up
+        to the 128 systolic edge (a K=5 input projection burns a full
+        K=128 pass), M to the 8-sublane pack."""
+        return (2 * _ceil_to(self.m, MXU_SUBLANE)
+                * _ceil_to(self.k, MXU_LANE) * _ceil_to(self.n, MXU_LANE))
+
+
+@dataclass(frozen=True)
+class PhaseGeometry:
+    """Grid + arithmetic model of one kernel phase (all directions)."""
+    name: str
+    directions: int
+    seq_len: int
+    batch: int
+    hidden: int
+    tile_fwd: int
+    tile_bwd: int
+    mm_fwd: Tuple[Matmul, ...]   # per fwd grid step
+    mm_bwd: Tuple[Matmul, ...]   # per bwd grid step
+    hbm_bytes_fwd: int           # whole phase, all directions
+    hbm_bytes_bwd: int
+
+    @property
+    def grid_fwd(self) -> int:
+        """Total fwd grid steps across directions."""
+        return self.directions * self.seq_len * (self.batch // self.tile_fwd)
+
+    @property
+    def grid_bwd(self) -> int:
+        return self.directions * self.seq_len * (self.batch // self.tile_bwd)
+
+    def mxu_seconds(self, peak_flops: float) -> Tuple[float, float]:
+        """(fwd, bwd) MXU-ideal seconds under the padded-pass model."""
+        fwd = self.grid_fwd * sum(m.padded_flops for m in self.mm_fwd)
+        bwd = self.grid_bwd * sum(m.padded_flops for m in self.mm_bwd)
+        return fwd / peak_flops, bwd / peak_flops
+
+    def hbm_seconds(self, gbytes_per_s: float) -> Tuple[float, float]:
+        return (self.hbm_bytes_fwd / (gbytes_per_s * 1e9),
+                self.hbm_bytes_bwd / (gbytes_per_s * 1e9))
+
+
+def _dtype_bytes(name: str) -> int:
+    return 2 if name == "bfloat16" else 4
+
+
+def encoder_geometry(hps: HParams) -> PhaseGeometry:
+    """``fused_lstm_seq`` x2 directions (the bidirectional encoder).
+
+    Backward recomputes both forward matmuls, then runs the three grad
+    matmuls (dwx, d_pre @ wh.T, dwh); there are no dxs / carry-grad
+    outputs (the seq kernel's contract). Residuals hs+cs are stored at
+    ``fused_residual_dtype``; the incoming cotangent dhs matches the
+    (rounded) primal dtype; xs is the compute-dtype stroke tensor.
+    """
+    from sketch_rnn_tpu.ops.pallas_fused import _batch_tile_seq
+
+    h, d, t, b = hps.enc_rnn_size, 5, hps.max_seq_len, hps.batch_size
+    bt = _batch_tile_seq(b, h)
+    rb = _dtype_bytes(hps.fused_residual_dtype)
+    xb_ = _dtype_bytes(hps.compute_dtype)
+    mm_fwd = (Matmul(bt, d, 4 * h), Matmul(bt, h, 4 * h))
+    mm_bwd = mm_fwd + (
+        Matmul(d, bt, 4 * h),     # dwx  = x.T @ d_pre
+        Matmul(bt, 4 * h, h),     # dh   = d_pre @ wh.T
+        Matmul(h, bt, 4 * h),     # dwh  = h_prev.T @ d_pre
+    )
+    dirs = 2
+    fwd_bytes = dirs * t * b * (d * xb_ + 2 * h * rb)          # xs in, hs+cs out
+    bwd_bytes = dirs * t * b * (d * xb_ + 3 * h * rb)          # xs, cs, h_prev, dhs
+    return PhaseGeometry("encoder", dirs, t, b, h, bt, bt,
+                         mm_fwd, mm_bwd, fwd_bytes, bwd_bytes)
+
+
+def decoder_geometry(hps: HParams) -> PhaseGeometry:
+    """``fused_ln_lstm`` with the x_bias path (flagship decoder).
+
+    The backward tile halves (x_bias adds two [bt, 4H] f32 blocks to
+    the backward's VMEM budget — see ``_batch_tile``), so the bwd grid
+    has twice the steps at half the M. Backward additionally writes the
+    dxs stream in f32 (the kernel's dx output) and the dxb block.
+    """
+    from sketch_rnn_tpu.ops.pallas_fused import _batch_tile
+
+    h, d, t, b = hps.dec_rnn_size, 5, hps.max_seq_len, hps.batch_size
+    bt_f = _batch_tile(b, h)
+    bt_b = _batch_tile(b, h, xb_bwd=True)
+    rb = _dtype_bytes(hps.fused_residual_dtype)
+    xb_ = _dtype_bytes(hps.compute_dtype)
+    mm_fwd = (Matmul(bt_f, d, 4 * h), Matmul(bt_f, h, 4 * h))
+    mm_bwd = (
+        Matmul(bt_b, d, 4 * h), Matmul(bt_b, h, 4 * h),  # recompute
+        Matmul(bt_b, 4 * h, d),   # dx   = d_pre @ wx.T
+        Matmul(d, bt_b, 4 * h),   # dwx  = x.T @ d_pre
+        Matmul(bt_b, 4 * h, h),   # dh   = d_pre @ wh.T
+        Matmul(h, bt_b, 4 * h),   # dwh  = h_prev.T @ d_pre
+    )
+    fwd_bytes = (t * b * (d * xb_ + 2 * h * rb)   # xs in, hs+cs out
+                 + b * 4 * h * 4                  # x_bias read (once per tile pass)
+                 + 2 * b * h * 4)                 # cT, hT out (f32)
+    bwd_bytes = (t * b * (d * xb_ + 3 * h * rb)   # xs, cs, h_prev, dhs
+                 + t * b * d * 4                  # dxs out (f32)
+                 + 2 * b * 4 * h * 4)             # x_bias read + dxb out
+    return PhaseGeometry("decoder", 1, t, b, h, bt_f, bt_b,
+                         mm_fwd, mm_bwd, fwd_bytes, bwd_bytes)

@@ -1,0 +1,90 @@
+"""Pins the analytic roofline geometry (utils/roofline.py) on CPU.
+
+The reconciliation table in ARCHITECTURE.md is only as good as this
+arithmetic: the grid counts and tile sizes must track the kernels'
+actual tile functions (imported, not copied), the matmul sets must
+match the kernels' per-step dataflow, and the padded-pass model must
+penalize the K=5 input projection the way the 128x128 systolic array
+does.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from sketch_rnn_tpu.config import get_default_hparams
+from sketch_rnn_tpu.utils import roofline as R
+
+
+@pytest.fixture
+def hps():
+    return get_default_hparams().replace(
+        batch_size=4096, max_seq_len=250, compute_dtype="bfloat16",
+        fused_rnn=True, fused_residual_dtype="bfloat16")
+
+
+def test_matmul_padding_model():
+    mm = R.Matmul(1024, 5, 1024)
+    assert mm.flops == 2 * 1024 * 5 * 1024
+    # K=5 burns a full 128-wide pass on the systolic array
+    assert mm.padded_flops == 2 * 1024 * 128 * 1024
+    # M packs to 8 sublanes: the dwx matmul's M=5 rounds to 8
+    assert R.Matmul(5, 1024, 2048).padded_flops == 2 * 8 * 1024 * 2048
+    # aligned shapes pay nothing
+    assert R.Matmul(256, 512, 2048).padded_flops == \
+        R.Matmul(256, 512, 2048).flops
+
+
+def test_encoder_geometry_tracks_kernel_tiles(hps):
+    from sketch_rnn_tpu.ops.pallas_fused import _batch_tile_seq
+
+    g = R.encoder_geometry(hps)
+    assert g.tile_fwd == g.tile_bwd == _batch_tile_seq(4096, 256)
+    # 2 directions x 250 steps x (4096 / tile) batch tiles
+    assert g.grid_fwd == 2 * 250 * (4096 // g.tile_fwd)
+    # per fwd step: input projection + recurrent matmul
+    assert [(m.k, m.n) for m in g.mm_fwd] == [(5, 1024), (256, 1024)]
+    # bwd: recompute both + dwx + dh + dwh
+    assert len(g.mm_bwd) == 5
+    # residual streams: hs+cs out (fwd) and cs+h_prev+dhs in (bwd), bf16
+    t, b, h = 250, 4096, 256
+    assert g.hbm_bytes_fwd == 2 * t * b * (5 * 2 + 2 * h * 2)
+    assert g.hbm_bytes_bwd == 2 * t * b * (5 * 2 + 3 * h * 2)
+
+
+def test_decoder_geometry_bwd_tile_halves(hps):
+    from sketch_rnn_tpu.ops.pallas_fused import _batch_tile
+
+    g = R.decoder_geometry(hps)
+    assert g.tile_fwd == _batch_tile(4096, 512)
+    assert g.tile_bwd == _batch_tile(4096, 512, xb_bwd=True)
+    assert g.tile_bwd * 2 == g.tile_fwd  # the xb budget-halving
+    assert g.grid_bwd == 2 * g.grid_fwd
+    # bwd adds dx to the seq-kernel set: 6 matmuls
+    assert len(g.mm_bwd) == 6
+    # the dxs stream the decoder writes back is f32
+    t, b = 250, 4096
+    assert g.hbm_bytes_bwd - (t * b * (5 * 2 + 3 * 512 * 2)) == \
+        t * b * 5 * 4 + 2 * b * 4 * 512 * 4
+
+
+def test_mxu_and_hbm_seconds_scale(hps):
+    g = R.encoder_geometry(hps)
+    f1, b1 = g.mxu_seconds(197e12)
+    f2, b2 = g.mxu_seconds(2 * 197e12)
+    assert f1 == pytest.approx(2 * f2) and b1 == pytest.approx(2 * b2)
+    hf, hb = g.hbm_seconds(800.0)
+    assert hf == pytest.approx(g.hbm_bytes_fwd / 8e11)
+    assert hb > hf  # bwd reads three streams vs fwd's two writes
+
+
+def test_geometry_follows_hparams_not_constants():
+    """A non-flagship shape must flow through (the model is not a table
+    of flagship numbers)."""
+    hps = get_default_hparams().replace(
+        batch_size=512, max_seq_len=100, enc_rnn_size=128,
+        dec_rnn_size=256, fused_rnn=True,
+        fused_residual_dtype="float32", compute_dtype="float32")
+    g = R.encoder_geometry(hps)
+    assert g.hidden == 128 and g.seq_len == 100 and g.batch == 512
+    # f32 everywhere: xs 4B, residuals 4B
+    assert g.hbm_bytes_fwd == 2 * 100 * 512 * (5 * 4 + 2 * 128 * 4)
