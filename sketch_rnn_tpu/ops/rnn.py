@@ -348,14 +348,24 @@ def bidirectional_rnn(cell_fwd, cell_bwd, params_fwd, params_bwd,
                               rdrop_gen=rdrop_gen_bwd, remat=remat,
                               fused=fused, residual_dtype=residual_dtype,
                               need_final=False)
-        # forward state at the last valid step
+        # forward state at the last valid step, as a one-hot contraction
+        # rather than take_along_axis: the gather's BACKWARD lowers to an
+        # XLA scatter into [T, B, H], which on v5e measured ~55 ms/step
+        # inside the training program (~24% of the whole step!) — the
+        # cost hid from standalone probes because with frozen params the
+        # cotangent being scattered is loop-invariant and XLA hoists it
+        # out of timing chains (r4 glue_ladder bisection). The one-hot
+        # einsum is EXACT (each output element is one input element
+        # times 1.0, f32-accumulated) and both its forward and backward
+        # are dense matmuls on the MXU.
         last = jnp.clip(seq_len - 1, 0, t - 1)            # [B]
-        h_f = jnp.take_along_axis(
-            hs_f, last[None, :, None].repeat(hs_f.shape[-1], -1), axis=0
-        )[0]
-        h_b = jnp.take_along_axis(
-            hs_b_rev, last[None, :, None].repeat(hs_b_rev.shape[-1], -1),
-            axis=0)[0]
+        onehot = jax.nn.one_hot(last, t, dtype=hs_f.dtype)  # [B, T]
+        h_f = jnp.einsum("tbh,bt->bh", hs_f, onehot,
+                         preferred_element_type=jnp.float32
+                         ).astype(hs_f.dtype)
+        h_b = jnp.einsum("tbh,bt->bh", hs_b_rev, onehot,
+                         preferred_element_type=jnp.float32
+                         ).astype(hs_b_rev.dtype)
         hs_b = jnp.take_along_axis(hs_b_rev, rev_idx[:, :, None], axis=0)
     h_final = jnp.concatenate([h_f, h_b], axis=-1)
     hs = jnp.concatenate([hs_f, hs_b], axis=-1)
