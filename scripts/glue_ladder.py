@@ -105,17 +105,23 @@ def main() -> int:
             batch["labels"] = jnp.zeros(sh[:-2], jnp.int32)
         return batch
 
-    def step_ms(hps, loss_override=None, label=""):
-        """Per-step ms of the cached K-step train call, K-differential."""
+    def step_ms(hps, loss_override=None, label="", use_mesh=True):
+        """Per-step ms of the cached K-step train call, K-differential.
+
+        ``use_mesh=False`` builds the identical step WITHOUT the
+        1-device shard_map wrapper (plain jit) — the bisection arm for
+        attributing wrapper cost."""
         model = SketchRNN(hps)
         if loss_override is not None:
             model.loss = loss_override.__get__(model, SketchRNN)
-        mesh = make_mesh(hps)
+        mesh = make_mesh(hps) if use_mesh else None
 
         def at(k):
             step = make_multi_train_step(model, hps, mesh,
                                          steps_per_call=k)
-            batch = shard_batch(device_batch(hps, k), mesh, stacked=True)
+            batch = device_batch(hps, k)
+            if mesh is not None:
+                batch = shard_batch(batch, mesh, stacked=True)
             state = make_train_state(model, hps, jax.random.key(0))
             kk = jax.random.key(1)
 
@@ -183,10 +189,15 @@ def main() -> int:
                        "kl_weight": jnp.asarray(kl_weight, jnp.float32)}
 
     full = step_ms(base, label="full")
+    # bisection arm: the IDENTICAL program without the 1-device
+    # shard_map wrapper — any gap is pure wrapper cost
+    full_nomesh = step_ms(base, label="full_nomesh", use_mesh=False)
     full_nodrop = step_ms(base.replace(use_recurrent_dropout=False),
                           label="full_nodrop")
     stub = step_ms(base, loss_override=loss_stub, label="stub_mdn")
     enc_only = step_ms(base, loss_override=loss_enc_only, label="enc_only")
+    enc_only_nomesh = step_ms(base, loss_override=loss_enc_only,
+                              label="enc_only_nomesh", use_mesh=False)
     # conditional off BUT class-conditional on: the class embedding keeps
     # the decoder x_bias path (and its halved backward tile) alive
     noenc_xb = step_ms(base.replace(conditional=False, num_classes=75),
@@ -219,7 +230,12 @@ def main() -> int:
 
     def enc_call(x):
         g = jax.grad(enc_loss)(params, x)
-        return g["mu_w"][0, 0]
+        # the chain dependency must consume EVERY grad leaf: depending
+        # on one head grad alone lets XLA dead-code the entire RNN
+        # backward out of the timed loop (the r4 bisection got bitten —
+        # its "params-constant" arms were silently forward-only)
+        return sum(jnp.sum(l.astype(jnp.float32))
+                   for l in jax.tree_util.tree_leaves(g))
 
     enc_path = (chain(enc_call, x_tm, K2) - chain(enc_call, x_tm, K1)) \
         / (K2 - K1) * 1e3
@@ -266,9 +282,11 @@ def main() -> int:
         "batch_size": B, "seq_len": T, "reps": reps,
         "k_pair": [K1, K2],
         "full_ms": round(full, 2),
+        "full_nomesh_ms": round(full_nomesh, 2),
         "full_nodrop_ms": round(full_nodrop, 2),
         "stub_mdn_ms": round(stub, 2),
         "enc_only_ms": round(enc_only, 2),
+        "enc_only_nomesh_ms": round(enc_only_nomesh, 2),
         "no_enc_xb_ms": round(noenc_xb, 2),
         "no_enc_plain_ms": round(noenc_plain, 2),
         "enc_path_ms": round(enc_path, 2),
