@@ -88,6 +88,69 @@ def main() -> int:
                              mesh)
     dump_per_class(per, os.path.join(outdir, f"pc_{rank}.npz"))
 
+    # --- mesh-sharded sampler across processes (VERDICT r3 #7) ---------
+    # the generation path must run under the SAME global mesh as
+    # training: fixed z/key so the only allowed variation is transport.
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    from sketch_rnn_tpu.sample.sampler import make_sampler
+
+    n = hps.batch_size  # divisible by the 4-device mesh
+    z = jax.random.normal(jax.random.key(11), (n, hps.z_size),
+                          jnp.float32)
+    # fixed INIT params (identical on every transport bitwise) so the
+    # test can demand bitwise sampler equality — trained params differ
+    # across transports by reassociation noise, which the categorical
+    # pen draws would amplify chaotically
+    sample_params = model.init_params(jax.random.key(21))
+    sampler = make_sampler(model, hps, mesh=mesh)
+    s5, lengths = sampler(sample_params, jax.random.key(12), n, z, None,
+                          0.7)
+    # gather the sharded outputs so every process can dump the GLOBAL
+    # result (the test then requires bitwise cross-process equality)
+    s5_all = multihost_utils.process_allgather(s5, tiled=True)
+    len_all = multihost_utils.process_allgather(lengths, tiled=True)
+    np.savez(os.path.join(outdir, f"sample_{rank}.npz"),
+             s5=np.asarray(s5_all), lengths=np.asarray(len_all))
+
+    # --- checkpoint save -> resume across processes (VERDICT r3 #7) ----
+    # the documented shared-workdir contract (train/loop.py): ONLY the
+    # primary writes; every process restores from the same directory.
+    from sketch_rnn_tpu.train.checkpoint import (restore_checkpoint,
+                                                 save_checkpoint)
+
+    ckpt_dir = os.path.join(outdir, "ckpt")
+    if mh.is_primary():
+        save_checkpoint(ckpt_dir, state, scale_factor=1.25, hps=hps)
+    multihost_utils.sync_global_devices("ckpt written")
+    template = make_train_state(model, hps, jax.random.key(0))
+    restored, scale2, meta = restore_checkpoint(ckpt_dir, template)
+    assert scale2 == 1.25 and meta["step"] == int(state.step)
+
+    # round-trip fidelity: the restored params are bitwise the params
+    # the primary saved (on rank 1 this also proves the cross-process
+    # read of the primary's file)
+    def _host_leaf(leaf):
+        if hasattr(leaf, "addressable_data"):
+            leaf = leaf.addressable_data(0)
+        return np.asarray(leaf)
+
+    jax.tree_util.tree_map(
+        lambda got, want: np.testing.assert_array_equal(
+            _host_leaf(got), _host_leaf(want)),
+        restored.params, state.params)
+
+    # continue training from the restored state: 2 more steps with the
+    # continuing key stream (fold_in(root, 3), fold_in(root, 4))
+    state2 = restored
+    for i, key in list(enumerate(step_keys(5)))[3:]:
+        local = loader.get_batch(i % max(loader.num_batches, 1))
+        state2, m2 = step(state2, shard_batch(local, mesh), key)
+    assert np.isfinite(float(m2["loss"]))
+    dump_params(state2.params,
+                os.path.join(outdir, f"params_resumed_{rank}.npz"))
+
     print(f"[worker {rank}] done, loss={loss:.5f}", flush=True)
     return 0
 

@@ -142,3 +142,56 @@ def test_two_process_dp_matches_single_process(tmp_path, fused):
         np.testing.assert_allclose(
             float(pcs[0][k]), per_ref[int(c)][metric], rtol=1e-5,
             err_msg=f"pc multi vs single: {k}")
+
+    # (5) mesh-sharded sampler (VERDICT r3 #7): identical across
+    # processes bitwise, and bitwise equal to a single-process run of
+    # the same sampler over the same 4-device mesh (per-shard keys fold
+    # in the mesh axis index, which is transport-independent; params
+    # are a fixed deterministic init so no training noise enters)
+    import jax.numpy as jnp
+
+    from sketch_rnn_tpu.sample.sampler import make_sampler
+
+    samples = [np.load(os.path.join(outdir, f"sample_{r}.npz"))
+               for r in range(nproc)]
+    np.testing.assert_array_equal(samples[0]["s5"], samples[1]["s5"],
+                                  err_msg="sampler cross-process s5")
+    np.testing.assert_array_equal(samples[0]["lengths"],
+                                  samples[1]["lengths"])
+    sample_params = model.init_params(jax.random.key(21))
+    sampler = make_sampler(model, hps, mesh=mesh)
+    n = hps.batch_size
+    z = jax.random.normal(jax.random.key(11), (n, hps.z_size),
+                          jnp.float32)
+    s5_ref, len_ref = sampler(sample_params, jax.random.key(12), n, z,
+                              None, 0.7)
+    np.testing.assert_array_equal(samples[0]["lengths"],
+                                  np.asarray(len_ref))
+    np.testing.assert_array_equal(samples[0]["s5"], np.asarray(s5_ref),
+                                  err_msg="sampler multi vs single")
+
+    # (6) checkpoint save -> resume across processes (VERDICT r3 #7,
+    # the shared-workdir contract): the primary's checkpoint restored
+    # by BOTH processes, trained 2 more steps — params bitwise equal
+    # across processes and equal (to transport reassociation) to a
+    # single-process 5-step run
+    resumed = [np.load(os.path.join(outdir, f"params_resumed_{r}.npz"))
+               for r in range(nproc)]
+    for k in resumed[0].files:
+        np.testing.assert_array_equal(
+            resumed[0][k], resumed[1][k],
+            err_msg=f"resumed cross-process mismatch: {k}")
+    from tests._multihost_common import step_keys
+    state5 = state
+    for i, key in list(enumerate(step_keys(5)))[3:]:
+        locals_ = [s.get_batch(i % max(s.num_batches, 1)) for s in stripes]
+        batch = {k: np.concatenate([lb[k] for lb in locals_])
+                 for k in locals_[0]}
+        state5, _ = step(state5, shard_batch(batch, mesh), key)
+    ref5_path = os.path.join(outdir, "params_ref5.npz")
+    dump_params(state5.params, ref5_path)
+    ref5 = np.load(ref5_path)
+    for k in resumed[0].files:
+        np.testing.assert_allclose(
+            resumed[0][k], ref5[k], rtol=2e-6, atol=2e-7,
+            err_msg=f"resumed multi vs single: {k}")
