@@ -49,9 +49,13 @@ K-step window, not one per step; every timed step still consumes a
 fresh host-assembled batch), BENCH_TRANSFER (strokes transfer dtype,
 default bfloat16 — halves host->device bytes: +3% in good windows and
 +43% in a measured transfer-bound window (same-window A/B, 2026-07-30:
-3.67M vs 2.56M strokes/s/chip), because slow tunnel windows are
-transfer-limited; float32 for exact-feed runs — see hps.transfer_dtype
-for the rounding trade).
+3.67M vs 2.56M strokes/s/chip). int16 moves the SAME 2 bytes/element
+as bfloat16 but is EXACT for integer-origin corpora like QuickDraw
+(bf16 rounds) at measured throughput parity (same-window A/B/A,
+2026-07-31: 5.04M / 4.99M / 5.03M) — it is the recommended mode for
+real data, but the bench's synthetic corpus is float-natured (scale
+factor ~0.24, so integer-unit quantization would destroy it — the
+int16 path refuses such corpora), hence bfloat16 here.
 
 Defaults are the measured-best v5e config: bfloat16 matmuls, global batch
 4096/chip (amortizes the per-step dispatch/feed overhead — measured
@@ -403,9 +407,9 @@ def main() -> int:
         print(f"BENCH_STEPS={steps} must be a positive multiple of "
               f"BENCH_SPC={spc}", file=sys.stderr)
         return 2
-    if transfer not in ("float32", "bfloat16"):
-        print(f"BENCH_TRANSFER={transfer!r} must be float32 or bfloat16",
-              file=sys.stderr)
+    if transfer not in ("float32", "bfloat16", "int16"):
+        print(f"BENCH_TRANSFER={transfer!r} must be float32, bfloat16 "
+              f"or int16", file=sys.stderr)
         return 2
     flagship = os.environ.get("BENCH_DEC", "layer_norm")
 

@@ -95,7 +95,14 @@ class HParams:
     #   when transfer-bound). Loss math stays f32 (the model upcasts on
     #   entry); the semantic delta is bf16 rounding of the inputs and
     #   MDN targets — smaller than the augmentation jitter, but not
-    #   bit-parity: eval sweeps always feed float32.
+    #   bit-parity: eval sweeps always feed float32. "int16" moves the
+    #   same 2 bytes/element as bfloat16 but is EXACT for integer-origin
+    #   corpora like QuickDraw — the on-device dequant reproduces host
+    #   normalization bit-for-bit at measured throughput parity
+    #   (data/prefetch.py) — the recommended mode for real data. The
+    #   quantization step is 1 raw data unit, so the path REFUSES
+    #   corpora whose normalization scale makes that coarse
+    #   (float-natured data, e.g. the synthetic corpus).
     compute_dtype: str = "float32"     # "bfloat16" for MXU-friendly matmuls
     fused_rnn: bool = False            # Pallas recompute-backward kernels for
     #   ALL three cells (ops/pallas_fused.py): measured fwd+bwd at the
@@ -125,10 +132,10 @@ class HParams:
             raise ValueError(
                 f"compute_dtype must be 'float32' or 'bfloat16', got "
                 f"{self.compute_dtype!r}")
-        if self.transfer_dtype not in ("float32", "bfloat16"):
+        if self.transfer_dtype not in ("float32", "bfloat16", "int16"):
             raise ValueError(
-                f"transfer_dtype must be 'float32' or 'bfloat16', got "
-                f"{self.transfer_dtype!r}")
+                f"transfer_dtype must be 'float32', 'bfloat16' or "
+                f"'int16', got {self.transfer_dtype!r}")
         if self.fused_residual_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"fused_residual_dtype must be 'float32' or 'bfloat16', "

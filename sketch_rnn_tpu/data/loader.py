@@ -69,6 +69,7 @@ class DataLoader:
                  global_size: Optional[int] = None,
                  num_hosts: int = 1):
         self.hps = hps
+        self.scale_factor = 1.0  # set by normalize(); int16 transfer reads it
         self.strokes: List[np.ndarray] = [np.asarray(s, np.float32)
                                           for s in stroke3_list]
         if labels is None:
@@ -106,7 +107,11 @@ class DataLoader:
 
     def normalize(self, scale_factor: float) -> None:
         # in place: the loader owns its arrays (see class docstring — float32
-        # inputs are adopted without copying)
+        # inputs are adopted without copying). The factor is kept for the
+        # int16 transfer path (data/prefetch.py): quantizing a normalized
+        # offset back by this factor recovers the EXACT integer delta for
+        # integer-origin corpora like QuickDraw.
+        self.scale_factor = float(scale_factor)
         for s in self.strokes:
             s[:, 0:2] /= scale_factor
 
@@ -121,36 +126,67 @@ class DataLoader:
             out[i, 0, :] = [0, 0, 1, 0, 0]       # start token
         return out
 
-    def _assemble(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+    def _assemble(self, idx: np.ndarray,
+                  int16_scale: Optional[float] = None
+                  ) -> Dict[str, np.ndarray]:
         # hot path: the C++ batcher (SURVEY §2 component 1 native path)
         # runs the whole batch assembly as one native call — at train time
         # including the augmentations (scale jitter + point dropout), so
         # no per-sequence Python loop remains. Golden-tested equal to the
         # numpy path (bit-exact without augmentation, distributionally
         # with — the native RNG is a counter-based stream, not numpy's).
+        # ``int16_scale``: quantize offsets back to integer data units in
+        # the SAME native pass (the exact int16 transfer path,
+        # data/prefetch.py) and add the "transfer_scale" [B] leaf.
         raw = [self.strokes[i] for i in idx]
-        if self.augment:
-            native = NB.assemble_batch_aug(
+        strokes = None
+        if int16_scale is not None:
+            native = NB.assemble_batch_aug_i16(
                 raw, self.hps.max_seq_len,
-                self.hps.random_scale_factor, self.hps.augment_stroke_prob,
-                seed=int(self.rng.integers(0, 2 ** 63)))
-        else:
-            native = NB.assemble_batch(raw, self.hps.max_seq_len)
-        if native is not None:
-            strokes, seq_len = native
-        else:
+                self.hps.random_scale_factor if self.augment else 0.0,
+                self.hps.augment_stroke_prob if self.augment else 0.0,
+                seed=(int(self.rng.integers(0, 2 ** 63))
+                      if self.augment else 0),
+                quant=float(int16_scale))
+            if native is not None:
+                strokes, seq_len = native
+            # else: assemble float32 below, quantize in numpy at the end
+        if strokes is None:
             if self.augment:
-                raw = [S.augment_strokes(
-                    S.random_scale(s, self.hps.random_scale_factor,
-                                   self.rng),
-                    self.hps.augment_stroke_prob, self.rng) for s in raw]
-            strokes = self._pad_batch(raw)
-            seq_len = np.array([len(s) for s in raw], dtype=np.int32)
-        return {
+                native = NB.assemble_batch_aug(
+                    raw, self.hps.max_seq_len,
+                    self.hps.random_scale_factor,
+                    self.hps.augment_stroke_prob,
+                    seed=int(self.rng.integers(0, 2 ** 63)))
+            else:
+                native = NB.assemble_batch(raw, self.hps.max_seq_len)
+            if native is not None:
+                strokes, seq_len = native
+            else:
+                if self.augment:
+                    raw = [S.augment_strokes(
+                        S.random_scale(s, self.hps.random_scale_factor,
+                                       self.rng),
+                        self.hps.augment_stroke_prob, self.rng) for s in raw]
+                strokes = self._pad_batch(raw)
+                seq_len = np.array([len(s) for s in raw], dtype=np.int32)
+            if int16_scale is not None:
+                # numpy fallback quantization: same rounding (np.rint is
+                # half-even, matching the native nearbyintf)
+                q = np.empty(strokes.shape, np.int16)
+                np.clip(np.rint(strokes[..., :2] * int16_scale),
+                        -32767, 32767, out=q[..., :2], casting="unsafe")
+                q[..., 2:] = strokes[..., 2:]
+                strokes = q
+        batch = {
             "strokes": strokes,
             "seq_len": seq_len,
             "labels": self.labels[idx],
         }
+        if int16_scale is not None:
+            batch["transfer_scale"] = np.full((len(raw),), int16_scale,
+                                              np.float32)
+        return batch
 
     @property
     def num_eval_batches(self) -> int:
@@ -192,10 +228,11 @@ class DataLoader:
         return DataLoader([self.strokes[i] for i in sel], self.hps,
                           labels=self.labels[sel], augment=False)
 
-    def random_batch(self) -> Dict[str, np.ndarray]:
+    def random_batch(self, int16_scale: Optional[float] = None
+                     ) -> Dict[str, np.ndarray]:
         idx = self.rng.choice(len(self.strokes), self.hps.batch_size,
                               replace=len(self.strokes) < self.hps.batch_size)
-        return self._assemble(idx)
+        return self._assemble(idx, int16_scale=int16_scale)
 
     def get_batch(self, batch_index: int) -> Dict[str, np.ndarray]:
         """Deterministic eval batch; includes a ``"weights"`` [B] vector.

@@ -26,7 +26,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "native", "batcher.cc")
 _SO = os.path.join(_HERE, "native", f"batcher_v{_ABI_VERSION}.so")
@@ -95,6 +95,20 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_uint64,                  # seed
             ctypes.c_int32,                   # n_threads
             ctypes.POINTER(ctypes.c_float),   # out
+            ctypes.POINTER(ctypes.c_int32),   # out_lens
+        ]
+        lib.assemble_batch_aug_i16.restype = ctypes.c_int
+        lib.assemble_batch_aug_i16.argtypes = [
+            ctypes.POINTER(ctypes.c_float),   # seq_data
+            ctypes.POINTER(ctypes.c_int32),   # seq_lens
+            ctypes.c_int32,                   # n
+            ctypes.c_int32,                   # max_len
+            ctypes.c_float,                   # scale_factor
+            ctypes.c_float,                   # drop_prob
+            ctypes.c_uint64,                  # seed
+            ctypes.c_int32,                   # n_threads
+            ctypes.c_float,                   # quant
+            ctypes.POINTER(ctypes.c_int16),   # out (int16)
             ctypes.POINTER(ctypes.c_int32),   # out_lens
         ]
         _lib = lib
@@ -174,6 +188,43 @@ def assemble_batch_aug(seqs: List[np.ndarray], max_len: int,
         ctypes.c_float(scale_factor), ctypes.c_float(drop_prob),
         ctypes.c_uint64(seed & (2 ** 64 - 1)), ctypes.c_int32(n_threads),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        return None
+    return out, out_lens
+
+
+def assemble_batch_aug_i16(seqs: List[np.ndarray], max_len: int,
+                           scale_factor: float, drop_prob: float,
+                           seed: int, quant: float, n_threads: int = 0
+                           ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Augment + pad + QUANTIZE a batch natively to int16 data units.
+
+    The int16 exact-transfer feed path (``hps.transfer_dtype="int16"``):
+    offsets are multiplied back by ``quant`` (the corpus normalization
+    scale) and rounded half-even — bit-identical to ``np.rint`` so the
+    Python fallback matches — in the same native pass as augmentation
+    and packing, so quantization adds no host-side Python work.
+    ``scale_factor=0`` / ``drop_prob=0`` is the no-augmentation path.
+    Returns ``(strokes int16 [n, max_len+1, 5], seq_len)`` or None.
+    """
+    lib = _load()
+    if lib is None or not seqs or quant <= 0:
+        return None
+    packed = _flatten(seqs, max_len)
+    if packed is None:
+        return None
+    n, lens, flat = packed
+    out = np.empty((n, max_len + 1, 5), dtype=np.int16)
+    out_lens = np.empty((n,), dtype=np.int32)
+    rc = lib.assemble_batch_aug_i16(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ctypes.c_int32(n), ctypes.c_int32(max_len),
+        ctypes.c_float(scale_factor), ctypes.c_float(drop_prob),
+        ctypes.c_uint64(seed & (2 ** 64 - 1)), ctypes.c_int32(n_threads),
+        ctypes.c_float(quant),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
         out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     if rc != 0:
         return None

@@ -136,29 +136,61 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
     ``transfer_dtype="bfloat16"`` casts the strokes array host-side so
     the transfer moves half the bytes (``hps.transfer_dtype``; the model
     upcasts on entry — see config.py for the rounding trade).
+    ``transfer_dtype="int16"`` quantizes the offset columns back to
+    integer data units (``round(x * scale_factor)``) and ships pen bits
+    as int16 0/1: the same 2 bytes/element as bfloat16, but for
+    integer-origin corpora (QuickDraw deltas) EXACT — the on-device
+    dequant ``int / scale`` reproduces the host normalization
+    bit-for-bit, so unlike bfloat16 there is no rounding trade (the
+    recommended mode for real data; measured throughput parity with
+    bfloat16). The per-example scale rides as a ``"transfer_scale"``
+    [B] batch leaf. Because the quantization step is ONE raw data
+    unit, the mode refuses corpora whose normalization scale would
+    make that coarse relative to the (unit-variance) normalized data —
+    silently training on rounded-to-nothing strokes is the failure
+    this guard exists to prevent.
     """
     if stack < 1:
         raise ValueError(f"stack must be >= 1, got {stack}")
-    if transfer_dtype not in (None, "float32", "bfloat16"):
+    if transfer_dtype not in (None, "float32", "bfloat16", "int16"):
         # mirror HParams' validation for direct callers: an arbitrary
         # dtype (e.g. int8) would silently truncate the stroke deltas
-        raise ValueError(f"transfer_dtype must be 'float32' or "
-                         f"'bfloat16', got {transfer_dtype!r}")
+        raise ValueError(f"transfer_dtype must be 'float32', 'bfloat16' "
+                         f"or 'int16', got {transfer_dtype!r}")
     cast = None
     if transfer_dtype == "bfloat16":
         import jax.numpy as jnp
 
         cast = jnp.dtype(transfer_dtype)
+    quant_scale = None
+    if transfer_dtype == "int16":
+        # quantization happens INSIDE the loader's native batch assembly
+        # (data/native/batcher.cc) — zero extra host-side Python work; a
+        # numpy fallback lives in DataLoader._assemble
+        quant_scale = getattr(loader, "scale_factor", None)
+        # max quantization error is 0.5/scale in normalized (unit-
+        # variance) units; refuse when that exceeds 10% of the data std
+        # — int16 is for integer-origin corpora (QuickDraw scale ~30-60),
+        # not float-natured ones, where it silently destroys the strokes
+        if quant_scale is None or quant_scale < 5.0:
+            raise ValueError(
+                f"transfer_dtype='int16' needs an integer-origin corpus: "
+                f"loader scale_factor is {quant_scale!r}, so quantizing "
+                f"to integer data units would round away the strokes "
+                f"(max error 0.5/scale normalized units). Use 'bfloat16' "
+                f"or 'float32' for float-natured corpora.")
+        quant_scale = float(quant_scale)
 
     def host_batch():
         import numpy as np
 
         if stack == 1:
-            out = loader.random_batch()
+            out = loader.random_batch(int16_scale=quant_scale)
             if cast is not None:
                 out = dict(out)  # don't mutate the loader's dict
         else:
-            parts = [loader.random_batch() for _ in range(stack)]
+            parts = [loader.random_batch(int16_scale=quant_scale)
+                     for _ in range(stack)]
             out = {k: np.stack([p[k] for p in parts]) for k in parts[0]}
         if cast is not None:
             out["strokes"] = out["strokes"].astype(cast)

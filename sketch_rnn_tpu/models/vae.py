@@ -240,7 +240,18 @@ class SketchRNN:
         terms are None for non-conditional models.
         """
         hps = self.hps
-        strokes = jnp.transpose(batch["strokes"], (1, 0, 2)
+        strokes_bm = batch["strokes"]
+        if strokes_bm.dtype == jnp.int16:
+            # int16 transfer (hps.transfer_dtype="int16"): offsets arrive
+            # as integer data units, pen bits as 0/1; dividing by the
+            # per-example transfer_scale reproduces the host
+            # normalization BIT-FOR-BIT for integer-origin corpora
+            # (data/prefetch.py) — the exact-feed transfer mode
+            sc = batch["transfer_scale"].astype(jnp.float32)  # [B]
+            f = strokes_bm.astype(jnp.float32)
+            strokes_bm = jnp.concatenate(
+                [f[..., :2] / sc[:, None, None], f[..., 2:]], axis=-1)
+        strokes = jnp.transpose(strokes_bm, (1, 0, 2)
                                 ).astype(jnp.float32)  # [T+1, B, 5]
         x_in, x_target = strokes[:-1], strokes[1:]
         seq_len = batch["seq_len"]

@@ -158,3 +158,45 @@ def test_loader_train_batch_uses_native_aug(native_available):
     # augmentation varies across draws
     b2 = loader.random_batch()
     assert not np.array_equal(b["strokes"], b2["strokes"])
+
+
+def test_i16_assembler_matches_numpy_quantization(native_available):
+    """The native int16 assembler must be BIT-identical to quantizing
+    the float32 native output with np.rint (both round half-even):
+    non-aug exactly, and aug with the same seed (same jitter stream)."""
+    seqs, _ = make_synthetic_strokes(24, min_len=10, max_len=40, seed=5)
+    seqs = [np.array(s) for s in seqs]
+    quant = 12.25
+    for sf, dp, seed in ((0.0, 0.0, 0), (0.15, 0.2, 99)):
+        f32, lens_f = NB.assemble_batch_aug(seqs, 48, sf, dp, seed=seed)
+        i16, lens_q = NB.assemble_batch_aug_i16(seqs, 48, sf, dp,
+                                                seed=seed, quant=quant)
+        np.testing.assert_array_equal(lens_f, lens_q)
+        assert i16.dtype == np.int16
+        want = np.empty(f32.shape, np.int16)
+        np.clip(np.rint(f32[..., :2] * quant), -32767, 32767,
+                out=want[..., :2], casting="unsafe")
+        want[..., 2:] = f32[..., 2:]
+        np.testing.assert_array_equal(i16, want)
+
+
+def test_loader_int16_fallback_matches_native(native_available,
+                                              monkeypatch):
+    """The loader's numpy int16 fallback must be bit-equal to the
+    native int16 path (non-aug: both reduce to half-even-rounding the
+    bit-exact f32 assembly)."""
+    from sketch_rnn_tpu.data import loader as L
+
+    hps = HParams(batch_size=8, max_seq_len=40)
+    seqs, _ = make_synthetic_strokes(16, min_len=10, max_len=38, seed=7)
+    a = DataLoader([np.array(s) for s in seqs], hps, seed=1)
+    a.normalize(4.5)
+    b = DataLoader([np.array(s) for s in seqs], hps, seed=1)
+    b.normalize(4.5)
+    got = a.random_batch(int16_scale=a.scale_factor)      # native path
+    monkeypatch.setattr(L.NB, "assemble_batch_aug_i16",
+                        lambda *a, **k: None)
+    want = b.random_batch(int16_scale=b.scale_factor)     # numpy fallback
+    assert got["strokes"].dtype == want["strokes"].dtype == np.int16
+    for k in got:
+        np.testing.assert_array_equal(got[k], want[k])

@@ -143,3 +143,129 @@ def test_prefetch_transfer_dtype_casts_strokes_only():
     assert got["seq_len"].dtype == want["seq_len"].dtype
     np.testing.assert_array_equal(np.asarray(got["seq_len"]),
                                   want["seq_len"])
+
+
+def _integer_origin_loader(seed=0, scale=17.5):
+    """Loader whose stroke offsets are INTEGERS before normalization —
+    the QuickDraw shape (raw deltas are int16 at origin)."""
+    hps = HParams(**TINY)
+    rng = np.random.default_rng(seed)
+    seqs = []
+    for _ in range(40):
+        n = int(rng.integers(8, 30))
+        s = np.zeros((n, 3), np.float32)
+        s[:, :2] = rng.integers(-300, 300, size=(n, 2)).astype(np.float32)
+        s[rng.integers(0, n, 3), 2] = 1
+        seqs.append(s)
+    loader = DataLoader(seqs, hps, seed=seed)
+    loader.normalize(scale)
+    return loader, hps
+
+
+def test_prefetch_int16_exact_for_integer_origin():
+    """int16 transfer must be EXACT end-to-end for integer-origin data:
+    dequantizing (int / scale) reproduces the host-normalized float32
+    batch bit-for-bit — the no-rounding-trade claim (VERDICT r3 #2)."""
+    loader, _ = _integer_origin_loader(seed=5)
+    ref_loader, _ = _integer_origin_loader(seed=5)
+    with prefetch_batches(loader, mesh=None, depth=1,
+                          transfer_dtype="int16") as feeder:
+        got = feeder.get()
+    want = ref_loader.random_batch()
+    assert got["strokes"].dtype == np.int16
+    sc = np.asarray(got["transfer_scale"])
+    assert sc.shape == (want["strokes"].shape[0],)
+    deq = got["strokes"].astype(np.float32)
+    deq[..., :2] /= sc[:, None, None]
+    np.testing.assert_array_equal(deq, want["strokes"])
+    # pen bits travel untouched
+    np.testing.assert_array_equal(got["strokes"][..., 2:],
+                                  want["strokes"][..., 2:].astype(np.int16))
+
+
+def test_prefetch_int16_stacked_and_bounded_error():
+    """Stacked (K-step) int16 batches carry a [K, B] scale leaf; for a
+    NON-integer corpus the quantization error is bounded by half a data
+    unit per offset (0.5 / scale in normalized units)."""
+    loader, _ = make_loader(seed=9)
+    loader.normalize(8.0)
+    ref_loader, _ = make_loader(seed=9)
+    ref_loader.normalize(8.0)
+    with prefetch_batches(loader, mesh=None, depth=1, stack=3,
+                          transfer_dtype="int16") as feeder:
+        got = feeder.get()
+    want = np.stack([ref_loader.random_batch()["strokes"]
+                     for _ in range(3)])
+    sc = np.asarray(got["transfer_scale"])
+    assert sc.shape == (3, want.shape[1])
+    deq = got["strokes"].astype(np.float32)
+    deq[..., :2] /= sc[..., None, None]
+    err = np.abs(deq[..., :2] - want[..., :2])
+    assert err.max() <= 0.5 / 8.0 + 1e-6
+    np.testing.assert_array_equal(deq[..., 2:], want[..., 2:])
+
+
+def test_train_step_int16_transfer_bitwise_for_integer_origin():
+    """A jitted train step fed int16-transferred strokes must produce
+    BITWISE the loss of the float32-fed step on an integer-origin
+    corpus (the exactness that bfloat16 transfer cannot offer)."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+
+    loader, hps = _integer_origin_loader(seed=11)
+    ref_loader, _ = _integer_origin_loader(seed=11)
+    hps = hps.replace(use_recurrent_dropout=False)
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh=None)
+    with prefetch_batches(loader, mesh=None, depth=1,
+                          transfer_dtype="int16") as feeder:
+        b_q = feeder.get()
+    b_f = ref_loader.random_batch()
+    key = jax.random.key(1)
+    _, m_q = step(state, b_q, key)
+    state2 = make_train_state(model, hps, jax.random.key(0))
+    _, m_f = step(state2, b_f, key)
+    assert float(m_q["loss"]) == float(m_f["loss"])
+
+
+def test_train_step_int16_transfer_on_mesh():
+    """int16 batches must flow through the sharded (shard_map) train
+    step: the transfer_scale [B] leaf shards over the data axis like
+    every other batch leaf, and the loss matches the f32 feed."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+
+    loader, hps = _integer_origin_loader(seed=13)
+    ref_loader, _ = _integer_origin_loader(seed=13)
+    hps = hps.replace(use_recurrent_dropout=False)
+    model = SketchRNN(hps)
+    mesh = make_mesh(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh=mesh)
+    b_q = loader.random_batch(int16_scale=loader.scale_factor)
+    b_f = ref_loader.random_batch()
+    key = jax.random.key(1)
+    _, m_q = step(state, shard_batch(b_q, mesh), key)
+    state2 = make_train_state(model, hps, jax.random.key(0))
+    _, m_f = step(state2, shard_batch(b_f, mesh), key)
+    assert float(m_q["loss"]) == float(m_f["loss"])
+
+
+def test_prefetch_int16_refuses_float_natured_corpus():
+    """A corpus whose normalization scale makes 1 raw unit coarse (the
+    synthetic corpus: scale ~0.24) must be REFUSED, not silently
+    rounded to nothing (r4 review finding: the bench briefly trained
+    on strokes quantized to almost-all-zero offsets)."""
+    loader, _ = make_loader(seed=2)   # never normalized: scale 1.0
+    with pytest.raises(ValueError, match="integer-origin"):
+        prefetch_batches(loader, mesh=None, depth=1,
+                         transfer_dtype="int16")
+
+    class NoScale:
+        pass
+
+    with pytest.raises(ValueError, match="integer-origin"):
+        prefetch_batches(NoScale(), mesh=None, depth=1,
+                         transfer_dtype="int16")
