@@ -16,9 +16,17 @@ per-phase shares of the full fed step:
                  through the full decoder/encoder.)
 4. ``no_enc``  — stub-MDN step with ``conditional=False`` (encoder, KL
                  and the z pathway removed); encoder share ≈
-                 stub_mdn - no_enc. Caveat: the z gate-bias (x_extra)
-                 path of the decoder kernel also disappears, so this
-                 attributes the (small) xb cost to the encoder.
+                 stub_mdn - no_enc. CAVEAT (r4): this rung is a flawed
+                 attribution — removing ``conditional`` also removes
+                 the decoder's x_bias path (switching its backward to
+                 the larger non-xb tile, ~5-6 ms measured), and in r3
+                 the "encoder" share it produced silently contained a
+                 ~55 ms take_along_axis backward scatter (since
+                 eliminated). Prefer ``scripts/glue_ladder.py``'s
+                 ``no_enc_xb`` rung (keeps x_bias alive via a class
+                 embedding) and its K-differential timing for
+                 attribution; this ladder remains useful for the
+                 fed/cached/feed-share rungs.
 5. ``update``  — optimizer-only program (clip + adam + apply) on
                  realistic gradient pytrees.
 6. decoder share = no_enc - update (the remainder: decoder fwd+bwd and

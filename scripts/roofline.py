@@ -146,16 +146,27 @@ def main() -> int:
     dec = R.decoder_geometry(hps)
 
     # ---- anchors ----------------------------------------------------------
-    # size differential: a single absolute timing would fold the tunnel's
-    # 10-130 ms dispatch stall into a ~1.5 ms reduction and report GB/s
-    # off by 10-100x (the first run of this script measured "11 GB/s")
-    red = jax.jit(lambda x: jnp.sum(x, dtype=jnp.float32))
-    big = jnp.ones((1024, 1024, 1024), jnp.bfloat16)   # 2 GiB
-    small = jnp.ones((256, 1024, 1024), jnp.bfloat16)  # 0.5 GiB
-    t_big = _median_time(red, big, reps=reps)
-    t_small = _median_time(red, small, reps=reps)
-    hbm_gbps = (big.size - small.size) * 2 / (t_big - t_small) / 1e9
-    del big, small
+    # scan-chained reduction, timed at two chain lengths: a single
+    # absolute timing folds the tunnel's 10-130 ms dispatch stall into a
+    # ~3 ms reduction ("11 GB/s"), and a size-differential of two
+    # absolute timings differences the same noise ("2257 GB/s" — above
+    # the chip's spec). Chaining N dependent passes inside one program
+    # and differencing in N cancels both. The perturbation makes each
+    # pass read a genuinely different array (no CSE).
+    big = jnp.ones((512, 1024, 1024), jnp.bfloat16)  # 1 GiB
+
+    def _hbm_body():
+        def body(c, _):
+            x, acc = c
+            s = jnp.sum(x, dtype=jnp.float32)
+            return (x + (s * 1e-24).astype(x.dtype), acc + s), None
+        return body
+
+    # each pass reads 1 GiB and writes 1 GiB (the perturbated copy)
+    t_pass = _chain_call_time(_hbm_body, (big, jnp.float32(0.0)),
+                              reps=reps)
+    hbm_gbps = 2 * big.size * 2 / t_pass / 1e9
+    del big
     print(f"# HBM stream anchor: {hbm_gbps:.0f} GB/s", file=sys.stderr)
 
     # ---- shared test tensors ---------------------------------------------
