@@ -1,0 +1,102 @@
+"""Eval-sweep wall-clock: per-batch vs K-amortized (VERDICT r3 #5).
+
+The eval sweep used to pay the tunneled runtime's 10-130 ms per-call
+dispatch once per batch; ``eval_steps_per_call`` scans K batches per
+jitted call. This script measures a full ``evaluate`` sweep both ways
+on the real chip and records the result (kind="eval_sweep") so the
+improvement is BENCH_HISTORY evidence, not an assertion. The sweep
+result itself is asserted equal between the two paths (same keys and
+weighting; ~1e-6 reassociation).
+
+Usage::
+
+    python scripts/eval_sweep_bench.py [--batches 8] [--reps 3] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import hist_append  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, default=8,
+                    help="eval batches in the sweep (corpus sized to fit)")
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--seq_len", type=int, default=250)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.data.loader import synthetic_loader
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.parallel.mesh import make_mesh
+    from sketch_rnn_tpu.train import make_train_state
+    from sketch_rnn_tpu.train.loop import evaluate
+    from sketch_rnn_tpu.train.step import (make_eval_step,
+                                           make_multi_eval_step)
+
+    hps = get_default_hparams().replace(
+        batch_size=args.batch, max_seq_len=args.seq_len,
+        compute_dtype="bfloat16", fused_rnn=True,
+        fused_residual_dtype="bfloat16",
+        eval_steps_per_call=args.k)
+    model = SketchRNN(hps)
+    mesh = make_mesh(hps)
+    loader, _ = synthetic_loader(hps, args.batches * args.batch, seed=2)
+    assert loader.num_eval_batches == args.batches
+    state = make_train_state(model, hps, jax.random.key(0))
+    ev = make_eval_step(model, hps, mesh)
+    mev = make_multi_eval_step(model, hps, mesh)
+
+    def sweep(multi):
+        return evaluate(state.params, loader, ev, mesh,
+                        key=jax.random.key(3), multi=multi)
+
+    def timed(multi):
+        out = sweep(multi)  # warmup/compile
+        ts = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            out = sweep(multi)
+            ts.append(time.perf_counter() - t0)
+        return statistics.median(ts), out
+
+    t1, out1 = timed(None)
+    tk, outk = timed((mev, args.k))
+    for m in out1:
+        if abs(outk[m] - out1[m]) > 1e-5 * max(1.0, abs(out1[m])):
+            raise RuntimeError(f"chunked sweep diverged on {m}: "
+                               f"{outk[m]} vs {out1[m]}")
+    rec = {
+        "kind": "eval_sweep",
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_size": args.batch, "seq_len": args.seq_len,
+        "batches": args.batches, "k": args.k, "reps": args.reps,
+        "per_batch_sweep_s": round(t1, 4),
+        "k_amortized_sweep_s": round(tk, 4),
+        "speedup": round(t1 / tk, 3),
+    }
+    print(f"# per-batch {t1:.3f}s vs K={args.k} {tk:.3f}s "
+          f"({t1 / tk:.2f}x)", file=sys.stderr)
+    print(json.dumps(rec))
+    if args.json:
+        hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

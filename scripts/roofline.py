@@ -17,8 +17,9 @@ compute step (encoder = ``fused_lstm_seq`` x2 directions, decoder =
    with ``unroll=8`` so the XLA loop-carry HBM traffic amortizes to
    noise. Replica-step x grid-count predicts the kernel's compute
    floor; the matmul/gates split attributes it to MXU vs VPU.
-3. **An HBM stream anchor** (bf16 read reduction) to price the
-   kernels' residual-stream bytes from the analytic model
+3. **An HBM stream anchor** (chained 1 GiB bf16 read+write copy,
+   chain-length differential like every other timing here) to price
+   the kernels' residual-stream bytes from the analytic model
    (``utils/roofline.py``).
 
 The reconciliation table then shows, per phase and pass:
@@ -31,8 +32,9 @@ with and without seed).
 Timing discipline: host-value drain after every call
 (``scripts/_measure.drain``); every quoted number is a median over
 ``--reps`` differential pairs. Run in a good window and sanity-check
-the phase sums against the committed ladder (README "Where the step
-time goes": encoder ~123 ms, decoder ~108-111 ms, cached ~258 ms).
+the phase sums against the committed post-scatter-fix shares
+(glue_ladder 2026-07-31: encoder 72.6 ms, decoder(+xb) 96.2 ms,
+cached ~177 ms; the kernels alone: enc 2x27.4-28, dec ~98).
 
 Usage::
 
@@ -116,10 +118,12 @@ def main() -> int:
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--batch", type=int, default=4096)
     ap.add_argument("--seq_len", type=int, default=250)
-    ap.add_argument("--enc_ms", type=float, default=123.0,
-                    help="ladder-measured encoder share (context row)")
-    ap.add_argument("--dec_ms", type=float, default=110.6,
-                    help="ladder-measured decoder share (context row)")
+    ap.add_argument("--enc_ms", type=float, default=72.6,
+                    help="glue_ladder-measured encoder share, post "
+                         "scatter fix (context row)")
+    ap.add_argument("--dec_ms", type=float, default=96.2,
+                    help="glue_ladder-measured decoder(+xb) share "
+                         "(context row)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -158,11 +162,14 @@ def main() -> int:
     def _hbm_body():
         def body(c, _):
             x, acc = c
-            s = jnp.sum(x, dtype=jnp.float32)
+            # dependency scalar from a 256-byte slice: the pass's
+            # traffic is EXACTLY one 1 GiB read + one 1 GiB write (a
+            # full-array reduction would add a second, unfusable read
+            # pass and the accounting would undercount by 1/3)
+            s = jnp.sum(x[0, 0].astype(jnp.float32))
             return (x + (s * 1e-24).astype(x.dtype), acc + s), None
         return body
 
-    # each pass reads 1 GiB and writes 1 GiB (the perturbated copy)
     t_pass = _chain_call_time(_hbm_body, (big, jnp.float32(0.0)),
                               reps=reps)
     hbm_gbps = 2 * big.size * 2 / t_pass / 1e9
