@@ -1,0 +1,293 @@
+"""Probe: would storing LN statistics speed up the decoder backward?
+
+The decoder backward (`_lnlstm_bwd_kernel`) recomputes the full LN
+forward per grid step — 10 VPU reductions over H (mean+var for 4 gate
+LNs + the cell LN) — before running the gate backward. Storing the
+forward's (mean, rstd) as residual streams would replace those
+reductions with elementwise ``(u - mean) * rstd``. An XLA replica A/B
+measured the recompute at ~3.0 us/step of the replica's 16.6 (18%);
+this probe measures the ceiling of the lever INSIDE Mosaic, where the
+reduction cost may differ:
+
+The B arm runs a bwd kernel identical to production EXCEPT the five
+(mean, rstd) pairs come from in-VMEM stand-ins (numerically WRONG — a
+pure op-count probe) rather than reductions over the recomputed
+pre-activations. No extra HBM streams: this is the lever's UPPER
+bound; the real implementation would also pay ~1.7 ms/step of stats
+stream traffic ([T,B,10] f32 padded to 128 lanes) plus plumbing.
+
+Same-window interleaved A/B, K-chained grad calls, differential
+timing (the r3 probe discipline). Decision rule: B arm < 0.95x A at
+the full shape -> invest in real stats residuals; else record the
+negative here and in NOTES.
+
+Result (v5e, 2026-07-31, B=4096 T=250 H=512 xb, K-diff over 3 reps):
+**NEGATIVE — ceiling 1.010x** (prod 59.43 ms, fake-stats 58.83, prod
+re-check 59.58 — window stable). Inside Mosaic the LN fwd-recompute
+reductions are effectively free; the XLA replica's 18% saving does
+not transfer, so stats residuals cannot pay for their stream traffic.
+The decoder backward's 1.9x-over-MXU-floor gap lives in the serial
+per-grid-step structure, not the LN math. BENCH_HISTORY
+`probe_ln_stats` row.
+
+Usage::
+
+    python scripts/probe_ln_stats.py [--reps 3] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain, hist_append  # noqa: E402
+from sketch_rnn_tpu.ops import pallas_fused as PF  # noqa: E402
+
+
+def _fake_ln_gates(pre, c_prev, gam, bet, gc, bc, *, forget_bias):
+    """`_ln_gates(want_residuals=True)` with the 10 reductions replaced
+    by in-VMEM stand-ins (numerically wrong; op-count parity with a
+    stats-residual implementation: xhat = (u - mean) * rstd is
+    elementwise)."""
+    h = c_prev.shape[-1]
+    ys, xhats, rs = [], [], []
+    for j in range(4):
+        u = pre[:, j * h:(j + 1) * h]
+        mean = c_prev[:, :1] * 1e-3          # stand-in "loaded" stats
+        r = 1.0 + c_prev[:, 1:2] * 1e-3
+        xhat = (u - mean) * r
+        ys.append(xhat * gam[j][None, :] + bet[j][None, :])
+        xhats.append(xhat)
+        rs.append(r)
+    i = jax.nn.sigmoid(ys[0])
+    g_u = jnp.tanh(ys[1])
+    f = jax.nn.sigmoid(ys[2] + forget_bias)
+    o = jax.nn.sigmoid(ys[3])
+    new_c = c_prev * f + i * g_u
+    meanc = c_prev[:, :1] * 1e-3
+    rc = 1.0 + c_prev[:, 1:2] * 1e-3
+    xhat_c = (new_c - meanc) * rc
+    yc = xhat_c * gc[0][None, :] + bc[0][None, :]
+    new_h = jnp.tanh(yc) * o
+    return (i, g_u, f, o, new_c, new_h, yc, xhat_c, rc, xhats, rs)
+
+
+def _bwd_kernel_fake(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
+                     gc_ref, bc_ref, cs_ref, hp_ref, mask_ref, seed_ref,
+                     dhs_ref, dcT_ref, dhT_ref,
+                     dx_ref, dxb_ref, dwx_ref, dwh_ref, dgam_ref,
+                     dbet_ref, dgc_ref, dbc_ref, dc0_ref, dh0_ref,
+                     dc_scr, dh_scr, *, forget_bias, mask_mode,
+                     keep_prob, xb_mode):
+    """Production `_lnlstm_bwd_kernel` with `_fake_ln_gates` swapped in
+    (everything else verbatim — the A/B isolates the LN recompute)."""
+    ib = pl.program_id(0)
+    it = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when((ib == 0) & (it == 0))
+    def _():
+        dwx_ref[:] = jnp.zeros_like(dwx_ref)
+        dwh_ref[:] = jnp.zeros_like(dwh_ref)
+        dgam_ref[:] = jnp.zeros_like(dgam_ref)
+        dbet_ref[:] = jnp.zeros_like(dbet_ref)
+        dgc_ref[:] = jnp.zeros_like(dgc_ref)
+        dbc_ref[:] = jnp.zeros_like(dbc_ref)
+
+    @pl.when(it == 0)
+    def _():
+        dc_scr[:] = dcT_ref[:]
+        dh_scr[:] = dhT_ref[:]
+        dxb_ref[...] = jnp.zeros_like(dxb_ref)
+
+    x = x_ref[0]
+    h_prev = hp_ref[0].astype(jnp.float32)
+    c_prev = cs_ref[0].astype(jnp.float32)
+    gam, bet = gam_ref[...], bet_ref[...]
+    gc, bc = gc_ref[...], bc_ref[...]
+    pre = (jnp.dot(PF._cast(x, wx_ref), wx_ref[:],
+                   preferred_element_type=jnp.float32)
+           + jnp.dot(PF._cast(h_prev, wh_ref), wh_ref[:],
+                     preferred_element_type=jnp.float32))
+    if xb_mode:
+        pre = pre + xb_ref[...]
+    m = PF._step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
+                      pl.num_programs(0), c_prev.shape, keep_prob,
+                      mask_mode)
+    ln_res = _fake_ln_gates(pre, c_prev, gam, bet, gc, bc,
+                            forget_bias=forget_bias)
+    if m is not None:  # keep the dropout op-count identical
+        ln_res = (ln_res[0], ln_res[1] * m) + ln_res[2:]
+
+    dh = dh_scr[:] + dhs_ref[0].astype(jnp.float32)
+    d_pre, dc_next = PF._ln_lstm_bwd_gates(dh, dc_scr[:], c_prev, m,
+                                           ln_res, gam, gc, dgam_ref,
+                                           dbet_ref, dgc_ref, dbc_ref)
+    if xb_mode:
+        dxb_ref[...] += d_pre
+
+    d_pre_c = PF._cast(d_pre, wx_ref)
+    dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
+                        preferred_element_type=jnp.float32)
+    dwx_ref[:] += jnp.dot(PF._cast(x, wx_ref).T, d_pre_c,
+                          preferred_element_type=jnp.float32)
+    dh_scr[:] = jnp.dot(d_pre_c, wh_ref[:].T,
+                        preferred_element_type=jnp.float32)
+    dwh_ref[:] += jnp.dot(PF._cast(h_prev, wh_ref).T, d_pre_c,
+                          preferred_element_type=jnp.float32)
+    dc_scr[:] = dc_next
+
+    @pl.when(it == nt - 1)
+    def _():
+        dc0_ref[:] = dc_scr[:]
+        dh0_ref[:] = dh_scr[:]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--seq_len", type=int, default=250)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    reps = args.reps
+    B, T, H, D = args.batch, args.seq_len, 512, 5
+    bf = jnp.bfloat16
+    key = jax.random.key(0)
+
+    def w(shape, scale, dtype=bf, k=1):
+        return (scale * jax.random.normal(jax.random.fold_in(key, k),
+                                          shape)).astype(dtype)
+
+    wx, wh = w((D, 4 * H), 0.3, k=1), w((H, 4 * H), 0.05, k=2)
+    gam = jnp.ones((4, H), jnp.float32)
+    bet = jnp.zeros((4, H), jnp.float32)
+    gc2 = jnp.ones((1, H), jnp.float32)
+    bc2 = jnp.zeros((1, H), jnp.float32)
+    xs = w((T, B, D), 1.0, k=3)
+    xb = w((B, 4 * H), 0.1, jnp.float32, k=4)
+    c0 = jnp.zeros((B, H), jnp.float32)
+    seed = jnp.asarray(5, jnp.int32)
+    keep = 0.9
+
+    # forward once (shared residuals for both bwd arms)
+    hs, cT, hT, cs = PF._lnlstm_fwd_call(
+        xs, wx, wh, gam, bet, gc2[0], bc2[0], c0, c0, 1.0, None, seed,
+        keep, bf, xb)
+    h_prev = jnp.concatenate([c0[None].astype(hs.dtype), hs[:-1]], axis=0)
+    dhs = jnp.ones_like(hs)
+    rev = lambda a: jnp.flip(a, axis=0)
+    bt = PF._batch_tile(B, H, xb_bwd=True)
+    mode, mask_arg, seed_arg = PF._mask_args(None, seed)
+    step, tile, whole, mask_spec, seed_spec = PF._specs(
+        bt, H, mode, mask_arg.shape)
+    xb_mode, xb_arg, xb_spec = PF._xb_args(xb, bt, tile, whole)
+
+    def build(kernel_fn):
+        kern = functools.partial(kernel_fn, forget_bias=1.0,
+                                 mask_mode=mode, keep_prob=keep,
+                                 xb_mode=xb_mode)
+        def call(xs_rev, cs_rev, hp_rev, dhs_rev):
+            # operands arrive PRE-REVERSED as jit ARGUMENTS: closing
+            # over the 0.5 GB residual streams embeds them in the
+            # serialized HLO and breaks the remote-compile tunnel
+            # (observed as UNAVAILABLE/broken-pipe)
+            return pl.pallas_call(
+                kern,
+                grid=(B // bt, T),
+                in_specs=[step((bt, D)), xb_spec, whole(wx.shape),
+                          whole(wh.shape), whole(gam.shape),
+                          whole(bet.shape), whole(gc2.shape),
+                          whole(bc2.shape), step((bt, H)), step((bt, H)),
+                          mask_spec, seed_spec, step((bt, H)),
+                          tile((bt, H)), tile((bt, H))],
+                out_specs=(step((bt, D)), xb_spec, whole(wx.shape),
+                           whole(wh.shape), whole(gam.shape),
+                           whole(bet.shape), whole(gc2.shape),
+                           whole(bc2.shape), tile((bt, H)),
+                           tile((bt, H))),
+                out_shape=(
+                    jax.ShapeDtypeStruct((T, B, D), jnp.float32),
+                    jax.ShapeDtypeStruct(xb_arg.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(wx.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(wh.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(gam.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(bet.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(gc2.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(bc2.shape, jnp.float32),
+                    jax.ShapeDtypeStruct((B, H), jnp.float32),
+                    jax.ShapeDtypeStruct((B, H), jnp.float32),
+                ),
+                scratch_shapes=[pltpu.VMEM((bt, H), jnp.float32),
+                                pltpu.VMEM((bt, H), jnp.float32)],
+            )(xs_rev, xb_arg, wx, wh, gam, bet, gc2, bc2, cs_rev,
+              hp_rev, mask_arg, seed_arg, dhs_rev, c0, c0)
+        return call
+
+    prod = build(PF._lnlstm_bwd_kernel)
+    fake = build(_bwd_kernel_fake)
+
+    xs_rev0, cs_rev, hp_rev, dhs_rev = (rev(xs), rev(cs), rev(h_prev),
+                                        rev(dhs))
+
+    def chain_time(call, k):
+        def run(c, cs_r, hp_r, dhs_r):
+            def body(cc, _):
+                x, acc = cc
+                outs = call(x, cs_r, hp_r, dhs_r)
+                s = outs[2][0, 0]
+                return (x + (s * 1e-24).astype(x.dtype), acc + s), None
+            return jax.lax.scan(body, c, None, length=k)
+        f = jax.jit(run)
+        def t():
+            args = ((xs_rev0, jnp.float32(0.0)), cs_rev, hp_rev, dhs_rev)
+            for _ in range(2):
+                drain(f(*args))
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                drain(f(*args))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+        return t
+
+    # interleaved same-window A/B (r3 probe discipline). Chain depth
+    # 4/1: an 8-deep chain of this 10-output bwd program produced an
+    # HLO large enough to break the remote-compile tunnel.
+    tp4, tf4 = chain_time(prod, 4), chain_time(fake, 4)
+    tp1, tf1 = chain_time(prod, 1), chain_time(fake, 1)
+    a = (tp4() - tp1()) / 3
+    b = (tf4() - tf1()) / 3
+    a2 = (tp4() - tp1()) / 3   # A again: window-drift check
+    rec = {
+        "kind": "probe_ln_stats",
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_size": B, "seq_len": T, "tile": bt, "reps": reps,
+        "prod_bwd_ms": round(a * 1e3, 2),
+        "fake_stats_bwd_ms": round(b * 1e3, 2),
+        "prod_bwd_ms_recheck": round(a2 * 1e3, 2),
+        "speedup_ceiling": round(a / b, 3),
+    }
+    print(f"# prod {a*1e3:.2f} ms  fake-stats {b*1e3:.2f} ms  "
+          f"prod-recheck {a2*1e3:.2f} ms  ceiling {a/b:.3f}x",
+          file=sys.stderr)
+    print(json.dumps(rec))
+    if args.json:
+        hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
