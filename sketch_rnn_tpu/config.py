@@ -99,10 +99,13 @@ class HParams:
     #   same 2 bytes/element as bfloat16 but is EXACT for integer-origin
     #   corpora like QuickDraw — the on-device dequant reproduces host
     #   normalization bit-for-bit at measured throughput parity
-    #   (data/prefetch.py) — the recommended mode for real data. The
+    #   (data/prefetch.py) — the recommended mode for real data.
+    #   (Exact for unaugmented feeds; train-time random-scale jitter
+    #   makes offsets non-integer first, so the jittered feed rounds by
+    #   <=0.5 raw units — augmentation noise, not data.) The
     #   quantization step is 1 raw data unit, so the path REFUSES
     #   corpora whose normalization scale makes that coarse
-    #   (float-natured data, e.g. the synthetic corpus).
+    #   (float-natured data, e.g. the legacy float synthetic corpus).
     compute_dtype: str = "float32"     # "bfloat16" for MXU-friendly matmuls
     fused_rnn: bool = False            # Pallas recompute-backward kernels for
     #   ALL three cells (ops/pallas_fused.py): measured fwd+bwd at the

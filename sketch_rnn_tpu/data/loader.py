@@ -138,15 +138,26 @@ class DataLoader:
         # ``int16_scale``: quantize offsets back to integer data units in
         # the SAME native pass (the exact int16 transfer path,
         # data/prefetch.py) and add the "transfer_scale" [B] leaf.
+        if int16_scale is not None and not (int16_scale > 0):
+            # mirrors the prefetch guard for direct random_batch callers:
+            # the native path refuses quant<=0 (returns None) and the
+            # numpy fallback would quantize with scale 0 into all-zero
+            # offsets + transfer_scale 0 (device-side divide-by-zero)
+            raise ValueError(
+                f"int16_scale must be positive, got {int16_scale}")
         raw = [self.strokes[i] for i in idx]
+        # ONE augmentation seed per batch, shared by every native attempt:
+        # drawing a fresh seed per attempt would make the augmentation
+        # stream diverge across environments (native-i16 present vs
+        # absent) for the same loader seed (ADVICE r4)
+        aug_seed = int(self.rng.integers(0, 2 ** 63)) if self.augment else 0
         strokes = None
         if int16_scale is not None:
             native = NB.assemble_batch_aug_i16(
                 raw, self.hps.max_seq_len,
                 self.hps.random_scale_factor if self.augment else 0.0,
                 self.hps.augment_stroke_prob if self.augment else 0.0,
-                seed=(int(self.rng.integers(0, 2 ** 63))
-                      if self.augment else 0),
+                seed=aug_seed,
                 quant=float(int16_scale))
             if native is not None:
                 strokes, seq_len = native
@@ -157,7 +168,7 @@ class DataLoader:
                     raw, self.hps.max_seq_len,
                     self.hps.random_scale_factor,
                     self.hps.augment_stroke_prob,
-                    seed=int(self.rng.integers(0, 2 ** 63)))
+                    seed=aug_seed)
             else:
                 native = NB.assemble_batch(raw, self.hps.max_seq_len)
             if native is not None:

@@ -143,7 +143,13 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
     dequant ``int / scale`` reproduces the host normalization
     bit-for-bit, so unlike bfloat16 there is no rounding trade (the
     recommended mode for real data; measured throughput parity with
-    bfloat16). The per-example scale rides as a ``"transfer_scale"``
+    bfloat16). Exactness caveat (ADVICE r4): bit-for-bit holds for
+    UNAUGMENTED feeds (eval loaders; train with augment off). Train
+    loaders default to random-scale jitter, which makes offsets
+    non-integer before quantization — the int16 train feed then
+    differs from an f32 feed by at most 0.5 raw data units per offset
+    (the same magnitude as the corpus's own integer quantization), a
+    rounding of the AUGMENTATION noise, not of the data. The per-example scale rides as a ``"transfer_scale"``
     [B] batch leaf. Because the quantization step is ONE raw data
     unit, the mode refuses corpora whose normalization scale would
     make that coarse relative to the (unit-variance) normalized data —

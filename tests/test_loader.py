@@ -200,3 +200,38 @@ def test_filter_by_label_rejects_host_striped_loader():
                     global_size=30, num_hosts=2)
     with pytest.raises(RuntimeError, match="host-striped"):
         dl.filter_by_label(0)
+
+
+def test_random_batch_rejects_nonpositive_int16_scale(hps):
+    """Direct random_batch callers bypass the prefetch guard; a scale
+    of 0 would quantize every offset to zero AND ship transfer_scale=0
+    (device-side divide-by-zero in the dequant) via the numpy fallback
+    (ADVICE r4)."""
+    seqs, labels = make_synthetic_strokes(16, max_len=90)
+    dl = DataLoader(seqs, hps, labels=labels, augment=False)
+    for bad in (0.0, -2.5):
+        with pytest.raises(ValueError, match="int16_scale"):
+            dl.random_batch(int16_scale=bad)
+
+
+def test_augment_seed_drawn_once_per_batch(hps, monkeypatch):
+    """The augmentation stream must not depend on which native
+    assemblers are available: the int16 path draws ONE batch seed and
+    reuses it for the float retry, so a loader's RNG state after a
+    batch is identical whether or not the native i16 assembler exists
+    (ADVICE r4)."""
+    from sketch_rnn_tpu.data import native_batcher as NB
+
+    seqs, labels = make_synthetic_strokes(16, max_len=90)
+
+    def state_after_batch(i16_available):
+        dl = DataLoader([s.copy() for s in seqs], hps, labels=labels,
+                        augment=True, seed=123)
+        dl.normalize(0.1)  # big scale_factor-normalized ints not needed
+        if not i16_available:
+            monkeypatch.setattr(NB, "assemble_batch_aug_i16",
+                                lambda *a, **k: None)
+        dl.random_batch(int16_scale=10.0)
+        return dl.rng.integers(0, 2 ** 63)
+
+    assert state_after_batch(True) == state_after_batch(False)
