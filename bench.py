@@ -88,7 +88,8 @@ def _hist_append(record: dict) -> None:
 def _hist_best_strokes(dec_model: str, batch: int, seq_len: int,
                        dtype: str, remat: bool, fused: bool,
                        resid_dtype: str, device_kind: str,
-                       n_chips: int, prefetch_depth: int) -> float | None:
+                       n_chips: int, prefetch_depth: int,
+                       steps: int) -> float | None:
     """Best recorded strokes/sec/chip for this *physical* config.
 
     Pools across steps_per_call and transfer_dtype (dispatch-
@@ -102,12 +103,11 @@ def _hist_best_strokes(dec_model: str, batch: int, seq_len: int,
     (bench_summary keys on all the feed knobs for best/latest
     reporting — different purpose.)
 
-    Also pools across ``steps``: shorter trials let more of the host-
-    assembly cost escape the timed window (up to ``depth/(steps/K)`` —
-    ~40% at 25 steps vs ~20% at the pre-r3 50), so cross-``steps``
-    comparisons carry a few-percent bias toward shorter trials. ``steps``
-    is recorded in every row for exact filtering; the pooled best only
-    gates plausibility at a 70% threshold, far coarser than the bias.
+    Keys on ``steps`` (VERDICT r4 #7, by construction): shorter trials
+    let more of the host-assembly cost escape the timed window (up to
+    ``depth/(steps/K)`` — ~40% at 25 steps vs ~20% at the pre-r3 50),
+    so a pooled cross-``steps`` best would gate plausibility a few
+    percent unlike-for-unlike. Every train row records ``steps``.
     """
     try:
         f = open(_hist_path())
@@ -147,7 +147,8 @@ def _hist_best_strokes(dec_model: str, batch: int, seq_len: int,
                     # per-chip workload
                     or r.get("device_kind") != device_kind
                     or r.get("n_chips") != n_chips
-                    or r.get("prefetch_depth") != prefetch_depth):
+                    or r.get("prefetch_depth") != prefetch_depth
+                    or r.get("steps") != steps):
                 continue
             v = r.get("strokes_per_sec_per_chip")
             if v is not None and (best is None or v > best):
@@ -257,7 +258,7 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
         kind = jax.devices()[0].device_kind
         hist_best = _hist_best_strokes(dec_model, batch, seq_len, dtype,
                                        remat, fused, resid_dtype, kind,
-                                       n_chips, prefetch_depth)
+                                       n_chips, prefetch_depth, steps)
         strokes_per_trial = steps * hps.batch_size * hps.max_seq_len
         # time_s above which best-of is implausibly slow vs history:
         # per_chip = strokes_per_trial / t / n_chips, solved for t at

@@ -24,12 +24,15 @@ def key_of(r: dict):
                 f"B={r.get('batch_size')} full={bool(r.get('full_len'))}")
     # steps_per_call / transfer_dtype change what is being measured (feed
     # amortization), so K=5 rows must not pool with K=1 rows; old rows
-    # predate the knobs and default to 1 / float32
+    # predate the knobs and default to 1 / float32. `steps` keys too
+    # (VERDICT r4 #7): short trials let more host-assembly cost escape
+    # the window, so 25- and 50-step rows are not like-for-like.
     return ("train", r.get("dec_model"),
             f"B={r.get('batch_size')} T={r.get('seq_len')} "
             f"{r.get('dtype')} fused={r.get('fused_rnn')} "
             f"resid={r.get('resid_dtype')} K={r.get('steps_per_call', 1)} "
-            f"xfer={r.get('transfer_dtype', 'float32')}")
+            f"xfer={r.get('transfer_dtype', 'float32')} "
+            f"steps={r.get('steps')}")
 
 
 def metric_of(r: dict):

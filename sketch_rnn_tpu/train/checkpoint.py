@@ -7,10 +7,16 @@ scale factor, which is part of the model contract (a model restored
 without its scale factor decodes garbage).
 
 Format: flax msgpack bytes for the state pytree plus a JSON sidecar with
-step / scale factor / hparams, named ``ckpt_<step>.msgpack`` +
-``ckpt_<step>.json``. Restore-from-latest scans the directory, matching
-the reference's resume-from-latest flag. Writes go via a temp file +
-rename so a crash mid-save never corrupts the latest checkpoint.
+format version / step / scale factor / hparams, named
+``ckpt_<step>.msgpack`` + ``ckpt_<step>.json``. Restore-from-latest scans
+the directory, matching the reference's resume-from-latest flag. Writes
+go via a temp file + rename so a crash mid-save never corrupts the
+latest checkpoint.
+
+Versioning: ``format_version`` in the sidecar (VERDICT r4 #8). Sidecars
+without the field are version 1 (every pre-versioning checkpoint,
+e.g. the committed demo). Restore fails LOUDLY on a future version or
+a corrupt/truncated msgpack instead of half-restoring.
 """
 
 from __future__ import annotations
@@ -28,6 +34,11 @@ from sketch_rnn_tpu.train.state import TrainState
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
 
+# Bump when the saved layout changes incompatibly (pytree structure,
+# sidecar schema). Version 1: flax-msgpack TrainState + json sidecar
+# {step, scale_factor, hps} — unchanged since round 1.
+FORMAT_VERSION = 1
+
 
 def _paths(ckpt_dir: str, step: int) -> Tuple[str, str]:
     base = os.path.join(ckpt_dir, f"ckpt_{step:08d}")
@@ -44,7 +55,8 @@ def save_checkpoint(ckpt_dir: str, state: TrainState, scale_factor: float,
     # sidecar FIRST: latest_checkpoint() requires both files, so a crash
     # after this write but before the msgpack lands leaves only a harmless
     # orphan json and resume falls back to the previous complete checkpoint
-    meta = {"step": step, "scale_factor": float(scale_factor),
+    meta = {"format_version": FORMAT_VERSION, "step": step,
+            "scale_factor": float(scale_factor),
             "hps": json.loads(hps.to_json())}
     tmp = meta_path + ".tmp"
     with open(tmp, "w") as f:
@@ -87,10 +99,31 @@ def restore_checkpoint(ckpt_dir: str, target: TrainState,
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
     data_path, meta_path = _paths(ckpt_dir, step)
-    with open(data_path, "rb") as f:
-        state = serialization.from_bytes(target, f.read())
     with open(meta_path) as f:
         meta = json.load(f)
+    version = meta.get("format_version", 1)  # pre-versioning sidecars
+    if version > FORMAT_VERSION:
+        raise RuntimeError(
+            f"{meta_path} has checkpoint format_version={version}, newer "
+            f"than this build's {FORMAT_VERSION}; refusing to guess at "
+            f"the layout — restore with a matching or newer build")
+    with open(data_path, "rb") as f:
+        raw = f.read()
+    try:
+        state = serialization.from_bytes(target, raw)
+    except Exception as e:
+        # Two distinct failures surface here and the message must not
+        # send the user down the wrong path: a truncated/corrupt msgpack
+        # (torn write outside the atomic rename, disk damage) vs a
+        # pytree-structure mismatch (restoring with different hparams —
+        # a config error, not corruption). flax reports the latter as a
+        # ValueError naming the differing structure.
+        raise RuntimeError(
+            f"cannot restore checkpoint {data_path} ({len(raw)} bytes): "
+            f"{type(e).__name__}: {e} — either the file is corrupt or "
+            f"truncated, or `target` was built from different hparams "
+            f"than the checkpoint's (compare with its .json sidecar)"
+        ) from e
     return state, float(meta["scale_factor"]), meta
 
 

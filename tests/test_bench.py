@@ -24,7 +24,7 @@ _BASE = {
     "kind": "train", "dec_model": "layer_norm", "batch_size": 4096,
     "seq_len": 250, "dtype": "bfloat16", "remat": True, "fused_rnn": True,
     "resid_dtype": "bfloat16", "device_kind": "TPU v5 lite", "n_chips": 1,
-    "prefetch_depth": 2,
+    "prefetch_depth": 2, "steps": 25,
 }
 
 
@@ -58,8 +58,34 @@ def test_hist_best_pools_across_feed_knobs(tmp_path, monkeypatch):
         f.write("not json\n")
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
     best = bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
-                                    True, True, "bfloat16", "TPU v5 lite", 1, 2)
+                                    True, True, "bfloat16", "TPU v5 lite", 1, 2, 25)
     assert best == 4.0e6
+
+
+def test_hist_best_keyed_by_steps(tmp_path, monkeypatch):
+    """VERDICT r4 #7 (by construction): 50-step rows let less host-
+    assembly cost escape the timed window than 25-step rows, so the
+    plausibility gate must only compare same-``steps`` history."""
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    _write_hist(hist, [
+        {**_BASE, "steps": 25, "strokes_per_sec_per_chip": 4.0e6},
+        {**_BASE, "steps": 50, "strokes_per_sec_per_chip": 9.9e6},
+    ])
+    monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
+    args = ("layer_norm", 4096, 250, "bfloat16", True, True, "bfloat16",
+            "TPU v5 lite", 1, 2)
+    assert bench._hist_best_strokes(*args, 25) == 4.0e6
+    assert bench._hist_best_strokes(*args, 50) == 9.9e6
+    assert bench._hist_best_strokes(*args, 15) is None
+
+
+def test_bench_summary_keys_by_steps():
+    """bench_summary must not report a 50-step best as the record for
+    the 25-step configuration."""
+    from scripts.bench_summary import key_of
+
+    assert key_of({**_BASE, "steps": 25}) != key_of({**_BASE, "steps": 50})
+    assert key_of({**_BASE, "steps": 25}) == key_of(dict(_BASE))
 
 
 def test_hist_best_legacy_rows_default_resid_dtype(tmp_path, monkeypatch):
@@ -71,7 +97,7 @@ def test_hist_best_legacy_rows_default_resid_dtype(tmp_path, monkeypatch):
     _write_hist(hist, [{**legacy, "strokes_per_sec_per_chip": 3.0e6}])
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
     args = ("layer_norm", 4096, 250, "bfloat16", True, True)
-    tail = ("TPU v5 lite", 1, 2)
+    tail = ("TPU v5 lite", 1, 2, 25)
     assert bench._hist_best_strokes(*args, "float32", *tail) == 3.0e6
     assert bench._hist_best_strokes(*args, "bfloat16", *tail) is None
 
@@ -88,7 +114,7 @@ def test_hist_best_ignores_resid_dtype_when_not_fused(tmp_path,
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
     best = bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
                                     True, False, "bfloat16",
-                                    "TPU v5 lite", 1, 2)
+                                    "TPU v5 lite", 1, 2, 25)
     assert best == 2.0e6
 
 
@@ -97,13 +123,13 @@ def test_hist_best_missing_file_and_no_match(tmp_path, monkeypatch):
         bench, "_hist_path", lambda: str(tmp_path / "absent.jsonl"))
     assert bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
                                     True, True, "bfloat16",
-                                    "TPU v5 lite", 1, 2) is None
+                                    "TPU v5 lite", 1, 2, 25) is None
     hist = tmp_path / "BENCH_HISTORY.jsonl"
     _write_hist(hist, [{**_BASE, "strokes_per_sec_per_chip": 1.0}])
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
     assert bench._hist_best_strokes("hyper", 4096, 250, "bfloat16",
                                     True, True, "bfloat16",
-                                    "TPU v5 lite", 1, 2) is None
+                                    "TPU v5 lite", 1, 2, 25) is None
 
 
 def test_should_stop_policy_matrix():
