@@ -52,10 +52,11 @@ default bfloat16 — halves host->device bytes: +3% in good windows and
 3.67M vs 2.56M strokes/s/chip). int16 moves the SAME 2 bytes/element
 as bfloat16 but is EXACT for integer-origin corpora like QuickDraw
 (bf16 rounds) at measured throughput parity (same-window A/B/A,
-2026-07-31: 5.04M / 4.99M / 5.03M) — it is the recommended mode for
-real data, but the bench's synthetic corpus is float-natured (scale
-factor ~0.24, so integer-unit quantization would destroy it — the
-int16 path refuses such corpora), hence bfloat16 here.
+2026-07-31: 5.04M / 4.99M / 5.03M) — the recommended mode for real
+data), BENCH_GRID (integer-grid scale of the synthetic corpus,
+default 255 — the corpus is integer-origin like QuickDraw, scale
+factor ~17-65 depending on the class mix, so int16 transfer trains with meaningful loss here;
+0 restores the legacy float-natured corpus, which int16 refuses).
 
 Defaults are the measured-best v5e config: bfloat16 matmuls, global batch
 4096/chip (amortizes the per-step dispatch/feed overhead — measured
@@ -184,7 +185,8 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
                 prefetch_depth: int, fused: bool = False,
                 resid_dtype: str = "float32",
                 steps_per_call: int = 1,
-                transfer_dtype: str = "float32") -> dict:
+                transfer_dtype: str = "float32",
+                corpus_grid: float | None = 255.0) -> dict:
     """Measure train-step throughput for one decoder cell; fresh batch
     per timed step via the prefetch pipeline. ``steps_per_call=K`` runs
     K optimizer steps per jitted call (lax.scan; one dispatch + one
@@ -218,8 +220,14 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
     mesh = make_mesh(hps)
     # corpus smaller than the batch: random_batch samples with replacement,
     # so assembly cost is the real per-step cost while corpus memory stays
-    # bounded
-    loader, _ = synthetic_loader(hps, min(batch, 4096), seed=0)
+    # bounded. Integer-origin by default (VERDICT r4 #2): scale factor > 5, so transfer_dtype="int16" trains with meaningful loss here
+    # instead of refusing. The corpus does not key the history gate —
+    # dense TPU compute is data-independent (measured A/B/A parity),
+    # so throughput rows stay comparable across corpora; `loss` values
+    # across the corpus change are NOT comparable (corpus_grid in the
+    # row marks which corpus produced each).
+    loader, _ = synthetic_loader(hps, min(batch, 4096), seed=0,
+                                 integer_grid=corpus_grid)
 
     state = make_train_state(model, hps, jax.random.key(0))
     step = make_multi_train_step(model, hps, mesh)  # single step when K=1
@@ -324,6 +332,7 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
         "steps_per_call": steps_per_call,
         "transfer_dtype": transfer_dtype,
         "steps": steps,
+        "corpus_grid": corpus_grid,
         "time_s": round(best, 4),
         "strokes_per_sec_per_chip": round(per_chip, 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
@@ -412,6 +421,12 @@ def main() -> int:
         print(f"BENCH_TRANSFER={transfer!r} must be float32, bfloat16 "
               f"or int16", file=sys.stderr)
         return 2
+    grid = float(os.environ.get("BENCH_GRID", "255"))
+    corpus_grid = grid if grid > 0 else None  # 0 = legacy float corpus
+    if transfer == "int16" and corpus_grid is None:
+        print("BENCH_TRANSFER=int16 needs the integer-origin corpus; "
+              "unset BENCH_GRID=0", file=sys.stderr)
+        return 2
     flagship = os.environ.get("BENCH_DEC", "layer_norm")
 
     cells = (("lstm", "layer_norm", "hyper")
@@ -431,7 +446,8 @@ def main() -> int:
         try:
             r = bench_train(cell, steps, cell_batch, seq_len, dtype,
                             remat, depth, fused=fused, resid_dtype=resid,
-                            steps_per_call=spc, transfer_dtype=transfer)
+                            steps_per_call=spc, transfer_dtype=transfer,
+                            corpus_grid=corpus_grid)
         except (ValueError, TypeError):
             # deterministic config/shape errors fail identically on
             # retry — re-raise and keep the round's 480s budget for
@@ -445,7 +461,8 @@ def main() -> int:
             time.sleep(10)
             r = bench_train(cell, steps, cell_batch, seq_len, dtype,
                             remat, depth, fused=fused, resid_dtype=resid,
-                            steps_per_call=spc, transfer_dtype=transfer)
+                            steps_per_call=spc, transfer_dtype=transfer,
+                            corpus_grid=corpus_grid)
         results[cell] = r
         _hist_append(r)
         print(f"# {json.dumps(r)}", file=sys.stderr)

@@ -1,0 +1,464 @@
+"""Decompose the decoder backward kernel into measured in-kernel terms.
+
+VERDICT r4 #1: the decoder backward — 73.1 ms of the 177 ms step, 1.9x
+its padded-MXU floor (38.3 ms) — was *attributed* to "VPU gate/LN stack
+plus per-grid-step orchestration" but never decomposed into measured
+terms, and its XLA-scan replica misleads (97 ms — slower than the
+kernel). This probe builds a strictly NESTED ladder of arm-split
+variants of the real Mosaic kernel (`ops.pallas_fused._lnlstm_bwd_kernel`)
+so each delta prices one term:
+
+  prod      : production kernel (matches probe_ln_stats' 59.4 ms arm)
+  no_lnbwd  : `_ln_bwd_input`'s correction terms elided (dy * gamma
+              passthrough; LN param-grad sums kept)
+  no_ln     : + LN forward-recompute reductions elided (fake stats,
+              probe_ln_stats' arm — expected ~free)
+  no_gates  : + gate transcendentals/dropout/cell algebra elided
+              (d_pre is a cheap elementwise mix that keeps every
+              matmul operand and carry chain live)
+  no_gradmm : + the dwx/dwh/dx gradient matmuls elided (keeps the two
+              recompute matmuls and the serial d_pre @ wh.T backprop)
+  floor     : no matmuls at all — DMA + grid orchestration + carry
+              copies only (every operand stream still read, every
+              output still written)
+
+plus two non-kernel arms:
+
+  glue      : the XLA-level stream prep `_fused_ln_lstm_bwd` pays
+              around the kernel — rev(cs), concat+rev(h_prev),
+              rev(dhs), rev(dxs) — K-chain-differential-timed. This is
+              the gap between the in-graph 73.1 ms phase attribution
+              and the bare kernel.
+  grid scaling : prod at batch tiles {64, 128, 256} at constant total
+              work — time vs grid-step count prices per-grid-step
+              orchestration directly (tile 256 suppresses the xb
+              budget-halving, standalone-compile only).
+
+Every arm is DCE-audited: elided work is replaced by cheap ops that
+keep the remaining operands, streams and carries live (Mosaic compiles
+the kernel as written, but an operand no dataflow consumes would let
+it drop the load).
+
+Same-window interleaved chains, differential timing (chain4-chain1)/3,
+drain() host fetch — the r3/r4 probe discipline.
+
+Result (v5e, 2026-07-31, B=4096 T=250 H=512 xb, tile 128):
+see ARCHITECTURE.md "Decoder backward decomposition" and the
+BENCH_HISTORY `probe_dec_bwd_split` row.
+
+Usage::
+
+    python scripts/probe_dec_bwd_split.py [--reps 3] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain, hist_append  # noqa: E402
+from sketch_rnn_tpu.ops import pallas_fused as PF  # noqa: E402
+
+ARMS = ("prod", "no_lnbwd", "no_ln", "no_gates", "no_gradmm", "floor")
+
+
+def _fake_ln_gates(pre, c_prev, gam, bet, gc, bc, *, forget_bias):
+    """LN forward with the 10 reductions replaced by in-VMEM stand-ins
+    (probe_ln_stats' arm; numerically wrong, op-count honest)."""
+    h = c_prev.shape[-1]
+    ys, xhats, rs = [], [], []
+    for j in range(4):
+        u = pre[:, j * h:(j + 1) * h]
+        mean = c_prev[:, :1] * 1e-3
+        r = 1.0 + c_prev[:, 1:2] * 1e-3
+        xhat = (u - mean) * r
+        ys.append(xhat * gam[j][None, :] + bet[j][None, :])
+        xhats.append(xhat)
+        rs.append(r)
+    i = jax.nn.sigmoid(ys[0])
+    g_u = jnp.tanh(ys[1])
+    f = jax.nn.sigmoid(ys[2] + forget_bias)
+    o = jax.nn.sigmoid(ys[3])
+    new_c = c_prev * f + i * g_u
+    meanc = c_prev[:, :1] * 1e-3
+    rc = 1.0 + c_prev[:, 1:2] * 1e-3
+    xhat_c = (new_c - meanc) * rc
+    yc = xhat_c * gc[0][None, :] + bc[0][None, :]
+    new_h = jnp.tanh(yc) * o
+    return (i, g_u, f, o, new_c, new_h, yc, xhat_c, rc, xhats, rs)
+
+
+def _ln_bwd_gates_noln(dh, dc_carry, c_prev, m, ln_res, gam, gc,
+                       dgam_ref, dbet_ref, dgc_ref, dbc_ref):
+    """`_ln_lstm_bwd_gates` with `_ln_bwd_input` elided to dy * gamma
+    (the two per-gate row-means + rsqrt-chain corrections gone); the
+    LN param-grad accumulations are kept (they are grad work, not LN
+    correction work)."""
+    (i, g_u, f, o, _new_c, _new_h, yc, xhat_c, r_c, xhats, rs) = ln_res
+    tanh_yc = jnp.tanh(yc)
+    do = dh * tanh_yc
+    dyc = dh * o * (1.0 - tanh_yc * tanh_yc)
+    dgc_ref[0] += jnp.sum(dyc * xhat_c, axis=0)
+    dbc_ref[0] += jnp.sum(dyc, axis=0)
+    dc = dc_carry + dyc * gc[0][None, :]          # elided: _ln_bwd_input
+
+    df = dc * c_prev
+    g = g_u * m if m is not None else g_u
+    di = dc * g
+    dg_u = dc * i * m if m is not None else dc * i
+    dys = [di * i * (1.0 - i),
+           dg_u * (1.0 - g_u * g_u),
+           df * f * (1.0 - f),
+           do * o * (1.0 - o)]
+    d_pre_parts = []
+    for j in range(4):
+        dgam_ref[j] += jnp.sum(dys[j] * xhats[j], axis=0)
+        dbet_ref[j] += jnp.sum(dys[j], axis=0)
+        d_pre_parts.append(dys[j] * gam[j][None, :])   # elided correction
+    return jnp.concatenate(d_pre_parts, axis=-1), dc * f
+
+
+def _tile4(v):
+    return jnp.concatenate([v, v, v, v], axis=-1)
+
+
+def make_bwd_kernel(arm):
+    """Production `_lnlstm_bwd_kernel` with `arm`'s work elided.
+
+    Strictly nested: each arm elides everything the previous one did.
+    Remaining work always feeds the kernel outputs / carries so Mosaic
+    cannot dead-code it.
+    """
+    if arm == "prod":
+        return PF._lnlstm_bwd_kernel
+
+    def kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
+               gc_ref, bc_ref, cs_ref, hp_ref, h00_ref, mask_ref,
+               seed_ref, dhs_ref, dcT_ref, dhT_ref,
+               dx_ref, dxb_ref, dwx_ref, dwh_ref, dgam_ref,
+               dbet_ref, dgc_ref, dbc_ref, dc0_ref, dh0_ref,
+               dc_scr, dh_scr, *, forget_bias, mask_mode,
+               keep_prob, xb_mode):
+        ib = pl.program_id(0)
+        it = pl.program_id(1)
+        nt = pl.num_programs(1)
+
+        @pl.when((ib == 0) & (it == 0))
+        def _():
+            dwx_ref[:] = jnp.zeros_like(dwx_ref)
+            dwh_ref[:] = jnp.zeros_like(dwh_ref)
+            dgam_ref[:] = jnp.zeros_like(dgam_ref)
+            dbet_ref[:] = jnp.zeros_like(dbet_ref)
+            dgc_ref[:] = jnp.zeros_like(dgc_ref)
+            dbc_ref[:] = jnp.zeros_like(dbc_ref)
+
+        @pl.when(it == 0)
+        def _():
+            dc_scr[:] = dcT_ref[:]
+            dh_scr[:] = dhT_ref[:]
+            dxb_ref[...] = jnp.zeros_like(dxb_ref)
+
+        x = x_ref[0]
+        h_prev = PF._prev_block(hp_ref, h00_ref, it, nt).astype(jnp.float32)
+        c_prev = cs_ref[0].astype(jnp.float32)
+        gam, bet = gam_ref[...], bet_ref[...]
+        gc, bc = gc_ref[...], bc_ref[...]
+        dh = dh_scr[:] + dhs_ref[0].astype(jnp.float32)
+        dc_carry = dc_scr[:]
+
+        if arm in ("no_lnbwd", "no_ln", "no_gates", "no_gradmm"):
+            # recompute projections (2 MXU matmuls) stay live
+            pre = (jnp.dot(PF._cast(x, wx_ref), wx_ref[:],
+                           preferred_element_type=jnp.float32)
+                   + jnp.dot(PF._cast(h_prev, wh_ref), wh_ref[:],
+                             preferred_element_type=jnp.float32))
+            if xb_mode:
+                pre = pre + xb_ref[...]
+
+        if arm in ("no_lnbwd", "no_ln"):
+            m = PF._step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
+                              pl.num_programs(0), c_prev.shape, keep_prob,
+                              mask_mode)
+            gates = PF._ln_gates if arm == "no_lnbwd" else _fake_ln_gates
+            if arm == "no_lnbwd":
+                ln_res = gates(pre, c_prev, m, gam, bet, gc, bc,
+                               forget_bias=forget_bias,
+                               want_residuals=True)
+            else:
+                ln_res = gates(pre, c_prev, gam, bet, gc, bc,
+                               forget_bias=forget_bias)
+                if m is not None:      # keep dropout op-count identical
+                    ln_res = (ln_res[0], ln_res[1] * m) + ln_res[2:]
+            d_pre, dc_next = _ln_bwd_gates_noln(
+                dh, dc_carry, c_prev, m, ln_res, gam, gc, dgam_ref,
+                dbet_ref, dgc_ref, dbc_ref)
+        elif arm in ("no_gates", "no_gradmm"):
+            # no transcendentals / LN: cheap elementwise mix that keeps
+            # pre (-> recompute matmuls), dh (-> dhs stream + carry) and
+            # dc (-> cs stream + carry) live
+            d_pre = pre * 0.25 + _tile4(dh) + _tile4(dc_carry) * 0.1
+            dc_next = dc_carry * 0.9 + c_prev * 1e-3
+        else:  # floor: no matmuls at all
+            d_pre = _tile4(dh) + _tile4(dc_carry) * 0.1
+            if xb_mode:
+                d_pre = d_pre + xb_ref[...]
+            dc_next = dc_carry * 0.9 + c_prev * 1e-3
+
+        if xb_mode:
+            dxb_ref[...] += d_pre
+
+        if arm in ("no_lnbwd", "no_ln", "no_gates"):
+            d_pre_c = PF._cast(d_pre, wx_ref)
+            dx_ref[0] = jnp.dot(d_pre_c, wx_ref[:].T,
+                                preferred_element_type=jnp.float32)
+            dwx_ref[:] += jnp.dot(PF._cast(x, wx_ref).T, d_pre_c,
+                                  preferred_element_type=jnp.float32)
+            dh_scr[:] = jnp.dot(d_pre_c, wh_ref[:].T,
+                                preferred_element_type=jnp.float32)
+            dwh_ref[:] += jnp.dot(PF._cast(h_prev, wh_ref).T, d_pre_c,
+                                  preferred_element_type=jnp.float32)
+        elif arm == "no_gradmm":
+            # keep only the serial-chain matmul; x stays live via dx
+            d_pre_c = PF._cast(d_pre, wx_ref)
+            dx_ref[0] = x.astype(jnp.float32) * 0.5
+            dh_scr[:] = jnp.dot(d_pre_c, wh_ref[:].T,
+                                preferred_element_type=jnp.float32)
+        else:  # floor: keep every stream live without MXU work
+            dx_ref[0] = x.astype(jnp.float32) * 0.5
+            dh_scr[:] = dh * 0.5 + h_prev * 1e-3
+        dc_scr[:] = dc_next
+
+        @pl.when(it == nt - 1)
+        def _():
+            dc0_ref[:] = dc_scr[:]
+            dh0_ref[:] = dh_scr[:]
+
+    kernel.__name__ = f"_bwd_kernel_{arm}"
+    return kernel
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--seq_len", type=int, default=250)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--skip_grid", action="store_true")
+    args = ap.parse_args()
+    reps = args.reps
+    B, T, H, D = args.batch, args.seq_len, 512, 5
+    bf = jnp.bfloat16
+    key = jax.random.key(0)
+
+    def w(shape, scale, dtype=bf, k=1):
+        return (scale * jax.random.normal(jax.random.fold_in(key, k),
+                                          shape)).astype(dtype)
+
+    wx, wh = w((D, 4 * H), 0.3, k=1), w((H, 4 * H), 0.05, k=2)
+    gam = jnp.ones((4, H), jnp.float32)
+    bet = jnp.zeros((4, H), jnp.float32)
+    gc2 = jnp.ones((1, H), jnp.float32)
+    bc2 = jnp.zeros((1, H), jnp.float32)
+    xs = w((T, B, D), 1.0, k=3)
+    xb = w((B, 4 * H), 0.1, jnp.float32, k=4)
+    c0 = jnp.zeros((B, H), jnp.float32)
+    seed = jnp.asarray(5, jnp.int32)
+    keep = 0.9
+
+    # forward once (shared residuals for all arms)
+    hs, cT, hT, cs = PF._lnlstm_fwd_call(
+        xs, wx, wh, gam, bet, gc2[0], bc2[0], c0, c0, 1.0, None, seed,
+        keep, bf, xb)
+    h00 = c0.astype(hs.dtype)
+    dhs = jnp.ones_like(hs).astype(jnp.float32)
+    mode, mask_arg, seed_arg = PF._mask_args(None, seed)
+
+    def build(kernel_fn, bt):
+        step, tile, whole, mask_spec, seed_spec = PF._specs(
+            bt, H, mode, mask_arg.shape)
+        # r5 layout: natural-order streams through reversed index maps
+        rstep, rprev, rmask = PF._rev_specs(T, bt, H, mode,
+                                            mask_arg.shape)
+        xb_mode, xb_arg, xb_spec = PF._xb_args(xb, bt, tile, whole)
+        kern = functools.partial(kernel_fn, forget_bias=1.0,
+                                 mask_mode=mode, keep_prob=keep,
+                                 xb_mode=xb_mode)
+
+        def call(xs_a, cs_a, hs_a, dhs_a):
+            # big streams arrive as jit ARGUMENTS (closing over the
+            # 0.5 GB streams breaks the remote-compile tunnel)
+            return pl.pallas_call(
+                kern,
+                grid=(B // bt, T),
+                in_specs=[rstep((bt, D)), xb_spec, whole(wx.shape),
+                          whole(wh.shape), whole(gam.shape),
+                          whole(bet.shape), whole(gc2.shape),
+                          whole(bc2.shape), rstep((bt, H)),
+                          rprev((bt, H)), tile((bt, H)),
+                          rmask, seed_spec, rstep((bt, H)),
+                          tile((bt, H)), tile((bt, H))],
+                out_specs=(rstep((bt, D)), xb_spec, whole(wx.shape),
+                           whole(wh.shape), whole(gam.shape),
+                           whole(bet.shape), whole(gc2.shape),
+                           whole(bc2.shape), tile((bt, H)),
+                           tile((bt, H))),
+                out_shape=(
+                    jax.ShapeDtypeStruct((T, B, D), jnp.float32),
+                    jax.ShapeDtypeStruct(xb_arg.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(wx.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(wh.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(gam.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(bet.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(gc2.shape, jnp.float32),
+                    jax.ShapeDtypeStruct(bc2.shape, jnp.float32),
+                    jax.ShapeDtypeStruct((B, H), jnp.float32),
+                    jax.ShapeDtypeStruct((B, H), jnp.float32),
+                ),
+                scratch_shapes=[pltpu.VMEM((bt, H), jnp.float32),
+                                pltpu.VMEM((bt, H), jnp.float32)],
+            )(xs_a, xb_arg, wx, wh, gam, bet, gc2, bc2, cs_a,
+              hs_a, h00, mask_arg, seed_arg, dhs_a, c0, c0)
+        return call
+
+    def chain_time(call, k):
+        def run(c, cs_r, hs_r, dhs_r):
+            def body(cc, _):
+                x, acc = cc
+                outs = call(x, cs_r, hs_r, dhs_r)
+                s = outs[2][0, 0]
+                return (x + (s * 1e-24).astype(x.dtype), acc + s), None
+            return jax.lax.scan(body, c, None, length=k)
+        f = jax.jit(run)
+
+        def t():
+            a = ((xs, jnp.float32(0.0)), cs, hs, dhs)
+            for _ in range(2):
+                drain(f(*a))
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                drain(f(*a))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+        return t
+
+    bt = PF._batch_tile(B, H, xb_bwd=True)
+
+    # ---- glue arm: the RETIRED (pre-r5) layout's stream prep ----
+    # K-chained with a data dependency through every flip so nothing
+    # hoists; measures rev(cs) + concat+rev(h_prev) + rev(dhs) +
+    # rev(dxs_out) — what `_fused_ln_lstm_bwd` paid before the
+    # reversed-index-map layout (PF._rev_specs) eliminated it. Kept as
+    # the record of what the change bought.
+    def glue(k):
+        rev = lambda a: jnp.flip(a, axis=0)
+
+        def run(hs_, cs_, dhs_, dxs_):
+            def body(cc, _):
+                hs_c, cs_c, dhs_c, dxs_c, acc = cc
+                hp = jnp.concatenate(
+                    [c0[None].astype(hs_c.dtype), hs_c[:-1]], axis=0)
+                a, bb, cc2, dd = (rev(cs_c), rev(hp), rev(dhs_c),
+                                  rev(dxs_c))
+                s = (a[0, 0, 0].astype(jnp.float32)
+                     + bb[0, 0, 0].astype(jnp.float32) + cc2[0, 0, 0]
+                     + dd[0, 0, 0])
+                eps = (s * 1e-24)
+                return (hs_c + eps.astype(hs_c.dtype),
+                        a + eps.astype(a.dtype), cc2 + eps,
+                        dd + eps, acc + s), None
+            return jax.lax.scan(body, (hs_, cs_, dhs_, dxs_,
+                                       jnp.float32(0.0)), None, length=k)
+        f = jax.jit(run)
+        dxs0 = jnp.zeros((T, B, D), jnp.float32)
+
+        def t():
+            a = (hs, cs, dhs, dxs0)
+            for _ in range(2):
+                drain(f(*a))
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                drain(f(*a))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+        return t
+
+    results = {}
+    timers = {}
+    for arm in ARMS:
+        call = build(make_bwd_kernel(arm), bt)
+        timers[arm] = (chain_time(call, 4), chain_time(call, 1))
+    g4, g1 = glue(4), glue(1)
+
+    # interleaved same-window pass: all arms measured back-to-back
+    for arm in ARMS:
+        t4, t1 = timers[arm]
+        results[arm] = (t4() - t1()) / 3
+    results["glue"] = (g4() - g1()) / 3
+    prod_recheck = (timers["prod"][0]() - timers["prod"][1]()) / 3
+
+    # ---- grid-count scaling at constant total work ----
+    grid = {}
+    if not args.skip_grid:
+        for tile_b in (64, 128, 256):
+            if tile_b == bt:
+                grid[tile_b] = results["prod"]
+                continue
+            try:
+                call = build(PF._lnlstm_bwd_kernel, tile_b)
+                t4, t1 = chain_time(call, 4), chain_time(call, 1)
+                grid[tile_b] = (t4() - t1()) / 3
+            except Exception as e:  # tile 256 may exceed scoped VMEM
+                grid[tile_b] = None
+                print(f"# tile {tile_b}: {type(e).__name__}: "
+                      f"{str(e)[:120]}", file=sys.stderr)
+
+    ms = {k: round(v * 1e3, 2) for k, v in results.items()}
+    deltas = {
+        "ln_bwd_corrections": ms["prod"] - ms["no_lnbwd"],
+        "ln_fwd_reductions": ms["no_lnbwd"] - ms["no_ln"],
+        "gate_transcendentals": ms["no_ln"] - ms["no_gates"],
+        "grad_weight_matmuls": ms["no_gates"] - ms["no_gradmm"],
+        "serial_matmuls": ms["no_gradmm"] - ms["floor"],
+        "dma_orchestration_floor": ms["floor"],
+    }
+    rec = {
+        "kind": "probe_dec_bwd_split",
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_size": B, "seq_len": T, "tile": bt, "reps": reps,
+        "arms_ms": ms,
+        "prod_recheck_ms": round(prod_recheck * 1e3, 2),
+        "deltas_ms": {k: round(v, 2) for k, v in deltas.items()},
+        "glue_ms": ms["glue"],
+        "grid_scaling_ms": {str(k): (round(v * 1e3, 2) if v else None)
+                            for k, v in grid.items()},
+    }
+    for k, v in ms.items():
+        print(f"# {k:24s} {v:8.2f} ms", file=sys.stderr)
+    print(f"# prod recheck            {prod_recheck*1e3:8.2f} ms",
+          file=sys.stderr)
+    for k, v in deltas.items():
+        print(f"# delta {k:22s} {v:7.2f} ms", file=sys.stderr)
+    for k, v in rec["grid_scaling_ms"].items():
+        print(f"# grid tile {k:4s} {v} ms", file=sys.stderr)
+    print(json.dumps(rec))
+    if args.json:
+        hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -271,11 +271,17 @@ def _lstm_fwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref,
 
 
 def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
-                     mask_ref, seed_ref, dhs_ref, dcT_ref, dhT_ref,
+                     h00_ref, mask_ref, seed_ref, dhs_ref, dcT_ref, dhT_ref,
                      dx_ref, dxb_ref, dwx_ref, db_ref, dwh_ref, dc0_ref,
                      dh0_ref, dc_scr, dh_scr,
                      *, forget_bias, mask_mode, keep_prob, xb_mode):
-    """Reverse-time inner grid: program (ib, it) handles step T-1-it."""
+    """Reverse-time inner grid: program (ib, it) handles step T-1-it.
+
+    Operand streams arrive in NATURAL time order and are read through
+    the reversed index maps of :func:`_rev_specs`; ``hp_ref`` is the
+    ``hs`` stream at the clamped previous-step index, overridden with
+    ``h00`` (the initial carry, residual-dtype) at the first real step.
+    """
     ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -297,8 +303,8 @@ def _lstm_bwd_kernel(x_ref, xb_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
 
     # ---- recompute the forward step + gate backward (shared math) ----
     x = x_ref[0]
-    h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
-    c_prev = cs_ref[0].astype(jnp.float32)
+    h_prev = _prev_block(hp_ref, h00_ref, it, nt).astype(jnp.float32)
+    c_prev = cs_ref[0].astype(jnp.float32)   # residuals may be bf16
     # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
     m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
                    pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
@@ -341,6 +347,50 @@ def _specs(bt, h, mask_mode, mask_shape):
     seed_spec = pl.BlockSpec((1, 1), lambda ib, it: (0, 0),
                              memory_space=pltpu.SMEM)
     return step, tile, whole, mask_spec, seed_spec
+
+
+def _rev_specs(t, bt, h, mask_mode, mask_shape):
+    """Reversed-time BlockSpec builders for the BACKWARD kernels.
+
+    The backward grid iterates ``it = 0..T-1`` over REAL time step
+    ``s = T-1-it``. Early rounds fed the kernels ``jnp.flip``-ed streams
+    (plus a ``concatenate`` building ``h_prev``); those XLA copies cost
+    ~20 ms per decoder backward at the flagship shape (measured,
+    scripts/probe_dec_bwd_split.py — ~11% of the whole training step
+    across both RNNs). Reading the NATURAL-ORDER streams through
+    reversed index maps moves zero bytes instead:
+
+    - ``rstep``: block ``s = t-1-it`` of a ``[T, B, *]`` stream.
+    - ``rprev``: block ``s-1`` clamped to 0 — the previous-step entry of
+      the ``hs`` stream, replacing the ``concat(h0, hs[:-1])`` copy; the
+      kernel overrides the clamped duplicate read at ``s == 0``
+      (``it == nt-1``) with the ``h0`` operand.
+    - ``rmask``: streamed dropout masks, reversed like any step stream.
+
+    The backward's OUTPUT ``dxs`` also uses ``rstep``, writing natural
+    time order directly (no post-flip).
+    """
+    rstep = lambda blk: pl.BlockSpec(
+        (1, *blk), lambda ib, it: (t - 1 - it, ib, 0),
+        memory_space=pltpu.VMEM)
+    rprev = lambda blk: pl.BlockSpec(
+        (1, *blk), lambda ib, it: (jnp.maximum(t - 2 - it, 0), ib, 0),
+        memory_space=pltpu.VMEM)
+    whole = lambda shape: pl.BlockSpec(
+        shape, lambda ib, it: tuple(0 for _ in shape),
+        memory_space=pltpu.VMEM)
+    rmask = rstep((bt, h)) if mask_mode == "streamed" else whole(mask_shape)
+    return rstep, rprev, rmask
+
+
+def _prev_block(hp_ref, h00_ref, it, nt):
+    """The previous-step hidden state under reversed indexing: the
+    ``rprev`` block, overridden with the initial carry at the first real
+    step (``it == nt-1``). ``h00`` arrives pre-cast to the residual
+    dtype so step 0 recomputes from the SAME rounded value the old
+    ``concat(h0.astype(hs.dtype), hs[:-1])`` path fed — bitwise parity
+    with the flip-based layout."""
+    return jnp.where(it == nt - 1, h00_ref[:], hp_ref[0])
 
 
 def _mask_args(masks, seed):
@@ -491,23 +541,26 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     bt = _batch_tile(bsz, h, xb_bwd=x_bias is not None)
     mode, mask_arg, seed_arg = _mask_args(masks, seed)
     b2 = b.reshape(1, -1).astype(jnp.float32)
-    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
-    rev = lambda a: jnp.flip(a, axis=0)
+    h00 = h0.astype(hs.dtype)  # see _prev_block: bitwise-matches the
+    #                            old concat(h0.astype(hs.dtype), ...)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
+    rstep, rprev, rmask = _rev_specs(t, bt, h, mode, mask_arg.shape)
     xb_mode, xb_arg, xb_spec = _xb_args(x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lstm_bwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob,
                                xb_mode=xb_mode)
-    dxs_rev, dxb, dwx, db2, dwh, dc0, dh0 = pl.pallas_call(
+    dxs, dxb, dwx, db2, dwh, dc0, dh0 = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), xb_spec, whole(wx.shape), whole(b2.shape),
-                  whole(wh.shape), step((bt, h)), step((bt, h)), mask_spec,
-                  seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h))],
-        out_specs=(step((bt, d)), xb_spec, whole(wx.shape), whole(b2.shape),
-                   whole(wh.shape), tile((bt, h)), tile((bt, h))),
+        in_specs=[rstep((bt, d)), xb_spec, whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape), rstep((bt, h)), rprev((bt, h)),
+                  tile((bt, h)), rmask, seed_spec, rstep((bt, h)),
+                  tile((bt, h)), tile((bt, h))],
+        out_specs=(rstep((bt, d)), xb_spec, whole(wx.shape),
+                   whole(b2.shape), whole(wh.shape), tile((bt, h)),
+                   tile((bt, h))),
         out_shape=(
             _sds((t, bsz, d), jnp.float32, xs),
             _sds(xb_arg.shape, jnp.float32, xs),
@@ -520,13 +573,12 @@ def _fused_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
-    )(rev(xs), xb_arg, wx, b2, wh, rev(cs), rev(h_prev),
-      rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
-      rev(dhs), dcT, dhT)
+    )(xs, xb_arg, wx, b2, wh, cs, hs, h00, mask_arg, seed_arg,
+      dhs, dcT, dhT)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
     dxb_out = dxb.astype(x_bias.dtype) if x_bias is not None else None
     # cotangent dtypes must match the primals (wx/wh may be pre-cast bf16)
-    return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
+    return (dxs.astype(xs.dtype), dwx.astype(wx.dtype),
             db2.reshape(-1).astype(b.dtype), dwh.astype(wh.dtype),
             dc0, dh0, dmasks, _seed_cotangent(seed), dxb_out)
 
@@ -584,14 +636,15 @@ def _lstm_seq_fwd_kernel(x_ref, wx_ref, b_ref, wh_ref, c0_ref, h0_ref,
 
 
 def _lstm_seq_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
-                         mask_ref, seed_ref, dhs_ref,
+                         h00_ref, mask_ref, seed_ref, dhs_ref,
                          dwx_ref, db_ref, dwh_ref,
                          dc_scr, dh_scr, *, forget_bias, mask_mode,
                          keep_prob):
     """Reverse-time grid; carries start from ZERO cotangents (no final
     carry was produced); the initial-carry AND input gradients are
     dropped (encoder contract: xs is data, carries are constants — only
-    the weights are differentiated)."""
+    the weights are differentiated). Streams arrive in natural time
+    order, read through :func:`_rev_specs` (no flip copies)."""
     ib = pl.program_id(0)
     it = pl.program_id(1)
 
@@ -607,9 +660,9 @@ def _lstm_seq_bwd_kernel(x_ref, wx_ref, b_ref, wh_ref, cs_ref, hp_ref,
         dh_scr[:] = jnp.zeros_like(dh_scr)
 
     x = x_ref[0]
-    h_prev = hp_ref[0].astype(jnp.float32)
-    c_prev = cs_ref[0].astype(jnp.float32)
     nt = pl.num_programs(1)
+    h_prev = _prev_block(hp_ref, h00_ref, it, nt).astype(jnp.float32)
+    c_prev = cs_ref[0].astype(jnp.float32)
     m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
                    pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
     dh = dh_scr[:] + dhs_ref[0].astype(jnp.float32)
@@ -697,10 +750,10 @@ def _fused_lstm_seq_bwd(forget_bias, keep_prob, residual_dtype, res, dhs):
     bt = _batch_tile_seq(bsz, h)
     mode, mask_arg, seed_arg = _mask_args(masks, seed)
     b2 = b.reshape(1, -1).astype(jnp.float32)
-    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
-    rev = lambda a: jnp.flip(a, axis=0)
+    h00 = h0.astype(hs.dtype)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
+    rstep, rprev, rmask = _rev_specs(t, bt, h, mode, mask_arg.shape)
 
     kernel = functools.partial(_lstm_seq_bwd_kernel,
                                forget_bias=forget_bias, mask_mode=mode,
@@ -708,9 +761,9 @@ def _fused_lstm_seq_bwd(forget_bias, keep_prob, residual_dtype, res, dhs):
     dwx, db2, dwh = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), whole(wx.shape), whole(b2.shape),
-                  whole(wh.shape), step((bt, h)), step((bt, h)), mask_spec,
-                  seed_spec, step((bt, h))],
+        in_specs=[rstep((bt, d)), whole(wx.shape), whole(b2.shape),
+                  whole(wh.shape), rstep((bt, h)), rprev((bt, h)),
+                  tile((bt, h)), rmask, seed_spec, rstep((bt, h))],
         out_specs=(whole(wx.shape), whole(b2.shape), whole(wh.shape)),
         out_shape=(
             _sds(wx.shape, jnp.float32, xs),
@@ -720,9 +773,7 @@ def _fused_lstm_seq_bwd(forget_bias, keep_prob, residual_dtype, res, dhs):
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
-    )(rev(xs), wx, b2, wh, rev(cs), rev(h_prev),
-      rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
-      rev(dhs))
+    )(xs, wx, b2, wh, cs, hs, h00, mask_arg, seed_arg, dhs)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
     return (jnp.zeros_like(xs), dwx.astype(wx.dtype),
             db2.reshape(-1).astype(b.dtype), dwh.astype(wh.dtype),
@@ -837,12 +888,13 @@ def _ln_lstm_bwd_gates(dh, dc_carry, c_prev, m, ln_res, gam, gc,
 
 
 def _lnlstm_bwd_kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
-                       gc_ref, bc_ref, cs_ref, hp_ref, mask_ref, seed_ref,
-                       dhs_ref, dcT_ref, dhT_ref,
+                       gc_ref, bc_ref, cs_ref, hp_ref, h00_ref, mask_ref,
+                       seed_ref, dhs_ref, dcT_ref, dhT_ref,
                        dx_ref, dxb_ref, dwx_ref, dwh_ref, dgam_ref,
                        dbet_ref, dgc_ref, dbc_ref, dc0_ref, dh0_ref,
                        dc_scr, dh_scr, *, forget_bias, mask_mode,
                        keep_prob, xb_mode):
+    """Reverse-time grid over natural-order streams (see _rev_specs)."""
     ib = pl.program_id(0)
     it = pl.program_id(1)
     nt = pl.num_programs(1)
@@ -866,8 +918,8 @@ def _lnlstm_bwd_kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
         dxb_ref[...] = jnp.zeros_like(dxb_ref)
 
     x = x_ref[0]
-    h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
-    c_prev = cs_ref[0].astype(jnp.float32)
+    h_prev = _prev_block(hp_ref, h00_ref, it, nt).astype(jnp.float32)
+    c_prev = cs_ref[0].astype(jnp.float32)   # residuals may be bf16
     gam, bet = gam_ref[...], bet_ref[...]
     gc, bc = gc_ref[...], bc_ref[...]
     pre = (jnp.dot(_cast(x, wx_ref), wx_ref[:],
@@ -991,26 +1043,28 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     bt = _batch_tile(bsz, h, xb_bwd=x_bias is not None)
     mode, mask_arg, seed_arg = _mask_args(masks, seed)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
-    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
-    rev = lambda a: jnp.flip(a, axis=0)
+    h00 = h0.astype(hs.dtype)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
+    rstep, rprev, rmask = _rev_specs(t, bt, h, mode, mask_arg.shape)
     xb_mode, xb_arg, xb_spec = _xb_args(x_bias, bt, tile, whole)
 
     kernel = functools.partial(_lnlstm_bwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob,
                                xb_mode=xb_mode)
-    (dxs_rev, dxb, dwx, dwh, dgam, dbet, dgc2, dbc2,
+    (dxs, dxb, dwx, dwh, dgam, dbet, dgc2, dbc2,
      dc0, dh0) = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), xb_spec, whole(wx.shape), whole(wh.shape),
+        in_specs=[rstep((bt, d)), xb_spec, whole(wx.shape), whole(wh.shape),
                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
-                  whole(bc2.shape), step((bt, h)), step((bt, h)), mask_spec,
-                  seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h))],
-        out_specs=(step((bt, d)), xb_spec, whole(wx.shape), whole(wh.shape),
-                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
-                   whole(bc2.shape), tile((bt, h)), tile((bt, h))),
+                  whole(bc2.shape), rstep((bt, h)), rprev((bt, h)),
+                  tile((bt, h)), rmask, seed_spec, rstep((bt, h)),
+                  tile((bt, h)), tile((bt, h))],
+        out_specs=(rstep((bt, d)), xb_spec, whole(wx.shape),
+                   whole(wh.shape), whole(gam.shape), whole(bet.shape),
+                   whole(gc2.shape), whole(bc2.shape), tile((bt, h)),
+                   tile((bt, h))),
         out_shape=(
             _sds((t, bsz, d), jnp.float32, xs),
             _sds(xb_arg.shape, jnp.float32, xs),
@@ -1026,13 +1080,12 @@ def _fused_ln_lstm_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
         scratch_shapes=[pltpu.VMEM((bt, h), jnp.float32),
                         pltpu.VMEM((bt, h), jnp.float32)],
         interpret=_interpret_default(),
-    )(rev(xs), xb_arg, wx, wh, gam, bet, gc2, bc2, rev(cs), rev(h_prev),
-      rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
-      rev(dhs), dcT, dhT)
+    )(xs, xb_arg, wx, wh, gam, bet, gc2, bc2, cs, hs, h00,
+      mask_arg, seed_arg, dhs, dcT, dhT)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
     dxb_out = dxb.astype(x_bias.dtype) if x_bias is not None else None
     # cotangent dtypes must match the primals (wx/wh may be pre-cast bf16)
-    return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
+    return (dxs.astype(xs.dtype), dwx.astype(wx.dtype),
             dwh.astype(wh.dtype), dgam, dbet, dgc2.reshape(-1),
             dbc2.reshape(-1), dc0, dh0, dmasks, _seed_cotangent(seed),
             dxb_out)
@@ -1236,7 +1289,8 @@ def _hyper_bwd_kernel(x_ref, xb_ref, xbh_ref, wx_ref, b_ref, wh_ref,
                       bh_ref, whh_ref, whzx_ref, bhzx_ref, whzh_ref,
                       bhzh_ref, whzb_ref, zdx_ref, zdh_ref, zdb_ref,
                       gam_ref, bet_ref, gc_ref, bc_ref,
-                      cs_ref, hp_ref, hycs_ref, hyhp_ref, mask_ref, seed_ref,
+                      cs_ref, hp_ref, h00_ref, hycs_ref, hyhp_ref,
+                      hh00_ref, mask_ref, seed_ref,
                       dhs_ref, dcT_ref, dhT_ref, dhcT_ref, dhhT_ref,
                       dx_ref, dxb_ref, dxbh_ref, dwx_ref, db_ref, dwh_ref,
                       dwxhx_ref,
@@ -1270,12 +1324,14 @@ def _hyper_bwd_kernel(x_ref, xb_ref, xbh_ref, wx_ref, b_ref, wh_ref,
         dxb_ref[...] = jnp.zeros_like(dxb_ref)
         dxbh_ref[...] = jnp.zeros_like(dxbh_ref)
 
-    # ---- recompute the forward step ----
+    # ---- recompute the forward step (natural-order streams through
+    # _rev_specs; prev-step blocks overridden with the initial carries
+    # at the first real step) ----
     x = x_ref[0]
-    h_prev = hp_ref[0].astype(jnp.float32)   # residuals may be bf16
-    c_prev = cs_ref[0].astype(jnp.float32)
+    h_prev = _prev_block(hp_ref, h00_ref, it, nt).astype(jnp.float32)
+    c_prev = cs_ref[0].astype(jnp.float32)   # residuals may be bf16
     hc_prev = hycs_ref[0].astype(jnp.float32)
-    hh_prev = hyhp_ref[0].astype(jnp.float32)
+    hh_prev = _prev_block(hyhp_ref, hh00_ref, it, nt).astype(jnp.float32)
     # t_real = nt-1-it: the prng mask must be the one the FORWARD drew
     m = _step_mask(mask_ref, seed_ref, nt - 1 - it, ib,
                    pl.num_programs(0), c_prev.shape, keep_prob, mask_mode)
@@ -1530,12 +1586,11 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     bhzx2 = b_hz_x.reshape(1, -1).astype(jnp.float32)
     bhzh2 = b_hz_h.reshape(1, -1).astype(jnp.float32)
     gc2, bc2 = gc.reshape(1, -1), bc.reshape(1, -1)
-    h_prev = jnp.concatenate([h0[None].astype(hs.dtype), hs[:-1]], axis=0)
-    hyh_prev = jnp.concatenate([hh0[None].astype(hyhs.dtype), hyhs[:-1]],
-                               axis=0)
-    rev = lambda a: jnp.flip(a, axis=0)
+    h00 = h0.astype(hs.dtype)
+    hh00 = hh0.astype(hyhs.dtype)
     step, tile, whole, mask_spec, seed_spec = _specs(
         bt, h, mode, mask_arg.shape)
+    rstep, rprev, rmask = _rev_specs(t, bt, h, mode, mask_arg.shape)
 
     (xb_mode, xb_arg, xb_spec, xbh_arg,
      xbh_spec) = _xb_pair_args(x_bias, x_bias_hyper, bt, tile, whole)
@@ -1543,12 +1598,12 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
     kernel = functools.partial(_hyper_bwd_kernel, forget_bias=forget_bias,
                                mask_mode=mode, keep_prob=keep_prob,
                                xb_mode=xb_mode)
-    (dxs_rev, dxb, dxbh, dwx, db2, dwh, dwxhx, dwxhh, dbh2, dwhh, dwhzx,
+    (dxs, dxb, dxbh, dwx, db2, dwh, dwxhx, dwxhh, dbh2, dwhh, dwhzx,
      dbhzx2, dwhzh, dbhzh2, dwhzb, dzdx, dzdh, dzdb, dgam, dbet, dgc2,
      dbc2, dc0, dh0, dhc0, dhh0) = pl.pallas_call(
         kernel,
         grid=(bsz // bt, t),
-        in_specs=[step((bt, d)), xb_spec, xbh_spec,
+        in_specs=[rstep((bt, d)), xb_spec, xbh_spec,
                   whole(wx.shape), whole(b2.shape),
                   whole(wh.shape), whole(wxh_x.shape), whole(wxh_h.shape),
                   whole(bh2.shape), whole(whh.shape), whole(w_hz_x.shape),
@@ -1556,11 +1611,13 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
                   whole(bhzh2.shape), whole(w_hz_b.shape),
                   whole(zd_x.shape), whole(zd_h.shape), whole(zd_b.shape),
                   whole(gam.shape), whole(bet.shape), whole(gc2.shape),
-                  whole(bc2.shape), step((bt, h)), step((bt, h)),
-                  step((bt, hh_size)), step((bt, hh_size)), mask_spec,
-                  seed_spec, step((bt, h)), tile((bt, h)), tile((bt, h)),
+                  whole(bc2.shape), rstep((bt, h)), rprev((bt, h)),
+                  tile((bt, h)),
+                  rstep((bt, hh_size)), rprev((bt, hh_size)),
+                  tile((bt, hh_size)), rmask,
+                  seed_spec, rstep((bt, h)), tile((bt, h)), tile((bt, h)),
                   tile((bt, hh_size)), tile((bt, hh_size))],
-        out_specs=(step((bt, d)), xb_spec, xbh_spec,
+        out_specs=(rstep((bt, d)), xb_spec, xbh_spec,
                    whole(wx.shape), whole(b2.shape),
                    whole(wh.shape), whole(wxh_x.shape), whole(wxh_h.shape),
                    whole(bh2.shape), whole(whh.shape), whole(w_hz_x.shape),
@@ -1603,14 +1660,13 @@ def _fused_hyper_bwd(forget_bias, keep_prob, residual_dtype, res, grads):
                         pltpu.VMEM((bt, hh_size), jnp.float32),
                         pltpu.VMEM((bt, hh_size), jnp.float32)],
         interpret=_interpret_default(),
-    )(rev(xs), xb_arg, xbh_arg, wx, b2, wh, wxh_x, wxh_h, bh2, whh,
+    )(xs, xb_arg, xbh_arg, wx, b2, wh, wxh_x, wxh_h, bh2, whh,
       w_hz_x, bhzx2, w_hz_h, bhzh2, w_hz_b, zd_x, zd_h, zd_b, gam, bet,
-      gc2, bc2, rev(cs), rev(h_prev), rev(hycs), rev(hyh_prev),
-      rev(mask_arg) if mode == "streamed" else mask_arg, seed_arg,
-      rev(dhs), dcT, dhT, dhcT, dhhT)
+      gc2, bc2, cs, hs, h00, hycs, hyhs, hh00,
+      mask_arg, seed_arg, dhs, dcT, dhT, dhcT, dhhT)
     dmasks = jnp.zeros_like(masks) if masks is not None else None
     # cotangent dtypes must match the primals (big weights may be bf16)
-    return (rev(dxs_rev).astype(xs.dtype), dwx.astype(wx.dtype),
+    return (dxs.astype(xs.dtype), dwx.astype(wx.dtype),
             db2.reshape(-1).astype(b.dtype), dwh.astype(wh.dtype),
             dwxhx.astype(wxh_x.dtype), dwxhh.astype(wxh_h.dtype),
             dbh2.reshape(-1).astype(bh.dtype), dwhh.astype(whh.dtype),

@@ -85,7 +85,9 @@ def test_bench_summary_keys_by_steps():
     from scripts.bench_summary import key_of
 
     assert key_of({**_BASE, "steps": 25}) != key_of({**_BASE, "steps": 50})
-    assert key_of({**_BASE, "steps": 25}) == key_of(dict(_BASE))
+    # same steps but a differing non-key field must still pool together
+    assert key_of({**_BASE, "steps": 25}) == key_of(
+        {**_BASE, "steps": 25, "plausible": False, "time_s": 9.9})
 
 
 def test_hist_best_legacy_rows_default_resid_dtype(tmp_path, monkeypatch):
