@@ -47,13 +47,16 @@ steps per jitted call, default 5 — K fresh batches ride one stacked
 transfer + one dispatch, so a tunnel-latency stall costs at most one
 K-step window, not one per step; every timed step still consumes a
 fresh host-assembled batch), BENCH_TRANSFER (strokes transfer dtype,
-default bfloat16 — halves host->device bytes: +3% in good windows and
-+43% in a measured transfer-bound window (same-window A/B, 2026-07-30:
-3.67M vs 2.56M strokes/s/chip). int16 moves the SAME 2 bytes/element
-as bfloat16 but is EXACT for integer-origin corpora like QuickDraw
-(bf16 rounds) at measured throughput parity (same-window A/B/A,
-2026-07-31: 5.04M / 4.99M / 5.03M) — the recommended mode for real
-data), BENCH_GRID (integer-grid scale of the synthetic corpus,
+default int16 — the recommended real-data mode, now the bench default
+(r5 decision) since the integer-origin corpus makes it both runnable
+and EXACT: one flagship-scale train step is loss-BITWISE-equal to an
+f32 feed (BENCH_HISTORY probe_int16_exact_flagship), throughput is at
+parity with bfloat16 (same-window A/B/A int16/f32/int16 2026-07-31:
+6.17M / 5.08M / 6.18M — f32 moves 2x the bytes and loses ~17%;
+int16-vs-bf16 parity measured twice: 5.04/4.99/5.03M r4,
+6.17-vs-6.12M r5). bfloat16 remains for float-natured corpora
+(BENCH_GRID=0), float32 for exact-AD runs), BENCH_GRID
+(integer-grid scale of the synthetic corpus,
 default 255 — the corpus is integer-origin like QuickDraw, scale
 factor ~17-65 depending on the class mix, so int16 transfer trains with meaningful loss here;
 0 restores the legacy float-natured corpus, which int16 refuses).
@@ -411,7 +414,7 @@ def main() -> int:
     fused = os.environ.get("BENCH_FUSED", "1") == "1"
     resid = os.environ.get("BENCH_RESID", "bfloat16")
     spc = int(os.environ.get("BENCH_SPC", "5"))
-    transfer = os.environ.get("BENCH_TRANSFER", "bfloat16")
+    transfer = os.environ.get("BENCH_TRANSFER", "int16")
     if spc < 1 or steps % spc != 0:
         # config error, not a transient — fail fast, don't retry
         print(f"BENCH_STEPS={steps} must be a positive multiple of "

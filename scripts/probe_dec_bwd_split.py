@@ -427,13 +427,17 @@ def main() -> int:
                       f"{str(e)[:120]}", file=sys.stderr)
 
     ms = {k: round(v * 1e3, 2) for k, v in results.items()}
+    # the zero-matmul "floor" arm is NOT a valid lower bound (measured
+    # SLOWER than prod — removing all MXU work degrades Mosaic's
+    # pipeline scheduling), so no delta is derived from it; no_gradmm
+    # (2 recompute matmuls + the serial backprop matmul + DMA +
+    # orchestration) is the honest base term
     deltas = {
         "ln_bwd_corrections": ms["prod"] - ms["no_lnbwd"],
         "ln_fwd_reductions": ms["no_lnbwd"] - ms["no_ln"],
         "gate_transcendentals": ms["no_ln"] - ms["no_gates"],
         "grad_weight_matmuls": ms["no_gates"] - ms["no_gradmm"],
-        "serial_matmuls": ms["no_gradmm"] - ms["floor"],
-        "dma_orchestration_floor": ms["floor"],
+        "base_serial_mm_dma_orchestration": ms["no_gradmm"],
     }
     rec = {
         "kind": "probe_dec_bwd_split",
@@ -443,6 +447,7 @@ def main() -> int:
         "prod_recheck_ms": round(prod_recheck * 1e3, 2),
         "deltas_ms": {k: round(v, 2) for k, v in deltas.items()},
         "glue_ms": ms["glue"],
+        "floor_arm_uninterpretable": True,
         "grid_scaling_ms": {str(k): (round(v * 1e3, 2) if v else None)
                             for k, v in grid.items()},
     }

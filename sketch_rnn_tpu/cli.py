@@ -62,6 +62,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--data_dir", default="", help="QuickDraw .npz directory")
     p.add_argument("--synthetic", action="store_true",
                    help="use the synthetic corpus instead of .npz files")
+    p.add_argument("--synthetic_grid", type=float, default=255.0,
+                   help="integer-grid scale of the synthetic corpus "
+                        "(QuickDraw-shaped integer deltas, scale factor "
+                        "> 5 so transfer_dtype=int16 works; 0 = legacy "
+                        "float-natured corpus)")
     p.add_argument("--seed", type=int, default=0)
 
 
@@ -103,10 +108,11 @@ def _load_data(hps: HParams, args,
     lhps = mh.local_batch_hps(hps)
     host, nhosts = mh.process_index(), mh.process_count()
     if args.synthetic:
+        grid = (args.synthetic_grid if args.synthetic_grid > 0 else None)
         if scale_factor is None:
             train_l, scale = synthetic_loader(
                 lhps, 20 * hps.batch_size, seed=1, augment=True,
-                host_id=host, num_hosts=nhosts)
+                host_id=host, num_hosts=nhosts, integer_grid=grid)
         else:
             # eval/sample with a checkpointed scale never touch the train
             # corpus — skip generating it
@@ -116,10 +122,12 @@ def _load_data(hps: HParams, args,
         # duplicated work across hosts
         valid_l, _ = synthetic_loader(lhps, 2 * hps.batch_size, seed=2,
                                       scale_factor=scale,
-                                      host_id=host, num_hosts=nhosts)
+                                      host_id=host, num_hosts=nhosts,
+                                      integer_grid=grid)
         test_l, _ = synthetic_loader(lhps, 2 * hps.batch_size, seed=3,
                                      scale_factor=scale,
-                                     host_id=host, num_hosts=nhosts)
+                                     host_id=host, num_hosts=nhosts,
+                                     integer_grid=grid)
         return train_l, valid_l, test_l, scale
     return load_dataset(lhps, scale_factor=scale_factor,
                         host_id=host, num_hosts=nhosts)

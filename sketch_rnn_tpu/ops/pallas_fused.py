@@ -339,14 +339,20 @@ def _specs(bt, h, mask_mode, mask_shape):
                                     memory_space=pltpu.VMEM)
     tile = lambda blk: pl.BlockSpec(blk, lambda ib, it: (ib, 0),
                                     memory_space=pltpu.VMEM)
-    whole = lambda shape: pl.BlockSpec(
-        shape, lambda ib, it: tuple(0 for _ in shape),
-        memory_space=pltpu.VMEM)
+    whole = _whole_spec
     mask_spec = step((bt, h)) if mask_mode == "streamed" \
         else whole(mask_shape)
     seed_spec = pl.BlockSpec((1, 1), lambda ib, it: (0, 0),
                              memory_space=pltpu.SMEM)
     return step, tile, whole, mask_spec, seed_spec
+
+
+def _whole_spec(shape):
+    """Whole-array BlockSpec (weights, biases, small operands); the one
+    definition shared by the forward (_specs) and backward (_rev_specs)
+    builders."""
+    return pl.BlockSpec(shape, lambda ib, it: tuple(0 for _ in shape),
+                        memory_space=pltpu.VMEM)
 
 
 def _rev_specs(t, bt, h, mask_mode, mask_shape):
@@ -376,10 +382,8 @@ def _rev_specs(t, bt, h, mask_mode, mask_shape):
     rprev = lambda blk: pl.BlockSpec(
         (1, *blk), lambda ib, it: (jnp.maximum(t - 2 - it, 0), ib, 0),
         memory_space=pltpu.VMEM)
-    whole = lambda shape: pl.BlockSpec(
-        shape, lambda ib, it: tuple(0 for _ in shape),
-        memory_space=pltpu.VMEM)
-    rmask = rstep((bt, h)) if mask_mode == "streamed" else whole(mask_shape)
+    rmask = (rstep((bt, h)) if mask_mode == "streamed"
+             else _whole_spec(mask_shape))
     return rstep, rprev, rmask
 
 

@@ -256,9 +256,16 @@ def test_integer_grid_corpus_is_integer_origin(hps):
                                       integer_grid=255.0)
     assert lscale > 5.0  # single-class hps corpus differs from above
     # quantizing a normalized batch back by the scale factor recovers
-    # exact integers: the int16 transfer invariant
+    # exact integers: the int16 transfer invariant — check the VALUES
+    # round-trip (dequant == an f32 batch of the same draw), not just
+    # the dtype
     b = loader.random_batch(int16_scale=lscale)
     assert b["strokes"].dtype == np.int16
+    ref_loader, _ = synthetic_loader(hps, 64, seed=3, integer_grid=255.0)
+    bf = ref_loader.random_batch()
+    np.testing.assert_array_equal(
+        b["strokes"][..., :2].astype(np.float32) / np.float32(lscale),
+        bf["strokes"][..., :2])
 
     # default stays the legacy float corpus
     legacy, _ = make_synthetic_strokes(8, seed=3)
