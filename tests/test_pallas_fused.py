@@ -701,3 +701,31 @@ def test_batch_tile_seq_doubles_budget():
     assert _batch_tile_seq(4096, 256) == 1024   # encoder: 2x the full 512
     assert _batch_tile(4096, 256) == 512
     assert _batch_tile_seq(4096, 512) == 512
+
+
+@pytest.mark.parametrize("t_len", [1, 2])
+def test_gradients_match_scan_short_sequences(t_len):
+    """T=1 / T=2 edge of the reversed-index backward layout: the
+    clamped previous-step index map (max(T-2-it, 0)) degenerates at
+    these lengths (every block index is 0) and the h0 override must
+    carry the whole recurrence."""
+    cell, params, xs, c0, h0 = _setup(LayerNormLSTMCell)
+    xs = xs[:t_len]
+
+    def loss_fused(p, c, hh):
+        hs, (cT, hT) = _call_fused(cell, p, xs, c, hh)
+        return jnp.sum(hs * 1.3) + jnp.sum(cT) + 2.0 * jnp.sum(hT)
+
+    def loss_scan(p, c, hh):
+        (cT, hT), hs = run_rnn(cell, p, xs, carry0=(c, hh))
+        return jnp.sum(hs * 1.3) + jnp.sum(cT) + 2.0 * jnp.sum(hT)
+
+    vf, gf = jax.value_and_grad(loss_fused, argnums=(0, 1, 2))(
+        params, c0, h0)
+    vs, gs = jax.value_and_grad(loss_scan, argnums=(0, 1, 2))(
+        params, c0, h0)
+    np.testing.assert_allclose(vf, vs, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gf),
+                    jax.tree_util.tree_leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=1e-5)
