@@ -46,9 +46,13 @@ Result (v5e, 2026-07-31, B=4096 T=250 H=512 xb, tile 128):
 see ARCHITECTURE.md "Decoder backward decomposition" and the
 BENCH_HISTORY `probe_dec_bwd_split` row.
 
+``--fwd`` runs the analogous FORWARD-kernel ladder (prod / no_ln /
+no_gates / floor) — the fwd's measured-vs-MXU-ideal gap (25.4 vs
+13.6 ms) decomposes into the same terms.
+
 Usage::
 
-    python scripts/probe_dec_bwd_split.py [--reps 3] [--json]
+    python scripts/probe_dec_bwd_split.py [--reps 3] [--json] [--fwd]
 """
 
 from __future__ import annotations
@@ -249,6 +253,200 @@ def make_bwd_kernel(arm):
     return kernel
 
 
+FWD_ARMS = ("prod", "no_ln", "no_gates", "floor")
+
+
+def _fake_ln_gates_fwd(pre, c_prev, m, gam, bet, gc, bc, *, forget_bias):
+    """Forward gate math with LN reductions replaced by stand-ins
+    (op-count parity with `_ln_gates(want_residuals=False)`)."""
+    h = c_prev.shape[-1]
+    ys = []
+    for j in range(4):
+        u = pre[:, j * h:(j + 1) * h]
+        mean = c_prev[:, :1] * 1e-3
+        r = 1.0 + c_prev[:, 1:2] * 1e-3
+        ys.append((u - mean) * r * gam[j][None, :] + bet[j][None, :])
+    i = jax.nn.sigmoid(ys[0])
+    g_u = jnp.tanh(ys[1])
+    g = g_u * m if m is not None else g_u
+    f = jax.nn.sigmoid(ys[2] + forget_bias)
+    o = jax.nn.sigmoid(ys[3])
+    new_c = c_prev * f + i * g
+    meanc = c_prev[:, :1] * 1e-3
+    rc = 1.0 + c_prev[:, 1:2] * 1e-3
+    yc = (new_c - meanc) * rc * gc[0][None, :] + bc[0][None, :]
+    return new_c, jnp.tanh(yc) * o
+
+
+def make_fwd_kernel(arm):
+    """Production `_lnlstm_fwd_kernel` with `arm`'s work elided
+    (nested: no_ln ⊃ no_gates ⊃ floor); remaining work always feeds
+    the outputs/carries so Mosaic cannot dead-code it."""
+    if arm == "prod":
+        return PF._lnlstm_fwd_kernel
+
+    def kernel(x_ref, xb_ref, wx_ref, wh_ref, gam_ref, bet_ref,
+               gc_ref, bc_ref, c0_ref, h0_ref, mask_ref, seed_ref,
+               hs_ref, cs_ref, cT_ref, hT_ref,
+               c_scr, h_scr, *, forget_bias, mask_mode, keep_prob,
+               xb_mode):
+        ib = pl.program_id(0)
+        it = pl.program_id(1)
+        nt = pl.num_programs(1)
+
+        @pl.when(it == 0)
+        def _():
+            c_scr[:] = c0_ref[:]
+            h_scr[:] = h0_ref[:]
+
+        c, h = c_scr[:], h_scr[:]
+        x = x_ref[0]
+        if arm in ("no_ln", "no_gates"):
+            pre = (jnp.dot(PF._cast(x, wx_ref), wx_ref[:],
+                           preferred_element_type=jnp.float32)
+                   + jnp.dot(PF._cast(h, wh_ref), wh_ref[:],
+                             preferred_element_type=jnp.float32))
+            if xb_mode:
+                pre = pre + xb_ref[...]
+        if arm == "no_ln":
+            m = PF._step_mask(mask_ref, seed_ref, it, ib,
+                              pl.num_programs(0), c.shape, keep_prob,
+                              mask_mode)
+            new_c, new_h = _fake_ln_gates_fwd(
+                pre, c, m, gam_ref[...], bet_ref[...], gc_ref[...],
+                bc_ref[...], forget_bias=forget_bias)
+        elif arm == "no_gates":
+            h_sz = c.shape[-1]
+            new_c = c * 0.9 + pre[:, :h_sz] * 0.1
+            new_h = h * 0.5 + pre[:, h_sz:2 * h_sz] * 0.1
+        else:  # floor: no matmuls; keep x/xb streams + carries live
+            h_sz = c.shape[-1]
+            new_c = c * 0.9 + x[:, :1] * 1e-3
+            new_h = h * 0.5 + (xb_ref[:, :h_sz] * 1e-3 if xb_mode
+                               else c * 1e-3)
+        cs_ref[0] = c.astype(cs_ref.dtype)
+        c_scr[:] = new_c
+        h_scr[:] = new_h
+        hs_ref[0] = new_h.astype(hs_ref.dtype)
+
+        @pl.when(it == nt - 1)
+        def _():
+            cT_ref[:] = new_c
+            hT_ref[:] = new_h
+
+    kernel.__name__ = f"_fwd_kernel_{arm}"
+    return kernel
+
+
+def run_fwd_ladder(args) -> int:
+    """Forward-kernel ladder at the flagship decoder shape."""
+    reps = args.reps
+    B, T, H, D = args.batch, args.seq_len, 512, 5
+    bf = jnp.bfloat16
+    key = jax.random.key(0)
+
+    def w(shape, scale, dtype=bf, k=1):
+        return (scale * jax.random.normal(jax.random.fold_in(key, k),
+                                          shape)).astype(dtype)
+
+    wx, wh = w((D, 4 * H), 0.3, k=1), w((H, 4 * H), 0.05, k=2)
+    gam = jnp.ones((4, H), jnp.float32)
+    bet = jnp.zeros((4, H), jnp.float32)
+    gc2 = jnp.ones((1, H), jnp.float32)
+    bc2 = jnp.zeros((1, H), jnp.float32)
+    xs = w((T, B, D), 1.0, k=3)
+    xb = w((B, 4 * H), 0.1, jnp.float32, k=4)
+    c0 = jnp.zeros((B, H), jnp.float32)
+    seed = jnp.asarray(5, jnp.int32)
+    keep = 0.9
+    bt = PF._batch_tile(B, H)   # fwd tile (no xb budget halving)
+    mode, mask_arg, seed_arg = PF._mask_args(None, seed)
+    step, tile, whole, mask_spec, seed_spec = PF._specs(
+        bt, H, mode, mask_arg.shape)
+    xb_mode, xb_arg, xb_spec = PF._xb_args(xb, bt, tile, whole)
+
+    def build(kernel_fn):
+        kern = functools.partial(kernel_fn, forget_bias=1.0,
+                                 mask_mode=mode, keep_prob=keep,
+                                 xb_mode=xb_mode)
+
+        def call(xs_a):
+            return pl.pallas_call(
+                kern,
+                grid=(B // bt, T),
+                in_specs=[step((bt, D)), xb_spec, whole(wx.shape),
+                          whole(wh.shape), whole(gam.shape),
+                          whole(bet.shape), whole(gc2.shape),
+                          whole(bc2.shape), tile((bt, H)), tile((bt, H)),
+                          mask_spec, seed_spec],
+                out_specs=(step((bt, H)), step((bt, H)), tile((bt, H)),
+                           tile((bt, H))),
+                out_shape=(
+                    jax.ShapeDtypeStruct((T, B, H), bf),
+                    jax.ShapeDtypeStruct((T, B, H), bf),
+                    jax.ShapeDtypeStruct((B, H), jnp.float32),
+                    jax.ShapeDtypeStruct((B, H), jnp.float32),
+                ),
+                scratch_shapes=[pltpu.VMEM((bt, H), jnp.float32),
+                                pltpu.VMEM((bt, H), jnp.float32)],
+            )(xs_a, xb_arg, wx, wh, gam, bet, gc2, bc2, c0, c0,
+              mask_arg, seed_arg)
+        return call
+
+    def chain_time(call, k):
+        def run(c):
+            def body(cc, _):
+                x, acc = cc
+                outs = call(x)
+                s = outs[2][0, 0]
+                return (x + (s * 1e-24).astype(x.dtype), acc + s), None
+            return jax.lax.scan(body, c, None, length=k)
+        f = jax.jit(run)
+
+        def t():
+            a = ((xs, jnp.float32(0.0)),)
+            for _ in range(2):
+                drain(f(*a))
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                drain(f(*a))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+        return t
+
+    timers = {a: (chain_time(build(make_fwd_kernel(a)), 4),
+                  chain_time(build(make_fwd_kernel(a)), 1))
+              for a in FWD_ARMS}
+    results = {a: (t4() - t1()) / 3 for a, (t4, t1) in timers.items()}
+    prod_recheck = (timers["prod"][0]() - timers["prod"][1]()) / 3
+    ms = {k: round(v * 1e3, 2) for k, v in results.items()}
+    deltas = {
+        "ln_stack": ms["prod"] - ms["no_ln"],
+        "gate_transcendentals": ms["no_ln"] - ms["no_gates"],
+        "matmuls_over_floor": ms["no_gates"] - ms["floor"],
+        "dma_orchestration_floor_CAUTION": ms["floor"],
+    }
+    rec = {
+        "kind": "probe_dec_fwd_split",
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_size": B, "seq_len": T, "tile": bt, "reps": reps,
+        "arms_ms": ms,
+        "prod_recheck_ms": round(prod_recheck * 1e3, 2),
+        "deltas_ms": {k: round(v, 2) for k, v in deltas.items()},
+    }
+    for k, v in ms.items():
+        print(f"# fwd {k:20s} {v:8.2f} ms", file=sys.stderr)
+    print(f"# fwd prod recheck        {prod_recheck*1e3:8.2f} ms",
+          file=sys.stderr)
+    for k, v in deltas.items():
+        print(f"# fwd delta {k:26s} {v:7.2f} ms", file=sys.stderr)
+    print(json.dumps(rec))
+    if args.json:
+        hist_append(rec)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--reps", type=int, default=3)
@@ -256,7 +454,11 @@ def main() -> int:
     ap.add_argument("--seq_len", type=int, default=250)
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--skip_grid", action="store_true")
+    ap.add_argument("--fwd", action="store_true",
+                    help="run the FORWARD-kernel ladder instead")
     args = ap.parse_args()
+    if args.fwd:
+        return run_fwd_ladder(args)
     reps = args.reps
     B, T, H, D = args.batch, args.seq_len, 512, 5
     bf = jnp.bfloat16

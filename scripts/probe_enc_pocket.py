@@ -1,0 +1,193 @@
+"""Itemize the encoder-phase non-kernel pocket (VERDICT r4 #3).
+
+After the r5 reversed-index backward layout, the encoder phase share
+is ~52 ms (glue_ladder: enc_only 52.7 / enc_path 51.7 / differential
+share 52.3 — three estimates agreeing) while the bare seq-kernel
+chains read only 2 x 14.2 = 28.4 ms (roofline kernels line). The ~20
+ms between them lives INSIDE the encode path. This probe decomposes
+it with a strictly NESTED ladder of inline encode replicas — each arm
+removes one mechanism, everything else held op-identical, all arms
+chain-differential-timed in ONE window with params-varying chains
+whose dependency consumes EVERY grad leaf (the r4 measurement traps):
+
+  prod       : length-aware reversal gather + 2 seq kernels (in-kernel
+               PRNG dropout) + one-hot final-state einsums + mu/presig
+               heads — op-identical to models.vae.SketchRNN.encode
+  no_drop    : dropout seeds off
+  flip_rev   : backward direction fed jnp.flip(xs) instead of the
+               length-aware take_along_axis gather
+  no_rev     : backward direction fed xs directly (no reversal at all)
+  slice_final: one-hot einsums replaced by static hs[-1] slices
+  sum_hs     : loss = plain sums of hs (no heads, no final-state
+               machinery; the hs cotangent becomes a loop-invariant
+               constant the compiler can hoist) — this arm should
+               reproduce the bare roofline kernel number, anchoring
+               the ladder to the independent measurement.
+
+Result (v5e, 2026-07-31, B=4096 T=250 H=256/dir): see ARCHITECTURE.md
+"The encoder pocket" and the BENCH_HISTORY `probe_enc_pocket` row.
+
+Usage::
+
+    python scripts/probe_enc_pocket.py [--reps 3] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._measure import drain, hist_append  # noqa: E402
+from sketch_rnn_tpu.ops import pallas_fused as PF  # noqa: E402
+from sketch_rnn_tpu.ops.rnn import length_reverse_indices  # noqa: E402
+
+ARMS = ("prod", "no_drop", "flip_rev", "no_rev", "slice_final", "sum_hs")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--seq_len", type=int, default=250)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    reps = args.reps
+    B, T, H, D, NZ = args.batch, args.seq_len, 256, 5, 128
+    bf = jnp.bfloat16
+    key = jax.random.key(0)
+
+    def w(shape, scale, dtype=bf, k=1):
+        return (scale * jax.random.normal(jax.random.fold_in(key, k),
+                                          shape)).astype(dtype)
+
+    # two directions' weights + the two latent heads (differentiated so
+    # the backward includes everything model.encode's does)
+    ws = {
+        "f": (w((D, 4 * H), 0.3, k=1), w((4 * H,), 0.05, jnp.float32, k=2),
+              w((H, 4 * H), 0.05, k=3)),
+        "b": (w((D, 4 * H), 0.3, k=4), w((4 * H,), 0.05, jnp.float32, k=5),
+              w((H, 4 * H), 0.05, k=6)),
+        "mu": w((2 * H, NZ), 0.1, k=7),
+        "presig": w((2 * H, NZ), 0.1, k=8),
+    }
+    xs = w((T, B, D), 1.0, jnp.float32, k=9)
+    c0 = jnp.zeros((B, H), jnp.float32)
+    seq_len = jax.random.randint(jax.random.fold_in(key, 10), (B,),
+                                 T // 3, T + 1)
+    rev_idx = length_reverse_indices(T, seq_len)
+    last = jnp.clip(seq_len - 1, 0, T - 1)
+    keep = 0.9
+
+    def seq_kernel(xs_in, wset, seed):
+        wx, b, wh = wset
+        return PF.fused_lstm_seq(xs_in, wx, b, wh, c0, c0, 1.0, None,
+                                 seed, keep if seed is not None else 1.0,
+                                 bf)
+
+    def make_loss(arm):
+        drop = arm == "prod"
+
+        def loss(ws, xs):
+            sf = jnp.int32(7) if drop else None
+            sb = jnp.int32(11) if drop else None
+            if arm in ("prod", "no_drop"):
+                xs_b = jnp.take_along_axis(xs, rev_idx[:, :, None], axis=0)
+            elif arm == "flip_rev":
+                xs_b = jnp.flip(xs, axis=0)
+            else:
+                xs_b = xs
+            hs_f = seq_kernel(xs, ws["f"], sf)
+            hs_b = seq_kernel(xs_b, ws["b"], sb)
+            if arm == "sum_hs":
+                return (jnp.sum(hs_f.astype(jnp.float32))
+                        + jnp.sum(hs_b.astype(jnp.float32)))
+            if arm == "slice_final":
+                h_f, h_b = hs_f[-1], hs_b[-1]
+            else:
+                onehot = jax.nn.one_hot(last, T, dtype=hs_f.dtype)
+                h_f = jnp.einsum("tbh,bt->bh", hs_f, onehot,
+                                 preferred_element_type=jnp.float32
+                                 ).astype(hs_f.dtype)
+                h_b = jnp.einsum("tbh,bt->bh", hs_b, onehot,
+                                 preferred_element_type=jnp.float32
+                                 ).astype(hs_b.dtype)
+            h = jnp.concatenate([h_f, h_b], axis=-1)
+            mu = jnp.dot(h, ws["mu"], preferred_element_type=jnp.float32)
+            ps = jnp.dot(h, ws["presig"],
+                         preferred_element_type=jnp.float32)
+            return jnp.sum(mu) + jnp.sum(ps)
+        return loss
+
+    def chain_time(arm, k):
+        loss = make_loss(arm)
+
+        def call(xs_a):
+            g = jax.grad(loss)(ws, xs_a)
+            # consume EVERY grad leaf (one-leaf deps let XLA dead-code
+            # the whole RNN backward — r4 trap)
+            return sum(jnp.sum(l.astype(jnp.float32))
+                       for l in jax.tree_util.tree_leaves(g))
+
+        def run(c):
+            def body(cc, _):
+                x, acc = cc
+                s = call(x)
+                return (x + (s * 1e-24).astype(x.dtype), acc + s), None
+            return jax.lax.scan(body, c, None, length=k)
+        f = jax.jit(run)
+
+        def t():
+            a = ((xs, jnp.float32(0.0)),)
+            for _ in range(2):
+                drain(f(*a))
+            ts = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                drain(f(*a))
+                ts.append(time.perf_counter() - t0)
+            return statistics.median(ts)
+        return t
+
+    timers = {a: (chain_time(a, 4), chain_time(a, 1)) for a in ARMS}
+    results = {a: (t4() - t1()) / 3 for a, (t4, t1) in timers.items()}
+    prod_recheck = (timers["prod"][0]() - timers["prod"][1]()) / 3
+    ms = {k: round(v * 1e3, 2) for k, v in results.items()}
+    deltas = {
+        "dropout_prng": ms["prod"] - ms["no_drop"],
+        "lenaware_gather_vs_flip": ms["no_drop"] - ms["flip_rev"],
+        "flip_vs_none": ms["flip_rev"] - ms["no_rev"],
+        "onehot_einsum_vs_slice": ms["no_rev"] - ms["slice_final"],
+        "heads_slice_dhs_vs_sumloss": ms["slice_final"] - ms["sum_hs"],
+        "kernels_anchor_sum_hs": ms["sum_hs"],
+    }
+    rec = {
+        "kind": "probe_enc_pocket",
+        "device_kind": jax.devices()[0].device_kind,
+        "batch_size": B, "seq_len": T, "reps": reps,
+        "arms_ms": ms,
+        "prod_recheck_ms": round(prod_recheck * 1e3, 2),
+        "deltas_ms": {k: round(v, 2) for k, v in deltas.items()},
+    }
+    for k, v in ms.items():
+        print(f"# {k:26s} {v:8.2f} ms", file=sys.stderr)
+    print(f"# prod recheck              {prod_recheck*1e3:8.2f} ms",
+          file=sys.stderr)
+    for k, v in deltas.items():
+        print(f"# delta {k:28s} {v:7.2f} ms", file=sys.stderr)
+    print(json.dumps(rec))
+    if args.json:
+        hist_append(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,9 @@ from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.ops import linear as L
 from sketch_rnn_tpu.ops import mdn
 from sketch_rnn_tpu.ops.cells import make_cell
-from sketch_rnn_tpu.ops.rnn import bidirectional_rnn, run_rnn
+from sketch_rnn_tpu.ops.rnn import (bidirectional_rnn,
+                                    length_reverse_indices,
+                                    run_rnn)
 
 Params = Dict[str, Any]
 
@@ -110,11 +112,20 @@ class SketchRNN:
     # -- submodules --------------------------------------------------------
 
     def encode(self, params: Params, x_tm: jax.Array, seq_len: jax.Array,
-               key: Optional[jax.Array] = None, train: bool = False
+               key: Optional[jax.Array] = None, train: bool = False,
+               x_rev_tm: Optional[jax.Array] = None
                ) -> Tuple[jax.Array, jax.Array]:
-        """Time-major strokes ``[T, B, 5]`` -> (mu, presig), each [B, Nz]."""
+        """Time-major strokes ``[T, B, 5]`` -> (mu, presig), each [B, Nz].
+
+        ``x_rev_tm``: optional pre-computed length-aware-reversed inputs
+        (``_forward`` gathers them on the compact batch-major raw
+        strokes, where the gather is ~3x cheaper than on this
+        lane-padded time-major stream — see ops.rnn.bidirectional_rnn).
+        """
         hps = self.hps
         x_tm = x_tm.astype(jnp.float32)  # robust to bf16-transferred strokes
+        if x_rev_tm is not None:
+            x_rev_tm = x_rev_tm.astype(jnp.float32)
         gen_f = gen_b = None
         if train and hps.use_recurrent_dropout and key is not None:
             # masks are drawn inside the scan (rdrop_gen) so no [T, B, H]
@@ -126,7 +137,8 @@ class SketchRNN:
             self.enc_fwd, self.enc_bwd, params["enc_fwd"], params["enc_bwd"],
             x_tm, seq_len=seq_len,
             rdrop_gen_fwd=gen_f, rdrop_gen_bwd=gen_b, remat=hps.remat,
-            fused=hps.fused_rnn, residual_dtype=_rdtype(hps))
+            fused=hps.fused_rnn, residual_dtype=_rdtype(hps),
+            xs_rev=x_rev_tm)
         mu = L.matmul(h_final, params["mu_w"], _dtype(hps)) + params["mu_b"]
         presig = L.matmul(h_final, params["presig_w"], _dtype(hps)) \
             + params["presig_b"]
@@ -240,27 +252,45 @@ class SketchRNN:
         terms are None for non-conditional models.
         """
         hps = self.hps
-        strokes_bm = batch["strokes"]
-        if strokes_bm.dtype == jnp.int16:
-            # int16 transfer (hps.transfer_dtype="int16"): offsets arrive
-            # as integer data units, pen bits as 0/1; dividing by the
-            # per-example transfer_scale reproduces the host
-            # normalization BIT-FOR-BIT for integer-origin corpora
-            # (data/prefetch.py) — the exact-feed transfer mode
-            sc = batch["transfer_scale"].astype(jnp.float32)  # [B]
-            f = strokes_bm.astype(jnp.float32)
-            strokes_bm = jnp.concatenate(
-                [f[..., :2] / sc[:, None, None], f[..., 2:]], axis=-1)
-        strokes = jnp.transpose(strokes_bm, (1, 0, 2)
-                                ).astype(jnp.float32)  # [T+1, B, 5]
-        x_in, x_target = strokes[:-1], strokes[1:]
+        raw_bm = batch["strokes"]
         seq_len = batch["seq_len"]
+        raw_rev = None
+        if hps.conditional:
+            # length-aware reversal for the encoder's backward direction,
+            # gathered HERE on the compact batch-major RAW strokes: the
+            # gather commutes with the dequant/upcast/transpose prep
+            # (pure row selection; the int16 transfer_scale is
+            # per-example and the gather stays within each example), and
+            # on the lane-padded [T, B, 5] time-major stream it costs
+            # ~3x more (scripts/probe_enc_pocket.py)
+            rev_bm = length_reverse_indices(raw_bm.shape[1] - 1,
+                                            seq_len).T
+            raw_rev = jnp.take_along_axis(raw_bm[:, 1:],
+                                          rev_bm[:, :, None], axis=1)
+
+        def prep(bm):
+            """dequant (int16 transfer) + time-major + f32 upcast."""
+            if bm.dtype == jnp.int16:
+                # int16 transfer (hps.transfer_dtype="int16"): offsets
+                # arrive as integer data units, pen bits as 0/1;
+                # dividing by the per-example transfer_scale reproduces
+                # the host normalization BIT-FOR-BIT for integer-origin
+                # corpora (data/prefetch.py) — the exact-feed mode
+                sc = batch["transfer_scale"].astype(jnp.float32)  # [B]
+                f = bm.astype(jnp.float32)
+                bm = jnp.concatenate(
+                    [f[..., :2] / sc[:, None, None], f[..., 2:]], axis=-1)
+            return jnp.transpose(bm, (1, 0, 2)).astype(jnp.float32)
+
+        strokes = prep(raw_bm)                   # [T+1, B, 5]
+        x_in, x_target = strokes[:-1], strokes[1:]
         labels = batch.get("labels") if hps.num_classes > 0 else None
         kenc, kz, kdec = jax.random.split(key, 3)
         mu = presig = z = None
         if hps.conditional:
             mu, presig = self.encode(params, x_target, seq_len,
-                                     key=kenc, train=train)
+                                     key=kenc, train=train,
+                                     x_rev_tm=prep(raw_rev))
             z = self.sample_z(mu, presig, kz)
         raw = self.decode(params, x_in, z, labels, key=kdec, train=train)
         mp = mdn.get_mixture_params(raw, hps.num_mixture)

@@ -146,3 +146,52 @@ def test_loss_accepts_bf16_strokes():
     t16, m16 = model.loss(params, b16, key, kl_weight=0.5, train=False)
     assert t16.dtype == jnp.float32
     assert float(t16) == pytest.approx(float(t32), rel=2e-2)
+
+
+def test_early_reversal_gather_bitwise_equals_device_gather():
+    """_forward gathers the encoder's length-aware-reversed inputs on
+    the compact batch-major raw strokes (cheap layout); the result must
+    be bitwise what the in-encode time-major device gather produces,
+    for both exact transfer modes (the gather commutes with
+    dequant/upcast/transpose)."""
+    import numpy as np
+
+    from sketch_rnn_tpu.data.loader import synthetic_loader
+    from sketch_rnn_tpu.ops.rnn import length_reverse_indices
+
+    for transfer in ("float32", "int16"):
+        hps = tiny_hps().replace(conditional=True,
+                                 use_recurrent_dropout=False)
+        model = SketchRNN(hps)
+        loader, scale = synthetic_loader(hps, 2 * hps.batch_size, seed=1,
+                                         integer_grid=255.0)
+        b = loader.random_batch(
+            int16_scale=scale if transfer == "int16" else None)
+        params = model.init_params(jax.random.key(0))
+        kenc = jax.random.key(2)
+        raw = jnp.asarray(b["strokes"])
+        seq_len = jnp.asarray(b["seq_len"])
+        if raw.dtype == jnp.int16:
+            sc = jnp.asarray(b["transfer_scale"], jnp.float32)
+            f = raw.astype(jnp.float32)
+            bm = jnp.concatenate(
+                [f[..., :2] / sc[:, None, None], f[..., 2:]], -1)
+        else:
+            bm = raw
+        x_target = jnp.transpose(bm, (1, 0, 2)).astype(jnp.float32)[1:]
+        # device-gather path (x_rev_tm=None)
+        mu_dev, ps_dev = model.encode(params, x_target, seq_len, key=kenc,
+                                      train=False)
+        # early batch-major raw gather (what _forward does)
+        rev_bm = length_reverse_indices(raw.shape[1] - 1, seq_len).T
+        raw_rev = jnp.take_along_axis(raw[:, 1:], rev_bm[:, :, None],
+                                      axis=1)
+        if raw.dtype == jnp.int16:
+            f = raw_rev.astype(jnp.float32)
+            raw_rev = jnp.concatenate(
+                [f[..., :2] / sc[:, None, None], f[..., 2:]], -1)
+        x_rev_tm = jnp.transpose(raw_rev, (1, 0, 2)).astype(jnp.float32)
+        mu_e, ps_e = model.encode(params, x_target, seq_len, key=kenc,
+                                  train=False, x_rev_tm=x_rev_tm)
+        np.testing.assert_array_equal(np.asarray(mu_dev), np.asarray(mu_e))
+        np.testing.assert_array_equal(np.asarray(ps_dev), np.asarray(ps_e))
