@@ -58,7 +58,8 @@ int16-vs-bf16 parity measured twice: 5.04/4.99/5.03M r4,
 (BENCH_GRID=0), float32 for exact-AD runs), BENCH_GRID
 (integer-grid scale of the synthetic corpus,
 default 255 — the corpus is integer-origin like QuickDraw, scale
-factor ~17-65 depending on the class mix, so int16 transfer trains with meaningful loss here;
+factor ~17-65 depending on the class mix, so int16 transfer trains
+with meaningful loss here;
 0 restores the legacy float-natured corpus, which int16 refuses).
 
 Defaults are the measured-best v5e config: bfloat16 matmuls, global batch
@@ -223,7 +224,9 @@ def bench_train(dec_model: str, steps: int, batch_per_chip: int,
     mesh = make_mesh(hps)
     # corpus smaller than the batch: random_batch samples with replacement,
     # so assembly cost is the real per-step cost while corpus memory stays
-    # bounded. Integer-origin by default (VERDICT r4 #2): scale factor > 5, so transfer_dtype="int16" trains with meaningful loss here
+    # bounded. Integer-origin by default (VERDICT r4 #2): scale
+    # factor > 5, so transfer_dtype="int16" trains with meaningful
+    # loss here
     # instead of refusing. The corpus does not key the history gate —
     # dense TPU compute is data-independent (measured A/B/A parity),
     # so throughput rows stay comparable across corpora; `loss` values

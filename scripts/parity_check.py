@@ -158,16 +158,16 @@ def main(argv=None) -> int:
     if args.reference_json:
         ref = json.load(open(args.reference_json))
 
+    import gc
+
+    import jax
+
     rows = []
     for name in names:
         rows.append(compare_row(run_config(name, args), ref, args.tol))
         # six presets' jitted programs + donated train states otherwise
         # accumulate device buffers across the loop and OOM a 16G chip
         # around preset 4 (observed: f32[250,512,512] temps piling up)
-        import gc
-
-        import jax
-
         gc.collect()
         jax.clear_caches()
 

@@ -149,8 +149,9 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
     non-integer before quantization — the int16 train feed then
     differs from an f32 feed by at most 0.5 raw data units per offset
     (the same magnitude as the corpus's own integer quantization), a
-    rounding of the AUGMENTATION noise, not of the data. The per-example scale rides as a ``"transfer_scale"``
-    [B] batch leaf. Because the quantization step is ONE raw data
+    rounding of the AUGMENTATION noise, not of the data. The
+    per-example scale rides as a ``"transfer_scale"`` [B] batch
+    leaf. Because the quantization step is ONE raw data
     unit, the mode refuses corpora whose normalization scale would
     make that coarse relative to the (unit-variance) normalized data —
     silently training on rounded-to-nothing strokes is the failure

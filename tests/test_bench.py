@@ -57,8 +57,9 @@ def test_hist_best_pools_across_feed_knobs(tmp_path, monkeypatch):
     with open(hist, "a") as f:
         f.write("not json\n")
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
-    best = bench._hist_best_strokes("layer_norm", 4096, 250, "bfloat16",
-                                    True, True, "bfloat16", "TPU v5 lite", 1, 2, 25)
+    best = bench._hist_best_strokes(
+        "layer_norm", 4096, 250, "bfloat16", True, True, "bfloat16",
+        "TPU v5 lite", 1, 2, 25)
     assert best == 4.0e6
 
 
@@ -72,8 +73,8 @@ def test_hist_best_keyed_by_steps(tmp_path, monkeypatch):
         {**_BASE, "steps": 50, "strokes_per_sec_per_chip": 9.9e6},
     ])
     monkeypatch.setattr(bench, "_hist_path", lambda: str(hist))
-    args = ("layer_norm", 4096, 250, "bfloat16", True, True, "bfloat16",
-            "TPU v5 lite", 1, 2)
+    args = ("layer_norm", 4096, 250, "bfloat16", True, True,
+            "bfloat16", "TPU v5 lite", 1, 2)
     assert bench._hist_best_strokes(*args, 25) == 4.0e6
     assert bench._hist_best_strokes(*args, 50) == 9.9e6
     assert bench._hist_best_strokes(*args, 15) is None
