@@ -195,3 +195,18 @@ def test_early_reversal_gather_bitwise_equals_device_gather():
                                   train=False, x_rev_tm=x_rev_tm)
         np.testing.assert_array_equal(np.asarray(mu_dev), np.asarray(mu_e))
         np.testing.assert_array_equal(np.asarray(ps_dev), np.asarray(ps_e))
+
+
+def test_bidirectional_rejects_xs_rev_without_seq_len():
+    """The no-seq_len path runs a plain reverse scan and would silently
+    ignore a caller's length-aware-reversed inputs; it must refuse."""
+    from sketch_rnn_tpu.ops.cells import LSTMCell
+    from sketch_rnn_tpu.ops.rnn import bidirectional_rnn
+
+    cell_f, cell_b = LSTMCell(8), LSTMCell(8)
+    pf = cell_f.init_params(jax.random.key(0), 5)
+    pb = cell_b.init_params(jax.random.key(1), 5)
+    xs = jax.random.normal(jax.random.key(2), (4, 2, 5))
+    with pytest.raises(ValueError, match="xs_rev"):
+        bidirectional_rnn(cell_f, cell_b, pf, pb, xs, seq_len=None,
+                          xs_rev=jnp.flip(xs, axis=0))
