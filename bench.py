@@ -161,6 +161,29 @@ def _hist_best_strokes(dec_model: str, batch: int, seq_len: int,
     return best
 
 
+def _unavailable(err: BaseException) -> bool:
+    """Classify a failure as backend-unavailable (the 2x120s-backoff
+    retry class for genuine tunnel/backend outages).
+
+    Matches on exception TYPE plus anchored phrasing, not a bare
+    'UNAVAILABLE' substring (ADVICE r5): XLA status errors surface as
+    ``XlaRuntimeError`` with the status code as the message PREFIX
+    ('UNAVAILABLE: ...'), and jax backend-init failures raise
+    RuntimeError messages STARTING with 'Unable to initialize
+    backend'. An unrelated error that merely quotes the word
+    UNAVAILABLE somewhere in its text (e.g. an XLA status string
+    embedded in a wrapped exception) stays in the quick-retry class.
+    """
+    msg = str(err)
+    # walk the type hierarchy by name: XlaRuntimeError's import path
+    # moved across jaxlib versions, but the name is stable
+    is_xla = any(t.__name__ == "XlaRuntimeError"
+                 for t in type(err).__mro__)
+    if is_xla and msg.startswith("UNAVAILABLE"):
+        return True
+    return msg.startswith("Unable to initialize backend")
+
+
 def _should_stop(trial: int, no_improve: int, best_t: float,
                  plaus_t: float, elapsed: float, budget_s: float,
                  max_trials: int) -> str | None:
@@ -469,10 +492,6 @@ def main() -> int:
             # first surfacing as a generic error still earns the long
             # backoff, and deterministic errors (ValueError/TypeError)
             # keep failing fast even when raised by a retry.
-            def _unavailable(err):
-                return ("UNAVAILABLE" in str(err)
-                        or "Unable to initialize backend" in str(err))
-
             last = e
             used = {"unavail": 0, "other": 0}   # per-class budgets
             while True:

@@ -46,10 +46,21 @@ def compare_row(row: dict, ref: dict, tol: float) -> dict:
     ``recon`` compares relatively (both implementations optimize the
     same NLL objective, scale ~1); ``kl`` compares absolutely (the
     free-bits floor pins small values where a ratio would explode).
+
+    When the reference entry records the corpus ``integer_grid`` it is
+    compared too: numbers measured on a different corpus are not a
+    parity signal, so a mismatch fails the row loudly
+    (``corpus_mismatch``) instead of producing a quiet bogus delta
+    (ADVICE r5).
     """
     out = dict(row)
     r = ref.get(row["config"])
     if not r:
+        return out
+    if "integer_grid" in r and r["integer_grid"] != row.get("integer_grid"):
+        out["corpus_mismatch"] = True
+        out["ref_integer_grid"] = r["integer_grid"]
+        out["within_tol"] = False
         return out
     checks = []
     if "recon" in r:
@@ -63,6 +74,39 @@ def compare_row(row: dict, ref: dict, tol: float) -> dict:
         checks.append(abs(out["d_kl_abs"]) <= max(tol * abs(r["kl"]), tol))
     out["within_tol"] = all(checks) if checks else None
     return out
+
+
+def check_corpus_marker(workdir: str, marker: dict) -> None:
+    """Refuse resumes onto a different corpus (ADVICE r5).
+
+    Each config workdir records the corpus it was trained on in
+    ``corpus.json``. Resuming with a different ``integer_grid`` /
+    source silently mixes corpora (the default grid changed once
+    already, turning legacy float-corpus workdirs stale); mismatches
+    — and pre-marker workdirs with checkpoints, whose corpus is
+    unknowable — fail loudly with a pointer to a fresh workdir_root.
+    """
+    from sketch_rnn_tpu.train.checkpoint import latest_checkpoint
+
+    path = os.path.join(workdir, "corpus.json")
+    recorded = None
+    if os.path.exists(path):
+        recorded = json.load(open(path))
+    if recorded is not None:
+        if recorded != marker:
+            raise RuntimeError(
+                f"{workdir} was trained on corpus {recorded}, this run "
+                f"uses {marker}; resuming would mix corpora — use a "
+                f"fresh --workdir_root or matching corpus flags")
+    elif latest_checkpoint(workdir) is not None:
+        raise RuntimeError(
+            f"{workdir} holds checkpoints but no corpus.json marker "
+            f"(predates corpus recording) — its training corpus is "
+            f"unknowable; use a fresh --workdir_root")
+    else:
+        os.makedirs(workdir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(marker, f)
 
 
 def run_config(name: str, args) -> dict:
@@ -81,6 +125,7 @@ def run_config(name: str, args) -> dict:
            .parse(PRESETS[name])
            .replace(num_steps=args.steps, data_dir=args.data_dir)
            .parse(args.hparams))
+    grid = None
     if args.synthetic:
         # integer-origin by default (VERDICT r4 #2): the corpus then has
         # QuickDraw's shape (integer deltas, scale > 5) so presets that
@@ -95,6 +140,11 @@ def run_config(name: str, args) -> dict:
     else:
         train_l, valid_l, test_l, scale = load_dataset(hps)
     workdir = os.path.join(args.workdir_root, name)
+    check_corpus_marker(workdir, {
+        "synthetic": bool(args.synthetic),
+        "integer_grid": grid,
+        "data_dir": args.data_dir,
+    })
     print(f"# [{name}] training to step {args.steps} in {workdir} "
           f"({len(train_l)} train sketches, scale {scale:.4f})",
           file=sys.stderr)
@@ -109,6 +159,9 @@ def run_config(name: str, args) -> dict:
         "config": name,
         "steps": int(state.step),
         "split": args.split,
+        # corpus provenance (like bench.py's corpus_grid): None for the
+        # legacy float synthetic corpus and for real-data runs
+        "integer_grid": grid,
         "recon": round(float(ev["recon"]), 6),
         "kl": round(float(ev["kl"]), 6),
         **{k: round(float(v), 6) for k, v in sorted(ev.items())
@@ -175,6 +228,9 @@ def main(argv=None) -> int:
     print(f"# {hdr}", file=sys.stderr)
     for r in rows:
         vs = ""
+        if r.get("corpus_mismatch"):
+            vs += (f"corpus mismatch (ref grid "
+                   f"{r.get('ref_integer_grid')}) ")
         if "ref_recon" in r:
             vs += f"recon {r['d_recon_rel']:+.1%} "
         if "ref_kl" in r:

@@ -1,0 +1,352 @@
+"""Serving load generator: continuous batching vs freeze-until-batch-done.
+
+Drives a skewed-length request mix (lengths ``Lmin + (Lmax-Lmin)*u^4``
+for u ~ U(0,1): mean ~= Lmin + (Lmax-Lmin)/5, so max ~= 4x mean at
+small Lmin) through BOTH generation paths at equal batch width B:
+
+1. **engine**: the continuous-batching engine (``serve/engine.py``) —
+   finished slots are recycled to queued requests between K-step chunks.
+2. **baseline**: the existing batch-synchronous sampler
+   (``sample/sampler.py``) fed batches of B in admission order with the
+   same per-request length caps (its new ``max_steps`` argument), so
+   each batch's while_loop runs until its SLOWEST request finishes —
+   the freeze-until-batch-done schedule this engine replaces.
+
+The model is freshly initialized with the end-of-sketch pen logit
+suppressed (the ``sampler_latency.py`` trick), so request lengths are
+exactly the drawn caps and the comparison is deterministic in work
+terms. Two result layers:
+
+- ``*_device_steps``: scheduling math — decode steps each path executes
+  (deterministic; the smoke test asserts the >= 2x advantage here).
+- ``*_sketches_per_sec`` wall-clock and the ``speedup`` ratio — the
+  serving throughput number (ISSUE 2 acceptance: >= 2x on the CPU smoke
+  config).
+
+Writes a ``SERVE_BENCH``-style JSON (``--out``) and appends the record
+to BENCH_HISTORY.jsonl. ``--smoke`` shrinks the model/mix to run in
+seconds on CPU so engine-throughput regressions are catchable without
+a TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def skewed_lengths(n: int, lmin: int, lmax: int, seed: int,
+                   mode: str = "power") -> np.ndarray:
+    """Right-skewed request lengths in [lmin, lmax], max ~= 4x mean.
+
+    ``power``: ``lmin + span * u^4`` — a smooth long tail (mean ~=
+    lmin + span/5). ``bimodal``: 20% of requests at ``lmax``, the rest
+    at ``lmin`` — with ``lmax = 4 * (0.2 lmax + 0.8 lmin) / ...`` i.e.
+    lmin ~= lmax/16 the mix has max exactly ~4x mean, and at B >= 16
+    nearly every freeze-until-batch-done batch contains a long request
+    and pays the full ``lmax`` (the worst case the ISSUE's serving
+    scenario describes; real LLM serving length mixes are this
+    long-tailed).
+    """
+    u = np.random.default_rng(seed).random(n)
+    if mode == "bimodal":
+        return np.where(u < 0.2, lmax, lmin).astype(np.int32)
+    return (lmin + (lmax - lmin) * u ** 4).astype(np.int32)
+
+
+def run_engine(model, hps, params, requests, slots, chunk, static=False,
+               trials=3):
+    """Serve ``requests`` through the engine; returns (metrics, results).
+
+    Best-of-``trials`` wall time: the work is deterministic (same
+    chunks, same strokes every trial — the determinism contract), so
+    the fastest trial is the least-noise measurement, the bench.py
+    discipline.
+    """
+    trial = make_engine_trial(model, hps, params, requests, slots,
+                              chunk, static=static)
+    best = None
+    for _ in range(trials):
+        out = trial()
+        if best is None or out["metrics"]["wall_s"] < \
+                best["metrics"]["wall_s"]:
+            best = out
+    return best["metrics"], best["results"]
+
+
+def make_engine_trial(model, hps, params, requests, slots, chunk,
+                      static=False):
+    """Compile the engine and return a zero-arg timed-trial callable.
+
+    The chunk program is shape-specialized on the request-pool size,
+    so the warm burst must carry the SAME request count as the timed
+    trials (clones capped at one decode step) — a 1-request warmup
+    leaves the real program to compile inside trial 1's timed window.
+    """
+    from sketch_rnn_tpu.serve import ServeEngine
+
+    eng = ServeEngine(model, hps, params, slots=slots, chunk=chunk)
+    eng.run([_clone_request(r, max_len=1) for r in requests])
+    return lambda: eng.run(list(requests), recycle=not static)
+
+
+def _clone_request(req, **kw):
+    import dataclasses
+
+    return dataclasses.replace(req, uid=None, **kw)
+
+
+def run_baseline(model, hps, params, requests, slots, max_len, trials=3):
+    """The legacy sampler fed B-request batches in admission order.
+
+    Per-request length caps ride on the sampler's ``max_steps``; the
+    while_loop early-exits once every row in the batch is done, i.e.
+    after max(caps in batch) steps — freeze-until-batch-done.
+    Best-of-``trials`` wall, like the engine measurement.
+    Returns ``{wall_s, sketches_per_sec, device_steps}``.
+    """
+    trial = make_baseline_trial(model, hps, params, requests, slots,
+                                max_len)
+    best = None
+    for _ in range(trials):
+        wall, device_steps = trial()
+        if best is None or wall < best[0]:
+            best = (wall, device_steps)
+    wall, device_steps = best
+    return {
+        "wall_s": round(wall, 6),
+        "sketches_per_sec": round(len(requests) / wall, 3),
+        "device_steps": device_steps,
+    }
+
+
+def make_baseline_trial(model, hps, params, requests, slots, max_len):
+    """Compile the legacy sampler and return a zero-arg trial callable
+    yielding ``(wall_s, device_steps)``."""
+    import jax
+    import jax.numpy as jnp
+
+    from sketch_rnn_tpu.sample.sampler import make_sampler
+
+    sampler = make_sampler(model, hps, max_len=max_len)
+    b = slots
+
+    def batch_args(batch):
+        z = (jnp.stack([jnp.asarray(r.z) for r in batch])
+             if hps.conditional else None)
+        labels = (jnp.asarray([r.label for r in batch], jnp.int32)
+                  if hps.num_classes > 0 else None)
+        caps = jnp.asarray([r.max_len for r in batch], jnp.int32)
+        return z, labels, caps
+
+    batches = [requests[i:i + b] for i in range(0, len(requests), b)]
+    # pad the trailing partial batch to B (the compiled program is
+    # fixed-shape; the legacy path would do the same)
+    if len(batches[-1]) < b:
+        batches[-1] = list(batches[-1]) + [
+            _clone_request(batches[-1][-1], max_len=1)
+        ] * (b - len(batches[-1]))
+    # compile outside the timed region
+    z, labels, caps = batch_args(batches[0])
+    sampler(params, jax.random.key(0), b, z, labels,
+            jnp.float32(batches[0][0].temperature),
+            jnp.ones((b,), jnp.int32))[1].block_until_ready()
+
+    def trial():
+        device_steps = 0
+        t0 = time.perf_counter()
+        for i, batch in enumerate(batches):
+            z, labels, caps = batch_args(batch)
+            _, lengths = sampler(params, jax.random.key(i), b, z, labels,
+                                 jnp.float32(batch[0].temperature), caps)
+            lengths.block_until_ready()
+            device_steps += int(np.max([r.max_len for r in batch]))
+        return time.perf_counter() - t0, device_steps
+
+    return trial
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="continuous-batching vs batch-synchronous serving")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU config (seconds); same measurement")
+    ap.add_argument("--slots", type=int, default=0,
+                    help="batch width B for BOTH paths (0 = mode default)")
+    ap.add_argument("--chunk", type=int, default=0,
+                    help="engine decode steps per dispatch (0 = default)")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="request count N (0 = mode default)")
+    ap.add_argument("--min_len", type=int, default=0)
+    ap.add_argument("--max_len", type=int, default=0)
+    ap.add_argument("--len_dist", choices=("power", "bimodal"),
+                    default="",
+                    help="length mix shape (default: bimodal for "
+                         "--smoke, power otherwise)")
+    ap.add_argument("--temperature", type=float, default=0.7)
+    ap.add_argument("--static_engine", action="store_true",
+                    help="also measure the engine with recycling off "
+                         "(isolates scheduling from chunking)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="SERVE_BENCH.json",
+                    help="result JSON path ('' = stdout only)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from scripts._measure import hist_append
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+
+    if args.smoke:
+        # sized so per-step decode compute dominates per-chunk host
+        # work (dec 256, B 32 — this box gives the host loop ~2 cores
+        # shared with XLA) and the request count amortizes the drain
+        # tail; the wall-clock speedup then tracks the scheduling
+        # advantage (expected ~2.3-2.5x at step ratio ~2.8), while the
+        # whole run (compiles included) stays ~20 s on CPU
+        hps = get_default_hparams().replace(
+            batch_size=32, max_seq_len=160, enc_rnn_size=16,
+            dec_rnn_size=256, z_size=8, num_mixture=5, dec_model="lstm")
+        slots = args.slots or 32
+        chunk = args.chunk or 8
+        n = args.requests or 512
+        # bimodal 20% long / 80% short at lmax/16: max = 4x mean, and
+        # nearly every baseline batch of B >= 16 pays the full lmax
+        dist = args.len_dist or "bimodal"
+        lmin = args.min_len or (10 if dist == "bimodal" else 4)
+        lmax = args.max_len or 160
+    else:
+        hps = get_default_hparams().replace(
+            dec_model=os.environ.get("BENCH_DEC", "layer_norm"))
+        slots = args.slots or 64
+        chunk = args.chunk or 8
+        n = args.requests or 512
+        dist = args.len_dist or "power"
+        lmin = args.min_len or 32
+        lmax = args.max_len or hps.max_seq_len
+    hps = hps.replace(max_seq_len=max(hps.max_seq_len, lmax))
+
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(args.seed))
+    # suppress the end-of-sketch pen state (pen logits are raw[..., :3],
+    # p3 at index 2 — the sampler_latency.py trick): lengths are exactly
+    # the drawn caps, so both paths do identical, deterministic work
+    params["out_b"] = params["out_b"].at[2].set(-1e9)
+    return _run(args, hps, model, params, slots, chunk, n, lmin, lmax,
+                hist_append, dist=dist)
+
+
+def _run(args, hps, model, params, slots, chunk, n, lmin, lmax,
+         hist_append, dist="power"):
+    import jax
+
+    from sketch_rnn_tpu.serve import Request
+
+    lengths = skewed_lengths(n, lmin, lmax, args.seed, mode=dist)
+    kz, kreq = jax.random.split(jax.random.key(args.seed))
+    z = (np.asarray(jax.random.normal(kz, (n, hps.z_size)), np.float32)
+         if hps.conditional else None)
+    requests = [
+        Request(key=jax.random.fold_in(kreq, i),
+                z=None if z is None else z[i],
+                temperature=args.temperature, max_len=int(lengths[i]))
+        for i in range(n)
+    ]
+
+    print(f"# serving {n} requests, lengths mean {lengths.mean():.1f} "
+          f"max {lengths.max()} (skew {lengths.max() / lengths.mean():.2f}x)"
+          f", B={slots} K={chunk}", file=sys.stderr)
+
+    # trials INTERLEAVED engine/baseline: ambient load on a shared host
+    # drifts on second scales, and back-to-back pairs see the same
+    # window — measuring all engine trials then all baseline trials
+    # was observed to swing the ratio ~2x on a busy box
+    trials = 4
+    eng_trial = make_engine_trial(model, hps, params, requests, slots,
+                                  chunk)
+    base_trial = make_baseline_trial(model, hps, params, requests,
+                                     slots, lmax)
+    eng_best = None
+    base_best = None
+    for i in range(trials):
+        out = eng_trial()
+        if eng_best is None or out["metrics"]["wall_s"] < \
+                eng_best["metrics"]["wall_s"]:
+            eng_best = out
+        bwall, bsteps = base_trial()
+        print(f"# trial {i}: engine {out['metrics']['wall_s']:.3f}s "
+              f"baseline {bwall:.3f}s", file=sys.stderr)
+        if base_best is None or bwall < base_best[0]:
+            base_best = (bwall, bsteps)
+    eng_metrics, results = eng_best["metrics"], eng_best["results"]
+    base = {
+        "wall_s": round(base_best[0], 6),
+        "sketches_per_sec": round(n / base_best[0], 3),
+        "device_steps": base_best[1],
+    }
+
+    got = {r.uid: r.steps for r in results}
+    want = {i: int(lengths[i]) for i in range(n)}
+    if got != want:  # pen suppression failed or scheduler dropped work
+        raise RuntimeError(f"engine executed wrong step counts "
+                           f"(first mismatch: "
+                           f"{next(k for k in want if got.get(k) != want[k])})")
+    print(f"# engine: {eng_metrics['sketches_per_sec']} sk/s, "
+          f"{eng_metrics['device_steps']} device steps, "
+          f"util {eng_metrics['slot_utilization']}", file=sys.stderr)
+    print(f"# baseline: {base['sketches_per_sec']} sk/s, "
+          f"{base['device_steps']} device steps", file=sys.stderr)
+
+    rec = {
+        "kind": "serve_bench",
+        "smoke": bool(args.smoke),
+        "device_kind": jax.devices()[0].device_kind,
+        "dec_model": hps.dec_model,
+        "slots": slots,
+        "chunk": chunk,
+        "n_requests": n,
+        "len_dist": dist,
+        "len_mean": round(float(lengths.mean()), 2),
+        "len_max": int(lengths.max()),
+        "temperature": args.temperature,
+        "engine_sketches_per_sec": eng_metrics["sketches_per_sec"],
+        "engine_wall_s": eng_metrics["wall_s"],
+        "engine_device_steps": eng_metrics["device_steps"],
+        "engine_chunks": eng_metrics["chunks"],
+        "engine_slot_utilization": eng_metrics["slot_utilization"],
+        "engine_latency_p50_s": eng_metrics["latency_p50_s"],
+        "engine_latency_p95_s": eng_metrics["latency_p95_s"],
+        "engine_latency_p99_s": eng_metrics["latency_p99_s"],
+        "engine_queue_wait_mean_s": eng_metrics["queue_wait_mean_s"],
+        "baseline_sketches_per_sec": base["sketches_per_sec"],
+        "baseline_wall_s": base["wall_s"],
+        "baseline_device_steps": base["device_steps"],
+        "speedup": round(eng_metrics["sketches_per_sec"]
+                         / base["sketches_per_sec"], 3),
+        "device_step_ratio": round(base["device_steps"]
+                                   / eng_metrics["device_steps"], 3),
+    }
+    if args.static_engine:
+        st, _ = run_engine(model, hps, params, requests, slots, chunk,
+                           static=True)
+        rec["static_engine_sketches_per_sec"] = st["sketches_per_sec"]
+        rec["static_engine_device_steps"] = st["device_steps"]
+
+    print(json.dumps(rec, indent=2))
+    hist_append(rec)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

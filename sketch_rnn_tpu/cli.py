@@ -308,6 +308,67 @@ def cmd_sample(args) -> int:
     return 0
 
 
+def cmd_serve_bench(args) -> int:
+    """Serve a burst of generation requests through the continuous-
+    batching engine and print aggregate serving metrics as JSON.
+
+    With ``--random_init`` the model is freshly initialized (engine
+    plumbing / throughput benchmarking without a checkpoint); otherwise
+    the latest checkpoint in ``--workdir`` is restored like ``sample``.
+    """
+    import time
+
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import Request, ServeEngine
+    from sketch_rnn_tpu.train.metrics import MetricsWriter
+
+    hps = _resolve_hps(args)
+    if args.random_init:
+        model = SketchRNN(hps)
+        state_params = model.init_params(jax.random.key(args.seed))
+        scale = 1.0
+    else:
+        model, state, scale, _ = _restore(hps, args.workdir)
+        state_params = state.params
+    key = jax.random.key(args.seed)
+    kz, kreq = jax.random.split(key)
+    n = args.n
+    z = None
+    if hps.conditional:
+        z = np.asarray(jax.random.normal(kz, (n, hps.z_size)), np.float32)
+    requests = [
+        Request(key=jax.random.fold_in(kreq, i),
+                z=None if z is None else z[i],
+                label=args.label, temperature=args.temperature)
+        for i in range(n)
+    ]
+    engine = ServeEngine(model, hps, state_params, slots=args.slots,
+                         chunk=args.chunk, greedy=args.greedy)
+    writer = (MetricsWriter(args.workdir, name="serve")
+              if args.log_metrics else None)
+    # warmup: compile outside the timed run. The chunk program is
+    # shape-specialized on the request-pool size, so the warm burst
+    # must have the SAME request count — clones capped at one step.
+    import dataclasses
+    engine.run([dataclasses.replace(r, uid=None, max_len=1)
+                for r in requests])
+    t0 = time.time()
+    out = engine.run(requests, recycle=not args.static,
+                     metrics_writer=writer)
+    report = {
+        "kind": "serve_bench_cli",
+        "n_requests": n,
+        "slots": engine.slots,
+        "chunk": engine.chunk,
+        "static": bool(args.static),
+        "scale_factor": scale,
+        "started": t0,
+        **out["metrics"],
+    }
+    print(json.dumps(report))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="sketch_rnn_tpu",
                                  description=__doc__.splitlines()[0])
@@ -353,6 +414,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", default="samples.svg")
     p.add_argument("--cols", type=int, default=5)
     p.set_defaults(fn=cmd_sample)
+
+    p = sub.add_parser("serve-bench",
+                       help="continuous-batching serving benchmark")
+    _add_common(p)
+    p.add_argument("-n", type=int, default=64, help="number of requests")
+    p.add_argument("--slots", type=int, default=0,
+                   help="decoder slots B (0 = hps.serve_slots)")
+    p.add_argument("--chunk", type=int, default=0,
+                   help="decode steps per dispatch K (0 = hps.serve_chunk)")
+    p.add_argument("--temperature", type=float, default=0.5)
+    p.add_argument("--label", type=int, default=0,
+                   help="class id for class-conditional models")
+    p.add_argument("--greedy", action="store_true")
+    p.add_argument("--static", action="store_true",
+                   help="disable slot recycling (freeze-until-batch-done "
+                        "schedule, for comparison)")
+    p.add_argument("--random_init", action="store_true",
+                   help="fresh random params instead of a checkpoint")
+    p.add_argument("--log_metrics", action="store_true",
+                   help="write per-request serve_metrics JSONL+CSV into "
+                        "--workdir")
+    p.set_defaults(fn=cmd_serve_bench)
     return ap
 
 

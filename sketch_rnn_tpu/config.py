@@ -124,6 +124,15 @@ class HParams:
     mesh_shape: Tuple[int, ...] = (-1,)  # -1 = all devices on the data axis
     mesh_axes: Tuple[str, ...] = ("data",)
 
+    # --- serving (serve/engine.py: continuous-batching generation) ---
+    serve_slots: int = 64              # decoder slots B: requests resident
+    #   in the chunked decode program at once; finished slots are
+    #   recycled to queued requests between chunks
+    serve_chunk: int = 8               # decode steps K per dispatch: the
+    #   sampler analogue of steps_per_call (one compiled program
+    #   advances all slots K steps; higher K amortizes launch latency,
+    #   lower K admits faster — finished slots idle at most K-1 steps)
+
     def __post_init__(self):
         if self.enc_model not in CELL_TYPES or self.dec_model not in CELL_TYPES:
             raise ValueError(
@@ -149,6 +158,10 @@ class HParams:
         if self.eval_steps_per_call < 1:
             raise ValueError(f"eval_steps_per_call must be >= 1, got "
                              f"{self.eval_steps_per_call}")
+        if self.serve_slots < 1 or self.serve_chunk < 1:
+            raise ValueError(
+                f"serve_slots and serve_chunk must be >= 1, got "
+                f"{self.serve_slots}/{self.serve_chunk}")
 
     # -- overrides ---------------------------------------------------------
 

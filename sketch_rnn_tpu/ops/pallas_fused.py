@@ -72,9 +72,15 @@ def vma_of(x) -> frozenset:
     """``x``'s varying-manual-axes (empty outside shard_map).
 
     The single place that knows about jax 0.9's ``typeof(...).vma``
-    attribute; shared with ops.rnn's operand widening.
+    attribute; shared with ops.rnn's operand widening. On older jax
+    (0.4.x: no ``jax.typeof``, no varying-manual-axes tracking) every
+    array reports an empty vma, which disables the widening exactly
+    where the concept does not exist.
     """
-    return frozenset(getattr(jax.typeof(x), "vma", None) or ())
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return frozenset(getattr(typeof(x), "vma", None) or ())
 
 
 def _sds(shape, dtype, ref):

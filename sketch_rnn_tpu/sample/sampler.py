@@ -32,6 +32,7 @@ from jax import lax
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.data import strokes as S
 from sketch_rnn_tpu.ops import mdn
+from sketch_rnn_tpu.utils.compat import shard_map
 
 END_TOKEN = jnp.array([0.0, 0.0, 0.0, 0.0, 1.0], jnp.float32)
 START_TOKEN = jnp.array([0.0, 0.0, 1.0, 0.0, 0.0], jnp.float32)
@@ -94,6 +95,17 @@ def make_sampler(model, hps: HParams, max_len: Optional[int] = None,
     return cache[ckey]
 
 
+def _row_done(stroke: jax.Array, done: jax.Array, t: jax.Array,
+              max_steps: Optional[jax.Array]) -> jax.Array:
+    """Per-row done update: end-of-sketch pen state, plus the optional
+    per-row step cap (rows freeze after emitting ``max_steps`` strokes —
+    the serving benchmark's controlled-length mix rides on this)."""
+    new_done = done | (stroke[:, 4] > 0.5)
+    if max_steps is not None:
+        new_done = new_done | (t + 1 >= max_steps)
+    return new_done
+
+
 def _build_sampler(model, hps: HParams, max_len: Optional[int] = None,
                    greedy: bool = False, mesh=None):
     """Build the jitted batched sampler.
@@ -106,11 +118,20 @@ def _build_sampler(model, hps: HParams, max_len: Optional[int] = None,
     ``lengths`` counts rows before the end-of-sketch pen state (or
     ``max_len`` if it never fired); rows past each sketch's end are end
     tokens, so the buffer is valid stroke-5 padding.
+
+    ``max_steps`` (optional, ``[B]`` int32): per-row step cap — row ``i``
+    freezes to end tokens once it has emitted ``max_steps[i]`` strokes,
+    even without drawing the end-of-sketch pen state (its ``length`` is
+    then ``max_steps[i]``: every emitted stroke is real). The while_loop
+    still runs until EVERY row is done, i.e. ``max(max_steps)`` steps
+    when the pen state never fires — this is exactly the
+    freeze-until-batch-done cost profile the serving engine's
+    continuous batching is benchmarked against.
     """
     t_max = int(max_len or hps.max_seq_len)
 
     def _sample_shard(params, key, batch_size: int, z=None, labels=None,
-                      temperature=1.0):
+                      temperature=1.0, max_steps=None):
         carry0 = model.decoder_initial_carry(params, z, batch_size)
         prev0 = jnp.broadcast_to(START_TOKEN, (batch_size, 5))
         done0 = jnp.zeros((batch_size,), bool)
@@ -133,8 +154,14 @@ def _build_sampler(model, hps: HParams, max_len: Optional[int] = None,
                 lambda new, old: jnp.where(
                     done.reshape((-1,) + (1,) * (new.ndim - 1)), old, new),
                 new_carry, carry)
-            new_done = done | (stroke[:, 4] > 0.5)
-            length = length + (~new_done).astype(jnp.int32)
+            new_done = _row_done(stroke, done, t, max_steps)
+            # length counts real strokes: live steps that did not draw
+            # the end-of-sketch pen state. (Counting ~new_done instead
+            # would also drop the LAST real stroke of cap-terminated
+            # rows — the serving engine counts that stroke, and the two
+            # paths must agree on the same event.)
+            length = length + (~done & ~(stroke[:, 4] > 0.5))\
+                .astype(jnp.int32)
             out = lax.dynamic_update_index_in_dim(out, stroke, t, axis=0)
             return (t + 1, carry, stroke, new_done, length, out, key)
 
@@ -160,20 +187,26 @@ def _build_sampler(model, hps: HParams, max_len: Optional[int] = None,
 
     @functools.partial(jax.jit, static_argnames=("batch_size",))
     def sharded(params, key, batch_size: int, z=None, labels=None,
-                temperature=1.0):
+                temperature=1.0, max_steps=None):
         check_batch_divisible(batch_size, mesh)
 
-        def per_device(params, key, z, labels, temperature):
+        def per_device(params, key, z, labels, temperature, max_steps):
             key = jax.random.fold_in(key, jax.lax.axis_index(DATA_AXIS))
             return _sample_shard(params, key, batch_size // n_dev, z,
-                                 labels, temperature)
+                                 labels, temperature, max_steps)
 
-        # z/labels may be None (empty pytrees) — their specs are unused
-        return jax.shard_map(
+        # z/labels/max_steps may be None (empty pytrees) — specs unused.
+        # 0.4.x's check_rep has no rule for the sampling while_loop;
+        # 0.9's vma tracking does (see _match_vma), so the check stays
+        # live exactly where it can run.
+        from sketch_rnn_tpu.utils.compat import VMA_TRACKING
+        return shard_map(
             per_device, mesh=mesh,
-            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P()),
+            in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(),
+                      P(DATA_AXIS)),
             out_specs=P(DATA_AXIS),
-        )(params, key, z, labels, temperature)
+            check_vma=VMA_TRACKING,
+        )(params, key, z, labels, temperature, max_steps)
 
     return sharded
 

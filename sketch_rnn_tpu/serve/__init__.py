@@ -1,0 +1,18 @@
+"""Serving subsystem: continuous-batching generation (ROADMAP north
+star — "serves heavy traffic"; engine design in ARCHITECTURE.md)."""
+
+from sketch_rnn_tpu.serve.engine import (
+    Request,
+    Result,
+    ServeEngine,
+    generate_many,
+    make_chunk_step,
+)
+
+__all__ = [
+    "Request",
+    "Result",
+    "ServeEngine",
+    "generate_many",
+    "make_chunk_step",
+]

@@ -1,0 +1,510 @@
+"""Continuous-batching generation engine: slot-recycling chunked decode.
+
+The batch-synchronous sampler (``sample/sampler.py``) runs one
+``lax.while_loop`` until the SLOWEST sketch in the batch finishes:
+finished rows are frozen to end tokens and their slots burn compute for
+the remainder of the batch, and B=1 generation is dispatch-bound
+(``scripts/sampler_latency.py``). This engine fixes both waste sources
+with the standard continuous-batching design from LLM serving (see
+PAPERS.md: compiler-first O(1) autoregressive caching; Gemma serving),
+which maps directly onto an RNN decoder because per-slot inference
+state is JUST the cell carry:
+
+- **Fixed-shape chunked decode step**: ONE compiled program advances
+  all ``B`` slots by ``K`` decode steps per dispatch (amortizing
+  per-launch latency exactly like training's ``steps_per_call``) and
+  returns per-slot finished flags plus the ``[K, B, 5]`` stroke chunk.
+- **Slot scheduler**: a host-side request queue admits pending requests
+  into finished slots BETWEEN chunks — pointing the slot at the new
+  request's row of the device-resident request pool (z / class label /
+  temperature / PRNG key / step cap) and flagging it for on-device
+  re-init — so steady-state slot utilization approaches 1 regardless
+  of length skew.
+- **Per-request determinism**: each request carries its own PRNG key
+  and the per-step randomness is ``fold_in(request_key, t)`` where
+  ``t`` is the request's OWN decode-step index. A request's strokes
+  are therefore bitwise-independent of batch composition, slot
+  position, admission time and chunk size — scheduling changes WHEN a
+  sketch is computed, never WHAT is computed (the testable invariant,
+  mirroring the per-shard fold_in discipline in ``parallel/``).
+
+Note the engine's RNG stream intentionally differs from the legacy
+sampler's (which draws one batch-wide key per step): determinism here
+is per-REQUEST, the property a serving system must guarantee.
+
+Host/device split: every request's fields are uploaded ONCE per burst
+into a device-resident pool; loop state (carry, prev token, step
+counts, done flags) round-trips through the chunk program as opaque
+device arrays; a steady-state chunk ships only two tiny ``[B]``
+scheduling vectors in and fetches (t, done, strokes) out, and chunk
+i+1 is dispatched before chunk i's outputs are fetched (depth-1
+pipelining, the ``data/prefetch.py`` discipline) so scheduler work
+overlaps device compute. See ARCHITECTURE.md "Serving" for the design
+and the measured alternatives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.ops import mdn
+from sketch_rnn_tpu.sample.sampler import END_TOKEN, START_TOKEN
+from sketch_rnn_tpu.utils.profiling import SpanTimer
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request; everything its strokes may depend on.
+
+    ``key`` is the request's OWN PRNG key (determinism contract above).
+    ``max_len`` caps emitted strokes (default: the engine's max_len).
+    """
+
+    key: jax.Array
+    z: Optional[np.ndarray] = None
+    label: int = 0
+    temperature: float = 1.0
+    max_len: Optional[int] = None
+    uid: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Result:
+    """A completed request: its strokes plus serving telemetry."""
+
+    uid: int
+    strokes5: np.ndarray          # [n_rows, 5]; last row is p3 if drawn
+    length: int                   # rows before the end-of-sketch state
+    steps: int                    # decode steps executed (= n_rows)
+    queue_wait_s: float           # enqueue -> slot admission
+    decode_s: float               # admission -> completion
+    latency_s: float              # enqueue -> completion
+
+    @property
+    def ended(self) -> bool:
+        """Whether the sketch drew its end-of-sketch pen state (vs cap)."""
+        return self.steps > self.length
+
+
+def sample_mixture_rows(mp: mdn.MixtureParams, u: jax.Array,
+                        temps: jax.Array, greedy: bool = False
+                        ) -> jax.Array:
+    """Draw one stroke-5 row per slot from ``[B, ·]`` MDN params using
+    FOUR uniforms per row (``u [B, 4]``) and per-row temperatures.
+
+    The batch sampler's :func:`sample_from_mixture` draws through five
+    per-key random primitives; with per-SLOT keys (the engine's
+    determinism contract) that vmaps into ~6 threefry streams per row
+    per step, measured ~70% per-step overhead on CPU. Here the same
+    three draws — mixture component, pen state, bivariate normal — run
+    from one pre-drawn uniform block: inverse-CDF for the categoricals,
+    Box-Muller for the Gaussian. Same canonical temperature semantics
+    (logits / tau, sigma * sqrt(tau)); a different (engine-local)
+    random stream than the batch sampler, which is already the
+    documented contract.
+    """
+    tau = temps[:, None]
+    if greedy:
+        idx = jnp.argmax(mp.log_pi, axis=-1)
+        pen_idx = jnp.argmax(mp.pen_logits, axis=-1)
+    else:
+        cdf = jnp.cumsum(
+            jax.nn.softmax(mp.log_pi / tau, axis=-1), axis=-1)
+        idx = jnp.minimum(
+            jnp.sum(u[:, 0:1] > cdf, axis=-1), mp.log_pi.shape[-1] - 1)
+        pen_cdf = jnp.cumsum(
+            jax.nn.softmax(mp.pen_logits / tau, axis=-1), axis=-1)
+        pen_idx = jnp.minimum(jnp.sum(u[:, 1:2] > pen_cdf, axis=-1), 2)
+    take = lambda a: jnp.take_along_axis(  # noqa: E731
+        a, idx[:, None], axis=-1)[:, 0]
+    mu1, mu2 = take(mp.mu1), take(mp.mu2)
+    if greedy:
+        dx, dy = mu1, mu2
+    else:
+        s1, s2 = jnp.exp(take(mp.log_s1)), jnp.exp(take(mp.log_s2))
+        rho = take(mp.rho)
+        # Box-Muller: two iid normals from two uniforms
+        r = jnp.sqrt(-2.0 * jnp.log(jnp.maximum(u[:, 2], 1e-12)))
+        theta = (2.0 * jnp.pi) * u[:, 3]
+        e0, e1 = r * jnp.cos(theta), r * jnp.sin(theta)
+        sq = jnp.sqrt(temps)
+        dx = mu1 + s1 * sq * e0
+        dy = mu2 + s2 * sq * (rho * e0
+                              + jnp.sqrt(1.0 - jnp.square(rho)) * e1)
+    pen = jax.nn.one_hot(pen_idx, 3, dtype=jnp.float32)
+    return jnp.concatenate([dx[:, None], dy[:, None], pen], axis=-1)
+
+
+def make_chunk_step(model, hps: HParams, chunk: int, params,
+                    greedy: bool = False):
+    """Build the jitted fixed-shape K-step decode program.
+
+    ``fn(carry, prev, t, done, reset, slot_idx, pool) ->
+    (carry, prev, t, done, strokes [K, B, 5])``.
+
+    ``params`` (the decode-path weights) are closed over and baked into
+    the compiled program as constants — the engine serves ONE model, and
+    shipping ~10 weight leaves through jit argument processing on every
+    chunk is measurable host time at serving chunk rates.
+
+    ``pool`` is the device-resident REQUEST POOL — ``[N, ...]`` arrays
+    of every pending request's fields (raw PRNG key data, z, label,
+    temperature, step cap), uploaded once per burst. ``slot_idx [B]``
+    maps each slot to its pool row and ``reset [B]`` marks slots the
+    host admitted into since the last chunk; the program gathers the
+    admitted requests' fields and re-initializes those slots' carry
+    (the canonical z -> tanh projection, bitwise-identical to the
+    batch sampler's init), prev token, step count and done flag before
+    stepping. The host never touches the carry — it round-trips as an
+    opaque device array — and a steady-state chunk ships only the two
+    tiny ``[B]`` scheduling vectors in and fetches (strokes, t, done)
+    out. The alternatives measured worse on CPU (and would be far
+    worse over a tunnel): a host-side carry scatter ~2x per-chunk
+    overhead, re-uploading per-slot request fields each admission
+    ~0.3 ms/chunk.
+
+    Done slots are frozen: they emit end tokens and keep their carry,
+    so a slot's live steps within a chunk are always a prefix of the
+    chunk. One compiled program exists per (B, K, pool size N) — pad
+    or bucket N if burst sizes vary wildly.
+    """
+    num_mixture = hps.num_mixture
+
+    def chunk_fn(carry, prev, t, done, reset, slot_idx, pool):
+        b = t.shape[0]
+        pool_keys, pool_z, pool_labels, pool_temps, pool_caps = pool
+        key_data = pool_keys[slot_idx]
+        z = None if pool_z is None else pool_z[slot_idx]
+        labels = None if pool_labels is None else pool_labels[slot_idx]
+        temps = pool_temps[slot_idx]
+        max_steps = pool_caps[slot_idx]
+        keys = jax.random.wrap_key_data(key_data)
+        # on-device admission: freshly admitted slots start from the
+        # request's initial state (init runs for all slots — one tiny
+        # matmul — and the mask keeps live slots' carries)
+        carry0 = model.decoder_initial_carry(params, z, b)
+        sel = lambda new, old: jnp.where(  # noqa: E731
+            reset.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
+        carry = jax.tree_util.tree_map(sel, carry0, carry)
+        prev = jnp.where(reset[:, None], START_TOKEN[None], prev)
+        t = jnp.where(reset, 0, t)
+        done = jnp.where(reset, False, done)
+
+        def body(st, _):
+            carry, prev, t, done = st
+            # per-slot-step RNG folded from the REQUEST key at the
+            # request's own step index: bitwise-independent of batch
+            # composition, slot position and chunk boundaries. One
+            # 4-uniform block per row carries the whole step's
+            # randomness (see sample_mixture_rows).
+            kstep = jax.vmap(jax.random.fold_in)(keys, t)
+            u = jax.vmap(lambda k: jax.random.uniform(k, (4,)))(kstep)
+            new_carry, raw = model.decode_step(params, carry, prev, z,
+                                               labels)
+            mp = mdn.get_mixture_params(raw, num_mixture)
+            stroke = sample_mixture_rows(mp, u, temps, greedy=greedy)
+            live = ~done
+            stroke = jnp.where(live[:, None], stroke, END_TOKEN[None])
+            carry = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(
+                    live.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
+                new_carry, carry)
+            t = t + live.astype(jnp.int32)
+            done = done | (stroke[:, 4] > 0.5) | (live & (t >= max_steps))
+            return (carry, stroke, t, done), stroke
+
+        (carry, prev, t, done), strokes = lax.scan(
+            body, (carry, prev, t, done), None, length=chunk)
+        return carry, prev, t, done, strokes
+
+    return jax.jit(chunk_fn)
+
+
+class ServeEngine:
+    """Continuous-batching generation over ``slots`` decoder slots.
+
+    ``run(requests)`` drives the request list to completion and returns
+    per-request :class:`Result` objects in completion order plus
+    aggregate metrics. ``recycle=False`` degrades to static batching
+    (admission only when ALL slots are done — the legacy sampler's
+    freeze-until-batch-done schedule) with the SAME compiled program,
+    which isolates the scheduling win in benchmarks.
+    """
+
+    def __init__(self, model, hps: HParams, params, slots: int = 0,
+                 chunk: int = 0, max_len: Optional[int] = None,
+                 greedy: bool = False):
+        self.model = model
+        self.hps = hps
+        self.slots = int(slots or hps.serve_slots)
+        self.chunk = int(chunk or hps.serve_chunk)
+        self.max_len = int(max_len or hps.max_seq_len)
+        if self.slots < 1 or self.chunk < 1:
+            raise ValueError(
+                f"slots and chunk must be >= 1, got {self.slots}/"
+                f"{self.chunk}")
+        # decode-path parameter subset, device-put once and baked into
+        # the chunk program as constants: the encoder's weights never
+        # enter a chunk, and per-call pytree processing of weight
+        # leaves is measurable at serving chunk rates
+        keep = ("dec", "out_w", "out_b", "dec_init_w", "dec_init_b",
+                "class_embed")
+        self.params = jax.device_put(
+            {k: params[k] for k in keep if k in params})
+        self._chunk_fn = make_chunk_step(model, hps, self.chunk,
+                                         self.params, greedy)
+        self.spans = SpanTimer()
+
+    # -- the request pool --------------------------------------------------
+    #
+    # Residency tuned so a steady-state chunk moves (almost) nothing
+    # host->device: the carry, prev token, step counts and done flags
+    # round-trip through the chunk program as opaque device arrays
+    # (on-device admission via the reset mask re-initializes admitted
+    # slots); every request's fields live in a device-resident pool
+    # uploaded ONCE per burst; admission ships only the [B] slot->pool
+    # index vector and reset mask; and the per-chunk fetch is one
+    # batched device_get of (t, done, strokes).
+
+    def _prepare_pool(self, requests: List[Request]):
+        """Build + upload the request pool ``[N, ...]`` in one put.
+
+        Key data is fetched per request host-side (not via one stacked
+        jnp call, whose eager-op compile is per request-count — poison
+        for a server seeing variable burst sizes); per-request
+        ``max_len`` caps are validated here so admission is just two
+        array writes.
+        """
+        hps = self.hps
+        key_data = np.stack([np.asarray(jax.random.key_data(req.key))
+                             for req in requests])
+        z = None
+        if hps.conditional:
+            missing = [i for i, r in enumerate(requests) if r.z is None]
+            if missing:
+                raise ValueError(
+                    f"conditional model: requests {missing[:5]} need z")
+            z = np.stack([np.asarray(r.z, np.float32)
+                          for r in requests])
+        labels = (np.asarray([r.label for r in requests], np.int32)
+                  if hps.num_classes > 0 else None)
+        temps = np.asarray([r.temperature for r in requests], np.float32)
+        caps = np.asarray([r.max_len or self.max_len for r in requests],
+                          np.int32)
+        over = [i for i, c in enumerate(caps) if c > self.max_len]
+        if over:
+            raise ValueError(
+                f"requests {over[:5]} exceed engine max_len "
+                f"{self.max_len}")
+        return jax.device_put((key_data, z, labels, temps, caps))
+
+    # -- the serving loop --------------------------------------------------
+
+    def run(self, requests: List[Request], recycle: bool = True,
+            metrics_writer=None) -> Dict[str, Any]:
+        """Drive ``requests`` to completion; continuous batching when
+        ``recycle`` (default), static freeze-until-batch-done otherwise.
+
+        Returns ``{"results": [Result...], "metrics": {...aggregate}}``.
+        ``metrics_writer``: optional ``train.metrics.MetricsWriter`` —
+        one JSONL row per completed request.
+        """
+        t_start = time.perf_counter()
+        self.spans = SpanTimer()  # per-run spans (warmup runs don't leak)
+        for i, req in enumerate(requests):
+            if req.uid is None:
+                req.uid = i
+        queue = deque(enumerate(requests))
+        pool = self._prepare_pool(requests) if requests else None
+        enq = {req.uid: t_start for req in requests}
+        admit_t: Dict[int, float] = {}
+        slot_req: List[Optional[Request]] = [None] * self.slots
+        results: List[Result] = []
+        n_chunks = 0
+        live_slot_steps = 0
+        nslots = self.slots
+
+        # device-resident loop state (opaque round-trip); the host owns
+        # only the two [B] scheduling vectors
+        carry = self.model.dec.initial_carry(nslots)
+        prev = jnp.broadcast_to(START_TOKEN, (nslots, 5))
+        t_dev = jnp.zeros((nslots,), jnp.int32)
+        done_dev = jnp.ones((nslots,), bool)   # all slots start empty
+        slot_idx = np.zeros((nslots,), np.int32)
+        reset = np.zeros((nslots,), bool)
+        # the dispatch index each slot's occupant FIRST runs in: under
+        # pipelining one in-flight chunk still reports the PREVIOUS
+        # occupant's (done) state for freshly admitted slots, and the
+        # collector must not complete the new request from it
+        first_chunk = np.zeros((nslots,), np.int64)
+        n_disp = 0
+        # t fetched from the most recent chunk (chunk c-1 while
+        # processing chunk c): the row-delta base for continuing slots
+        t_host = np.zeros((nslots,), np.int32)
+
+        def admit_free_slots():
+            now = time.perf_counter()
+            with self.spans.span("admit"):
+                for b in range(nslots):
+                    if not queue:
+                        break
+                    if slot_req[b] is None:
+                        idx, req = queue.popleft()
+                        slot_idx[b] = idx
+                        reset[b] = True
+                        first_chunk[b] = n_disp  # the next dispatch
+                        slot_req[b] = req
+                        admit_t[req.uid] = now
+
+        def dispatch():
+            """Enqueue one chunk; returns its output futures and its
+            dispatch index."""
+            nonlocal carry, prev, t_dev, done_dev, n_disp
+            with self.spans.span("dispatch"):
+                # .copy(): the CPU backend can alias numpy args
+                # zero-copy, and the scheduler mutates these while the
+                # async-dispatched chunk is still reading them
+                carry, prev, t_dev, done_dev, strokes_dev = \
+                    self._chunk_fn(carry, prev, t_dev, done_dev,
+                                   reset.copy(), slot_idx.copy(), pool)
+                reset[:] = False
+                cidx = n_disp
+                n_disp += 1
+                return (t_dev, done_dev, strokes_dev), cidx
+
+        # Depth-1 software pipelining (the prefetch.py discipline on
+        # the output side): chunk i+1 is dispatched BEFORE chunk i's
+        # outputs are fetched, so the host's fetch/collect/admit work
+        # overlaps device compute instead of serializing a full
+        # dispatch->execute->fetch round trip into every chunk
+        # (measured ~1.3 ms/chunk on CPU, worth ~25% engine
+        # throughput; over a tunnel it would dominate). The price is
+        # that a freed slot idles ONE extra chunk before its next
+        # request starts — scheduling delay only: per-request strokes
+        # are admission-time-invariant by construction.
+        # Stroke collection is DEFERRED to request completion: per
+        # chunk the scheduler does a handful of vectorized numpy ops
+        # (a 32-slot python loop per chunk measured ~0.3 ms — on par
+        # with everything else host-side), retaining fetched chunk
+        # outputs in a short ring; a request's strokes are gathered
+        # from the ring only when it finishes. The ring needs
+        # ceil(max_len / K) + 2 entries — the longest possible request
+        # lifetime in chunks (caps force done) plus pipeline slack.
+        ring: Dict[int, Any] = {}   # cidx -> (t, strokes)
+        horizon = -(-self.max_len // self.chunk) + 2
+        occupied = np.zeros((nslots,), bool)
+        n_live = 0
+
+        def gather(b: int, cidx: int) -> np.ndarray:
+            """Reassemble slot ``b``'s strokes from the ring at its
+            completion in chunk ``cidx``."""
+            parts = []
+            for c in range(int(first_chunk[b]), cidx + 1):
+                t_c, s_c = ring[c]
+                base = (0 if c == first_chunk[b]
+                        else int(ring[c - 1][0][b]))
+                rows = int(t_c[b]) - base
+                if rows:
+                    parts.append(s_c[:rows, b])
+            return np.concatenate(parts)
+
+        admit_free_slots()
+        occupied[:] = [r is not None for r in slot_req]
+        n_live = int(occupied.sum())
+        nxt = dispatch() if requests else None
+        while n_live:
+            fut, cidx = nxt
+            nxt = dispatch()   # admissions decided from chunk i-1
+            t_prev = t_host    # chunk cidx-1's t: the row-delta base
+            with self.spans.span("fetch"):
+                t_host, done, strokes = jax.device_get(fut)
+            n_chunks += 1
+            t = t_host
+            now = time.perf_counter()
+            with self.spans.span("collect"):
+                ring[cidx] = (t, strokes)
+                ring.pop(cidx - horizon, None)
+                eligible = occupied & (first_chunk <= cidx)
+                base = np.where(first_chunk == cidx, 0, t_prev)
+                live_slot_steps += int(
+                    (t - base)[eligible].sum())
+                for b in np.nonzero(eligible & done)[0]:
+                    req = slot_req[b]
+                    s5 = gather(int(b), cidx)
+                    steps = int(t[b])
+                    length = steps - int(s5[-1, 4] > 0.5)
+                    res = Result(
+                        uid=req.uid, strokes5=s5, length=length,
+                        steps=steps,
+                        queue_wait_s=admit_t[req.uid] - enq[req.uid],
+                        decode_s=now - admit_t[req.uid],
+                        latency_s=now - enq[req.uid])
+                    results.append(res)
+                    slot_req[b] = None
+                    occupied[b] = False
+                    n_live -= 1
+                    if metrics_writer is not None:
+                        metrics_writer.write(len(results), {
+                            "uid": res.uid, "steps": res.steps,
+                            "length": res.length,
+                            "queue_wait_s": res.queue_wait_s,
+                            "decode_s": res.decode_s,
+                            "latency_s": res.latency_s})
+            if queue and (recycle or n_live == 0):
+                admit_free_slots()
+                occupied[:] = [r is not None for r in slot_req]
+                n_live = int(occupied.sum())
+        if nxt is not None:
+            # drain the last in-flight (all-frozen) chunk
+            jax.device_get(nxt[0][1])
+            n_chunks += 1
+
+        wall = time.perf_counter() - t_start
+        lat = np.array([r.latency_s for r in results]) if results else \
+            np.zeros((1,))
+        metrics = {
+            "completed": len(results),
+            "wall_s": round(wall, 6),
+            "sketches_per_sec": round(len(results) / wall, 3) if wall
+            else 0.0,
+            "decode_steps": int(sum(r.steps for r in results)),
+            "device_steps": n_chunks * self.chunk,
+            "chunks": n_chunks,
+            "slot_utilization": round(
+                live_slot_steps / max(n_chunks * self.chunk * self.slots,
+                                      1), 4),
+            "queue_wait_mean_s": round(
+                float(np.mean([r.queue_wait_s for r in results]))
+                if results else 0.0, 6),
+            "latency_p50_s": round(float(np.percentile(lat, 50)), 6),
+            "latency_p95_s": round(float(np.percentile(lat, 95)), 6),
+            "latency_p99_s": round(float(np.percentile(lat, 99)), 6),
+            "spans": self.spans.summary(),
+        }
+        return {"results": results, "metrics": metrics}
+
+
+def generate_many(model, params, hps: HParams, requests: List[Request],
+                  slots: int = 0, chunk: int = 0,
+                  max_len: Optional[int] = None, greedy: bool = False,
+                  recycle: bool = True, metrics_writer=None
+                  ) -> Dict[str, Any]:
+    """One-call request-level API: build an engine, serve ``requests``.
+
+    Convenience wrapper over :class:`ServeEngine` for scripts/tests that
+    serve one request list; long-lived callers should hold the engine
+    (the compiled chunk program is cached on it).
+    """
+    eng = ServeEngine(model, hps, params, slots=slots, chunk=chunk,
+                      max_len=max_len, greedy=greedy)
+    return eng.run(requests, recycle=recycle,
+                   metrics_writer=metrics_writer)

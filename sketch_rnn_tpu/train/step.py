@@ -45,6 +45,7 @@ from sketch_rnn_tpu.parallel.mesh import (
 )
 from sketch_rnn_tpu.train.schedules import kl_weight_schedule, lr_schedule
 from sketch_rnn_tpu.train.state import TrainState, make_optimizer
+from sketch_rnn_tpu.utils.compat import shard_map
 
 Batch = Dict[str, jax.Array]
 Metrics = Dict[str, jax.Array]
@@ -108,7 +109,7 @@ def _make_single_step_core(model, hps: HParams, mesh: Optional[Mesh],
         return step_fn
 
     check_batch_divisible(hps.batch_size, mesh)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         lambda params, batch, key, kl_w: grads_and_metrics(
             params, batch, key, kl_w, DATA_AXIS),
         mesh=mesh,
@@ -231,7 +232,7 @@ def _make_eval_core(model, hps: HParams, mesh: Optional[Mesh]):
 
     if mesh is None:
         return eval_fn
-    return jax.shard_map(
+    return shard_map(
         lambda params, batch, key: eval_fn(params, batch, key, DATA_AXIS),
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P()),
@@ -314,7 +315,7 @@ def _make_per_class_core(model, hps: HParams, mesh: Optional[Mesh]):
 
     if mesh is None:
         return eval_fn
-    return jax.shard_map(
+    return shard_map(
         lambda params, batch, key: eval_fn(params, batch, key, DATA_AXIS),
         mesh=mesh,
         in_specs=(P(), P(DATA_AXIS), P()),

@@ -1,0 +1,33 @@
+"""JAX version compatibility shims (0.4.x <-> 0.9 API drift).
+
+The repo targets jax 0.9's public surface (``jax.shard_map``,
+``jax.typeof(...).vma``); the deployment image may pin an older jax
+(observed: 0.4.37, where shard_map still lives in ``jax.experimental``
+and varying-manual-axes tracking does not exist). Every version probe
+lives HERE so call sites stay on one spelling and the suite runs
+unchanged on either release line.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# jax 0.9+: varying-manual-axes tracking exists and its replication
+# check understands while_loop; 0.4.x's check_rep predecessor has no
+# rule for `while` and must stay off around loop-carrying shard_maps.
+VMA_TRACKING = hasattr(jax, "typeof")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` on 0.9+; the experimental spelling on 0.4.x.
+
+    ``check_vma`` maps onto 0.4.x's ``check_rep`` (the same replication
+    check under its earlier name).
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm_old
+    return sm_old(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)

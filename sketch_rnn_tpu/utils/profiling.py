@@ -28,6 +28,36 @@ def trace(logdir: str) -> Iterator[None]:
         jax.profiler.stop_trace()
 
 
+class SpanTimer:
+    """Named wall-clock span accumulator (host-side, nesting-agnostic).
+
+    The serving engine wraps each phase of its loop (``chunk`` dispatch,
+    ``admit`` slot writes, ``collect`` output gathering) so a bench run
+    can attribute wall time without a device trace. ``summary()``
+    returns ``{name: {count, total_s, mean_ms}}``.
+    """
+
+    def __init__(self):
+        self._spans: dict = {}
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            rec = self._spans.setdefault(name, [0, 0.0])
+            rec[0] += 1
+            rec[1] += time.perf_counter() - t0
+
+    def summary(self) -> dict:
+        return {
+            name: {"count": n, "total_s": round(t, 6),
+                   "mean_ms": round(1e3 * t / n, 4)}
+            for name, (n, t) in sorted(self._spans.items())
+        }
+
+
 class Throughput:
     """Streaming steps/sec and strokes/sec/chip counter.
 

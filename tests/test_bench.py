@@ -190,6 +190,35 @@ def test_bench_summary_skips_diagnostic_rows(tmp_path, capsys):
     assert not any("2,200,000" in l for l in lines)
 
 
+def test_unavailable_classification():
+    """ADVICE r5: the 2x120s outage backoff must key on exception type
+    / anchored backend-init phrasing, not a bare 'UNAVAILABLE'
+    substring of str(err)."""
+
+    class XlaRuntimeError(Exception):  # matched by NAME, as jaxlib's
+        pass
+
+    class WrappedXla(XlaRuntimeError):  # subclasses classify too
+        pass
+
+    assert bench._unavailable(
+        XlaRuntimeError("UNAVAILABLE: socket closed"))
+    assert bench._unavailable(
+        WrappedXla("UNAVAILABLE: connection reset"))
+    assert bench._unavailable(
+        RuntimeError("Unable to initialize backend 'tpu': ..."))
+    # an XLA error of a DIFFERENT status class: quick retry
+    assert not bench._unavailable(
+        XlaRuntimeError("INTERNAL: something broke"))
+    # unrelated errors merely quoting the word must NOT earn the
+    # outage budget
+    assert not bench._unavailable(
+        RuntimeError("step failed (prior status: UNAVAILABLE: x)"))
+    assert not bench._unavailable(
+        RuntimeError("log said 'Unable to initialize backend' earlier"))
+    assert not bench._unavailable(OSError("UNAVAILABLE"))
+
+
 def test_bench_train_rejects_non_divisible_steps():
     """ADVICE r2: steps % steps_per_call != 0 must raise, not silently
     run fewer optimizer steps while computing throughput over `steps`."""

@@ -103,6 +103,25 @@ def test_cli_rejects_unknown_hparam(tmp_path):
 # -- driver contract --------------------------------------------------------
 
 
+def test_cli_serve_bench_random_init(tmp_path, capsys):
+    """serve-bench without a checkpoint: random init, JSON metrics out,
+    per-request JSONL written into the workdir."""
+    wd = str(tmp_path / "serve_wd")
+    assert main(["serve-bench", "--random_init", "-n", "6",
+                 "--slots", "3", "--chunk", "2", "--log_metrics",
+                 f"--workdir={wd}",
+                 f"--hparams={HP},serve_slots=3,serve_chunk=2"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["kind"] == "serve_bench_cli"
+    assert rep["completed"] == 6
+    assert rep["slots"] == 3 and rep["chunk"] == 2
+    assert rep["sketches_per_sec"] > 0
+    assert rep["latency_p50_s"] <= rep["latency_p99_s"]
+    assert os.path.exists(os.path.join(wd, "serve_metrics.jsonl"))
+    with open(os.path.join(wd, "serve_metrics.jsonl")) as f:
+        assert len(f.readlines()) == 6
+
+
 def test_graft_entry_compiles():
     import __graft_entry__ as ge
     fn, args = ge.entry()

@@ -42,3 +42,14 @@ def test_json_roundtrip():
 
 def test_hashable_for_jit_static_args():
     assert hash(get_default_hparams()) == hash(get_default_hparams())
+
+
+def test_serve_hparams():
+    hps = get_default_hparams()
+    assert hps.serve_slots >= 1 and hps.serve_chunk >= 1
+    hps = hps.parse("serve_slots=128,serve_chunk=16")
+    assert hps.serve_slots == 128 and hps.serve_chunk == 16
+    with pytest.raises(ValueError, match="serve_slots and serve_chunk"):
+        get_default_hparams().replace(serve_slots=0)
+    with pytest.raises(ValueError, match="serve_slots and serve_chunk"):
+        get_default_hparams().replace(serve_chunk=-1)

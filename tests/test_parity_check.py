@@ -72,6 +72,48 @@ def test_compare_row_pure():
     assert "within_tol" not in out or out["within_tol"] is None
 
 
+def test_compare_row_corpus_mismatch():
+    """ADVICE r5: a reference row recorded under a different corpus
+    grid must fail loudly, not produce a quiet bogus delta."""
+    row = {"config": "vae", "recon": 1.00, "kl": 0.40,
+           "integer_grid": 255.0}
+    ref = {"vae": {"recon": 1.00, "kl": 0.40, "integer_grid": None}}
+    out = parity_check.compare_row(row, ref, tol=0.05)
+    assert out["within_tol"] is False
+    assert out["corpus_mismatch"] is True
+    # matching grids compare normally
+    ref = {"vae": {"recon": 1.00, "kl": 0.40, "integer_grid": 255.0}}
+    out = parity_check.compare_row(row, ref, tol=0.05)
+    assert out["within_tol"] is True and "corpus_mismatch" not in out
+    # references without a grid record keep working (pre-this-PR refs)
+    out = parity_check.compare_row(row, {"vae": {"recon": 1.0}}, 0.05)
+    assert out["within_tol"] is True
+
+
+def test_corpus_marker_guards_resume(tmp_path):
+    """ADVICE r5: resuming a workdir onto a different corpus — or one
+    whose corpus was never recorded — must fail loudly."""
+    wd = str(tmp_path / "vae")
+    marker = {"synthetic": True, "integer_grid": 255.0, "data_dir": ""}
+    # fresh workdir: marker is written
+    parity_check.check_corpus_marker(wd, marker)
+    assert json.load(open(tmp_path / "vae" / "corpus.json")) == marker
+    # same corpus: resume fine
+    parity_check.check_corpus_marker(wd, marker)
+    # different grid: refuse
+    with pytest.raises(RuntimeError, match="mix corpora"):
+        parity_check.check_corpus_marker(
+            wd, {**marker, "integer_grid": None})
+    # legacy workdir: checkpoints but no marker -> unknowable corpus
+    wd2 = tmp_path / "old"
+    wd2.mkdir()
+    (wd2 / "ckpt_00000002.msgpack").write_bytes(b"")
+    (wd2 / "ckpt_00000002.json").write_text(
+        json.dumps({"step": 2, "format_version": 1}))
+    with pytest.raises(RuntimeError, match="corpus.json"):
+        parity_check.check_corpus_marker(str(wd2), marker)
+
+
 def test_unknown_config_rejected(tmp_path, capsys):
     rc = parity_check.main(["--synthetic", "--configs", "nope"])
     assert rc == 2

@@ -95,6 +95,36 @@ def test_sampler_end_to_end(dec):
             assert (strokes[i, n + 1:, 0:2] == 0.0).all()
 
 
+def test_sampler_per_row_max_steps():
+    """The optional [B] step cap: row i freezes to end tokens after
+    emitting max_steps[i] strokes (the serving benchmark's controlled
+    freeze-until-batch-done baseline rides on this)."""
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    # suppress the end-of-sketch pen state so caps are the only stop
+    params["out_b"] = params["out_b"].at[2].set(-1e9)
+    z = jax.random.normal(jax.random.key(1), (3, hps.z_size))
+    sampler = make_sampler(model, hps)
+    caps = jnp.array([3, 7, 12], jnp.int32)
+    strokes, lengths = sampler(params, jax.random.key(2), 3, z, None,
+                               jnp.float32(0.8), caps)
+    strokes, lengths = np.asarray(strokes), np.asarray(lengths)
+    for i, cap in enumerate([3, 7, 12]):
+        # frozen rows after the cap are end tokens
+        assert (strokes[i, cap:, 4] == 1.0).all()
+        assert (strokes[i, cap:, 0:2] == 0.0).all()
+        # rows before the cap are live samples (pen suppressed -> p3=0)
+        assert (strokes[i, :cap, 4] == 0.0).all()
+    # capped rows never drew p3, so every emitted stroke is real and
+    # length == cap (matching the serving engine's accounting)
+    np.testing.assert_array_equal(lengths, [3, 7, 12])
+    # without caps the same call runs the full buffer
+    s2, l2 = sampler(params, jax.random.key(2), 3, z, None,
+                     jnp.float32(0.8))
+    assert (np.asarray(l2) == hps.max_seq_len).all()
+
+
 def test_sampler_deterministic_same_key():
     hps = tiny_hps()
     model = SketchRNN(hps)

@@ -1,0 +1,61 @@
+"""serve_bench load-generator tests (tier-1-safe: a shrunken smoke).
+
+The wall-clock speedup is noise-prone on a shared 2-core CI box, so
+the tier-1 regression signal is the DETERMINISTIC part: the skewed
+length mixes, the request accounting, and the device-step ratio (the
+scheduling advantage — chunks x K vs sum of per-batch maxima), which
+slot recycling must keep well above 1 regardless of timing. The full
+``--smoke`` config's >= 2x wall-clock acceptance run stays a script
+invocation (seconds, but too timing-sensitive for CI assertion).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from scripts import serve_bench
+
+
+def test_skewed_lengths_deterministic_and_skewed():
+    a = serve_bench.skewed_lengths(256, 4, 160, seed=0)
+    b = serve_bench.skewed_lengths(256, 4, 160, seed=0)
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 4 and a.max() <= 160
+    # the ISSUE's mix: max ~ 4x mean
+    assert 3.0 <= a.max() / a.mean() <= 5.0
+    assert not np.array_equal(a, serve_bench.skewed_lengths(
+        256, 4, 160, seed=1))
+
+
+def test_bimodal_lengths_max_4x_mean():
+    a = serve_bench.skewed_lengths(1000, 10, 160, seed=0,
+                                   mode="bimodal")
+    assert set(np.unique(a)) == {10, 160}
+    # 20% long / 80% short at lmax/16: max = 4x mean by construction
+    assert 3.5 <= a.max() / a.mean() <= 4.5
+
+
+@pytest.mark.parametrize("dist", ["power", "bimodal"])
+def test_serve_bench_end_to_end_small(tmp_path, capsys, dist):
+    """A shrunken smoke run: both paths execute, the record is
+    well-formed, the step counts verify, and recycling wins the
+    deterministic device-step comparison."""
+    out = tmp_path / "SB.json"
+    rc = serve_bench.main([
+        "--smoke", "--slots", "8", "--chunk", "4", "--requests", "64",
+        "--min_len", "3", "--max_len", "48", "--len_dist", dist,
+        "--out", str(out)])
+    assert rc == 0
+    rec = json.load(open(out))
+    assert rec["kind"] == "serve_bench" and rec["smoke"] is True
+    assert rec["n_requests"] == 64 and rec["len_dist"] == dist
+    assert rec["engine_sketches_per_sec"] > 0
+    assert rec["baseline_sketches_per_sec"] > 0
+    assert rec["speedup"] > 0
+    # the deterministic scheduling advantage: freeze-until-batch-done
+    # burns sum(per-batch max) steps, recycling ~ sum(len)/B — with
+    # max/mean >= 4 skew it must stay clearly above 1 even at this
+    # shrunken scale (the full smoke config measures ~2.7)
+    assert rec["device_step_ratio"] > 1.3
+    assert 0 < rec["engine_slot_utilization"] <= 1
