@@ -33,5 +33,8 @@ def drain(out) -> float:
 
 
 def hist_append(record: dict) -> None:
-    """Append ``record`` to the repo's BENCH_HISTORY.jsonl."""
+    """Append ``record`` to the repo's bench history. Routing is
+    bench.py's: smoke/CPU rows (``smoke: true`` or ``device_kind ==
+    "cpu"``) land in BENCH_SMOKE_HISTORY.jsonl, accelerator rows in the
+    canonical BENCH_HISTORY.jsonl."""
     bench._hist_append(record)

@@ -147,6 +147,11 @@ def cmd_train(args) -> int:
     from sketch_rnn_tpu.train import train
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
+    if getattr(args, "sync_io", False):
+        # bisection/debugging escape hatch: force the fully synchronous
+        # loop (blocking saves, eager metric conversion) in one flag
+        # instead of two hparam overrides
+        hps = hps.replace(async_checkpoint=False, metrics_defer=False)
     train_l, valid_l, test_l, scale = _load_data(hps, args)
     print(f"[cli] host {mh.process_index()}/{mh.process_count()}: "
           f"{len(train_l)} train / {len(valid_l)} valid sketches, "
@@ -383,6 +388,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="start fresh even when <workdir> holds "
                         "checkpoints (default: resume from latest — the "
                         "reference's resume-from-latest contract)")
+    p.add_argument("--sync_io", action="store_true",
+                   help="disable the overlapped goodput runtime "
+                        "(async_checkpoint=false,metrics_defer=false): "
+                        "blocking saves and eager metric conversion, for "
+                        "debugging/bisection; results are identical "
+                        "either way, only step time changes")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint")

@@ -87,6 +87,20 @@ class HParams:
     #   (one dispatch + one host fetch per K batches). Same per-index
     #   keys and weighting as the per-batch sweep; results agree to
     #   ~1e-6 float reassociation noise. 1 restores the per-batch path.
+    async_checkpoint: bool = True      # save_every checkpoints commit on
+    #   a background writer thread (train/async_ckpt.py): the loop only
+    #   snapshots device state (async HBM copy + early D2H) and moves
+    #   on, instead of blocking on fetch + msgpack write. Byte-identical
+    #   files and restore states vs the sync path (same commit code on
+    #   an already-fetched snapshot); at most ONE save in flight (the
+    #   next save joins the previous). false = the synchronous save.
+    metrics_defer: bool = True         # log_every metrics convert to
+    #   host floats one window LATE (train/metrics.py MetricsDrain), by
+    #   when that window's compute has long finished — logging then
+    #   never synchronizes the step-dispatch chain. Values are bitwise
+    #   identical (late fetch, not lossy); check_finite stops training
+    #   at most one window after a divergence. false = convert eagerly
+    #   at the window (the pre-r6 synchronous behavior).
 
     # --- TPU / parallelism (component 18) ---
     transfer_dtype: str = "float32"    # host->device dtype of the TRAIN

@@ -11,12 +11,15 @@ from sketch_rnn_tpu.train.step import (
     make_per_class_eval_step,
     make_train_step,
 )
+from sketch_rnn_tpu.train.async_ckpt import AsyncCheckpointer
 from sketch_rnn_tpu.train.checkpoint import (
     latest_checkpoint,
     restore_checkpoint,
     save_checkpoint,
+    write_checkpoint,
 )
 from sketch_rnn_tpu.train.loop import evaluate, evaluate_per_class, train
+from sketch_rnn_tpu.train.metrics import MetricsDrain, MetricsWriter
 
 __all__ = [
     "lr_schedule",
@@ -29,8 +32,12 @@ __all__ = [
     "make_eval_step",
     "make_per_class_eval_step",
     "save_checkpoint",
+    "write_checkpoint",
     "restore_checkpoint",
     "latest_checkpoint",
+    "AsyncCheckpointer",
+    "MetricsDrain",
+    "MetricsWriter",
     "train",
     "evaluate",
     "evaluate_per_class",

@@ -1,0 +1,143 @@
+"""Asynchronous checkpointing: background writer off the dispatch path.
+
+The synchronous ``save_checkpoint`` blocks the training loop's hot thread
+on a device->host fetch (which first waits for every dispatched step to
+finish) plus a msgpack serialization and file write — at an aggressive
+``save_every`` that stall is the dominant host-side goodput loss
+(ISSUE 3; the overlap design production JAX stacks use, arXiv:2204.06514).
+
+``AsyncCheckpointer`` removes the stall in three moves:
+
+1. **Device snapshot on the loop thread** — ``save()`` makes a device-side
+   copy of the state (``jnp.copy`` per leaf: an async-dispatched HBM
+   copy, enqueued after the producing step, so the host does not wait).
+   The copy is essential for correctness, not a nicety: the train step
+   donates its input state buffers (``donate_argnums=0``), so the NEXT
+   dispatched step invalidates the arrays the loop just held — the
+   snapshot gives the writer arrays nobody will donate. A
+   ``copy_to_host_async`` on each snapshot leaf then starts the D2H
+   transfer early so it overlaps device compute.
+2. **Fetch + serialize + commit on a writer thread** — the blocking
+   ``jax.device_get`` (waits for the snapshot copy to land) and the
+   msgpack write happen off the hot thread, through the SAME
+   ``checkpoint.write_checkpoint`` commit path as the sync save
+   (sidecar-first, temp file + rename), so files are byte-identical to
+   the sync path's and a kill mid-write never corrupts
+   ``latest_checkpoint``.
+3. **Backpressure: at most ONE in-flight save** — ``save()`` joins the
+   pending writer before starting the next, and ``wait()`` joins at loop
+   exit; saves can never pile up or reorder, and the loop's only
+   checkpoint stall is the (steady-state ~zero) join of a long-finished
+   write. A writer failure is stored and re-raised on the next
+   ``save()``/``wait()`` — the sync path's failure-stops-training
+   semantics, at most one save late.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.train.checkpoint import write_checkpoint
+from sketch_rnn_tpu.train.state import TrainState
+
+
+def snapshot_device_state(state: TrainState) -> TrainState:
+    """Donation-safe device snapshot with the D2H transfer started.
+
+    Returns a tree of fresh device arrays (async HBM copies — the host
+    does not block) on which ``copy_to_host_async`` has been called, so a
+    later ``jax.device_get`` only waits for transfers that overlap the
+    already-dispatched compute.
+    """
+    snap = jax.tree_util.tree_map(jnp.copy, state)
+    for leaf in jax.tree_util.tree_leaves(snap):
+        # start the device->host transfer without blocking; device_get on
+        # the writer thread then awaits the cached copy
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+    return snap
+
+
+class AsyncCheckpointer:
+    """One-deep background checkpoint writer for a single directory.
+
+    Not thread-safe across callers: exactly one loop thread calls
+    ``save``/``wait``/``close`` (the training loop's usage). The writer
+    thread only ever touches its private snapshot and the checkpoint
+    directory.
+    """
+
+    def __init__(self, ckpt_dir: str, keep: int = 3):
+        self.ckpt_dir = ckpt_dir
+        self.keep = keep
+        self._thread: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self.last_path: Optional[str] = None
+        self.saves_started = 0
+
+    # -- loop-thread API ---------------------------------------------------
+
+    def save(self, state: TrainState, scale_factor: float,
+             hps: HParams) -> None:
+        """Snapshot ``state`` and commit it in the background.
+
+        Joins any pending save first (backpressure: at most one
+        in-flight), re-raising its failure — so a dead disk stops
+        training at the NEXT save, exactly one cadence window late.
+        """
+        self.wait()
+        snap = snapshot_device_state(state)
+        self.saves_started += 1
+        self._thread = threading.Thread(
+            target=self._write, args=(snap, float(scale_factor), hps),
+            name="ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight save (if any); re-raise its failure."""
+        self.join()
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise RuntimeError(
+                f"async checkpoint write to {self.ckpt_dir} failed"
+            ) from exc
+
+    def join(self) -> None:
+        """Join the in-flight save WITHOUT raising (for ``finally``
+        blocks, where a writer error must not mask the propagating
+        one; the stored failure still surfaces on the next
+        ``wait()``/``save()``)."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+
+    @property
+    def in_flight(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def failure(self) -> Optional[BaseException]:
+        """Peek at a stored background-write failure without clearing
+        it (``wait()`` re-raises and clears) — for finally-block
+        reporting, where raising is forbidden but silence loses the
+        operator's only signal that a checkpoint never landed."""
+        return self._exc
+
+    # -- writer thread -----------------------------------------------------
+
+    def _write(self, snap: TrainState, scale_factor: float,
+               hps: HParams) -> None:
+        try:
+            host_state = jax.device_get(snap)
+            self.last_path = write_checkpoint(
+                self.ckpt_dir, host_state, scale_factor, hps,
+                keep=self.keep)
+        except BaseException as e:  # noqa: BLE001 — must cross the thread
+            self._exc = e

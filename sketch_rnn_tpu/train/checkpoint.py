@@ -47,14 +47,33 @@ def _paths(ckpt_dir: str, step: int) -> Tuple[str, str]:
 
 def save_checkpoint(ckpt_dir: str, state: TrainState, scale_factor: float,
                     hps: HParams, keep: int = 3) -> str:
-    """Write the state; prune to the ``keep`` most recent. Returns path."""
+    """Write the state; prune to the ``keep`` most recent. Returns path.
+
+    Synchronous: the device->host fetch and the file write both happen on
+    the calling thread. The training loop's overlapped path
+    (``train.async_ckpt.AsyncCheckpointer``) fetches and commits on a
+    background thread through the same :func:`write_checkpoint`, so both
+    paths produce byte-identical files.
+    """
+    return write_checkpoint(ckpt_dir, jax.device_get(state), scale_factor,
+                            hps, keep=keep)
+
+
+def write_checkpoint(ckpt_dir: str, host_state: TrainState,
+                     scale_factor: float, hps: HParams,
+                     keep: int = 3) -> str:
+    """Serialize an already-fetched HOST pytree and atomically commit it.
+
+    The single commit discipline shared by the sync and async save paths:
+    sidecar FIRST (latest_checkpoint() requires both files, so a crash
+    after this write but before the msgpack lands leaves only a harmless
+    orphan json and resume falls back to the previous complete
+    checkpoint), then the msgpack — each via temp file + rename so a kill
+    mid-write never corrupts ``latest_checkpoint``.
+    """
     os.makedirs(ckpt_dir, exist_ok=True)
-    state = jax.device_get(state)
-    step = int(state.step)
+    step = int(host_state.step)
     data_path, meta_path = _paths(ckpt_dir, step)
-    # sidecar FIRST: latest_checkpoint() requires both files, so a crash
-    # after this write but before the msgpack lands leaves only a harmless
-    # orphan json and resume falls back to the previous complete checkpoint
     meta = {"format_version": FORMAT_VERSION, "step": step,
             "scale_factor": float(scale_factor),
             "hps": json.loads(hps.to_json())}
@@ -64,7 +83,7 @@ def save_checkpoint(ckpt_dir: str, state: TrainState, scale_factor: float,
     os.replace(tmp, meta_path)
     tmp = data_path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(state))
+        f.write(serialization.to_bytes(host_state))
     os.replace(tmp, data_path)
     _prune(ckpt_dir, keep)
     return data_path

@@ -7,6 +7,18 @@ sharded onto the mesh, and (every ``log_every`` steps) fetches scalar
 metrics. Everything else (fwd, bwd, all-reduce, Adam, schedules) runs on
 device. Eval sweeps the whole valid/test split with the dropout-off step
 and averages, which is the recon-NLL/KL parity surface.
+
+Goodput runtime (ISSUE 3): in the steady state the loop performs NO
+blocking host synchronization between step dispatches — checkpoints
+commit on a background writer (``async_checkpoint``, train/async_ckpt.py)
+and log-window metrics convert one window late (``metrics_defer``,
+train/metrics.py MetricsDrain), so the dispatch pipeline stays full.
+Both paths are semantics-preserving: resumed state, metric values, and
+the training stream are bitwise-identical to the synchronous ones
+(``scripts/goodput_bench.py`` measures the stall removal and asserts the
+parity). A ``GoodputLedger`` attributes the loop's wall time per phase
+(dispatch / feeder_wait / metrics_drain / ckpt_wait / eval) into every
+metrics row and an end-of-run summary.
 """
 
 from __future__ import annotations
@@ -23,12 +35,13 @@ from sketch_rnn_tpu.data.prefetch import prefetch_batches
 from sketch_rnn_tpu.models.vae import SketchRNN
 from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
 from sketch_rnn_tpu.parallel.multihost import is_primary
+from sketch_rnn_tpu.train.async_ckpt import AsyncCheckpointer
 from sketch_rnn_tpu.train.checkpoint import (
     latest_checkpoint,
     restore_checkpoint,
     save_checkpoint,
 )
-from sketch_rnn_tpu.train.metrics import MetricsWriter
+from sketch_rnn_tpu.train.metrics import MetricsDrain, MetricsWriter
 from sketch_rnn_tpu.train.state import TrainState, make_train_state
 from sketch_rnn_tpu.train.step import (
     make_eval_step,
@@ -37,7 +50,12 @@ from sketch_rnn_tpu.train.step import (
     make_train_step,
 )
 from sketch_rnn_tpu.utils.debug import check_finite, param_count
-from sketch_rnn_tpu.utils.profiling import Throughput
+from sketch_rnn_tpu.utils.profiling import GoodputLedger, Throughput
+
+# the loop's accounted phases, pre-declared so every metrics row carries
+# all t_<phase>_s columns from the first window (CSV header stability)
+GOODPUT_PHASES = ("dispatch", "feeder_wait", "metrics_drain", "ckpt_wait",
+                  "eval")
 
 
 def _sweep_rows(params, loader: DataLoader, eval_step, mesh, key, multi):
@@ -215,6 +233,16 @@ def train(hps: HParams,
     write_dir = workdir if is_primary() else None
     writer = MetricsWriter(write_dir, "train")
     eval_writer = MetricsWriter(write_dir, "valid")
+    # the goodput runtime: one-window-deferred metrics conversion (the
+    # drain persists each row before check_finite, preserving the
+    # divergence-leaves-its-record discipline) and a one-deep background
+    # checkpoint writer — in the steady state the loop never blocks on a
+    # device->host sync between dispatches
+    drain = MetricsDrain(writer, defer=hps.metrics_defer,
+                         check=check_finite)
+    ckpt = (AsyncCheckpointer(write_dir)
+            if write_dir and hps.async_checkpoint else None)
+    ledger = GoodputLedger(GOODPUT_PHASES)
 
     step = int(state.step)
     throughput = Throughput(hps.batch_size * hps.max_seq_len,
@@ -236,12 +264,14 @@ def train(hps: HParams,
     # triggers on crossing a multiple rather than landing on one (for K=1
     # the two are identical)
     crossed = lambda prev, every: step // every > prev // every
+    last_saved_step = None  # highest step THIS run checkpointed
     try:
         while step < num_steps:
             if profile_span and not trace_active and step >= profile_span[0]:
                 jax.profiler.start_trace(f"{workdir}/trace")
                 trace_active = True
-            batch = feeder.get()
+            with ledger.span("feeder_wait"):
+                batch = feeder.get()
             # key is a pure function of (seed, step): a resumed run
             # continues the stream instead of replaying the pre-checkpoint
             # keys
@@ -249,7 +279,8 @@ def train(hps: HParams,
             prev = step
             remaining = num_steps - step
             if spc == 1 or remaining >= spc:
-                state, metrics = train_step(state, batch, step_key)
+                with ledger.span("dispatch"):
+                    state, metrics = train_step(state, batch, step_key)
                 step += spc
             else:
                 # final non-K-aligned remainder: replay the stacked micro-
@@ -257,10 +288,11 @@ def train(hps: HParams,
                 # per-micro-step keys the K-step call would have used
                 if single_step is None:
                     single_step = make_train_step(model, hps, mesh)
-                for i in range(remaining):
-                    b_i = jax.tree_util.tree_map(lambda x: x[i], batch)
-                    state, metrics = single_step(
-                        state, b_i, jax.random.fold_in(step_key, i))
+                with ledger.span("dispatch"):
+                    for i in range(remaining):
+                        b_i = jax.tree_util.tree_map(lambda x: x[i], batch)
+                        state, metrics = single_step(
+                            state, b_i, jax.random.fold_in(step_key, i))
                 step += remaining
             if trace_active and step >= profile_span[1]:
                 jax.block_until_ready(metrics["loss"])
@@ -269,26 +301,73 @@ def train(hps: HParams,
                 profile_span = None
 
             if crossed(prev, hps.log_every) or step == num_steps:
-                scalars = {k: float(v) for k, v in metrics.items()}
-                rates = throughput.update(step)
-                if rates:
-                    scalars.update(rates)
-                # persist the row BEFORE the guard so a divergence leaves
-                # its diagnostic record in the metrics files
-                writer.write(step, scalars)
-                writer.log_console(step, scalars)
-                check_finite(scalars, step)
+                # host-side extras (throughput, per-phase stall ledger)
+                # ride with this window's device refs; the drain converts
+                # + persists + finiteness-checks the PREVIOUS window,
+                # whose compute is long done — no step-chain sync
+                extras = throughput.update(step) or {}
+                extras.update(ledger.window())
+                with ledger.span("metrics_drain"):
+                    drain.push(step, metrics, extras)
 
             if valid_loader is not None and crossed(prev, hps.eval_every):
-                ev = evaluate(state.params, valid_loader, eval_step, mesh,
-                              multi=eval_multi)
+                with ledger.span("eval"):
+                    ev = evaluate(state.params, valid_loader, eval_step,
+                                  mesh, multi=eval_multi)
                 eval_writer.write(step, ev)
                 eval_writer.log_console(step, ev)
 
             if write_dir and crossed(prev, hps.save_every):
-                save_checkpoint(write_dir, state, scale_factor, hps)
+                # drain the deferral queue BEFORE committing: without
+                # this, a divergence in the save step's own log window
+                # (the common alignment — save_every is a multiple of
+                # log_every) would checkpoint the NaN state and become
+                # latest_checkpoint before the one-window-late raise,
+                # wedging resume-from-latest. The flush syncs on at most
+                # one window, only on save steps (save_every >>
+                # log_every), preserving the pre-r6 guarantee that a
+                # committed checkpoint's logged windows were all finite.
+                with ledger.span("metrics_drain"):
+                    drain.flush()
+                # async: join any previous save (steady state ~zero),
+                # snapshot, hand off — the fetch + serialize + commit
+                # happen on the writer thread
+                with ledger.span("ckpt_wait"):
+                    if ckpt is not None:
+                        ckpt.save(state, scale_factor, hps)
+                    else:
+                        save_checkpoint(write_dir, state, scale_factor,
+                                        hps)
+                last_saved_step = step
+        # tail of the deferral queue: the final window's row (and its
+        # finiteness guard — divergence still stops the run before the
+        # final checkpoint commits) lands here
+        drain.flush()
     finally:
         feeder.close()
+        # best-effort: persist the pending deferred window so a crash
+        # post-mortem has its last metrics row (the synchronous loop
+        # wrote every window at its own step; deferral must not lose
+        # one to an unrelated raise). Swallow everything — nothing in
+        # a finally may mask the propagating error. On the normal path
+        # the in-try flush already emptied the queue; this is a no-op.
+        try:
+            drain.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        # join (never raise here — a writer error must not mask a
+        # propagating one; it resurfaces via ckpt.wait() below on the
+        # normal path) so no daemon thread outlives the loop; a stored
+        # failure is at least REPORTED, because on an abnormal exit
+        # wait() never runs and the operator must learn the checkpoint
+        # they think exists was never written
+        if ckpt is not None:
+            ckpt.join()
+            if ckpt.failure is not None:
+                print(f"[ckpt] WARNING: background checkpoint write "
+                      f"failed: {ckpt.failure!r} — latest_checkpoint "
+                      f"in {write_dir} is older than the last save "
+                      f"cadence", flush=True)
         # a check_finite/evaluate/save raise must not leave an open trace
         # session (the partial trace would be unusable and the session
         # poisons any later start_trace in this process)
@@ -296,7 +375,22 @@ def train(hps: HParams,
             jax.profiler.stop_trace()
 
     if write_dir:
-        save_checkpoint(write_dir, state, scale_factor, hps)
+        if ckpt is not None:
+            ckpt.wait()  # surface a background save failure loudly
+        # skip the final write when THIS run's last cadenced save
+        # already committed this exact step (num_steps a multiple of
+        # save_every): it would re-fetch and rewrite byte-identical
+        # files — for a large model that doubles end-of-run latency.
+        # Tracked per-run, NOT via latest_checkpoint(): a stale
+        # same-step checkpoint left by a previous --no_resume run must
+        # be overwritten, so directory contents cannot be trusted
+        if last_saved_step != step:
+            save_checkpoint(write_dir, state, scale_factor, hps)
+    if is_primary():
+        totals = ledger.summary()
+        print("[goodput] " + " ".join(
+            f"{name}={rec['total_s']:.2f}s" for name, rec in
+            sorted(totals.items())), flush=True)
     if test_loader is not None and test_loader.num_eval_batches > 0:
         ev = evaluate(state.params, test_loader, eval_step, mesh,
                       multi=eval_multi)

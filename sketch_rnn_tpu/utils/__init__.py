@@ -1,6 +1,12 @@
 """Auxiliary subsystems: profiling, NaN guards (SURVEY.md §5)."""
 
-from sketch_rnn_tpu.utils.profiling import Throughput, trace
+from sketch_rnn_tpu.utils.profiling import (
+    GoodputLedger,
+    SpanTimer,
+    Throughput,
+    trace,
+)
 from sketch_rnn_tpu.utils.debug import check_finite, find_nonfinite
 
-__all__ = ["trace", "Throughput", "check_finite", "find_nonfinite"]
+__all__ = ["trace", "SpanTimer", "GoodputLedger", "Throughput",
+           "check_finite", "find_nonfinite"]

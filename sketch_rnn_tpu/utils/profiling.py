@@ -53,9 +53,46 @@ class SpanTimer:
     def summary(self) -> dict:
         return {
             name: {"count": n, "total_s": round(t, 6),
-                   "mean_ms": round(1e3 * t / n, 4)}
+                   "mean_ms": round(1e3 * t / n, 4) if n else 0.0}
             for name, (n, t) in sorted(self._spans.items())
         }
+
+
+class GoodputLedger(SpanTimer):
+    """Per-phase wall-clock ledger for the training loop (ISSUE 3).
+
+    The host loop wraps each phase of its iteration — ``dispatch`` (step
+    enqueue, including device backpressure), ``feeder_wait`` (input
+    pipeline starvation), ``metrics_drain`` (deferred metric
+    conversion), ``ckpt_wait`` (join + snapshot of the async
+    checkpointer, or the full sync save), ``eval`` (sweep turnaround) —
+    so a run can attribute every second of wall time between device
+    goodput and host stalls without a device trace.
+
+    :meth:`window` returns the per-phase seconds accrued SINCE the last
+    ``window()`` call (keys ``t_<phase>_s``) for embedding in the
+    ``MetricsWriter`` row of each log window; the inherited
+    :meth:`~SpanTimer.summary` gives run totals for the end-of-train
+    console line.
+    """
+
+    def __init__(self, phases: tuple = ()):
+        super().__init__()
+        # pre-declare phases that first fire late (ckpt_wait, eval): the
+        # FIRST metrics row defines the CSV header, so a phase absent
+        # from it would be dropped from the CSV forever (the writer's
+        # resume-alignment rule); seeding pins every column from row one
+        for name in phases:
+            self._spans.setdefault(name, [0, 0.0])
+        self._window_mark: dict = {}
+
+    def window(self, prefix: str = "t_") -> dict:
+        out = {}
+        for name, (_, total) in sorted(self._spans.items()):
+            prev = self._window_mark.get(name, 0.0)
+            out[f"{prefix}{name}_s"] = round(total - prev, 6)
+            self._window_mark[name] = total
+        return out
 
 
 class Throughput:

@@ -20,3 +20,22 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_bench_history(tmp_path, monkeypatch):
+    """Tests must never append to the repo's COMMITTED bench history
+    files — the r5 review found test-suite smoke rows accumulated in
+    BENCH_HISTORY.jsonl exactly this way. Route both history paths to
+    the test's temp dir; tests that pin their own path monkeypatch over
+    this (their setattr runs later and wins)."""
+    import bench
+
+    monkeypatch.setattr(
+        bench, "_hist_path",
+        lambda: str(tmp_path / "BENCH_HISTORY.jsonl"))
+    monkeypatch.setattr(
+        bench, "_smoke_hist_path",
+        lambda: str(tmp_path / "BENCH_SMOKE_HISTORY.jsonl"))

@@ -1,0 +1,185 @@
+"""Async-checkpoint tests: bitwise parity, crash safety, backpressure.
+
+ISSUE 3 satellite: the background writer must be an invisible
+optimization — same bytes on disk as the sync path, a death mid-write
+never corrupts ``latest_checkpoint``, failures stop training (one save
+late), and at most ONE save is ever in flight.
+"""
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sketch_rnn_tpu.train.async_ckpt as AC
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.train import make_train_state
+from sketch_rnn_tpu.train.async_ckpt import (
+    AsyncCheckpointer,
+    snapshot_device_state,
+)
+from sketch_rnn_tpu.train.checkpoint import (
+    _paths,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+TINY = dict(batch_size=8, max_seq_len=16, enc_rnn_size=8, dec_rnn_size=12,
+            z_size=4, num_mixture=2, hyper_rnn_size=8, hyper_embed_size=4)
+
+
+def tiny_state(step=3, key=0):
+    hps = HParams(**TINY)
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(key))
+    return hps, state._replace(step=jnp.asarray(step, jnp.int32))
+
+
+def test_async_save_byte_identical_to_sync(tmp_path):
+    """The async writer goes through the same write_checkpoint commit on
+    the same host values — files must be byte-identical to a sync save
+    of the same state, and restore bitwise-equal."""
+    hps, state = tiny_state(step=7)
+    d_sync = str(tmp_path / "sync")
+    d_async = str(tmp_path / "async")
+    save_checkpoint(d_sync, state, scale_factor=2.5, hps=hps)
+
+    ckpt = AsyncCheckpointer(d_async)
+    ckpt.save(state, 2.5, hps)
+    ckpt.wait()
+    assert latest_checkpoint(d_async) == 7
+    for a, b in zip(_paths(d_sync, 7), _paths(d_async, 7)):
+        assert open(a, "rb").read() == open(b, "rb").read()
+
+    template = tiny_state(step=0, key=9)[1]
+    rs, scale_s, _ = restore_checkpoint(d_sync, template)
+    ra, scale_a, _ = restore_checkpoint(d_async, template)
+    assert scale_s == scale_a == 2.5
+    for a, b in zip(jax.tree_util.tree_leaves(rs),
+                    jax.tree_util.tree_leaves(ra)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_snapshot_is_fresh_arrays_with_equal_values():
+    """The device snapshot must hand the writer arrays the training loop
+    can never donate: fresh buffers (distinct objects), same values."""
+    _, state = tiny_state()
+    snap = snapshot_device_state(state)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(snap)):
+        assert a is not b
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_crash_mid_write_keeps_previous_checkpoint(tmp_path, monkeypatch):
+    """A writer death between the sidecar and the msgpack (the worst
+    window: sidecar committed, data not) must leave latest_checkpoint on
+    the previous COMPLETE step, and surface on the next wait()."""
+    import sketch_rnn_tpu.train.checkpoint as C
+
+    hps, state = tiny_state(step=3)
+    d = str(tmp_path)
+    save_checkpoint(d, state, 1.5, hps)
+    assert latest_checkpoint(d) == 3
+
+    # die during serialization: the sidecar json for step 5 is already
+    # on disk, the msgpack never lands — the exact kill-mid-write shape
+    def boom(_):
+        raise OSError("disk died")
+
+    monkeypatch.setattr(C.serialization, "to_bytes", boom)
+    ckpt = AsyncCheckpointer(d)
+    later = tiny_state(step=5)[1]
+    ckpt.save(later, 1.5, hps)
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        ckpt.wait()
+    monkeypatch.undo()
+
+    assert os.path.exists(_paths(d, 5)[1])  # the orphan sidecar...
+    assert latest_checkpoint(d) == 3        # ...is invisible to resume
+    restored, scale, _ = restore_checkpoint(d, state)
+    assert int(restored.step) == 3 and scale == 1.5
+
+
+def test_failure_surfaces_on_next_save(tmp_path, monkeypatch):
+    """The sync path's failure-stops-training semantics, one save late:
+    a background write error re-raises from the NEXT save() call."""
+    hps, state = tiny_state()
+    calls = []
+
+    def boom(*a, **k):
+        calls.append(1)
+        raise OSError("no space")
+
+    monkeypatch.setattr(AC, "write_checkpoint", boom)
+    ckpt = AsyncCheckpointer(str(tmp_path))
+    ckpt.save(state, 1.0, hps)  # fails in the background
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        ckpt.save(state, 1.0, hps)
+    assert len(calls) == 1  # the second save never spawned a writer
+    ckpt.wait()  # the failure was consumed; wait() is clean again
+
+
+def test_backpressure_caps_in_flight_at_one(tmp_path, monkeypatch):
+    """save() must JOIN the pending writer before starting the next —
+    two writers can never run concurrently (they would race _prune and
+    interleave partial files)."""
+    hps, state = tiny_state()
+    gate = threading.Event()
+    active = []
+    max_active = []
+
+    def slow_write(*a, **k):
+        active.append(1)
+        max_active.append(len(active))
+        gate.wait(timeout=10)
+        active.pop()
+        return "path"
+
+    monkeypatch.setattr(AC, "write_checkpoint", slow_write)
+    ckpt = AsyncCheckpointer(str(tmp_path))
+    ckpt.save(state, 1.0, hps)
+    assert ckpt.in_flight
+
+    # second save from another thread: must block in the join until the
+    # first writer finishes, never spawning a concurrent one
+    second_started = threading.Event()
+    second_done = threading.Event()
+
+    def second():
+        second_started.set()
+        ckpt.save(state, 1.0, hps)
+        second_done.set()
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    second_started.wait(timeout=5)
+    assert not second_done.wait(timeout=0.3)  # blocked on the join
+    gate.set()
+    t.join(timeout=10)
+    assert second_done.is_set()
+    ckpt.join()
+    assert max(max_active) == 1
+    assert ckpt.saves_started == 2
+
+
+def test_join_never_raises_wait_does(tmp_path, monkeypatch):
+    """join() is the finally-block primitive: it must swallow nothing
+    permanently — the stored failure is peekable via .failure (for the
+    loop's abnormal-exit warning) and still raises from wait()."""
+    hps, state = tiny_state()
+    monkeypatch.setattr(AC, "write_checkpoint",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("x")))
+    ckpt = AsyncCheckpointer(str(tmp_path))
+    assert ckpt.failure is None
+    ckpt.save(state, 1.0, hps)
+    ckpt.join()  # no raise
+    assert isinstance(ckpt.failure, OSError)  # peek does not clear
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        ckpt.wait()
+    assert ckpt.failure is None  # wait() consumed it

@@ -1,0 +1,42 @@
+"""goodput_bench tests (tier-1-safe: a shrunken smoke).
+
+Wall-clock overheads are noise-prone on a shared CI box (and on CPU the
+writer thread shares cores with the "device"), so the tier-1 regression
+signal is the DETERMINISTIC part: the record shape, the save/row
+accounting, and above all the PARITY block — sync vs overlapped through
+the real train() must stay byte-identical in checkpoints and identical
+in logged metric values. The timing acceptance (async within a few
+percent of no-checkpoint baseline at an aggressive cadence) is the
+full-config run's job on the real chip.
+"""
+
+import json
+
+from scripts import goodput_bench
+
+
+def test_goodput_bench_smoke_end_to_end(tmp_path):
+    out = tmp_path / "GOODPUT.json"
+    rc = goodput_bench.main([
+        "--smoke", "--steps", "8", "--save_every", "2", "--log_every",
+        "2", "--trials", "1", "--workdir", str(tmp_path / "scratch"),
+        "--out", str(out)])
+    assert rc == 0
+    rec = json.load(open(out))
+    assert rec["kind"] == "goodput_bench" and rec["smoke"] is True
+    assert set(rec["configs"]) == {"baseline", "async_ckpt", "sync_ckpt",
+                                   "eager_metrics", "sync_both"}
+    for name, r in rec["configs"].items():
+        assert r["wall_s"] > 0, name
+        assert r["rows"] == 4, name  # 8 steps / log_every 2
+        want_saves = 4 if "ckpt" in name or name == "sync_both" else 0
+        assert r["saves"] == want_saves, name
+    assert rec["configs"]["baseline"]["overhead_vs_baseline"] == 0.0
+    # the semantics contract: every parity boolean true
+    parity = rec["parity"]
+    assert parity["final_step_equal"] is True
+    assert parity["ckpt_bytes_equal"] is True
+    assert parity["mid_ckpt_bytes_equal"] is True  # async-written file
+    assert parity["state_bitwise_equal"] is True
+    assert parity["metrics_identical"] is True
+    assert parity["logged_rows"] > 0

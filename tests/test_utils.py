@@ -1,10 +1,16 @@
-"""Tests for aux subsystems: throughput counter, NaN guards."""
+"""Tests for aux subsystems: throughput counter, goodput ledger, NaN
+guards, metrics drain."""
 
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from sketch_rnn_tpu.utils import Throughput, check_finite, find_nonfinite
+from sketch_rnn_tpu.utils import (
+    GoodputLedger,
+    Throughput,
+    check_finite,
+    find_nonfinite,
+)
 
 
 def test_throughput_counter():
@@ -20,6 +26,89 @@ def test_throughput_counter():
         rates["strokes_per_sec"] / 4)
     # non-advancing step resets instead of dividing by zero
     assert tp.update(10) is None
+
+
+def test_goodput_ledger_windows_and_totals():
+    led = GoodputLedger(("dispatch", "ckpt_wait"))
+    # pre-declared phases appear in the FIRST window even before any
+    # span fires (CSV header stability) and summary tolerates count 0
+    w0 = led.window()
+    assert w0 == {"t_dispatch_s": 0.0, "t_ckpt_wait_s": 0.0}
+    assert led.summary()["ckpt_wait"]["mean_ms"] == 0.0
+
+    import time
+    with led.span("dispatch"):
+        time.sleep(0.01)
+    with led.span("dispatch"):
+        pass
+    w1 = led.window()
+    assert w1["t_dispatch_s"] >= 0.01
+    assert w1["t_ckpt_wait_s"] == 0.0
+    # windows are DELTAS: a second call without new spans reads ~zero
+    assert led.window()["t_dispatch_s"] == 0.0
+    # totals keep accumulating across windows
+    s = led.summary()
+    assert s["dispatch"]["count"] == 2
+    assert s["dispatch"]["total_s"] >= 0.01
+    # an undeclared phase joins the ledger on first use
+    with led.span("eval"):
+        pass
+    assert "t_eval_s" in led.window()
+
+
+def test_metrics_drain_one_window_deferral():
+    from sketch_rnn_tpu.train.metrics import MetricsDrain
+
+    class Rec:
+        def __init__(self):
+            self.rows = []
+
+        def write(self, step, scalars):
+            self.rows.append((step, scalars))
+
+        def log_console(self, *a, **k):
+            pass
+
+    rec = Rec()
+    checked = []
+    d = MetricsDrain(rec, defer=True,
+                     check=lambda s, step: checked.append(step))
+    d.push(2, {"loss": jnp.float32(1.0)}, {"rate": 5.0})
+    assert rec.rows == []          # held: one-window deferral
+    d.push(4, {"loss": jnp.float32(2.0)})
+    assert rec.rows == [(2, {"loss": 1.0, "rate": 5.0})]
+    assert checked == [2]          # guard ran on the drained window
+    d.flush()
+    assert rec.rows[-1] == (4, {"loss": 2.0})
+    d.flush()                      # idempotent on an empty queue
+    assert len(rec.rows) == 2
+
+    # defer=False is the synchronous path: emit inside push
+    rec2 = Rec()
+    d2 = MetricsDrain(rec2, defer=False)
+    d2.push(2, {"loss": jnp.float32(3.0)})
+    assert rec2.rows == [(2, {"loss": 3.0})]
+
+
+def test_metrics_drain_check_raise_after_persist():
+    """A failing check (divergence) must raise AFTER the row is written
+    — the record survives for post-mortem."""
+    from sketch_rnn_tpu.train.metrics import MetricsDrain
+
+    rows = []
+
+    class Rec:
+        def write(self, step, scalars):
+            rows.append(step)
+
+        def log_console(self, *a, **k):
+            pass
+
+    d = MetricsDrain(Rec(), defer=True, check=check_finite)
+    d.push(2, {"loss": jnp.float32(float("nan"))})
+    with pytest.raises(FloatingPointError, match="step 2"):
+        d.push(4, {"loss": jnp.float32(1.0)})
+    assert rows == [2]
 
 
 def test_check_finite_passes_and_raises():
