@@ -73,7 +73,11 @@ int16-vs-bf16 parity measured twice: 5.04/4.99/5.03M r4,
 default 255 — the corpus is integer-origin like QuickDraw, scale
 factor ~17-65 depending on the class mix, so int16 transfer trains
 with meaningful loss here;
-0 restores the legacy float-natured corpus, which int16 refuses).
+0 restores the legacy float-natured corpus, which int16 refuses),
+BENCH_CELL_DEADLINE (per-cell wall budget in seconds, default 900:
+retry backoffs are capped by the remaining deadline and a cell whose
+backoff no longer fits records an ``unavailable`` row instead of
+running the matrix into the driver's outer timeout).
 
 Defaults are the measured-best v5e config: bfloat16 matmuls, global batch
 4096/chip (amortizes the per-step dispatch/feed overhead — measured
@@ -213,6 +217,51 @@ def _unavailable(err: BaseException) -> bool:
     if is_xla and msg.startswith("UNAVAILABLE"):
         return True
     return msg.startswith("Unable to initialize backend")
+
+
+# minimum useful remainder of a cell's deadline: a retry must leave room
+# for the sleep plus compile + warmup + a couple of trials, otherwise the
+# cell should record its outage instead of running into the outer timeout
+_RETRY_MARGIN_S = 60.0
+
+
+def _retry_decision(used: dict, cls: str, elapsed: float,
+                    deadline: float) -> tuple:
+    """Per-failure retry decision for one bench cell (pure, unit-tested).
+
+    Returns ``(action, sleep_s)``: ``"retry"`` (sleep ``sleep_s`` then
+    re-run), ``"raise"`` (this class's retry budget is exhausted), or
+    ``"give_up"`` (the remaining cell deadline cannot fit the backoff
+    plus a meaningful attempt — the cell must emit its ``_unavailable``
+    row NOW, while there is still budget to emit anything). Sleeps are
+    capped by the remaining deadline: BENCH_r05 recorded rc=124 with
+    ``parsed: null`` because an uncapped 120s unavailable backoff ran
+    the matrix into the driver's outer ``timeout`` mid-retry, losing the
+    whole round's record.
+    """
+    budget, delay = (2, 120.0) if cls == "unavail" else (1, 10.0)
+    if used.get(cls, 0) >= budget:
+        return "raise", 0.0
+    remaining = deadline - elapsed
+    if remaining <= _RETRY_MARGIN_S:
+        return "give_up", 0.0
+    return "retry", min(delay, remaining - _RETRY_MARGIN_S)
+
+
+def _unavailable_row(cell: str, err: BaseException, used: dict,
+                     elapsed: float) -> dict:
+    """The cell's outage record: streamed and history-appended in place
+    of a result row so a dead backend window still leaves a parseable,
+    attributable trace (consumers key on ``kind`` and ignore it for
+    best-of/plausibility)."""
+    return {
+        "kind": "unavailable",
+        "dec_model": cell,
+        "error": repr(err)[:300],
+        "unavail_retries": used.get("unavail", 0),
+        "other_retries": used.get("other", 0),
+        "elapsed_s": round(elapsed, 1),
+    }
 
 
 def _should_stop(trial: int, no_improve: int, best_t: float,
@@ -503,6 +552,10 @@ def main() -> int:
         cell_batch = batch_per_chip
         if cell == "hyper" and (resid == "float32" or not fused):
             cell_batch = min(batch_per_chip, 2048)
+        cell_t0 = time.perf_counter()
+        # the per-cell wall budget retries must fit inside; the driver's
+        # outer `timeout` should comfortably exceed n_cells * this
+        deadline_s = float(os.environ.get("BENCH_CELL_DEADLINE", "900"))
         try:
             r = bench_train(cell, steps, cell_batch, seq_len, dtype,
                             remat, depth, fused=fused, resid_dtype=resid,
@@ -522,17 +575,31 @@ def main() -> int:
             # one. The class is re-decided per failure so an outage
             # first surfacing as a generic error still earns the long
             # backoff, and deterministic errors (ValueError/TypeError)
-            # keep failing fast even when raised by a retry.
+            # keep failing fast even when raised by a retry. Sleeps are
+            # capped by the cell deadline: when the backoff no longer
+            # fits, the cell records an `unavailable` row instead of
+            # running the matrix into the driver's outer timeout
+            # (BENCH_r05: rc=124, parsed null, round record lost).
             last = e
             used = {"unavail": 0, "other": 0}   # per-class budgets
             while True:
                 cls = "unavail" if _unavailable(last) else "other"
-                budget, delay = (2, 120) if cls == "unavail" else (1, 10)
-                if used[cls] >= budget:
+                action, delay = _retry_decision(
+                    used, cls, time.perf_counter() - cell_t0, deadline_s)
+                if action == "raise":
                     raise last
+                if action == "give_up":
+                    r = _unavailable_row(
+                        cell, last, used,
+                        time.perf_counter() - cell_t0)
+                    print(f"# bench_train({cell}) giving up "
+                          f"({deadline_s:.0f}s cell deadline cannot fit "
+                          f"another {cls} backoff); recording "
+                          f"unavailable row", file=sys.stderr)
+                    break
                 used[cls] += 1
                 print(f"# bench_train({cell}) failed ({last!r}); "
-                      f"{cls} retry {used[cls]}/{budget} in {delay}s",
+                      f"{cls} retry {used[cls]} in {delay:.0f}s",
                       file=sys.stderr)
                 time.sleep(delay)
                 try:
@@ -562,8 +629,21 @@ def main() -> int:
             print(f"# {json.dumps(stamped)}", file=sys.stderr)
 
     flag = results[flagship]
-    per_chip = flag["strokes_per_sec_per_chip"]
     baseline = float(os.environ.get("BENCH_BASELINE", "0") or 0)
+    if flag.get("kind") == "unavailable":
+        # the flagship cell never produced a number this round; the
+        # summary line stays parseable (value null) and rc=1 flags the
+        # degraded round — far better than the outer-timeout rc=124
+        # that loses every streamed row after it
+        print(json.dumps({
+            "metric": "train_strokes_per_sec_per_chip",
+            "value": None,
+            "unit": "strokes/sec/chip",
+            "vs_baseline": None,
+            "unavailable": True,
+        }))
+        return 1
+    per_chip = flag["strokes_per_sec_per_chip"]
     print(json.dumps({
         "metric": "train_strokes_per_sec_per_chip",
         "value": per_chip,

@@ -31,6 +31,11 @@ def key_of(r: dict):
     # alongside the canonical one, a CPU smoke row must never pool with
     # (or shadow) an accelerator record of the same shape
     dev = r.get("device_kind")
+    if r.get("kind") == "bucket_bench":
+        return ("bucket", r.get("dec_model"),
+                f"B={r.get('batch_size')} T={r.get('max_seq_len')} "
+                f"edges={';'.join(str(e) for e in r.get('bucket_edges') or ())} "
+                f"dev={dev}")
     if r.get("kind") == "sampler":
         # full_len rows (r3+) force max_len loop steps; earlier rows let
         # the untrained model early-exit after a few steps — not comparable
@@ -51,6 +56,10 @@ def key_of(r: dict):
 
 
 def metric_of(r: dict):
+    if r.get("kind") == "bucket_bench":
+        # the bucketed runtime's headline: steps/sec multiple over the
+        # fixed-T baseline on the same corpus
+        return r.get("speedup_steps_per_sec")
     return r.get("strokes_per_sec_per_chip") or r.get("sketches_per_sec")
 
 
@@ -87,10 +96,11 @@ def main(argv=None) -> int:
     for path in paths:
         for r in iter_rows(path):
             # diagnostic rows (profile_breakdown, sampler_latency,
-            # probe_*) are not best-of configs; without this guard a
-            # breakdown row's strokes_per_sec_per_chip prints as a
-            # phantom train config with None knobs
-            if r.get("kind") not in ("train", "sampler"):
+            # probe_*, the unavailable-outage markers) are not best-of
+            # configs; without this guard a breakdown row's
+            # strokes_per_sec_per_chip prints as a phantom train config
+            # with None knobs
+            if r.get("kind") not in ("train", "sampler", "bucket_bench"):
                 continue
             v = metric_of(r)
             if v is None:
@@ -103,6 +113,15 @@ def main(argv=None) -> int:
         b, l = best[k], latest[k]
         when = time.strftime("%m-%d %H:%M",
                              time.localtime(b.get("wall_time", 0)))
+        if k[0] == "bucket":
+            # padding-waste columns: what fixed-T padding burned and
+            # what the bucketed runtime still pads
+            pf = (b.get("fixed") or {}).get("padded_frac")
+            pb = (b.get("bucketed") or {}).get("padded_frac")
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"best={metric_of(b):>11.2f}x ({when} padded_frac "
+                  f"{pf}->{pb})  latest={metric_of(l):>11.2f}x")
+            continue
         extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""
         # records the bench itself flagged as never reaching 70% of the
         # historical best are slow-window artifacts, not the build's speed

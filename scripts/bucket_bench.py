@@ -1,0 +1,342 @@
+"""Length-bucketed execution benchmark: padding waste vs steps/sec.
+
+Measures the ISSUE 4 acceptance surface on ONE skewed-length corpus
+(short sketches dominate, a long tail reaches ``max_seq_len`` — the
+QuickDraw length shape that makes fixed-T padding expensive):
+
+- ``fixed``    — the pre-bucketing baseline: every batch padded to
+  ``max_seq_len`` (``bucket_edges=()``), the exact-parity mode.
+- ``bucketed`` — batches assembled from length buckets and padded only
+  to their bucket edge ``Tb``; each ``(B, Tb)`` geometry runs its own
+  compiled step executable (train/step.py).
+
+Both modes time the same optimizer step over the same corpus with the
+same synchronous feed (batch assembly inline, identical cost either
+side), best-of ``--trials`` with trials INTERLEAVED across modes so an
+ambient-load window cannot invert the comparison (the goodput_bench
+lesson). Every geometry is compiled in warmup — including the
+weighted wrap-tail variants — so the timed window holds zero compiles.
+``padded_frac`` comes from the loader's ``PaddingLedger`` (host-side
+exact counts over the timed window only).
+
+Semantics checks ride along (the part of the acceptance that must hold
+on every backend):
+
+- masked EVAL losses are bitwise independent of bucketing: a full
+  ``evaluate`` sweep over bucket-padded eval batches must equal the
+  fixed-T sweep metric-for-metric, exactly;
+- the documented train-mode delta — the canonical unmasked pen CE loses
+  its truncated all-padding tail (ops/mdn.py) — is measured and
+  reported as ``train_pen_ce_tail_delta`` (the GMM term must be exact).
+
+Writes ``BUCKET_BENCH.json`` (``--out``) and appends the record to the
+bench history (``--smoke``/CPU rows route to BENCH_SMOKE_HISTORY.jsonl).
+``--smoke`` shrinks the model so the whole thing runs in ~a minute on
+CPU; the speedup acceptance (>= 1.3x steps/sec on the skewed corpus) is
+checked there too — on CPU the scan cost is nearly linear in T, so
+bucketing's win shows without an accelerator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_skewed_corpus(n: int, max_seq_len: int, seed: int,
+                       short_frac: float = 0.85):
+    """Skewed-length synthetic corpus: ``short_frac`` short sketches
+    (6-20 steps) + a long tail reaching ``max_seq_len`` — mean length a
+    small fraction of the padded maximum, like QuickDraw under the
+    canonical max_seq_len=250."""
+    from sketch_rnn_tpu.data.loader import make_synthetic_strokes
+
+    n_short = int(n * short_frac)
+    short, _ = make_synthetic_strokes(n_short, min_len=6, max_len=20,
+                                      seed=seed)
+    long_, _ = make_synthetic_strokes(n - n_short,
+                                      min_len=max(24, max_seq_len // 2),
+                                      max_len=max_seq_len - 4,
+                                      seed=seed + 1)
+    seqs = short + long_
+    lens = np.array([len(s) for s in seqs])
+    return seqs, {"n": n, "short_frac": short_frac,
+                  "mean_len": round(float(lens.mean()), 2),
+                  "max_len": int(lens.max())}
+
+
+def _build_loader(seqs, hps, seed):
+    from sketch_rnn_tpu.data import strokes as S
+    from sketch_rnn_tpu.data.loader import DataLoader
+
+    loader = DataLoader([s.copy() for s in seqs], hps, seed=seed)
+    loader.normalize(S.calculate_normalizing_scale_factor(
+        [np.asarray(s, np.float32) for s in seqs]))
+    return loader
+
+
+def _warmup_geometries(loader, step_fn, state, key):
+    """Compile every (B, Tb) executable the bucketed stream can emit —
+    full batches per edge plus the weighted wrap-tail variant — so the
+    timed window never hits a compile. Returns the post-warmup state."""
+    import jax
+
+    b = loader.hps.batch_size
+    edges = loader.bucket_edges or (loader.hps.max_seq_len,)
+    for j, e in enumerate(edges):
+        fits = np.flatnonzero(loader._lengths <= e)
+        if len(fits) == 0:
+            continue
+        idx = fits[np.arange(b) % len(fits)]
+        batch = loader._assemble(idx, pad_to=e if loader.bucket_edges
+                                 else None)
+        state, m = step_fn(state, batch, jax.random.fold_in(key, j))
+        float(m["loss"])
+        if loader.bucket_edges:
+            batch = dict(batch)
+            batch["weights"] = np.ones((b,), np.float32)
+            state, m = step_fn(state, batch,
+                               jax.random.fold_in(key, 100 + j))
+            float(m["loss"])
+    return state
+
+
+def run_mode(model, hps, loader, state, steps, key):
+    """Time ``steps`` optimizer steps through ``loader.next_batch``.
+
+    Returns ``{time_s, steps_per_sec, padded_frac, bucket_batches}``;
+    the padding stats cover exactly the timed window (the ledger mark
+    is reset right before it).
+    """
+    import jax
+
+    loader.padding_ledger.window()  # reset the window mark
+    t0 = time.perf_counter()
+    for i in range(steps):
+        batch = loader.next_batch()
+        state, metrics = step_cache(model, hps)(
+            state, batch, jax.random.fold_in(key, 1000 + i))
+    float(metrics["loss"])  # drain the dispatched chain
+    dt = time.perf_counter() - t0
+    win = loader.padding_ledger.window()
+    return state, {
+        "time_s": round(dt, 4),
+        "steps_per_sec": round(steps / dt, 3),
+        "padded_frac": win.pop("padded_frac"),
+        "bucket_batches": {k: v for k, v in win.items() if v},
+    }
+
+
+_STEP_CACHE = {}
+
+
+def step_cache(model, hps):
+    """One jitted train step per hps (its shape-keyed executable cache
+    IS the per-bucket dispatch — train/step.py)."""
+    from sketch_rnn_tpu.train.step import make_train_step
+
+    if hps not in _STEP_CACHE:
+        _STEP_CACHE[hps] = make_train_step(model, hps, mesh=None)
+    return _STEP_CACHE[hps]
+
+
+def check_eval_parity(model, hps_fixed, hps_bucket, seqs, seed):
+    """Full masked-eval sweep, fixed-T vs bucket-padded batches: every
+    averaged metric must be EXACTLY equal (bitwise-independent pad)."""
+    import jax
+
+    from sketch_rnn_tpu.train.loop import evaluate
+    from sketch_rnn_tpu.train.step import make_eval_step
+
+    params = model.init_params(jax.random.key(7))
+    eval_step = make_eval_step(model, hps_fixed, mesh=None)
+    sweeps = {}
+    pads = {}
+    for name, hps in (("fixed", hps_fixed), ("bucketed", hps_bucket)):
+        loader = _build_loader(seqs, hps, seed)
+        pads[name] = sorted({loader.eval_pad_len(i)
+                             for i in range(loader.num_eval_batches)})
+        sweeps[name] = evaluate(params, loader, eval_step, mesh=None,
+                                key=jax.random.key(11))
+    equal = (set(sweeps["fixed"]) == set(sweeps["bucketed"]) and all(
+        sweeps["fixed"][k] == sweeps["bucketed"][k]
+        for k in sweeps["fixed"]))
+    return {
+        "bitwise_equal": bool(equal),
+        "eval_pad_lens_bucketed": [int(p) for p in pads["bucketed"]],
+        "loss_fixed": sweeps["fixed"]["loss"],
+        "loss_bucketed": sweeps["bucketed"]["loss"],
+    }
+
+
+def measure_train_tail_delta(model, hps_fixed, hps_bucket, seqs, seed):
+    """Train-mode reconstruction on the SAME rows, full-T vs
+    bucket-padded: the masked GMM term must be exact — asserted on the
+    PER-EXAMPLE time-sums, which are bitwise equal (the truncated
+    tail's summands are exactly 0.0; the fused whole-batch scalar may
+    reassociate its reduction by ~1e-7 relative, which is compile-order
+    noise, not a semantic change) — while the unmasked pen CE shrinks
+    by the truncated all-padding tail (the documented bucketed delta,
+    ops/mdn.py)."""
+    import jax
+
+    from sketch_rnn_tpu.ops import mdn
+
+    params = model.init_params(jax.random.key(7))
+    key = jax.random.key(13)
+
+    def sums(params, batch, key):
+        mp, x_target, _, _, _ = model._forward(params, batch, key,
+                                               train=True)
+        return mdn.reconstruction_sums(mp, x_target, mask_pen=False)
+
+    out = {}
+    for name, hps in (("fixed", hps_fixed), ("bucketed", hps_bucket)):
+        loader = _build_loader(seqs, hps, seed)
+        batch = loader.get_batch(0)
+        batch.pop("weights")  # train-shaped batch, full geometry
+        nll_ex, pen_ex = jax.jit(sums)(params, batch, key)
+        out[name] = (np.asarray(nll_ex), np.asarray(pen_ex))
+    nmax_b = hps_fixed.max_seq_len * hps_fixed.batch_size
+    pen_f = float(out["fixed"][1].sum()) / nmax_b
+    pen_b = float(out["bucketed"][1].sum()) / nmax_b
+    return {
+        "gmm_nll_exact": bool(np.array_equal(out["fixed"][0],
+                                             out["bucketed"][0])),
+        "train_pen_ce_tail_delta": round(pen_f - pen_b, 8),
+        "pen_ce_fixed": round(pen_f, 8),
+        "pen_ce_bucketed": round(pen_b, 8),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fixed-T vs length-bucketed training throughput")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU config (~a minute); same measurement")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="timed optimizer steps per trial (0 = mode "
+                         "default)")
+    ap.add_argument("--trials", type=int, default=3,
+                    help="best-of trials per mode (interleaved)")
+    ap.add_argument("--edges", default="",
+                    help="semicolon/comma-separated bucket edges "
+                         "(default: mode preset)")
+    ap.add_argument("--corpus_n", type=int, default=0,
+                    help="corpus size (0 = mode default; tests shrink it)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BUCKET_BENCH.json",
+                    help="result JSON path ('' = stdout only)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from scripts._measure import hist_append
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train import make_train_state
+    from sketch_rnn_tpu.train.step import geometry_cache_size
+
+    if args.smoke:
+        base = get_default_hparams().replace(
+            batch_size=32, max_seq_len=128, enc_rnn_size=32,
+            dec_rnn_size=64, z_size=16, num_mixture=5, dec_model="lstm",
+            eval_steps_per_call=1, transfer_dtype="float32")
+        edges = (16, 32, 64, 128)
+        steps = args.steps or 30
+        corpus_n = 16 * base.batch_size
+    else:
+        base = get_default_hparams().replace(
+            batch_size=1024, max_seq_len=250,
+            dec_model=os.environ.get("BENCH_DEC", "layer_norm"))
+        edges = (64, 128, 192, 250)
+        steps = args.steps or 50
+        corpus_n = 8 * base.batch_size
+    if args.edges:
+        edges = tuple(int(e) for e in
+                      args.edges.replace(",", ";").split(";") if e)
+    if args.corpus_n:
+        corpus_n = args.corpus_n
+    hps_fixed = base
+    hps_bucket = base.replace(bucket_edges=edges)
+
+    seqs, corpus = make_skewed_corpus(corpus_n, base.max_seq_len,
+                                      args.seed)
+    print(f"# corpus: {corpus}", file=sys.stderr)
+    model = SketchRNN(base)
+
+    # one warm state per mode, all geometries compiled outside timing
+    key = jax.random.key(args.seed)
+    loaders, states = {}, {}
+    for name, hps in (("fixed", hps_fixed), ("bucketed", hps_bucket)):
+        loaders[name] = _build_loader(seqs, hps, args.seed)
+        st = make_train_state(model, hps, jax.random.key(0))
+        states[name] = _warmup_geometries(loaders[name],
+                                          step_cache(model, hps), st, key)
+
+    results = {}
+    for t in range(args.trials):
+        for name, hps in (("fixed", hps_fixed), ("bucketed", hps_bucket)):
+            states[name], r = run_mode(model, hps, loaders[name],
+                                       states[name], steps,
+                                       jax.random.fold_in(key, t))
+            print(f"#   {name} trial {t}: {r['time_s']}s "
+                  f"({r['steps_per_sec']} steps/s, padded_frac="
+                  f"{r['padded_frac']})", file=sys.stderr)
+            if (name not in results
+                    or r["steps_per_sec"] > results[name]["steps_per_sec"]):
+                results[name] = r
+
+    speedup = round(results["bucketed"]["steps_per_sec"]
+                    / results["fixed"]["steps_per_sec"], 3)
+    print("# checking masked-eval bitwise parity + train tail delta",
+          file=sys.stderr)
+    parity = check_eval_parity(model, hps_fixed, hps_bucket, seqs,
+                               args.seed)
+    tail = measure_train_tail_delta(model, hps_fixed, hps_bucket, seqs,
+                                    args.seed)
+
+    rec = {
+        "kind": "bucket_bench",
+        "smoke": bool(args.smoke),
+        "device_kind": jax.devices()[0].device_kind,
+        "n_chips": jax.device_count(),
+        "dec_model": base.dec_model,
+        "batch_size": base.batch_size,
+        "max_seq_len": base.max_seq_len,
+        "bucket_edges": list(edges),
+        "steps": steps,
+        "corpus": corpus,
+        "fixed": results["fixed"],
+        "bucketed": results["bucketed"],
+        "compiled_geometries": geometry_cache_size(
+            step_cache(model, hps_bucket)),
+        "speedup_steps_per_sec": speedup,
+        "padded_frac_saved": round(results["fixed"]["padded_frac"]
+                                   - results["bucketed"]["padded_frac"],
+                                   6),
+        "meets_1p3x": speedup >= 1.3,
+        "eval_parity": parity,
+        "train_tail": tail,
+    }
+    print(json.dumps(rec, indent=2))
+    hist_append(rec)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=2)
+    if not (parity["bitwise_equal"] and tail["gmm_nll_exact"]):
+        print("# PARITY FAILURE: bucketing changed masked eval loss or "
+              "the masked GMM term", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

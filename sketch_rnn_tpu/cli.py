@@ -147,6 +147,11 @@ def cmd_train(args) -> int:
     from sketch_rnn_tpu.train import train
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
+    if getattr(args, "bucket_edges", ""):
+        # convenience spelling of --hparams bucket_edges=...: accept
+        # comma OR semicolon separators (the hparam tuple syntax is ';')
+        hps = hps.parse(
+            f"bucket_edges={args.bucket_edges.replace(',', ';')}")
     if getattr(args, "sync_io", False):
         # bisection/debugging escape hatch: force the fully synchronous
         # loop (blocking saves, eager metric conversion) in one flag
@@ -381,6 +386,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("train", help="train a model")
     _add_common(p)
+    p.add_argument("--bucket_edges", default="",
+                   help="length-bucketed execution: comma/semicolon-"
+                        "separated bucket pad lengths (e.g. 64,128,250); "
+                        "batches pad only to their bucket edge and each "
+                        "(B, Tb) geometry gets its own compiled step. "
+                        "Empty (default) = exact-parity fixed-T padding. "
+                        "Shorthand for --hparams bucket_edges=...")
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler device trace of steps "
                         "~10-20 into <workdir>/trace (view with XProf)")

@@ -31,6 +31,24 @@ class HParams:
     batch_size: int = 100
     random_scale_factor: float = 0.15  # stroke augmentation scale jitter
     augment_stroke_prob: float = 0.10  # prob of dropping a point (train only)
+    bucket_edges: Tuple[int, ...] = ()  # length-bucketed execution (off
+    #   when empty — the exact-parity default): training batches are
+    #   assembled from sequences binned by length and padded only to
+    #   their bucket's edge Tb instead of max_seq_len, and each (B, Tb)
+    #   geometry gets its own compiled step executable (train/step.py).
+    #   Edges are strictly ascending pad lengths, e.g. "64;128;250";
+    #   max_seq_len is always an implicit terminal edge. The masked GMM
+    #   loss term is EXACTLY preserved (normalization stays max_seq_len
+    #   * B); the canonical unmasked-to-Nmax train pen CE loses its
+    #   truncated [Tb, Nmax) all-padding tail — see ops/mdn.py. Masked
+    #   eval losses are bitwise independent of bucketing. Single-host
+    #   only; requires steps_per_call=1 (bucket batches have per-batch
+    #   shapes and cannot ride one stacked transfer).
+    bucket_shuffle_window: int = 256   # seeded shuffle window (in
+    #   batches) applied to the bucketed epoch's batch order so binning
+    #   by length does not introduce a length-curriculum bias; windows
+    #   >= the epoch's batch count give a full shuffle (tf.data-style
+    #   windowed-shuffle semantics, deterministic per (seed, epoch)).
 
     # --- model (components 2-10) ---
     conditional: bool = True           # seq2seq VAE vs decoder-only
@@ -176,6 +194,28 @@ class HParams:
             raise ValueError(
                 f"serve_slots and serve_chunk must be >= 1, got "
                 f"{self.serve_slots}/{self.serve_chunk}")
+        if self.bucket_edges:
+            edges = self.bucket_edges
+            if any(e <= 0 for e in edges):
+                raise ValueError(f"bucket_edges must be positive pad "
+                                 f"lengths, got {edges}")
+            if list(edges) != sorted(set(edges)):
+                raise ValueError(f"bucket_edges must be strictly "
+                                 f"ascending, got {edges}")
+            if edges[-1] > self.max_seq_len:
+                raise ValueError(
+                    f"bucket_edges {edges} exceed max_seq_len="
+                    f"{self.max_seq_len}; a bucket longer than the padded "
+                    f"maximum can never be filled")
+            if self.steps_per_call != 1:
+                raise ValueError(
+                    f"bucket_edges requires steps_per_call=1 (got "
+                    f"{self.steps_per_call}): bucketed batches have "
+                    f"per-batch shapes and cannot ride one stacked "
+                    f"K-micro-step transfer")
+        if self.bucket_shuffle_window < 1:
+            raise ValueError(f"bucket_shuffle_window must be >= 1, got "
+                             f"{self.bucket_shuffle_window}")
 
     # -- overrides ---------------------------------------------------------
 
@@ -230,8 +270,21 @@ def _coerce(val: str, like: Any) -> Any:
         items = [s for s in val.split(";") if s]
         if like and isinstance(like[0], int):
             return tuple(int(s) for s in items)
+        if not like and all(_is_int(s) for s in items):
+            # empty-tuple defaults (bucket_edges=()) carry no element
+            # type to copy; all-integer literals coerce to ints so
+            # "bucket_edges=64;128" does not silently become strings
+            return tuple(int(s) for s in items)
         return tuple(items)
     return val
+
+
+def _is_int(s: str) -> bool:
+    try:
+        int(s)
+        return True
+    except ValueError:
+        return False
 
 
 def get_default_hparams() -> HParams:

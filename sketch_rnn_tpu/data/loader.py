@@ -31,6 +31,7 @@ import numpy as np
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.data import native_batcher as NB
 from sketch_rnn_tpu.data import strokes as S
+from sketch_rnn_tpu.utils.profiling import PaddingLedger
 
 
 def _purify(stroke3_list, max_seq_len: int, limit: float = 1000.0):
@@ -58,6 +59,15 @@ class DataLoader:
       start token ``(0, 0, 1, 0, 0)`` at t=0,
     - ``"seq_len"``: ``[B]`` int32 true lengths (excluding start token),
     - ``"labels"``: ``[B]`` int32 class ids (zeros when unlabeled).
+
+    Length-bucketed execution (ISSUE 4, ``hps.bucket_edges``):
+    :meth:`next_batch` feeds training from a seeded per-epoch bucket
+    plan — batches padded only to their bucket edge ``Tb`` (strokes
+    ``[B, Tb + 1, 5]``), every example covered exactly once per epoch —
+    and :meth:`get_batch` pads eval batches to :meth:`eval_pad_len`.
+    With ``bucket_edges`` empty (default) ``next_batch`` is exactly
+    ``random_batch``. Every assembled batch is accounted in
+    ``padding_ledger`` (padded-timestep fraction + per-bucket counts).
     """
 
     def __init__(self,
@@ -96,6 +106,33 @@ class DataLoader:
         else:
             self._common_len = self._max_local_len = len(self.strokes)
         self.num_batches = self._common_len // hps.batch_size
+        # -- length-bucketed execution (ISSUE 4) ---------------------------
+        # Effective edges always end at max_seq_len (the terminal bucket),
+        # so every admitted sequence has a bucket. Empty = bucketing off,
+        # the exact-parity default: next_batch then IS random_batch.
+        self.seed = seed
+        if hps.bucket_edges:
+            if num_hosts > 1:
+                # each host would plan its own bucket schedule, so the
+                # per-step GLOBAL batch would mix (B, Tb) geometries
+                # across hosts and the SPMD collectives would deadlock;
+                # multi-host bucketing needs a coordinated plan
+                raise RuntimeError(
+                    f"bucket_edges on a host-striped loader (num_hosts="
+                    f"{num_hosts}) would launch mismatched per-host "
+                    f"batch geometries; bucketed execution is "
+                    f"single-host only")
+            edges = tuple(hps.bucket_edges)
+            if edges[-1] < hps.max_seq_len:
+                edges = edges + (hps.max_seq_len,)
+            self.bucket_edges: Tuple[int, ...] = edges
+        else:
+            self.bucket_edges = ()
+        self._lengths = np.array([len(s) for s in self.strokes], np.int32)
+        self._bucket_epoch = 0
+        self._bucket_queue: List[tuple] = []
+        self.padding_ledger = PaddingLedger(
+            self.bucket_edges or (hps.max_seq_len,))
 
     def __len__(self) -> int:
         return len(self.strokes)
@@ -117,8 +154,9 @@ class DataLoader:
 
     # -- batching ----------------------------------------------------------
 
-    def _pad_batch(self, batch: Sequence[np.ndarray]) -> np.ndarray:
-        nmax = self.hps.max_seq_len
+    def _pad_batch(self, batch: Sequence[np.ndarray],
+                   nmax: Optional[int] = None) -> np.ndarray:
+        nmax = self.hps.max_seq_len if nmax is None else nmax
         out = np.zeros((len(batch), nmax + 1, 5), dtype=np.float32)
         for i, s in enumerate(batch):
             big = S.to_big_strokes(s, nmax)      # [nmax, 5]
@@ -127,7 +165,8 @@ class DataLoader:
         return out
 
     def _assemble(self, idx: np.ndarray,
-                  int16_scale: Optional[float] = None
+                  int16_scale: Optional[float] = None,
+                  pad_to: Optional[int] = None
                   ) -> Dict[str, np.ndarray]:
         # hot path: the C++ batcher (SURVEY §2 component 1 native path)
         # runs the whole batch assembly as one native call — at train time
@@ -138,6 +177,10 @@ class DataLoader:
         # ``int16_scale``: quantize offsets back to integer data units in
         # the SAME native pass (the exact int16 transfer path,
         # data/prefetch.py) and add the "transfer_scale" [B] leaf.
+        # ``pad_to``: pad only to this bucket edge instead of max_seq_len
+        # (length-bucketed execution; every row must fit — callers bin by
+        # raw length, and augmentation only ever SHORTENS a sequence).
+        pad = self.hps.max_seq_len if pad_to is None else int(pad_to)
         if int16_scale is not None and not (int16_scale > 0):
             # mirrors the prefetch guard for direct random_batch callers:
             # the native path refuses quant<=0 (returns None) and the
@@ -154,7 +197,7 @@ class DataLoader:
         strokes = None
         if int16_scale is not None:
             native = NB.assemble_batch_aug_i16(
-                raw, self.hps.max_seq_len,
+                raw, pad,
                 self.hps.random_scale_factor if self.augment else 0.0,
                 self.hps.augment_stroke_prob if self.augment else 0.0,
                 seed=aug_seed,
@@ -165,12 +208,12 @@ class DataLoader:
         if strokes is None:
             if self.augment:
                 native = NB.assemble_batch_aug(
-                    raw, self.hps.max_seq_len,
+                    raw, pad,
                     self.hps.random_scale_factor,
                     self.hps.augment_stroke_prob,
                     seed=aug_seed)
             else:
-                native = NB.assemble_batch(raw, self.hps.max_seq_len)
+                native = NB.assemble_batch(raw, pad)
             if native is not None:
                 strokes, seq_len = native
             else:
@@ -179,7 +222,7 @@ class DataLoader:
                         S.random_scale(s, self.hps.random_scale_factor,
                                        self.rng),
                         self.hps.augment_stroke_prob, self.rng) for s in raw]
-                strokes = self._pad_batch(raw)
+                strokes = self._pad_batch(raw, pad)
                 seq_len = np.array([len(s) for s in raw], dtype=np.int32)
             if int16_scale is not None:
                 # numpy fallback quantization: same rounding (np.rint is
@@ -189,6 +232,9 @@ class DataLoader:
                         -32767, 32767, out=q[..., :2], casting="unsafe")
                 q[..., 2:] = strokes[..., 2:]
                 strokes = q
+        # padding-waste accounting (host-side, thread-safe, no RNG): the
+        # metrics row's padded_frac / per-bucket dispatch columns
+        self.padding_ledger.record(pad, len(raw), int(seq_len.sum()))
         batch = {
             "strokes": strokes,
             "seq_len": seq_len,
@@ -245,6 +291,111 @@ class DataLoader:
                               replace=len(self.strokes) < self.hps.batch_size)
         return self._assemble(idx, int16_scale=int16_scale)
 
+    # -- length-bucketed batching (ISSUE 4) --------------------------------
+
+    def bucket_edge_of(self, length: int) -> int:
+        """Smallest bucket edge that fits a sequence of ``length`` steps
+        (``max_seq_len`` when bucketing is off)."""
+        if not self.bucket_edges:
+            return self.hps.max_seq_len
+        e = int(np.searchsorted(np.asarray(self.bucket_edges), length))
+        if e >= len(self.bucket_edges):
+            raise ValueError(
+                f"sequence length {length} exceeds the terminal bucket "
+                f"edge {self.bucket_edges[-1]} (= max_seq_len); the "
+                f"corpus was not filtered to max_seq_len")
+        return self.bucket_edges[e]
+
+    def _plan_bucket_epoch(self, epoch: int) -> List[tuple]:
+        """One epoch's bucketed batch plan: ``[(tb, idx[B], weights?)]``.
+
+        Deterministic in ``(loader seed, epoch)`` and independent of the
+        loader's augmentation RNG stream (a separate generator plans the
+        epoch). Covering contract: every corpus index appears with
+        weight 1 exactly ONCE across the epoch's batches — a seeded
+        permutation is binned by RAW length (augmentation point-dropout
+        only shortens, so a raw-length bin's edge always still fits),
+        each bucket is cut into full batches of ``batch_size``, and the
+        per-bucket tails are merged (padded to the largest member's
+        edge) into the final batches; the last of those wrap-fills with
+        already-emitted rows carrying weight 0, exactly like the eval
+        sweep's wrap batches, so every full-shape batch stays
+        compiled-geometry-clean while the weighted loss still treats
+        each example once. The batch ORDER then passes through a seeded
+        windowed shuffle (``bucket_shuffle_window``) so binning by
+        length cannot introduce a length-curriculum bias; windows >= the
+        epoch's batch count give a full shuffle.
+        """
+        b = self.hps.batch_size
+        rng = np.random.default_rng([self.seed & 0x7FFFFFFF, 9176, epoch])
+        perm = rng.permutation(len(self.strokes))
+        bins: Dict[int, List[int]] = {e: [] for e in self.bucket_edges}
+        for i in perm:
+            bins[self.bucket_edge_of(int(self._lengths[i]))].append(int(i))
+        batches: List[tuple] = []
+        tails: List[Tuple[int, int]] = []
+        for e in self.bucket_edges:
+            arr = bins[e]
+            for lo in range(0, len(arr) - len(arr) % b, b):
+                batches.append((e, np.array(arr[lo:lo + b], np.int64),
+                                None))
+            tails.extend((e, i) for i in arr[len(arr) - len(arr) % b:])
+        for lo in range(0, len(tails), b):
+            chunk = tails[lo:lo + b]
+            tb = max(e for e, _ in chunk)
+            idx = np.array([i for _, i in chunk], np.int64)
+            w = None
+            if len(idx) < b:
+                w = np.zeros((b,), np.float32)
+                w[:len(idx)] = 1.0
+                idx = idx[np.arange(b) % len(idx)]
+            batches.append((tb, idx, w))
+        return _windowed_shuffle(batches,
+                                 self.hps.bucket_shuffle_window, rng)
+
+    def next_batch(self, int16_scale: Optional[float] = None
+                   ) -> Dict[str, np.ndarray]:
+        """Next training batch: the bucketed epoch stream when
+        ``hps.bucket_edges`` is set, else exactly :meth:`random_batch`
+        (the buckets-off path is bit-for-bit the pre-bucketing feed —
+        same RNG stream, same shapes)."""
+        if not self.bucket_edges:
+            return self.random_batch(int16_scale=int16_scale)
+        if not self._bucket_queue:
+            if not self.strokes:
+                raise ValueError("bucketed next_batch on an empty corpus")
+            self._bucket_queue = self._plan_bucket_epoch(self._bucket_epoch)
+            self._bucket_epoch += 1
+        tb, idx, w = self._bucket_queue.pop(0)
+        batch = self._assemble(idx, int16_scale=int16_scale, pad_to=tb)
+        if w is not None:
+            # wrap-filled duplicate rows are zero-weighted: the loss
+            # normalizes by sum(weights), so the epoch's weighted stream
+            # treats every example exactly once (mdn.reconstruction_loss)
+            batch["weights"] = w
+        return batch
+
+    def eval_pad_len(self, batch_index: int) -> int:
+        """Pad length :meth:`get_batch` will use for ``batch_index``:
+        the bucket edge of the batch's longest row under bucketed
+        execution, else ``max_seq_len``. Host-side metadata only — the
+        eval sweep groups consecutive same-geometry batches into one
+        scan program with it (train.loop._sweep_rows)."""
+        if not self.bucket_edges:
+            return self.hps.max_seq_len
+        idx = self._eval_indices(batch_index)
+        return self.bucket_edge_of(int(self._lengths[idx].max()))
+
+    def _eval_indices(self, batch_index: int) -> np.ndarray:
+        if not 0 <= batch_index < self.num_eval_batches:
+            raise IndexError(f"batch {batch_index} of "
+                             f"{self.num_eval_batches}")
+        lo = batch_index * self.hps.batch_size
+        linear = np.arange(lo, lo + self.hps.batch_size)
+        # modulo is over the LOCAL length so hosts holding a striping
+        # remainder example still use it
+        return linear % len(self.strokes)
+
     def get_batch(self, batch_index: int) -> Dict[str, np.ndarray]:
         """Deterministic eval batch; includes a ``"weights"`` [B] vector.
 
@@ -252,17 +403,39 @@ class DataLoader:
         from the corpus start to keep the compiled batch shape; those
         duplicate rows get weight 0 so weighted eval metrics are exact
         sample means over the split (first occurrences get weight 1).
+
+        Under bucketed execution (``hps.bucket_edges``) the batch is
+        padded only to :meth:`eval_pad_len` — masked eval losses are
+        bitwise independent of the pad length (tested), so the sweep
+        result is unchanged while the eval scan runs at bucket depth.
         """
-        if not 0 <= batch_index < self.num_eval_batches:
-            raise IndexError(f"batch {batch_index} of {self.num_eval_batches}")
         lo = batch_index * self.hps.batch_size
         linear = np.arange(lo, lo + self.hps.batch_size)
-        # modulo is over the LOCAL length so hosts holding a striping
-        # remainder example still use it
-        idx = linear % len(self.strokes)
-        batch = self._assemble(idx)
+        idx = self._eval_indices(batch_index)
+        pad = (self.eval_pad_len(batch_index)
+               if self.bucket_edges else None)
+        batch = self._assemble(idx, pad_to=pad)
         batch["weights"] = (linear < len(self.strokes)).astype(np.float32)
         return batch
+
+
+def _windowed_shuffle(items: List, window: int,
+                      rng: np.random.Generator) -> List:
+    """tf.data-style windowed shuffle: emit a uniform draw from a
+    sliding buffer of ``window`` items. ``window`` >= ``len(items)`` is
+    a full shuffle; a small window bounds how far an item can travel,
+    which is enough to break bucket-ordered (length-curriculum) runs."""
+    if len(items) <= 1:
+        return list(items)
+    out: List = []
+    buf: List = []
+    for it in items:
+        buf.append(it)
+        if len(buf) >= max(1, window):
+            out.append(buf.pop(int(rng.integers(len(buf)))))
+    while buf:
+        out.append(buf.pop(int(rng.integers(len(buf)))))
+    return out
 
 
 # -- dataset assembly ------------------------------------------------------

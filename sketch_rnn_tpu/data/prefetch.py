@@ -123,7 +123,8 @@ class SyncFeeder:
 
 def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
                      transfer_dtype: Optional[str] = None):
-    """Feeder over ``loader.random_batch()`` with the device transfer
+    """Feeder over ``loader.next_batch()`` (``random_batch`` when the
+    loader has no bucketed plan / no such method) with the device transfer
     (sharded onto ``mesh`` when given) done on the producer thread;
     ``depth <= 0`` returns a synchronous feeder with the same interface.
 
@@ -159,6 +160,13 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
     """
     if stack < 1:
         raise ValueError(f"stack must be >= 1, got {stack}")
+    if stack > 1 and getattr(loader, "bucket_edges", ()):
+        # bucketed batches have per-batch (B, Tb) shapes; K of them
+        # cannot ride one stacked [K, ...] transfer (np.stack would fail
+        # opaquely deep in the producer thread) — config.py rejects the
+        # combination up front, this guards direct callers
+        raise ValueError("steps_per_call/stack > 1 is incompatible with "
+                         "bucketed execution (bucket_edges)")
     if transfer_dtype not in (None, "float32", "bfloat16", "int16"):
         # mirror HParams' validation for direct callers: an arbitrary
         # dtype (e.g. int8) would silently truncate the stroke deltas
@@ -188,15 +196,20 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
                 f"or 'float32' for float-natured corpora.")
         quant_scale = float(quant_scale)
 
+    # bucketed loaders feed from their epoch plan via next_batch; with
+    # bucket_edges unset next_batch IS random_batch (bit-for-bit the same
+    # feed), and plain producers without the method keep working
+    next_fn = getattr(loader, "next_batch", None) or loader.random_batch
+
     def host_batch():
         import numpy as np
 
         if stack == 1:
-            out = loader.random_batch(int16_scale=quant_scale)
+            out = next_fn(int16_scale=quant_scale)
             if cast is not None:
                 out = dict(out)  # don't mutate the loader's dict
         else:
-            parts = [loader.random_batch(int16_scale=quant_scale)
+            parts = [next_fn(int16_scale=quant_scale)
                      for _ in range(stack)]
             out = {k: np.stack([p[k] for p in parts]) for k in parts[0]}
         if cast is not None:

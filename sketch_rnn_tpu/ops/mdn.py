@@ -16,6 +16,26 @@ SURVEY §7 'Hard parts'):
 - both terms are normalized by ``max_seq_len * batch`` regardless of mask,
 - KL has the reference's ``kl_tolerance`` floor (free bits).
 
+Length-bucketed execution (ISSUE 4): ``target`` may be a bucket-padded
+``[Tb, B, 5]`` stream with ``Tb < max_seq_len`` — the normalizer stays
+``max_seq_len * batch`` (passed explicitly), so the masked GMM term is
+EXACTLY the fixed-T value: the truncated tail lies beyond every row's
+true length, where ``fs`` is 0 and every summand exactly 0.0, making
+the per-example time-sums of :func:`reconstruction_sums` bitwise
+independent of the pad length (the masked-pen eval CE likewise; the
+weighted eval scalars stay bitwise equal through the real eval step —
+tested — while the no-weights whole-batch scalar may pick up ~1e-7
+reduction-reassociation noise from the differently-tiled fused
+program). The one term that changes is the canonical
+UNMASKED train pen CE: it sums CE over all padded steps, so truncating
+to ``Tb`` drops the all-padding tail ``[Tb, Nmax)`` — per row that tail
+contributes ``(Nmax - Tb) * ce_pad / (Nmax * B)`` where ``ce_pad`` is
+the CE of the (constant) end-of-sketch pen target, a well-trained
+model's cheapest prediction (|delta| bounded by ``(1 - Tb/Nmax) *
+max_step_ce``; scripts/bucket_bench.py reports the measured gap).
+Buckets off (``bucket_edges=()``, the default) is the exact-parity
+mode: every batch arrives at full ``max_seq_len`` and nothing changes.
+
 Everything here is elementwise/reduction math that XLA fuses straight into
 the surrounding graph (SURVEY §2: "fuse into a single XLA graph").
 """
@@ -130,7 +150,18 @@ def reconstruction_loss(mp: MixtureParams, target: jax.Array,
     ``axis_name``: when called on a per-device batch shard inside
     ``shard_map``, numerators AND normalizers are psum'd over that mesh
     axis, so the returned scalars are exactly the global-batch values.
+
+    Bucketed batches (``T < max_seq_len``, module docstring): the GMM
+    term and masked pen CE are exact; the unmasked train pen CE drops
+    its truncated all-padding tail. ``T > max_seq_len`` is always a
+    caller bug (the normalizer would silently shrink the loss) and
+    raises.
     """
+    if target.shape[0] > max_seq_len:
+        raise ValueError(
+            f"target has {target.shape[0]} steps but max_seq_len="
+            f"{max_seq_len}: the fixed normalizer would under-weight "
+            f"every step; pass the model's true max_seq_len")
     b = target.shape[1]
     nll, pen_ce = reconstruction_sums(mp, target, mask_pen)  # each [B]
     if weights is None:

@@ -19,6 +19,18 @@ the training stream are bitwise-identical to the synchronous ones
 parity). A ``GoodputLedger`` attributes the loop's wall time per phase
 (dispatch / feeder_wait / metrics_drain / ckpt_wait / eval) into every
 metrics row and an end-of-run summary.
+
+Length-bucketed execution (ISSUE 4): with ``hps.bucket_edges`` set the
+feeder pulls bucketed batches (``DataLoader.next_batch``) padded only to
+their bucket edge; the jitted step's shape-keyed compile cache routes
+each ``(B, Tb)`` to its own executable (train/step.py), the eval sweep
+chunks at geometry boundaries (``_sweep_rows``), and every metrics row
+carries the loader ``PaddingLedger``'s padded-timestep fraction and
+per-bucket dispatch counts. Buckets off (the default) is bit-for-bit the
+pre-bucketing loop; masked eval losses are bucket-independent either
+way. Note ``strokes_per_sec`` still counts nominal ``B * max_seq_len``
+points per step — under bucketing read it against ``padded_frac``
+(``scripts/bucket_bench.py`` reports the honest steps/sec comparison).
 """
 
 from __future__ import annotations
@@ -73,6 +85,16 @@ def _sweep_rows(params, loader: DataLoader, eval_step, mesh, key, multi):
     exactly 1 falls back to the single-batch program; a larger
     remainder runs a smaller scan — at most two program sizes per sweep
     geometry, compiled once and cached across a training run's sweeps.
+
+    Bucketed execution (ISSUE 4): eval batches are padded to their
+    bucket edge (``loader.eval_pad_len``), so a chunk additionally
+    breaks at geometry changes — each scan program holds one ``(B, Tb)``
+    and lands in the same shape-keyed compiled cache the fixed-T sweep
+    already uses. Masked eval losses are bitwise independent of the pad
+    length, so chunking/bucketing cannot change sweep results beyond
+    the pre-existing ~1e-6 scan-reassociation note. With bucketing off
+    ``eval_pad_len`` is constant and the chunk schedule is exactly the
+    pre-bucketing one.
     """
     n = loader.num_eval_batches
     if n == 0:
@@ -81,9 +103,15 @@ def _sweep_rows(params, loader: DataLoader, eval_step, mesh, key, multi):
             f"examples, batch_size={loader.hps.batch_size}): some host's "
             f"stripe is empty; enlarge the split or reduce host count")
     multi_step, k_max = multi if multi is not None else (None, 1)
+    pad_len = getattr(loader, "eval_pad_len", None)
     i = 0
     while i < n:
         k = min(k_max, n - i) if multi_step is not None else 1
+        if k > 1 and pad_len is not None:
+            run, p0 = 1, pad_len(i)
+            while run < k and pad_len(i + run) == p0:
+                run += 1
+            k = run
         if k > 1:
             batches = [loader.get_batch(j) for j in range(i, i + k)]
             stacked = jax.tree_util.tree_map(
@@ -243,6 +271,17 @@ def train(hps: HParams,
     ckpt = (AsyncCheckpointer(write_dir)
             if write_dir and hps.async_checkpoint else None)
     ledger = GoodputLedger(GOODPUT_PHASES)
+    # padding-waste ledger (ISSUE 4): the loader records every assembled
+    # batch's pad length + true timesteps host-side, so each metrics row
+    # carries padded_frac and per-bucket dispatch counts with NO device
+    # sync; with bucketing off it quantifies the fixed-T waste the
+    # buckets would remove. Columns are pre-declared at loader build
+    # (CSV header stability).
+    pad_ledger = getattr(train_loader, "padding_ledger", None)
+    if getattr(train_loader, "bucket_edges", ()) and is_primary():
+        print(f"[train] bucketed execution: edges="
+              f"{train_loader.bucket_edges} "
+              f"shuffle_window={hps.bucket_shuffle_window}", flush=True)
 
     step = int(state.step)
     throughput = Throughput(hps.batch_size * hps.max_seq_len,
@@ -307,6 +346,8 @@ def train(hps: HParams,
                 # whose compute is long done — no step-chain sync
                 extras = throughput.update(step) or {}
                 extras.update(ledger.window())
+                if pad_ledger is not None:
+                    extras.update(pad_ledger.window())
                 with ledger.span("metrics_drain"):
                     drain.push(step, metrics, extras)
 

@@ -53,6 +53,32 @@ StepFn = Callable[[TrainState, Batch, jax.Array], Tuple[TrainState, Metrics]]
 EvalFn = Callable[[Any, Batch, jax.Array], Metrics]
 
 
+def batch_geometry(batch: Batch) -> Tuple[int, int]:
+    """``(B, T)`` of a loader batch — T excludes the start token.
+
+    Host-side metadata (array SHAPES never sync the device). Under
+    length-bucketed execution (ISSUE 4) this is the compiled-executable
+    cache key: every jitted step/eval function here is traced per input
+    geometry, so a batch padded to bucket edge ``Tb`` dispatches the
+    ``(B, Tb)`` executable — the same shape-keyed cache the eval sweep's
+    K-batch scan programs already live in. The cache is ``jax.jit``'s
+    own; :func:`geometry_cache_size` exposes its size so tests (and the
+    bucket bench) can assert one executable per bucket, not one per
+    step.
+    """
+    b, t1 = batch["strokes"].shape[-3], batch["strokes"].shape[-2]
+    return int(b), int(t1) - 1
+
+
+def geometry_cache_size(fn) -> Optional[int]:
+    """Number of compiled executables held by a jitted step/eval fn
+    (None when the runtime does not expose it)."""
+    try:
+        return int(fn._cache_size())
+    except AttributeError:
+        return None
+
+
 def _vma_check(hps: HParams) -> bool:
     """Whether shard_map's varying-manual-axes replication check can run.
 
@@ -128,7 +154,18 @@ def _make_single_step_core(model, hps: HParams, mesh: Optional[Mesh],
 
 def make_train_step(model, hps: HParams,
                     mesh: Optional[Mesh] = None) -> StepFn:
-    """Build the jitted ``(state, batch, key) -> (state, metrics)`` step."""
+    """Build the jitted ``(state, batch, key) -> (state, metrics)`` step.
+
+    The returned function is the per-bucket compiled-step cache of
+    length-bucketed execution: jit keys its executable cache on input
+    geometry, so dispatching bucket-padded batches routes each ``(B,
+    Tb)`` to its own compiled program (compiled once, on first
+    dispatch) while the ``TrainState`` — whose shapes never vary with
+    the bucket — is donated and updated in place by every one of them.
+    The loss normalizer is ``hps.max_seq_len`` (static, NOT the batch
+    T), which is what keeps the masked GMM term exactly
+    bucket-independent (ops/mdn.py).
+    """
     step_fn = _make_single_step_core(model, hps, mesh, make_optimizer(hps))
     if mesh is None:
         return jax.jit(step_fn, donate_argnums=0)

@@ -9,8 +9,9 @@ counters — the BASELINE.json metric.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
-from typing import Iterator, Optional
+from typing import Dict, Iterator, Optional, Sequence
 
 import jax
 
@@ -92,6 +93,70 @@ class GoodputLedger(SpanTimer):
             prev = self._window_mark.get(name, 0.0)
             out[f"{prefix}{name}_s"] = round(total - prev, 6)
             self._window_mark[name] = total
+        return out
+
+
+class PaddingLedger:
+    """Padded-timestep accounting for (bucketed) batch assembly (ISSUE 4).
+
+    ``DataLoader._assemble`` records every assembled batch — the pad
+    length ``tb`` it was padded to, its row count and its total TRUE
+    timesteps — so each training metrics row can carry the padding-waste
+    fraction and the per-bucket dispatch counts, making the bucketed
+    runtime's win (or the fixed-T baseline's waste) observable without a
+    device sync: everything here is host-side numpy bookkeeping at
+    assembly time. Batches are recorded when ASSEMBLED, which leads
+    consumption by at most ``prefetch_depth`` batches — window
+    attribution may be off by that lead, totals are exact.
+
+    Thread-safe (the prefetch producer thread assembles concurrently
+    with the loop reading windows). ``edges`` pre-declares the
+    ``bucket_T<edge>_n`` columns so the FIRST metrics row already
+    carries every column (the CSV-header stability rule, see
+    :class:`GoodputLedger`).
+
+    :meth:`window` returns, since the last ``window()`` call:
+
+    - ``padded_frac`` — fraction of dispatched timesteps that were
+      padding (``1 - true/dispatched``; 0.0 when nothing was assembled),
+    - ``bucket_T<edge>_n`` — batches assembled per bucket edge.
+    """
+
+    def __init__(self, edges: Sequence[int] = ()):
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {int(e): 0 for e in edges}
+        self._dispatched = 0   # timesteps shipped (rows * tb)
+        self._true = 0         # timesteps inside true sequence lengths
+        self._mark = (0, 0, {})
+
+    def record(self, tb: int, rows: int, true_steps: int) -> None:
+        with self._lock:
+            self._counts[int(tb)] = self._counts.get(int(tb), 0) + 1
+            self._dispatched += int(rows) * int(tb)
+            self._true += int(true_steps)
+
+    @staticmethod
+    def _frac(dispatched: int, true: int) -> float:
+        return 1.0 - true / dispatched if dispatched else 0.0
+
+    def window(self) -> Dict[str, float]:
+        with self._lock:
+            pd, pt, pc = self._mark
+            out = {"padded_frac": round(
+                self._frac(self._dispatched - pd, self._true - pt), 6)}
+            for e in sorted(self._counts):
+                out[f"bucket_T{e}_n"] = self._counts[e] - pc.get(e, 0)
+            self._mark = (self._dispatched, self._true, dict(self._counts))
+        return out
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            out = {"padded_frac": round(
+                self._frac(self._dispatched, self._true), 6),
+                "dispatched_timesteps": self._dispatched,
+                "true_timesteps": self._true}
+            for e in sorted(self._counts):
+                out[f"bucket_T{e}_n"] = self._counts[e]
         return out
 
 

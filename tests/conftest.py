@@ -30,7 +30,15 @@ def _hermetic_bench_history(tmp_path, monkeypatch):
     files — the r5 review found test-suite smoke rows accumulated in
     BENCH_HISTORY.jsonl exactly this way. Route both history paths to
     the test's temp dir; tests that pin their own path monkeypatch over
-    this (their setattr runs later and wins)."""
+    this (their setattr runs later and wins).
+
+    This also covers every scripts/ probe that appends through
+    ``bench._hist_append`` / ``scripts._measure.hist_append`` — incl.
+    the bucket-bench smoke rows (ISSUE 4), which carry ``smoke: true``
+    or ``device_kind == "cpu"`` and therefore take the
+    BENCH_SMOKE_HISTORY routing, here redirected to the temp dir.
+    (Bucket-bench's BUCKET_BENCH.json is written to ``--out``, which
+    tests must point into their tmp_path.)"""
     import bench
 
     monkeypatch.setattr(

@@ -319,6 +319,97 @@ def test_bench_main_streams_rows_to_stdout(monkeypatch, capsys,
     assert out_lines[-1]["value"] == 200.0  # flagship = layer_norm
 
 
+def test_retry_decision_caps_sleep_by_remaining_deadline():
+    """ISSUE 4 satellite: the 120s unavailable backoff must shrink to
+    fit the remaining cell deadline instead of sleeping the matrix into
+    the driver's outer timeout (BENCH_r05: rc=124, parsed null)."""
+    used = {"unavail": 0, "other": 0}
+    # plenty of deadline left: full backoff
+    assert bench._retry_decision(used, "unavail", 10.0, 900.0) == \
+        ("retry", 120.0)
+    # deadline nearly consumed: the sleep is capped to what fits
+    action, sleep = bench._retry_decision(used, "unavail", 800.0, 900.0)
+    assert action == "retry"
+    assert 0 < sleep <= 900.0 - 800.0 - bench._RETRY_MARGIN_S + 1e-9
+    # not enough room for a sleep plus a meaningful attempt: give up
+    assert bench._retry_decision(used, "unavail", 870.0, 900.0) == \
+        ("give_up", 0.0)
+    assert bench._retry_decision(used, "other", 895.0, 900.0) == \
+        ("give_up", 0.0)
+
+
+def test_retry_decision_budgets_still_raise():
+    """Class budgets are unchanged: 2 unavailable retries, 1 other."""
+    assert bench._retry_decision({"unavail": 2}, "unavail", 0.0,
+                                 900.0) == ("raise", 0.0)
+    assert bench._retry_decision({"other": 1}, "other", 0.0, 900.0) == \
+        ("raise", 0.0)
+    # under budget, the quick class keeps its 10s backoff
+    assert bench._retry_decision({"other": 0}, "other", 0.0, 900.0) == \
+        ("retry", 10.0)
+
+
+def test_bench_main_emits_unavailable_row_before_deadline(
+        monkeypatch, capsys, tmp_path):
+    """A cell facing a dead backend with no deadline room must stream
+    an ``unavailable`` row (and a parseable null summary) instead of
+    raising or sleeping into the outer timeout."""
+    def dead(*a, **k):
+        raise RuntimeError("Unable to initialize backend 'axon': "
+                           "UNAVAILABLE: TPU backend setup error")
+
+    monkeypatch.setattr(bench, "bench_train", dead)
+    monkeypatch.setattr(bench, "_hist_path",
+                        lambda: str(tmp_path / "h.jsonl"))
+    monkeypatch.setattr(bench, "_smoke_hist_path",
+                        lambda: str(tmp_path / "s.jsonl"))
+    monkeypatch.setenv("BENCH_CELL_DEADLINE", "1")  # no room: no sleeps
+    monkeypatch.setenv("BENCH_STEPS", "5")
+    monkeypatch.setenv("BENCH_SPC", "5")
+    monkeypatch.delenv("BENCH_MATRIX", raising=False)
+    assert bench.main() == 1  # degraded round, but a parseable one
+    out_lines = [json.loads(l)
+                 for l in capsys.readouterr().out.splitlines() if l]
+    row, summary = out_lines[0], out_lines[-1]
+    assert row["kind"] == "unavailable"
+    assert "Unable to initialize backend" in row["error"]
+    assert "wall_time" in row  # streamed rows carry the history stamp
+    assert summary["value"] is None and summary["unavailable"] is True
+    # the outage row landed in the history for round triage...
+    hist = [json.loads(l) for l in open(tmp_path / "h.jsonl")]
+    assert [r["kind"] for r in hist] == ["unavailable"]
+    # ...where the plausibility gate and the summary must ignore it
+    assert bench._hist_best_strokes(
+        "layer_norm", 4096, 250, "bfloat16", True, True, "bfloat16",
+        "TPU v5 lite", 1, 2, 25) is None
+    from scripts import bench_summary
+    assert bench_summary.main([str(tmp_path / "h.jsonl")]) == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_bench_summary_aggregates_bucket_bench_rows(tmp_path, capsys):
+    """ISSUE 4 satellite: bucket_bench rows surface with their
+    padding-waste columns and speedup metric, keyed separately per
+    edge-set and device."""
+    from scripts import bench_summary
+
+    hist = tmp_path / "h.jsonl"
+    row = {"kind": "bucket_bench", "dec_model": "lstm", "batch_size": 32,
+           "max_seq_len": 128, "bucket_edges": [16, 32, 64, 128],
+           "device_kind": "cpu", "speedup_steps_per_sec": 2.76,
+           "fixed": {"padded_frac": 0.81},
+           "bucketed": {"padded_frac": 0.34}}
+    _write_hist(hist, [row,
+                       {**row, "bucket_edges": [64, 128],
+                        "speedup_steps_per_sec": 1.4}])
+    assert bench_summary.main([str(hist)]) == 0
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l.strip()]
+    assert len(lines) == 2  # distinct edge sets key separately
+    full = next(l for l in lines if "16;32;64;128" in l)
+    assert "2.76" in full and "0.81" in full and "0.34" in full
+
+
 def test_bench_train_rejects_non_divisible_steps():
     """ADVICE r2: steps % steps_per_call != 0 must raise, not silently
     run fewer optimizer steps while computing throughput over `steps`."""

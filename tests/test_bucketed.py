@@ -1,0 +1,406 @@
+"""Length-bucketed execution (ISSUE 4): loader plan, dispatch, parity.
+
+Covers the tentpole's contracts:
+
+- seeded bucketed-loader determinism (same seed -> identical bucket
+  sequence and batch contents) and exactly-once-per-epoch coverage,
+- buckets-off (``bucket_edges=()``) is bit-for-bit the pre-bucketing
+  feed AND training path — ``next_batch`` IS ``random_batch`` and a
+  ``train()`` run equals a replica of the pre-PR loop (random_batch +
+  single jitted step + the loop's key discipline) leaf-for-leaf,
+- per-bucket compiled-step routing: one executable per (B, Tb)
+  geometry in the jitted step's shape-keyed cache,
+- masked eval is bitwise independent of bucketing, through the real
+  eval step and the full ``evaluate`` sweep (incl. the chunked
+  multi-eval path, which must break scan chunks at geometry changes),
+- the guards: multi-host striping, steps_per_call > 1, stacked
+  prefetch, config validation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from sketch_rnn_tpu.config import HParams, get_default_hparams
+from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+from sketch_rnn_tpu.utils.profiling import PaddingLedger
+
+
+def small_hps(**kw):
+    base = dict(batch_size=8, max_seq_len=96, enc_rnn_size=16,
+                dec_rnn_size=24, z_size=8, num_mixture=3,
+                transfer_dtype="float32", eval_steps_per_call=1)
+    base.update(kw)
+    return get_default_hparams().replace(**base)
+
+
+def corpus(n=60, seed=3, max_len=90):
+    return make_synthetic_strokes(n, num_classes=2, min_len=4,
+                                  max_len=max_len, seed=seed)
+
+
+def make_loader_sorted(hps, n=60, seed=5, max_len=90):
+    """Loader over a length-SORTED corpus: consecutive eval batches then
+    hold same-length-scale rows, so eval bucketing actually engages."""
+    seqs, labels = corpus(n, max_len=max_len)
+    order = np.argsort([len(s) for s in seqs], kind="stable")
+    return DataLoader([seqs[i].copy() for i in order], hps,
+                      labels=labels[order], seed=seed)
+
+
+@pytest.fixture
+def bucket_hps():
+    return small_hps(bucket_edges=(16, 32, 64))
+
+
+def make_loader(hps, n=60, seed=5, max_len=90, **kw):
+    seqs, labels = corpus(n, max_len=max_len)
+    return DataLoader([s.copy() for s in seqs], hps, labels=labels,
+                      seed=seed, **kw)
+
+
+# -- plan / loader contracts ----------------------------------------------
+
+
+def test_bucketed_plan_covers_every_sequence_exactly_once(bucket_hps):
+    dl = make_loader(bucket_hps, n=83)
+    plan = dl._plan_bucket_epoch(0)
+    assert len(plan) == -(-83 // bucket_hps.batch_size)
+    seen = []
+    for tb, idx, w in plan:
+        assert tb in dl.bucket_edges
+        assert len(idx) == bucket_hps.batch_size
+        # every row fits its batch's bucket edge
+        assert dl._lengths[idx].max() <= tb
+        seen.extend(idx.tolist() if w is None else idx[w > 0].tolist())
+    # weight-1 rows are exactly the corpus, once each
+    assert sorted(seen) == list(range(83))
+
+
+def test_bucketed_plan_epochs_differ_but_both_cover(bucket_hps):
+    dl = make_loader(bucket_hps, n=40)
+    p0, p1 = dl._plan_bucket_epoch(0), dl._plan_bucket_epoch(1)
+    flat = lambda p: [i for _, idx, w in p
+                      for i in (idx.tolist() if w is None
+                                else idx[w > 0].tolist())]
+    assert sorted(flat(p0)) == sorted(flat(p1)) == list(range(40))
+    assert flat(p0) != flat(p1)  # fresh permutation per epoch
+
+
+def test_bucketed_stream_deterministic_across_loaders(bucket_hps):
+    a = make_loader(bucket_hps, seed=5)
+    b = make_loader(bucket_hps, seed=5)
+    for _ in range(14):  # crosses an epoch boundary (8 batches/epoch)
+        ba, bb = a.next_batch(), b.next_batch()
+        assert ba["strokes"].shape == bb["strokes"].shape
+        np.testing.assert_array_equal(ba["strokes"], bb["strokes"])
+        np.testing.assert_array_equal(ba["seq_len"], bb["seq_len"])
+        np.testing.assert_array_equal(ba["labels"], bb["labels"])
+        assert ("weights" in ba) == ("weights" in bb)
+    # and a different seed plans a different stream
+    c, a2 = make_loader(bucket_hps, seed=6), make_loader(bucket_hps,
+                                                         seed=5)
+    diff = False
+    for _ in range(8):
+        x, y = c.next_batch(), a2.next_batch()
+        if (x["strokes"].shape != y["strokes"].shape
+                or not np.array_equal(x["strokes"], y["strokes"])):
+            diff = True
+            break
+    assert diff
+
+
+def test_bucketed_batches_pad_to_edges_only(bucket_hps):
+    dl = make_loader(bucket_hps)
+    for _ in range(10):
+        b = dl.next_batch()
+        tb = b["strokes"].shape[1] - 1
+        assert tb in dl.bucket_edges
+        assert b["seq_len"].max() <= tb
+        # start token intact at the bucketed pad
+        np.testing.assert_array_equal(
+            b["strokes"][:, 0, :],
+            np.tile([0, 0, 1, 0, 0], (bucket_hps.batch_size, 1)))
+
+
+def test_windowed_shuffle_semantics(bucket_hps):
+    """window=1 is the degenerate no-shuffle (emit in formation order);
+    a window >= n is a full permutation; every window preserves the
+    multiset. The plan's batch order must actually depend on the
+    window (the anti-length-curriculum knob does something)."""
+    from sketch_rnn_tpu.data.loader import _windowed_shuffle
+
+    rng = np.random.default_rng(0)
+    items = list(range(40))
+    assert _windowed_shuffle(items, 1, rng) == items
+    full = _windowed_shuffle(items, 1000, np.random.default_rng(1))
+    assert sorted(full) == items and full != items
+    small = _windowed_shuffle(items, 4, np.random.default_rng(2))
+    assert sorted(small) == items
+    # an item can travel at most (window - 1) positions EARLIER
+    assert all(pos >= i - 3 for pos, i in
+               ((small.index(i), i) for i in items))
+
+    h1 = bucket_hps.replace(bucket_shuffle_window=1)
+    dl = make_loader(h1, n=80)
+    ordered = [tb for tb, _, _ in dl._plan_bucket_epoch(0)]
+    dl2 = make_loader(bucket_hps, n=80)  # default window 256: full shuffle
+    shuffled = [tb for tb, _, _ in dl2._plan_bucket_epoch(0)]
+    assert sorted(ordered) == sorted(shuffled)
+    assert ordered != shuffled
+
+
+def test_buckets_off_next_batch_is_random_batch():
+    hps = small_hps()
+    a = make_loader(hps, seed=9)
+    b = make_loader(hps, seed=9)
+    for _ in range(5):
+        x, y = a.next_batch(), b.random_batch()
+        np.testing.assert_array_equal(x["strokes"], y["strokes"])
+        np.testing.assert_array_equal(x["seq_len"], y["seq_len"])
+        assert "weights" not in x
+
+
+def test_buckets_off_prefetch_stream_unchanged():
+    """The feeder path (prefetch_batches -> next_batch) must be
+    bit-for-bit the pre-bucketing random_batch stream."""
+    from sketch_rnn_tpu.data.prefetch import prefetch_batches
+
+    hps = small_hps()
+    a = make_loader(hps, seed=11)
+    b = make_loader(hps, seed=11)
+    feeder = prefetch_batches(a, mesh=None, depth=2)
+    try:
+        for _ in range(4):
+            x, y = feeder.get(), b.random_batch()
+            np.testing.assert_array_equal(np.asarray(x["strokes"]),
+                                          y["strokes"])
+    finally:
+        feeder.close()
+
+
+def test_bucketed_loader_rejects_host_striping():
+    seqs, labels = corpus(30)
+    with pytest.raises(RuntimeError, match="single-host"):
+        DataLoader(seqs[0::2], small_hps(bucket_edges=(32, 64)),
+                   labels=labels[0::2], global_size=30, num_hosts=2)
+
+
+def test_prefetch_stack_rejects_bucketed_loader():
+    from sketch_rnn_tpu.data.prefetch import prefetch_batches
+
+    dl = make_loader(small_hps(bucket_edges=(32, 64)))
+    with pytest.raises(ValueError, match="bucket"):
+        prefetch_batches(dl, mesh=None, depth=0, stack=4)
+
+
+def test_config_validates_bucket_edges():
+    for bad in ((0, 16), (32, 16), (16, 16), (16, 200)):
+        with pytest.raises(ValueError):
+            small_hps(bucket_edges=bad)
+    with pytest.raises(ValueError, match="steps_per_call"):
+        small_hps(bucket_edges=(16, 32), steps_per_call=4)
+    with pytest.raises(ValueError, match="bucket_shuffle_window"):
+        small_hps(bucket_shuffle_window=0)
+    # terminal edge implied: loader appends max_seq_len
+    dl = make_loader(small_hps(bucket_edges=(16, 32)))
+    assert dl.bucket_edges == (16, 32, 96)
+    # edges ending AT max_seq_len are kept as-is
+    dl2 = make_loader(small_hps(bucket_edges=(16, 96)))
+    assert dl2.bucket_edges == (16, 96)
+
+
+def test_hparams_parse_bucket_edges_coerces_ints():
+    hps = get_default_hparams().parse("bucket_edges=64;128;250")
+    assert hps.bucket_edges == (64, 128, 250)
+    # round-trips through json too
+    assert HParams.from_json(hps.to_json()).bucket_edges == (64, 128, 250)
+    # and mesh_axes (string tuple) coercion is untouched
+    assert get_default_hparams().parse(
+        "mesh_axes=data").mesh_axes == ("data",)
+
+
+def test_padding_ledger_math():
+    led = PaddingLedger((16, 64))
+    first = led.window()
+    assert set(first) == {"padded_frac", "bucket_T16_n", "bucket_T64_n"}
+    led.record(16, 8, 100)        # 128 dispatched, 100 true
+    led.record(64, 8, 256)        # 512 dispatched, 256 true
+    win = led.window()
+    assert win["bucket_T16_n"] == 1 and win["bucket_T64_n"] == 1
+    assert win["padded_frac"] == pytest.approx(1 - 356 / 640, abs=1e-6)
+    # window is incremental; summary is cumulative
+    assert led.window()["padded_frac"] == 0.0
+    led.record(16, 8, 128)        # zero waste
+    assert led.window()["padded_frac"] == 0.0
+    s = led.summary()
+    assert s["dispatched_timesteps"] == 768 and s["true_timesteps"] == 484
+    assert s["bucket_T16_n"] == 2
+
+
+# -- compiled-step routing / training -------------------------------------
+
+
+def test_train_step_compiles_one_executable_per_geometry(bucket_hps):
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train import make_train_state
+    from sketch_rnn_tpu.train.step import (batch_geometry,
+                                           geometry_cache_size,
+                                           make_train_step)
+
+    dl = make_loader(bucket_hps)
+    model = SketchRNN(bucket_hps)
+    state = make_train_state(model, bucket_hps, jax.random.key(0))
+    step = make_train_step(model, bucket_hps, mesh=None)
+    key = jax.random.key(1)
+    seen = {}
+    for i in range(10):
+        batch = dl.next_batch()
+        geom = batch_geometry(batch) + ("weights" in batch,)
+        state, metrics = step(state, batch, jax.random.fold_in(key, i))
+        seen[geom] = seen.get(geom, 0) + 1
+        assert np.isfinite(float(metrics["loss"]))
+    assert len(seen) >= 2  # the skewed corpus fills >1 bucket
+    cache = geometry_cache_size(step)
+    if cache is not None:
+        # one executable per distinct geometry — NOT one per step
+        assert cache == len(seen)
+
+
+def test_weighted_tail_batch_trains_under_mesh():
+    """The epoch tail's zero-weighted wrap rows must flow through the
+    sharded step (weights shard over the data axis like every leaf)."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.parallel.mesh import make_mesh
+    from sketch_rnn_tpu.train import make_train_state
+    from sketch_rnn_tpu.train.step import make_train_step
+
+    hps = small_hps(bucket_edges=(16, 32, 64))
+    dl = make_loader(hps, n=60)
+    tail = next(b for b in (dl.next_batch() for _ in range(16))
+                if "weights" in b)
+    assert tail["weights"].sum() < hps.batch_size
+    model = SketchRNN(hps)
+    mesh = make_mesh(hps)
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, mesh)
+    state, metrics = step(state, tail, jax.random.key(1))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_buckets_off_train_bitwise_matches_pre_bucketing_replica():
+    """Tier-1 parity: a buckets-off ``train()`` run must be bitwise
+    identical to the pre-PR loop — replicated here as random_batch +
+    the single jitted step + the loop's exact key discipline (root key
+    split for init, fold_in(root, step) per step)."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train import make_train_state
+    from sketch_rnn_tpu.train.loop import train
+    from sketch_rnn_tpu.train.step import make_train_step
+
+    hps = small_hps(num_steps=4, log_every=2, eval_every=10 ** 9,
+                    save_every=10 ** 9, prefetch_depth=2)
+    state = train(hps, make_loader(hps, seed=7), workdir=None,
+                  use_mesh=False, seed=3)
+
+    model = SketchRNN(hps)
+    root = jax.random.key(3)
+    root, init_key = jax.random.split(root)
+    replica = make_train_state(model, hps, init_key)
+    step_fn = make_train_step(model, hps, mesh=None)
+    dl = make_loader(hps, seed=7)
+    for step in range(4):
+        replica, _ = step_fn(replica, dl.random_batch(),
+                             jax.random.fold_in(root, step))
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(replica.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_bucketed_train_loop_logs_padding_columns(tmp_path):
+    import json
+    import os
+
+    from sketch_rnn_tpu.train.loop import train
+
+    hps = small_hps(bucket_edges=(16, 32), max_seq_len=64, num_steps=4,
+                    log_every=2, eval_every=10 ** 9, save_every=10 ** 9)
+    dl = make_loader(hps, n=40, max_len=60)
+    train(hps, dl, workdir=str(tmp_path), use_mesh=False, seed=1)
+    rows = [json.loads(l) for l in
+            open(os.path.join(tmp_path, "train_metrics.jsonl"))]
+    for col in ("padded_frac", "bucket_T16_n", "bucket_T32_n",
+                "bucket_T64_n"):
+        assert all(col in r for r in rows), col
+    assert any(r["padded_frac"] > 0 for r in rows)
+    # the CSV header carries the bucket columns from row one
+    header = open(os.path.join(tmp_path,
+                               "train_metrics.csv")).readline()
+    assert "bucket_T16_n" in header and "padded_frac" in header
+
+
+# -- eval parity -----------------------------------------------------------
+
+
+def test_masked_eval_sweep_bitwise_independent_of_bucketing():
+    """Tier-1 acceptance: bucketing never changes masked eval loss —
+    the full evaluate() sweep over bucket-padded batches equals the
+    fixed-T sweep EXACTLY, metric for metric."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train.loop import evaluate
+    from sketch_rnn_tpu.train.step import make_eval_step
+
+    hps = small_hps()
+    hb = hps.replace(bucket_edges=(16, 32, 64))
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    eval_step = make_eval_step(model, hps, mesh=None)
+    rf = evaluate(params, make_loader(hps, n=40), eval_step,
+                  key=jax.random.key(5))
+    rb = evaluate(params, make_loader(hb, n=40), eval_step,
+                  key=jax.random.key(5))
+    assert set(rf) == set(rb)
+    for k in rf:
+        assert rf[k] == rb[k], (k, rf[k], rb[k])
+
+
+def test_bucketed_eval_batches_use_bucket_pads():
+    hps = small_hps(bucket_edges=(16, 32, 64))
+    dl = make_loader_sorted(hps, n=40)
+    pads = set()
+    for i in range(dl.num_eval_batches):
+        b = dl.get_batch(i)
+        tb = b["strokes"].shape[1] - 1
+        assert tb == dl.eval_pad_len(i)
+        assert tb in dl.bucket_edges
+        assert b["seq_len"].max() <= tb
+        pads.add(tb)
+    # the corpus actually exercises short pads, not just the terminal one
+    assert min(pads) < hps.max_seq_len
+
+
+def test_multi_eval_chunks_break_at_geometry_changes():
+    """The chunked (K-batch scan) eval path must group only
+    same-geometry runs under bucketing and still agree with the
+    per-batch sweep to scan-reassociation tolerance; with buckets off
+    its chunk schedule is the pre-bucketing one."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train.loop import evaluate
+    from sketch_rnn_tpu.train.step import (make_eval_step,
+                                           make_multi_eval_step)
+
+    hps = small_hps(bucket_edges=(16, 32, 64))
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    dl = make_loader_sorted(hps, n=48)
+    # mixed geometries across the sweep, so chunking must split
+    pads = [dl.eval_pad_len(i) for i in range(dl.num_eval_batches)]
+    assert len(set(pads)) > 1
+    eval_step = make_eval_step(model, hps, mesh=None)
+    multi = (make_multi_eval_step(model, hps, mesh=None), 3)
+    r1 = evaluate(params, dl, eval_step, key=jax.random.key(5))
+    r2 = evaluate(params, dl, eval_step, key=jax.random.key(5),
+                  multi=multi)
+    for k in r1:
+        assert r1[k] == pytest.approx(r2[k], rel=3e-5, abs=1e-6), k
