@@ -63,6 +63,24 @@ def metric_of(r: dict):
     return r.get("strokes_per_sec_per_chip") or r.get("sketches_per_sec")
 
 
+def _stacked_cols(r: dict) -> str:
+    """Dispatch-amortization columns for a bucket_bench row (ISSUE 5):
+    the best stacked bucketed arm's speedup over its own K=1, plus the
+    realized run length and dispatches saved in that arm's timed
+    window. Pre-ISSUE-5 rows (no grid) print nothing."""
+    gain = r.get("best_stacked_gain")
+    grid = r.get("grid") or {}
+    stacked = {kk: row for kk, row in grid.items()
+               if kk.startswith("bucketed_k") and kk != "bucketed_k1"}
+    if gain is None or not stacked:
+        return ""
+    best_k, best = max(stacked.items(),
+                       key=lambda it: it[1].get("steps_per_sec", 0.0))
+    return (f" stacked={gain}x@K{best_k.split('_k')[1]}"
+            f" run_len={best.get('mean_run_len')}"
+            f" saved={best.get('dispatches_saved')}")
+
+
 def iter_rows(path):
     """Yield result rows from ``path``, tolerating partial/streamed logs:
     non-JSON lines and non-dict values are skipped (a driver capture
@@ -120,7 +138,8 @@ def main(argv=None) -> int:
             pb = (b.get("bucketed") or {}).get("padded_frac")
             print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
                   f"best={metric_of(b):>11.2f}x ({when} padded_frac "
-                  f"{pf}->{pb})  latest={metric_of(l):>11.2f}x")
+                  f"{pf}->{pb}){_stacked_cols(b)}  "
+                  f"latest={metric_of(l):>11.2f}x")
             continue
         extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""
         # records the bench itself flagged as never reaching 70% of the

@@ -103,6 +103,13 @@ def run_config(save_mode, defer, model, hps, mesh, loader, steps,
     ckpt = AsyncCheckpointer(workdir) if save_mode == "async" else None
     crossed = lambda prev, step, every: step // every > prev // every
 
+    # this bench counts `step += spc` per get(): that is only valid for
+    # exactly-K stacks, i.e. an UNBUCKETED loader (a bucketed one feeds
+    # variable-k geometry-run prefixes — train/loop.py's dispatch_stack
+    # handles those; this harness deliberately does not)
+    if getattr(loader, "bucket_edges", ()):
+        raise ValueError("goodput_bench assumes fixed-K stacks; "
+                         "bucket_edges is unsupported here")
     feeder = prefetch_batches(loader, mesh, hps.prefetch_depth, stack=spc,
                               transfer_dtype=hps.transfer_dtype)
     saves = 0

@@ -101,6 +101,12 @@ def main() -> int:
         fused_residual_dtype="bfloat16", steps_per_call=K)
     mesh = make_mesh(base)
     loader, _ = synthetic_loader(base, min(args.batch, 4096), seed=0)
+    # every feeder.get() below is assumed to be a FULL K-stack; that
+    # holds only for unbucketed loaders (bucketed ones emit variable-k
+    # geometry-run prefixes that need train/loop.py's dispatch_stack)
+    if getattr(loader, "bucket_edges", ()):
+        raise ValueError("profile_breakdown assumes fixed-K stacks; "
+                         "bucket_edges is unsupported here")
 
     def stacked_batch(hps):
         feeder = prefetch_batches(loader, mesh, depth=1, stack=K)

@@ -152,6 +152,11 @@ def cmd_train(args) -> int:
         # comma OR semicolon separators (the hparam tuple syntax is ';')
         hps = hps.parse(
             f"bucket_edges={args.bucket_edges.replace(',', ';')}")
+    if getattr(args, "steps_per_call", 0):
+        # convenience spelling of --hparams steps_per_call=K; with
+        # --bucket_edges this turns on the bucket-run scheduler (stacked
+        # same-geometry dispatch, ISSUE 5)
+        hps = hps.replace(steps_per_call=args.steps_per_call)
     if getattr(args, "sync_io", False):
         # bisection/debugging escape hatch: force the fully synchronous
         # loop (blocking saves, eager metric conversion) in one flag
@@ -393,6 +398,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "(B, Tb) geometry gets its own compiled step. "
                         "Empty (default) = exact-parity fixed-T padding. "
                         "Shorthand for --hparams bucket_edges=...")
+    p.add_argument("--steps_per_call", type=int, default=0,
+                   help="optimizer micro-steps per jitted call (K>1 = "
+                        "one lax.scan'd dispatch per K steps; composes "
+                        "with --bucket_edges via the bucket-run "
+                        "scheduler: geometry runs ride stacked "
+                        "[K, B, Tb] transfers). 0 = keep the hparams "
+                        "value. Shorthand for --hparams "
+                        "steps_per_call=K")
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler device trace of steps "
                         "~10-20 into <workdir>/trace (view with XProf)")

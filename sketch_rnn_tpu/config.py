@@ -42,13 +42,30 @@ class HParams:
     #   * B); the canonical unmasked-to-Nmax train pen CE loses its
     #   truncated [Tb, Nmax) all-padding tail — see ops/mdn.py. Masked
     #   eval losses are bitwise independent of bucketing. Single-host
-    #   only; requires steps_per_call=1 (bucket batches have per-batch
-    #   shapes and cannot ride one stacked transfer).
-    bucket_shuffle_window: int = 256   # seeded shuffle window (in
-    #   batches) applied to the bucketed epoch's batch order so binning
-    #   by length does not introduce a length-curriculum bias; windows
-    #   >= the epoch's batch count give a full shuffle (tf.data-style
+    #   only (a coordinated multi-host plan is future work); composes
+    #   with steps_per_call=K via the bucket-run scheduler (geometry
+    #   runs ride stacked [K, B, Tb, ...] transfers, run remainders
+    #   replay as single micro-steps — see data/loader.py next_stack).
+    bucket_shuffle_window: int = 256   # seeded shuffle window applied
+    #   to the bucketed epoch's batch order so binning by length does
+    #   not introduce a length-curriculum bias; windows >= the epoch's
+    #   batch (or run) count give a full shuffle (tf.data-style
     #   windowed-shuffle semantics, deterministic per (seed, epoch)).
+    #   With bucket_run_len > 0 the window counts RUNS, not batches —
+    #   the run-aware mode shuffles geometry runs as units instead of
+    #   splitting them.
+    bucket_run_len: int = 8            # geometry-run granularity of the
+    #   bucketed epoch plan (ISSUE 5): each bucket's batches are grouped
+    #   into runs of up to this many consecutive batches sharing one
+    #   (B, Tb) geometry, and the windowed shuffle permutes runs as
+    #   units. Long runs are what stacked execution (steps_per_call=K)
+    #   amortizes: K consecutive same-geometry batches ride ONE stacked
+    #   [K, B, Tb, ...] transfer + one compiled K-step scan. Purely an
+    #   ORDERING knob — coverage, per-batch contents and the per-step
+    #   RNG stream are unchanged — and independent of steps_per_call,
+    #   so the plan stays a pure function of (seed, epoch) at every K.
+    #   0 = legacy per-batch shuffle (runs emerge only by chance;
+    #   stacked dispatch then degenerates to per-batch replay).
 
     # --- model (components 2-10) ---
     conditional: bool = True           # seq2seq VAE vs decoder-only
@@ -207,15 +224,12 @@ class HParams:
                     f"bucket_edges {edges} exceed max_seq_len="
                     f"{self.max_seq_len}; a bucket longer than the padded "
                     f"maximum can never be filled")
-            if self.steps_per_call != 1:
-                raise ValueError(
-                    f"bucket_edges requires steps_per_call=1 (got "
-                    f"{self.steps_per_call}): bucketed batches have "
-                    f"per-batch shapes and cannot ride one stacked "
-                    f"K-micro-step transfer")
         if self.bucket_shuffle_window < 1:
             raise ValueError(f"bucket_shuffle_window must be >= 1, got "
                              f"{self.bucket_shuffle_window}")
+        if self.bucket_run_len < 0:
+            raise ValueError(f"bucket_run_len must be >= 0, got "
+                             f"{self.bucket_run_len}")
 
     # -- overrides ---------------------------------------------------------
 

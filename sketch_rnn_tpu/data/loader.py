@@ -68,6 +68,16 @@ class DataLoader:
     With ``bucket_edges`` empty (default) ``next_batch`` is exactly
     ``random_batch``. Every assembled batch is accounted in
     ``padding_ledger`` (padded-timestep fraction + per-bucket counts).
+
+    Bucket-run scheduling (ISSUE 5, ``hps.bucket_run_len`` /
+    ``steps_per_call > 1``): the plan orders batches into *geometry
+    runs* — maximal consecutive sequences sharing one ``(B, Tb)`` —
+    and :meth:`next_stack` pops up to K same-geometry batches at once,
+    stacked on a new leading axis, so one transfer + one compiled
+    K-step scan can consume them. The stacked stream is micro-batch-
+    for-micro-batch identical to the :meth:`next_batch` stream (same
+    plan, same assembly order, same RNG draws), so stacking can never
+    change WHAT is trained on, only how it is dispatched.
     """
 
     def __init__(self,
@@ -350,8 +360,50 @@ class DataLoader:
                 w[:len(idx)] = 1.0
                 idx = idx[np.arange(b) % len(idx)]
             batches.append((tb, idx, w))
+        if self.hps.bucket_run_len > 0:
+            # run-aware shuffle (ISSUE 5): group consecutive same-
+            # geometry batches into runs of <= bucket_run_len and let
+            # the windowed shuffle permute RUNS as units instead of
+            # splitting them — the stacked K-step scheduler amortizes
+            # exactly these consecutive same-(B, Tb) sequences. Pure
+            # ordering: the multiset of batches (hence coverage and
+            # per-batch contents) is untouched, and nothing here reads
+            # steps_per_call, so the plan stays K-independent.
+            runs: List[List[tuple]] = []
+            for bt in batches:
+                g = (bt[0], bt[2] is None)
+                if (runs and (runs[-1][0][0], runs[-1][0][2] is None) == g
+                        and len(runs[-1]) < self.hps.bucket_run_len):
+                    runs[-1].append(bt)
+                else:
+                    runs.append([bt])
+            shuffled = _windowed_shuffle(runs,
+                                         self.hps.bucket_shuffle_window,
+                                         rng)
+            return [bt for run in shuffled for bt in run]
         return _windowed_shuffle(batches,
                                  self.hps.bucket_shuffle_window, rng)
+
+    @staticmethod
+    def _count_geometry_runs(plan: List[tuple]) -> int:
+        """Maximal consecutive same-geometry sequences in a plan (a run
+        boundary falls wherever ``(Tb, weighted?)`` changes)."""
+        runs, prev = 0, None
+        for tb, _, w in plan:
+            g = (tb, w is None)
+            if g != prev:
+                runs += 1
+                prev = g
+        return runs
+
+    def _refill_bucket_queue(self) -> None:
+        if not self.strokes:
+            raise ValueError("bucketed next_batch on an empty corpus")
+        plan = self._plan_bucket_epoch(self._bucket_epoch)
+        self._bucket_epoch += 1
+        self.padding_ledger.note_epoch_plan(
+            self._count_geometry_runs(plan), len(plan))
+        self._bucket_queue = plan
 
     def next_batch(self, int16_scale: Optional[float] = None
                    ) -> Dict[str, np.ndarray]:
@@ -362,10 +414,7 @@ class DataLoader:
         if not self.bucket_edges:
             return self.random_batch(int16_scale=int16_scale)
         if not self._bucket_queue:
-            if not self.strokes:
-                raise ValueError("bucketed next_batch on an empty corpus")
-            self._bucket_queue = self._plan_bucket_epoch(self._bucket_epoch)
-            self._bucket_epoch += 1
+            self._refill_bucket_queue()
         tb, idx, w = self._bucket_queue.pop(0)
         batch = self._assemble(idx, int16_scale=int16_scale, pad_to=tb)
         if w is not None:
@@ -374,6 +423,65 @@ class DataLoader:
             # treats every example exactly once (mdn.reconstruction_loss)
             batch["weights"] = w
         return batch
+
+    def seek_epoch(self, epoch: int) -> None:
+        """Rewind the bucketed stream to the START of ``epoch``'s plan.
+
+        The plan is a pure function of ``(seed, epoch)``, so two
+        loaders (or two passes over one loader) seeked to the same
+        epoch emit identical batch streams — the hook benchmarks use
+        to time arms over the same workload (scripts/bucket_bench.py).
+        Bucketed loaders only; the queue refills lazily on the next
+        ``next_batch``/``next_stack`` call."""
+        if not self.bucket_edges:
+            raise ValueError("seek_epoch requires bucketed execution "
+                             "(bucket_edges)")
+        self._bucket_queue = []
+        self._bucket_epoch = int(epoch)
+
+    def next_stack(self, k_max: int, int16_scale: Optional[float] = None
+                   ) -> Dict[str, np.ndarray]:
+        """Up to ``k_max`` consecutive same-geometry training batches,
+        stacked on a new leading axis (ISSUE 5 bucket-run scheduler).
+
+        Pops the maximal prefix of the current geometry run — batches
+        sharing one ``(Tb, weighted?)`` — capped at ``k_max`` and at
+        the epoch boundary (stacks never cross an epoch refill), so
+        every returned array has leading axis ``k`` with ``1 <= k <=
+        k_max``. A full ``k == k_max`` stack rides the compiled K-step
+        scan; shorter stacks are run remainders the training loop
+        replays as single micro-steps.
+
+        Stream contract: concatenating the micro-batches of successive
+        ``next_stack`` calls reproduces the :meth:`next_batch` stream
+        of an identically-seeded loader EXACTLY (same plan, same
+        assembly order, same augmentation RNG draws) — the scheduler
+        changes dispatch, never data. Weighted wrap-tail batches form
+        their own (length-1) runs, so a stack's micro-batches either
+        all carry ``"weights"`` or none do.
+        """
+        if k_max < 1:
+            raise ValueError(f"k_max must be >= 1, got {k_max}")
+        if not self.bucket_edges:
+            raise ValueError(
+                "next_stack is the bucketed scheduler's entry point; "
+                "with bucket_edges unset use next_batch/random_batch "
+                "(fixed-T stacks are plain np.stack over K batches)")
+        if not self._bucket_queue:
+            self._refill_bucket_queue()
+        tb0, _, w0 = self._bucket_queue[0]
+        k = 1
+        while (k < k_max and k < len(self._bucket_queue)
+               and self._bucket_queue[k][0] == tb0
+               and (self._bucket_queue[k][2] is None) == (w0 is None)):
+            k += 1
+        # delegate the pops to next_batch so the stream-identity
+        # contract is structural, not a duplicated assembly body
+        # (k <= len(queue), so no refill can happen mid-stack)
+        parts = [self.next_batch(int16_scale=int16_scale)
+                 for _ in range(k)]
+        return {name: np.stack([p[name] for p in parts])
+                for name in parts[0]}
 
     def eval_pad_len(self, batch_index: int) -> int:
         """Pad length :meth:`get_batch` will use for ``batch_index``:

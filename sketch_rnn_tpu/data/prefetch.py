@@ -134,6 +134,15 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
     RNG sequence is identical to K single gets, so K-step training sees
     exactly the batches K single steps would have.
 
+    Bucketed loaders (``loader.bucket_edges`` set) compose with
+    ``stack=K`` through the bucket-run scheduler (ISSUE 5): each
+    ``get()`` returns ``loader.next_stack(K)`` — up to K consecutive
+    batches of ONE ``(B, Tb)`` geometry run stacked ``[k, B, Tb+1, 5]``
+    with ``k <= K`` (run remainders come back short; the training loop
+    replays them as single micro-steps). The micro-batch stream is
+    exactly the ``next_batch`` stream, so stacking never changes what
+    is trained on.
+
     ``transfer_dtype="bfloat16"`` casts the strokes array host-side so
     the transfer moves half the bytes (``hps.transfer_dtype``; the model
     upcasts on entry — see config.py for the rounding trade).
@@ -160,13 +169,6 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
     """
     if stack < 1:
         raise ValueError(f"stack must be >= 1, got {stack}")
-    if stack > 1 and getattr(loader, "bucket_edges", ()):
-        # bucketed batches have per-batch (B, Tb) shapes; K of them
-        # cannot ride one stacked [K, ...] transfer (np.stack would fail
-        # opaquely deep in the producer thread) — config.py rejects the
-        # combination up front, this guards direct callers
-        raise ValueError("steps_per_call/stack > 1 is incompatible with "
-                         "bucketed execution (bucket_edges)")
     if transfer_dtype not in (None, "float32", "bfloat16", "int16"):
         # mirror HParams' validation for direct callers: an arbitrary
         # dtype (e.g. int8) would silently truncate the stroke deltas
@@ -200,11 +202,17 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
     # bucket_edges unset next_batch IS random_batch (bit-for-bit the same
     # feed), and plain producers without the method keep working
     next_fn = getattr(loader, "next_batch", None) or loader.random_batch
+    bucketed_stack = stack > 1 and bool(getattr(loader, "bucket_edges", ()))
 
     def host_batch():
         import numpy as np
 
-        if stack == 1:
+        if bucketed_stack:
+            # bucket-run scheduler: one geometry run's prefix, already
+            # stacked [k, B, Tb+1, 5] with k <= stack (run remainders
+            # are short — the consumer replays those per micro-step)
+            out = loader.next_stack(stack, int16_scale=quant_scale)
+        elif stack == 1:
             out = next_fn(int16_scale=quant_scale)
             if cast is not None:
                 out = dict(out)  # don't mutate the loader's dict

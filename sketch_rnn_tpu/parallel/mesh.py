@@ -66,7 +66,14 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
 
 def stacked_batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     """Sharding for ``[K, batch, ...]`` stacked multi-step batches: the
-    micro-step axis replicated, the batch axis split over ``axis``."""
+    micro-step axis replicated, the batch axis split over ``axis``.
+
+    This is also the bucketed stacked layout (ISSUE 5): a geometry
+    run's stack is ``[k, B, Tb+1, 5]`` where the per-bucket ``Tb`` is
+    replicated shape metadata (every device compiles against it) and
+    only ``B`` shards — so length-bucketed K-step execution composes
+    with the mesh exactly like fixed-T K-step execution, one sharded
+    transfer per dispatched run prefix."""
     return NamedSharding(mesh, P(None, axis))
 
 
@@ -95,8 +102,19 @@ def shard_batch(batch: Dict[str, Any], mesh: Mesh,
     (``1/process_count`` of the rows, see ``parallel.multihost``) and the
     global array is assembled without any cross-host data movement.
     ``stacked=True`` handles ``[K, batch, ...]`` multi-step batches
-    (micro-step axis replicated, batch axis split).
+    (micro-step axis replicated, batch axis split) — including bucketed
+    geometry-run stacks ``[k, B, Tb+1, 5]``, whose per-run ``k`` and
+    per-bucket ``Tb`` vary call to call (shape metadata only; each
+    geometry routes to its own compiled program downstream).
     """
+    if stacked:
+        # a torn stack (a producer bug mixing run prefixes) would
+        # otherwise surface as an opaque XLA shape error steps later
+        ks = {np.shape(x)[0] for x in jax.tree_util.tree_leaves(batch)}
+        if len(ks) > 1:
+            raise ValueError(
+                f"stacked batch leaves disagree on the micro-step "
+                f"leading axis: {sorted(ks)}")
     sharding = (stacked_batch_sharding if stacked
                 else batch_sharding)(mesh, axis)
     if jax.process_count() > 1:

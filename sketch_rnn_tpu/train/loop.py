@@ -31,6 +31,19 @@ pre-bucketing loop; masked eval losses are bucket-independent either
 way. Note ``strokes_per_sec`` still counts nominal ``B * max_seq_len``
 points per step — under bucketing read it against ``padded_frac``
 (``scripts/bucket_bench.py`` reports the honest steps/sec comparison).
+
+Bucket-run scheduler (ISSUE 5): bucketing now composes with
+``steps_per_call=K``. The feeder hands stacked geometry-run prefixes
+``[k, B, Tb+1, 5]`` (``DataLoader.next_stack``; ``k <= K``): a full
+``k == K`` stack dispatches ONE compiled K-step scan for its ``(K, B,
+Tb)`` geometry (``make_multi_train_step(key_by_global_step=True)``),
+while run remainders replay their micro-batches through the single-step
+program. Both paths key micro-step RNG as ``fold_in(root, global_step)``
+— a bucketed K run is step-for-step RNG-identical to the K=1 bucketed
+loop, and the epoch plan itself never reads K, so the consumed batch
+stream is identical too. The ``PaddingLedger`` additionally reports
+``runs_per_epoch`` / ``mean_run_len`` (plan run structure) and
+``dispatches_saved`` (realized K-amortization) in every metrics row.
 """
 
 from __future__ import annotations
@@ -68,6 +81,70 @@ from sketch_rnn_tpu.utils.profiling import GoodputLedger, Throughput
 # all t_<phase>_s columns from the first window (CSV header stability)
 GOODPUT_PHASES = ("dispatch", "feeder_wait", "metrics_drain", "ckpt_wait",
                   "eval")
+
+
+def dispatch_stack(single_step, multi_step, state, batch, step: int,
+                   remaining: int, root_key, k: int):
+    """One bucket-run scheduler dispatch decision (ISSUE 5) — THE
+    shared copy of the contract, used by the training loop and by
+    ``scripts/bucket_bench.py``'s timing/parity arms so the two cannot
+    drift.
+
+    ``batch`` is a stacked geometry-run prefix with leading axis ``kk
+    <= k``; ``use = min(kk, remaining)`` micro-steps are consumed. A
+    full ``use == k`` stack dispatches ONE compiled (K, B, Tb) scan
+    (``multi_step`` must be built with ``key_by_global_step=True``; it
+    folds the live ``state.step`` into ``root_key``), anything shorter
+    replays per micro-step through ``single_step`` with
+    ``fold_in(root_key, step + i)`` — the identical key either way, so
+    the whole run is step-for-step RNG-identical to K=1.
+
+    Replay windows report metrics with the SAME semantics as the scan
+    (``make_multi_train_step``): the MEAN over the window's
+    micro-steps, ``grad_norm_max`` the window's max, ``lr`` /
+    ``kl_weight`` the last micro-step's schedule values — accumulated
+    device-side (no host sync), so a spike inside a replay window
+    surfaces in the logged row exactly like a spike inside a scan.
+
+    Returns ``(state, metrics, use, dispatches)`` — ``dispatches`` is
+    the number of jitted calls issued (1 for a full stack, ``use`` for
+    a replay), so ledger accounting cannot drift from the decision
+    made here.
+    """
+    kk = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+    use = min(kk, remaining)
+    if use == k:
+        state, metrics = multi_step(state, batch, root_key)
+        return state, metrics, use, 1
+    per_step = []
+    for i in range(use):
+        b_i = jax.tree_util.tree_map(lambda x: x[i], batch)
+        state, m = single_step(
+            state, b_i, jax.random.fold_in(root_key, step + i))
+        per_step.append(m)
+    return state, _replay_window_metrics(per_step), use, use
+
+
+def _replay_window_metrics(per_step) -> Dict:
+    """Fold a replayed window's per-micro-step metric dicts into one
+    row with the scan's semantics (``make_multi_train_step``): MEAN
+    over the window, ``grad_norm_max`` the max, ``lr``/``kl_weight``
+    the last micro-step's schedule values. Pure device-side tree math
+    on the (lazy) metric refs — no host sync. Shared by every replay
+    path so logged rows cannot drift in meaning between the scan, the
+    run-remainder replay and the fixed-T final remainder."""
+    sums = None
+    gmax = None
+    for m in per_step:
+        g = m["grad_norm"]
+        gmax = g if gmax is None else jnp.maximum(gmax, g)
+        sums = (dict(m) if sums is None
+                else {name: sums[name] + m[name] for name in sums})
+    metrics = {name: v / len(per_step) for name, v in sums.items()}
+    metrics["grad_norm_max"] = gmax
+    metrics["lr"] = per_step[-1]["lr"]
+    metrics["kl_weight"] = per_step[-1]["kl_weight"]
+    return metrics
 
 
 def _sweep_rows(params, loader: DataLoader, eval_step, mesh, key, multi):
@@ -244,10 +321,18 @@ def train(hps: HParams,
 
     # steps_per_call > 1: K optimizer steps per jitted call (one dispatch,
     # one stacked transfer) — host-loop amortization for remote runtimes;
-    # K == 1 builds the plain single-step fn
+    # K == 1 builds the plain single-step fn.
+    # With bucketing on too, the bucket-run scheduler (ISSUE 5) drives
+    # the same K-scan: the feeder hands stacked geometry-run prefixes
+    # [k, B, Tb+1, 5] (k <= K), full stacks dispatch one compiled
+    # (K, B, Tb) scan, run remainders replay as single micro-steps, and
+    # the scan folds the LIVE global step into the key so the whole run
+    # is step-for-step RNG-identical to the K=1 bucketed loop.
     spc = hps.steps_per_call
-    train_step = make_multi_train_step(model, hps, mesh)
-    single_step = None  # built lazily for a non-K-aligned final remainder
+    run_sched = spc > 1 and bool(getattr(train_loader, "bucket_edges", ()))
+    train_step = make_multi_train_step(model, hps, mesh,
+                                       key_by_global_step=run_sched)
+    single_step = None  # built lazily for remainder micro-step replays
     eval_step = make_eval_step(model, hps, mesh)
     # dispatch-amortized eval sweeps (same keys/weighting as per-batch;
     # the K-batch program only compiles if a sweep actually uses it)
@@ -279,9 +364,12 @@ def train(hps: HParams,
     # (CSV header stability).
     pad_ledger = getattr(train_loader, "padding_ledger", None)
     if getattr(train_loader, "bucket_edges", ()) and is_primary():
+        sched = (f" run_sched: steps_per_call={spc} "
+                 f"run_len={hps.bucket_run_len}" if run_sched else "")
         print(f"[train] bucketed execution: edges="
               f"{train_loader.bucket_edges} "
-              f"shuffle_window={hps.bucket_shuffle_window}", flush=True)
+              f"shuffle_window={hps.bucket_shuffle_window}{sched}",
+              flush=True)
 
     step = int(state.step)
     throughput = Throughput(hps.batch_size * hps.max_seq_len,
@@ -313,25 +401,52 @@ def train(hps: HParams,
                 batch = feeder.get()
             # key is a pure function of (seed, step): a resumed run
             # continues the stream instead of replaying the pre-checkpoint
-            # keys
-            step_key = jax.random.fold_in(root_key, step)
+            # keys. (The run scheduler derives its keys from root_key
+            # directly — fold_in(root, global_step) per micro-step.)
             prev = step
             remaining = num_steps - step
-            if spc == 1 or remaining >= spc:
+            if run_sched:
+                # bucket-run scheduler: the feeder's stack is one
+                # geometry run's prefix with leading axis k <= spc —
+                # dispatch_stack (the shared contract) scans a full
+                # stack or replays a run remainder per micro-step
+                if single_step is None:
+                    single_step = make_train_step(model, hps, mesh)
+                with ledger.span("dispatch"):
+                    state, metrics, use, n_disp = dispatch_stack(
+                        single_step, train_step, state, batch, step,
+                        remaining, root_key, spc)
+                if pad_ledger is not None:
+                    pad_ledger.record_dispatch(use, n_disp)
+                step += use
+            elif spc == 1 or remaining >= spc:
+                step_key = jax.random.fold_in(root_key, step)
                 with ledger.span("dispatch"):
                     state, metrics = train_step(state, batch, step_key)
+                if pad_ledger is not None:
+                    pad_ledger.record_dispatch(spc, 1)
                 step += spc
             else:
                 # final non-K-aligned remainder: replay the stacked micro-
                 # batches through a single-step program with the same
                 # per-micro-step keys the K-step call would have used
+                step_key = jax.random.fold_in(root_key, step)
                 if single_step is None:
                     single_step = make_train_step(model, hps, mesh)
+                per_step = []
                 with ledger.span("dispatch"):
                     for i in range(remaining):
                         b_i = jax.tree_util.tree_map(lambda x: x[i], batch)
                         state, metrics = single_step(
                             state, b_i, jax.random.fold_in(step_key, i))
+                        per_step.append(metrics)
+                # this branch's window always logs: give it the same
+                # row semantics as every scan window (mean / max /
+                # last-schedule — _replay_window_metrics), so a spike
+                # inside the remainder surfaces like any other
+                metrics = _replay_window_metrics(per_step)
+                if pad_ledger is not None:
+                    pad_ledger.record_dispatch(remaining, remaining)
                 step += remaining
             if trace_active and step >= profile_span[1]:
                 jax.block_until_ready(metrics["loss"])

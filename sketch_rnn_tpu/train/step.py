@@ -183,7 +183,8 @@ def make_train_step(model, hps: HParams,
 
 def make_multi_train_step(model, hps: HParams,
                           mesh: Optional[Mesh] = None,
-                          steps_per_call: Optional[int] = None) -> StepFn:
+                          steps_per_call: Optional[int] = None,
+                          key_by_global_step: bool = False) -> StepFn:
     """Build a jitted K-micro-step train call (host-loop amortization).
 
     ``(state, batches, key) -> (state, last_metrics)`` where ``batches``
@@ -199,6 +200,21 @@ def make_multi_train_step(model, hps: HParams,
     ``state.step`` carried through the scan, so K calls of this are
     step-for-step equivalent (same schedules, same per-step key
     discipline) to K single-step calls with keys ``fold_in(key, i)``.
+
+    ``key_by_global_step=True`` (the bucket-run scheduler's mode,
+    ISSUE 5) folds the live ``state.step`` carried through the scan
+    instead of the micro-step index: micro-step ``i`` starting at
+    global step ``s0`` uses ``fold_in(key, s0 + i)``. Called with the
+    loop's ROOT key, this makes a ``steps_per_call=K`` run step-for-
+    step RNG-IDENTICAL to the K=1 loop (whose per-step key is
+    ``fold_in(root, global_step)``) — which is what lets run
+    remainders replay through the single-step program mid-run without
+    forking the key stream. One compiled K-scan per input geometry:
+    the returned function's jit cache keys on the stacked batch shape,
+    so bucketed ``[K, B, Tb, ...]`` stacks each get their own
+    executable (``geometry_cache_size`` counts scan programs the same
+    way it counts single-step ones).
+
     Returned metrics are the MEAN over the K micro-steps (a divergence
     spike inside the window surfaces at the next log line instead of
     only when it happens to land on micro-step K), plus
@@ -209,7 +225,7 @@ def make_multi_train_step(model, hps: HParams,
     scan's stacked metrics never leave the device.
     """
     k = hps.steps_per_call if steps_per_call is None else steps_per_call
-    if k == 1:
+    if k == 1 and not key_by_global_step:
         return make_train_step(model, hps, mesh)
     tx = make_optimizer(hps)
     single = _make_single_step_core(model, hps, mesh, tx)
@@ -217,10 +233,19 @@ def make_multi_train_step(model, hps: HParams,
     def multi_fn(state: TrainState, batches: Batch, key: jax.Array):
         def body(st, xs):
             batch_i, i = xs
-            st, metrics = single(st, batch_i, jax.random.fold_in(key, i))
+            micro_key = (jax.random.fold_in(key, st.step)
+                         if key_by_global_step
+                         else jax.random.fold_in(key, i))
+            st, metrics = single(st, batch_i, micro_key)
             return st, metrics
 
-        state, stacked = jax.lax.scan(body, state, (batches, jnp.arange(k)))
+        # scan length comes from the stacked batch's leading axis, so
+        # the SAME jitted fn serves every full-stack size the scheduler
+        # dispatches (one executable per (K, B, Tb) input geometry)
+        state, stacked = jax.lax.scan(
+            body, state,
+            (batches, jnp.arange(jax.tree_util.tree_leaves(batches)[0]
+                                 .shape[0])))
         metrics = jax.tree_util.tree_map(
             lambda v: jnp.mean(v, axis=0), stacked)
         metrics["grad_norm_max"] = jnp.max(stacked["grad_norm"])

@@ -410,6 +410,40 @@ def test_bench_summary_aggregates_bucket_bench_rows(tmp_path, capsys):
     assert "2.76" in full and "0.81" in full and "0.34" in full
 
 
+def test_bench_summary_bucket_stacked_columns(tmp_path, capsys):
+    """ISSUE 5 satellite: grid-bearing bucket_bench rows additionally
+    print the dispatch-amortization columns — best stacked gain over
+    K=1, realized mean_run_len and dispatches_saved; legacy rows
+    without a grid print none."""
+    from scripts import bench_summary
+
+    hist = tmp_path / "h.jsonl"
+    legacy = {"kind": "bucket_bench", "dec_model": "lstm",
+              "batch_size": 32, "max_seq_len": 128,
+              "bucket_edges": [16, 32], "device_kind": "cpu",
+              "speedup_steps_per_sec": 2.0,
+              "fixed": {"padded_frac": 0.8},
+              "bucketed": {"padded_frac": 0.3}}
+    stacked = {**legacy, "bucket_edges": [16, 32, 64],
+               "best_stacked_gain": 1.21,
+               "grid": {
+                   "bucketed_k1": {"steps_per_sec": 50.0},
+                   "bucketed_k4": {"steps_per_sec": 57.0,
+                                   "mean_run_len": 6.4,
+                                   "dispatches_saved": 60},
+                   "bucketed_k8": {"steps_per_sec": 60.5,
+                                   "mean_run_len": 6.4,
+                                   "dispatches_saved": 78}}}
+    _write_hist(hist, [legacy, stacked])
+    assert bench_summary.main([str(hist)]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    with_grid = next(l for l in lines if "16;32;64" in l)
+    assert ("stacked=1.21x@K8" in with_grid
+            and "run_len=6.4" in with_grid and "saved=78" in with_grid)
+    without = next(l for l in lines if "16;32 " in l)
+    assert "stacked=" not in without
+
+
 def test_bench_train_rejects_non_divisible_steps():
     """ADVICE r2: steps % steps_per_call != 0 must raise, not silently
     run fewer optimizer steps while computing throughput over `steps`."""

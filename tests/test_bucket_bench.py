@@ -1,10 +1,12 @@
-"""Smoke test for scripts/bucket_bench.py (ISSUE 4 acceptance surface).
+"""Smoke test for scripts/bucket_bench.py (ISSUE 4/5 acceptance surface).
 
-Runs a shrunk version of the ``--smoke`` measurement end-to-end on CPU:
-the record must report padded-timestep fractions for both modes, the
-per-bucket dispatch counts, a positive speedup, and the semantics
-checks (masked-eval bitwise parity, exact per-example GMM) must pass —
-the speedup ACCEPTANCE number itself (>= 1.3x) is asserted by the real
+Runs a shrunk version of the ``--smoke`` grid end-to-end on CPU: the
+record must report padded-timestep fractions and the run-length /
+dispatch-amortization columns for EVERY grid arm, a positive K=1
+speedup, and the semantics checks (masked-eval bitwise parity, exact
+per-example GMM, stacked RNG parity, buckets-off bitwise pin) must
+pass — the speedup ACCEPTANCE numbers themselves (>= 1.3x bucketed
+over fixed; bucketed K>1 strictly over K=1) are asserted by the real
 ``--smoke`` run that produces the committed BUCKET_BENCH.json, not
 here, where trials are cut to the bone for suite runtime.
 
@@ -22,24 +24,41 @@ from scripts import bucket_bench
 def test_bucket_bench_smoke(tmp_path, capsys):
     out = tmp_path / "BUCKET_BENCH.json"
     rc = bucket_bench.main([
-        "--smoke", "--steps", "6", "--trials", "1",
-        "--corpus_n", "128", "--out", str(out)])
+        "--smoke", "--steps", "8", "--trials", "1", "--ks", "1,4",
+        "--corpus_n", "192", "--out", str(out)])
     assert rc == 0
     rec = json.loads(out.read_text())
     assert rec["kind"] == "bucket_bench" and rec["smoke"] is True
-    for mode in ("fixed", "bucketed"):
-        assert 0.0 <= rec[mode]["padded_frac"] < 1.0
-        assert rec[mode]["steps_per_sec"] > 0
+    assert rec["ks"] == [1, 4]
+    # every grid arm carries throughput, padding AND the run-length /
+    # dispatch-amortization columns (ISSUE 5 acceptance: present in
+    # every metrics row)
+    assert set(rec["grid"]) == {"fixed_k1", "fixed_k4",
+                                "bucketed_k1", "bucketed_k4"}
+    for arm in rec["grid"].values():
+        assert arm["steps_per_sec"] > 0
+        assert 0.0 <= arm["padded_frac"] < 1.0
+        for col in ("runs_per_epoch", "mean_run_len", "dispatches_saved"):
+            assert col in arm, col
     # fixed-T pads everything to max_seq_len; bucketing must waste less
     assert rec["fixed"]["padded_frac"] > rec["bucketed"]["padded_frac"]
     assert rec["bucketed"]["bucket_batches"]  # per-bucket dispatch counts
     assert rec["speedup_steps_per_sec"] > 0
+    # the bucketed plan has run structure; stacked arms save dispatches
+    assert rec["grid"]["bucketed_k4"]["runs_per_epoch"] > 0
+    assert rec["grid"]["bucketed_k4"]["mean_run_len"] >= 1.0
+    assert rec["grid"]["fixed_k4"]["dispatches_saved"] > 0
+    assert "k4" in rec["stacked_gain_bucketed"]
     # the semantics half of the acceptance criteria, on every backend
     assert rec["eval_parity"]["bitwise_equal"] is True
     assert rec["eval_parity"]["loss_fixed"] == rec["eval_parity"][
         "loss_bucketed"]
     assert rec["train_tail"]["gmm_nll_exact"] is True
     assert rec["train_tail"]["train_pen_ce_tail_delta"] >= 0
+    # ISSUE 5 in-run parity assertions
+    assert rec["parity"]["stacked"]["params_match"] is True
+    assert rec["parity"]["stacked"]["same_step"] is True
+    assert rec["parity"]["buckets_off_bitwise"]["bitwise_equal"] is True
     # smoke row routed through the (fixture-redirected) smoke history
     smoke_hist = tmp_path / "BENCH_SMOKE_HISTORY.jsonl"
     assert smoke_hist.exists()
@@ -49,9 +68,18 @@ def test_bucket_bench_smoke(tmp_path, capsys):
                if r.get("kind") == "bucket_bench")
 
 
+def test_bucket_bench_rejects_bad_ks(tmp_path, capsys):
+    # the K=1 baseline arm is the comparison anchor; a grid without it
+    # (or with a nonsense K) is a usage error, not a measurement
+    assert bucket_bench.main(["--smoke", "--ks", "4,8"]) == 2
+    assert bucket_bench.main(["--smoke", "--ks", "0,1"]) == 2
+
+
 def test_committed_bucket_bench_meets_acceptance():
     """The committed BUCKET_BENCH.json (produced by a real --smoke run)
-    must show the >= 1.3x steps/sec acceptance and the parity bits."""
+    must show the >= 1.3x bucketed-over-fixed speedup, the strict
+    stacked improvement (some bucketed K>1 beats bucketed K=1), and
+    every parity bit."""
     import os
 
     path = os.path.join(os.path.dirname(os.path.dirname(
@@ -61,3 +89,12 @@ def test_committed_bucket_bench_meets_acceptance():
     assert rec["speedup_steps_per_sec"] >= 1.3
     assert rec["eval_parity"]["bitwise_equal"] is True
     assert rec["train_tail"]["gmm_nll_exact"] is True
+    # ISSUE 5 acceptance: stacked execution strictly improves the
+    # bucketed runtime, with the parity assertions green in-run
+    assert rec["stacked_strictly_improves"] is True
+    assert rec["best_stacked_gain"] > 1.0
+    assert rec["parity"]["stacked"]["params_match"] is True
+    assert rec["parity"]["buckets_off_bitwise"]["bitwise_equal"] is True
+    for arm in rec["grid"].values():
+        for col in ("runs_per_epoch", "mean_run_len", "dispatches_saved"):
+            assert col in arm, col

@@ -738,8 +738,8 @@ def test_train_loop_divergence_stops_one_window_late(tmp_path):
 
     real_step = L.make_multi_train_step
 
-    def poisoned(model, hps_, mesh):
-        fn = real_step(model, hps_, mesh)
+    def poisoned(model, hps_, mesh, **kw):
+        fn = real_step(model, hps_, mesh, **kw)
 
         def wrapped(state, batch, key):
             state, metrics = fn(state, batch, key)
@@ -817,8 +817,8 @@ def test_train_loop_never_checkpoints_a_diverged_window(tmp_path):
 
     real_step = L.make_multi_train_step
 
-    def poisoned(model, hps_, mesh):
-        fn = real_step(model, hps_, mesh)
+    def poisoned(model, hps_, mesh, **kw):
+        fn = real_step(model, hps_, mesh, **kw)
 
         def wrapped(state, batch, key):
             state, metrics = fn(state, batch, key)
@@ -1034,8 +1034,12 @@ def test_multi_step_equals_k_single_steps():
     assert int(s_multi.step) == int(s_single.step) == 3
     for a, b in zip(jax.tree_util.tree_leaves(s_multi.params),
                     jax.tree_util.tree_leaves(s_single.params)):
+        # the scan is a different XLA program than 3 single steps, so
+        # f32 reassociation noise up to ~1.3e-6 is expected (observed
+        # to straddle a 1e-6 bound depending on how many programs the
+        # process compiled before this one — the isolation-run flake)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   atol=1e-6, rtol=1e-6)
+                                   atol=3e-6, rtol=3e-6)
     # returned metrics are the K-MEAN over micro-steps, plus the window's
     # max grad_norm; lr is the last micro-step's schedule value
     assert float(m_multi["loss"]) == pytest.approx(
