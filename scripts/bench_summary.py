@@ -36,6 +36,11 @@ def key_of(r: dict):
                 f"B={r.get('batch_size')} T={r.get('max_seq_len')} "
                 f"edges={';'.join(str(e) for e in r.get('bucket_edges') or ())} "
                 f"dev={dev}")
+    if r.get("kind") == "serve_bench":
+        return ("serve", r.get("dec_model"),
+                f"B={r.get('slots')} K={r.get('chunk')} "
+                f"n={r.get('n_requests')} dist={r.get('len_dist')} "
+                f"dev={dev}")
     if r.get("kind") == "sampler":
         # full_len rows (r3+) force max_len loop steps; earlier rows let
         # the untrained model early-exit after a few steps — not comparable
@@ -60,7 +65,22 @@ def metric_of(r: dict):
         # the bucketed runtime's headline: steps/sec multiple over the
         # fixed-T baseline on the same corpus
         return r.get("speedup_steps_per_sec")
+    if r.get("kind") == "serve_bench":
+        # the engine's headline: continuous-batching sketches/sec
+        return r.get("engine_sketches_per_sec")
     return r.get("strokes_per_sec_per_chip") or r.get("sketches_per_sec")
+
+
+def _serve_lat_cols(r: dict) -> str:
+    """Serving latency percentile columns for a serve_bench row
+    (ISSUE 6): the SLA surface next to the throughput record. Rows
+    predating the percentiles print nothing."""
+    ps = [(p, r.get(f"engine_latency_{p}_s")) for p in ("p50", "p95",
+                                                        "p99")]
+    if all(v is None for _, v in ps):
+        return ""
+    return " lat[ms] " + "/".join(
+        "-" if v is None else f"{1e3 * v:.0f}" for _, v in ps)
 
 
 def _stacked_cols(r: dict) -> str:
@@ -118,7 +138,8 @@ def main(argv=None) -> int:
             # configs; without this guard a breakdown row's
             # strokes_per_sec_per_chip prints as a phantom train config
             # with None knobs
-            if r.get("kind") not in ("train", "sampler", "bucket_bench"):
+            if r.get("kind") not in ("train", "sampler", "bucket_bench",
+                                     "serve_bench"):
                 continue
             v = metric_of(r)
             if v is None:
@@ -140,6 +161,17 @@ def main(argv=None) -> int:
                   f"best={metric_of(b):>11.2f}x ({when} padded_frac "
                   f"{pf}->{pb}){_stacked_cols(b)}  "
                   f"latest={metric_of(l):>11.2f}x")
+            continue
+        if k[0] == "serve":
+            # serving record: sketches/sec plus the latency percentile
+            # columns (SLA surface) and the speedup over the legacy
+            # freeze-until-batch-done sampler
+            sp = b.get("speedup")
+            sp_col = f" {sp}x vs sampler" if sp is not None else ""
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"best={metric_of(b):>11.2f} sk/s ({when}"
+                  f"{_serve_lat_cols(b)}{sp_col})  "
+                  f"latest={metric_of(l):>11.2f}")
             continue
         extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""
         # records the bench itself flagged as never reaching 70% of the

@@ -1,0 +1,235 @@
+"""Human-readable report over a telemetry JSONL (ISSUE 6).
+
+Reads the ``telemetry.jsonl`` event stream a traced run exported
+(``cli train --trace_dir=...``, ``cli serve-bench --trace_dir=...`` —
+see sketch_rnn_tpu/utils/telemetry.py) and prints:
+
+- **Stall breakdown** — per-(category, name) span count / total / mean /
+  share of accounted wall time. Totals come from the exact ``agg``
+  summary lines (maintained independently of the bounded event ring),
+  so they reconcile with ``GoodputLedger.summary()`` within rounding
+  even when the ring dropped events; the per-event sum is cross-checked
+  and a drop warning printed when they diverge.
+- **Slot-occupancy timeline** — the serve engine's per-chunk
+  ``slots_live`` gauge rendered as an ASCII sparkline over the run,
+  plus its mean.
+- **Latency percentile table** — p50/p95/p99 (exact ``np.percentile``
+  over the per-request ``complete`` events' queue-wait / decode / total
+  latencies, so the numbers MATCH ``ServeEngine.run()``'s summary dict)
+  next to the streaming-histogram approximations recorded live.
+
+``--json`` emits the same report as one machine-readable JSON object
+(what the tier-1 reconciliation tests consume).
+
+Usage:
+    python scripts/trace_report.py <telemetry.jsonl | trace_dir> [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sketch_rnn_tpu.utils.telemetry import TELEMETRY_JSONL  # noqa: E402
+
+SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def load(path: str) -> Dict:
+    """Parse a telemetry JSONL into {meta, events, agg, counters, hists}.
+
+    ``path`` may be the JSONL itself or a trace_dir containing
+    ``telemetry.jsonl``. Torn tail lines (a killed run) are skipped.
+    """
+    if os.path.isdir(path):
+        path = os.path.join(path, TELEMETRY_JSONL)
+    out: Dict = {"meta": {}, "events": [], "agg": {}, "counters": {},
+                 "hists": {}}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line
+            t = rec.get("type")
+            if t == "meta":
+                out["meta"] = rec
+            elif t in ("span", "instant", "counter"):
+                out["events"].append(rec)
+            elif t == "agg":
+                out["agg"][(rec["cat"], rec["name"])] = (
+                    rec["count"], rec["total_s"])
+            elif t == "counter_total":
+                out["counters"][(rec["cat"], rec["name"])] = rec["value"]
+            elif t == "hist":
+                out["hists"][(rec["cat"], rec["name"])] = {
+                    k: v for k, v in rec.items()
+                    if k not in ("type", "cat", "name")}
+    return out
+
+
+def span_breakdown(data: Dict) -> List[Dict]:
+    """Per-(cat, name) rows sorted by total_s descending.
+
+    Totals prefer the exact ``agg`` lines (these reconcile with the
+    ledgers' ``summary()``); ``event_total_s`` is the sum over the ring
+    events actually present — equal unless the ring dropped spans.
+    """
+    ev_tot: Dict = {}
+    for ev in data["events"]:
+        if ev["type"] == "span":
+            k = (ev["cat"], ev["name"])
+            n, t = ev_tot.get(k, (0, 0.0))
+            ev_tot[k] = (n + 1, t + ev["dur"])
+    keys = set(data["agg"]) | set(ev_tot)
+    rows = []
+    for k in keys:
+        n, total = data["agg"].get(k, ev_tot.get(k))
+        rows.append({
+            "cat": k[0], "name": k[1], "count": int(n),
+            "total_s": float(total),
+            "mean_ms": 1e3 * total / n if n else 0.0,
+            "event_total_s": float(ev_tot.get(k, (0, 0.0))[1]),
+        })
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
+def occupancy(data: Dict, name: str = "slots_live",
+              cat: str = "serve") -> Optional[Dict]:
+    """Timeline of a gauge: (ts, value) samples -> sparkline + stats."""
+    pts = [(ev["ts"], ev["value"]) for ev in data["events"]
+           if ev["type"] == "counter" and ev["name"] == name
+           and ev["cat"] == cat]
+    if not pts:
+        return None
+    ts = np.array([p[0] for p in pts])
+    vs = np.array([p[1] for p in pts])
+    # bucket the samples into <= 60 time columns, mean per column
+    ncols = min(60, len(pts))
+    edges = np.linspace(ts[0], ts[-1] + 1e-9, ncols + 1)
+    cols = []
+    for i in range(ncols):
+        m = (ts >= edges[i]) & (ts < edges[i + 1])
+        cols.append(float(vs[m].mean()) if m.any() else None)
+    top = float(vs.max()) or 1.0
+    spark = "".join(
+        "·" if c is None else SPARK[int(round(c / top * (len(SPARK) - 1)))]
+        for c in cols)
+    return {"name": name, "cat": cat, "samples": len(pts),
+            "mean": float(vs.mean()), "max": float(vs.max()),
+            "span_s": float(ts[-1] - ts[0]), "sparkline": spark}
+
+
+def latency_table(data: Dict) -> List[Dict]:
+    """Exact percentiles from serve ``complete`` events, per metric.
+
+    Uses ``np.percentile`` over the event-carried values — the same
+    math over the same floats as ``ServeEngine.run()``'s summary, so
+    ``latency_s``'s p50/p95/p99 match it exactly. The live streaming-
+    histogram approximations ride along for comparison.
+    """
+    vals: Dict[str, List[float]] = {}
+    for ev in data["events"]:
+        if ev["type"] == "instant" and ev["name"] == "complete" \
+                and ev["cat"] == "serve":
+            for m in ("queue_wait_s", "decode_s", "latency_s"):
+                if m in ev.get("args", {}):
+                    vals.setdefault(m, []).append(ev["args"][m])
+    rows = []
+    for m, xs in sorted(vals.items()):
+        a = np.array(xs)
+        row = {"metric": m, "count": len(xs), "mean_s": float(a.mean()),
+               "p50_s": float(np.percentile(a, 50)),
+               "p95_s": float(np.percentile(a, 95)),
+               "p99_s": float(np.percentile(a, 99))}
+        h = data["hists"].get(("serve", m))
+        if h:
+            row["hist_p50_s"] = h["p50"]
+            row["hist_p95_s"] = h["p95"]
+            row["hist_p99_s"] = h["p99"]
+        rows.append(row)
+    return rows
+
+
+def report(data: Dict) -> Dict:
+    return {
+        "meta": data["meta"],
+        "spans": span_breakdown(data),
+        "occupancy": occupancy(data),
+        "latency": latency_table(data),
+        "counters": {f"{c}/{n}": v
+                     for (c, n), v in sorted(data["counters"].items())},
+    }
+
+
+def print_report(rep: Dict) -> None:
+    dropped = rep["meta"].get("dropped", 0)
+    if dropped:
+        print(f"WARNING: event ring dropped {dropped} events — per-event "
+              f"sums undercount; agg totals remain exact\n")
+    spans = rep["spans"]
+    if spans:
+        accounted = sum(r["total_s"] for r in spans)
+        print("== span breakdown (stalls) ==")
+        print(f"{'cat':10s} {'name':16s} {'count':>7s} {'total_s':>10s} "
+              f"{'mean_ms':>9s} {'share':>6s}")
+        for r in spans:
+            share = r["total_s"] / accounted if accounted else 0.0
+            print(f"{r['cat']:10s} {r['name']:16s} {r['count']:7d} "
+                  f"{r['total_s']:10.3f} {r['mean_ms']:9.3f} "
+                  f"{share:6.1%}")
+        print(f"{'':10s} {'(accounted)':16s} {'':7s} {accounted:10.3f}")
+        print()
+    occ = rep["occupancy"]
+    if occ:
+        print("== serve slot occupancy ==")
+        print(f"mean {occ['mean']:.2f} / max {occ['max']:.0f} slots over "
+              f"{occ['span_s']:.3f}s ({occ['samples']} chunks)")
+        print(f"[{occ['sparkline']}]")
+        print()
+    lat = rep["latency"]
+    if lat:
+        print("== serve latency percentiles (exact, from events) ==")
+        print(f"{'metric':14s} {'count':>6s} {'mean_ms':>9s} "
+              f"{'p50_ms':>9s} {'p95_ms':>9s} {'p99_ms':>9s}")
+        for r in lat:
+            print(f"{r['metric']:14s} {r['count']:6d} "
+                  f"{1e3 * r['mean_s']:9.3f} {1e3 * r['p50_s']:9.3f} "
+                  f"{1e3 * r['p95_s']:9.3f} {1e3 * r['p99_s']:9.3f}")
+        print()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="stall breakdown / occupancy / latency report over "
+                    "a telemetry JSONL")
+    ap.add_argument("path", help="telemetry.jsonl or the trace_dir "
+                                 "holding it")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON instead of tables")
+    args = ap.parse_args(argv)
+    data = load(args.path)
+    rep = report(data)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print_report(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head
+        sys.exit(0)

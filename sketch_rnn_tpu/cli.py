@@ -169,7 +169,8 @@ def cmd_train(args) -> int:
     train(hps, train_l, valid_l, test_l, scale_factor=scale,
           workdir=args.workdir, seed=args.seed,
           resume=not getattr(args, "no_resume", False),
-          profile=getattr(args, "profile", False))
+          profile=getattr(args, "profile", False),
+          trace_dir=getattr(args, "trace_dir", "") or None)
     return 0
 
 
@@ -367,9 +368,35 @@ def cmd_serve_bench(args) -> int:
     import dataclasses
     engine.run([dataclasses.replace(r, uid=None, max_len=1)
                 for r in requests])
+    # telemetry (ISSUE 6): configured AFTER the warmup burst so the
+    # exported per-request lifecycle (enqueue/admit/complete, latency
+    # histograms, slot occupancy) covers exactly the measured run
+    trace_dir = getattr(args, "trace_dir", "") or None
+    tel = None
+    if trace_dir:
+        from sketch_rnn_tpu.utils import telemetry as tele
+        tel = tele.configure(trace_dir=trace_dir)
     t0 = time.time()
-    out = engine.run(requests, recycle=not args.static,
-                     metrics_writer=writer)
+    try:
+        out = engine.run(requests, recycle=not args.static,
+                         metrics_writer=writer)
+    except BaseException:
+        # a mid-run crash still leaves the trace that explains it (the
+        # train loop's post-mortem discipline); best-effort so an export
+        # failure never masks the real error
+        if tel is not None:
+            try:
+                tel.export()
+            except Exception:  # noqa: BLE001
+                pass
+            tele.disable()
+        raise
+    if tel is not None:
+        paths = tel.export()
+        print(f"[telemetry] wrote {paths['jsonl']} and {paths['chrome']} "
+              f"(read with scripts/trace_report.py or Perfetto)",
+              file=sys.stderr)
+        tele.disable()  # restore the process default
     report = {
         "kind": "serve_bench_cli",
         "n_requests": n,
@@ -408,7 +435,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "steps_per_call=K")
     p.add_argument("--profile", action="store_true",
                    help="capture a jax.profiler device trace of steps "
-                        "~10-20 into <workdir>/trace (view with XProf)")
+                        "~10-20 into <workdir>/trace (view with XProf); "
+                        "with --trace_dir the device trace lands in "
+                        "<trace_dir>/device, aligned to the host spans")
+    p.add_argument("--trace_dir", default="",
+                   help="enable the unified telemetry runtime and write "
+                        "telemetry.jsonl + trace.json (Chrome trace / "
+                        "Perfetto) here at exit; read with "
+                        "scripts/trace_report.py. Off by default and "
+                        "invisible when off")
     p.add_argument("--no_resume", action="store_true",
                    help="start fresh even when <workdir> holds "
                         "checkpoints (default: resume from latest — the "
@@ -471,6 +506,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log_metrics", action="store_true",
                    help="write per-request serve_metrics JSONL+CSV into "
                         "--workdir")
+    p.add_argument("--trace_dir", default="",
+                   help="enable per-request serving telemetry and write "
+                        "telemetry.jsonl + trace.json (Chrome trace) "
+                        "here; read with scripts/trace_report.py")
     p.set_defaults(fn=cmd_serve_bench)
     return ap
 

@@ -25,6 +25,8 @@ import queue
 import threading
 from typing import Any, Callable, Optional
 
+from sketch_rnn_tpu.utils.telemetry import get_telemetry
+
 
 class Prefetcher:
     """Bounded look-ahead around a ``producer() -> batch`` callable.
@@ -204,31 +206,45 @@ def prefetch_batches(loader, mesh=None, depth: int = 2, stack: int = 1,
     next_fn = getattr(loader, "next_batch", None) or loader.random_batch
     bucketed_stack = stack > 1 and bool(getattr(loader, "bucket_edges", ()))
 
+    # telemetry (ISSUE 6): the producer's two phases — host batch
+    # assembly (next_batch / next_stack) and the sharded device
+    # transfer — are spanned under cat "data", so an exported trace
+    # shows feeder work on its own thread track against the loop's
+    # feeder_wait stalls. Resolved per call: a late configure() (cli
+    # --trace_dir) still catches a feeder built earlier; disabled
+    # cost is one attribute check per batch.
+    assemble = "next_stack" if bucketed_stack else "assemble"
+
     def host_batch():
         import numpy as np
 
-        if bucketed_stack:
-            # bucket-run scheduler: one geometry run's prefix, already
-            # stacked [k, B, Tb+1, 5] with k <= stack (run remainders
-            # are short — the consumer replays those per micro-step)
-            out = loader.next_stack(stack, int16_scale=quant_scale)
-        elif stack == 1:
-            out = next_fn(int16_scale=quant_scale)
+        with get_telemetry().span(assemble, cat="data"):
+            if bucketed_stack:
+                # bucket-run scheduler: one geometry run's prefix,
+                # already stacked [k, B, Tb+1, 5] with k <= stack (run
+                # remainders are short — the consumer replays those
+                # per micro-step)
+                out = loader.next_stack(stack, int16_scale=quant_scale)
+            elif stack == 1:
+                out = next_fn(int16_scale=quant_scale)
+                if cast is not None:
+                    out = dict(out)  # don't mutate the loader's dict
+            else:
+                parts = [next_fn(int16_scale=quant_scale)
+                         for _ in range(stack)]
+                out = {k: np.stack([p[k] for p in parts])
+                       for k in parts[0]}
             if cast is not None:
-                out = dict(out)  # don't mutate the loader's dict
-        else:
-            parts = [next_fn(int16_scale=quant_scale)
-                     for _ in range(stack)]
-            out = {k: np.stack([p[k] for p in parts]) for k in parts[0]}
-        if cast is not None:
-            out["strokes"] = out["strokes"].astype(cast)
-        return out
+                out["strokes"] = out["strokes"].astype(cast)
+            return out
 
     if mesh is not None:
         from sketch_rnn_tpu.parallel.mesh import shard_batch
 
         def producer():
-            return shard_batch(host_batch(), mesh, stacked=stack > 1)
+            batch = host_batch()
+            with get_telemetry().span("transfer", cat="data"):
+                return shard_batch(batch, mesh, stacked=stack > 1)
     else:
         producer = host_batch
     if depth <= 0:

@@ -59,6 +59,7 @@ from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.ops import mdn
 from sketch_rnn_tpu.sample.sampler import END_TOKEN, START_TOKEN
 from sketch_rnn_tpu.utils.profiling import SpanTimer
+from sketch_rnn_tpu.utils.telemetry import get_telemetry
 
 
 @dataclasses.dataclass
@@ -262,7 +263,7 @@ class ServeEngine:
             {k: params[k] for k in keep if k in params})
         self._chunk_fn = make_chunk_step(model, hps, self.chunk,
                                          self.params, greedy)
-        self.spans = SpanTimer()
+        self.spans = SpanTimer(category="serve")
 
     # -- the request pool --------------------------------------------------
     #
@@ -319,13 +320,24 @@ class ServeEngine:
         one JSONL row per completed request.
         """
         t_start = time.perf_counter()
-        self.spans = SpanTimer()  # per-run spans (warmup runs don't leak)
+        self.spans = SpanTimer(category="serve")  # per-run (no warmup leak)
+        # per-request lifecycle telemetry (ISSUE 6): enqueue/admit/
+        # complete instants plus streaming latency histograms flow into
+        # the process core LIVE — an operator (or trace_report.py) sees
+        # queue-wait/decode/latency percentiles and slot occupancy
+        # while the run is in flight, not only in the returned summary.
+        # One attribute check when telemetry is off (the default).
+        tel = get_telemetry()
         for i, req in enumerate(requests):
             if req.uid is None:
                 req.uid = i
         queue = deque(enumerate(requests))
         pool = self._prepare_pool(requests) if requests else None
         enq = {req.uid: t_start for req in requests}
+        if tel.enabled:
+            for req in requests:
+                tel.instant("enqueue", cat="serve", ts=t_start,
+                            args={"uid": req.uid})
         admit_t: Dict[int, float] = {}
         slot_req: List[Optional[Request]] = [None] * self.slots
         results: List[Result] = []
@@ -364,6 +376,10 @@ class ServeEngine:
                         first_chunk[b] = n_disp  # the next dispatch
                         slot_req[b] = req
                         admit_t[req.uid] = now
+                        if tel.enabled:
+                            tel.instant("admit", cat="serve", ts=now,
+                                        args={"uid": req.uid,
+                                              "slot": int(b)})
 
         def dispatch():
             """Enqueue one chunk; returns its output futures and its
@@ -437,6 +453,12 @@ class ServeEngine:
                 base = np.where(first_chunk == cidx, 0, t_prev)
                 live_slot_steps += int(
                     (t - base)[eligible].sum())
+                if tel.enabled:
+                    # per-chunk occupancy sample: how many slots held a
+                    # request during this chunk — trace_report.py's
+                    # slot-occupancy timeline, a Chrome counter track
+                    tel.gauge("slots_live", int(eligible.sum()),
+                              cat="serve", ts=now)
                 for b in np.nonzero(eligible & done)[0]:
                     req = slot_req[b]
                     s5 = gather(int(b), cidx)
@@ -449,6 +471,23 @@ class ServeEngine:
                         decode_s=now - admit_t[req.uid],
                         latency_s=now - enq[req.uid])
                     results.append(res)
+                    if tel.enabled:
+                        # the complete event carries the EXACT Result
+                        # latencies, so event-derived percentiles in
+                        # trace_report.py match run()'s summary; the
+                        # histograms stream the same values live
+                        tel.instant("complete", cat="serve", ts=now,
+                                    args={"uid": res.uid,
+                                          "steps": res.steps,
+                                          "length": res.length,
+                                          "queue_wait_s": res.queue_wait_s,
+                                          "decode_s": res.decode_s,
+                                          "latency_s": res.latency_s})
+                        tel.observe("queue_wait_s", res.queue_wait_s,
+                                    cat="serve")
+                        tel.observe("decode_s", res.decode_s, cat="serve")
+                        tel.observe("latency_s", res.latency_s,
+                                    cat="serve")
                     slot_req[b] = None
                     occupied[b] = False
                     n_live -= 1

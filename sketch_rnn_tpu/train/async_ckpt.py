@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.train.checkpoint import write_checkpoint
 from sketch_rnn_tpu.train.state import TrainState
+from sketch_rnn_tpu.utils.telemetry import get_telemetry
 
 
 def snapshot_device_state(state: TrainState) -> TrainState:
@@ -92,7 +93,12 @@ class AsyncCheckpointer:
         training at the NEXT save, exactly one cadence window late.
         """
         self.wait()
-        snap = snapshot_device_state(state)
+        # telemetry (ISSUE 6): the loop-thread snapshot and the writer
+        # thread's fetch/commit are spanned under cat "ckpt", so an
+        # exported trace shows the background save's lifetime against
+        # the loop's (steady-state ~zero) ckpt_wait joins
+        with get_telemetry().span("snapshot", cat="ckpt"):
+            snap = snapshot_device_state(state)
         self.saves_started += 1
         self._thread = threading.Thread(
             target=self._write, args=(snap, float(scale_factor), hps),
@@ -135,9 +141,12 @@ class AsyncCheckpointer:
     def _write(self, snap: TrainState, scale_factor: float,
                hps: HParams) -> None:
         try:
-            host_state = jax.device_get(snap)
-            self.last_path = write_checkpoint(
-                self.ckpt_dir, host_state, scale_factor, hps,
-                keep=self.keep)
+            tel = get_telemetry()
+            with tel.span("fetch", cat="ckpt"):
+                host_state = jax.device_get(snap)
+            with tel.span("commit", cat="ckpt"):
+                self.last_path = write_checkpoint(
+                    self.ckpt_dir, host_state, scale_factor, hps,
+                    keep=self.keep)
         except BaseException as e:  # noqa: BLE001 — must cross the thread
             self._exc = e

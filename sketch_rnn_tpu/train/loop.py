@@ -44,10 +44,19 @@ loop, and the epoch plan itself never reads K, so the consumed batch
 stream is identical too. The ``PaddingLedger`` additionally reports
 ``runs_per_epoch`` / ``mean_run_len`` (plan run structure) and
 ``dispatches_saved`` (realized K-amortization) in every metrics row.
+
+Telemetry runtime (ISSUE 6): ``train(..., trace_dir=...)`` enables the
+process-wide telemetry core (utils/telemetry.py) — the ledgers above
+double as views into it, the prefetch producer / async checkpointer /
+serve engine emit their own spans — and exports a JSONL event stream
+plus a Chrome-trace JSON at exit (``scripts/trace_report.py`` prints
+the stall breakdown and reconciles it against the ledger totals). Off
+by default and bitwise-invisible when off.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional
 
 import jax
@@ -76,6 +85,7 @@ from sketch_rnn_tpu.train.step import (
 )
 from sketch_rnn_tpu.utils.debug import check_finite, param_count
 from sketch_rnn_tpu.utils.profiling import GoodputLedger, Throughput
+from sketch_rnn_tpu.utils import telemetry as tele
 
 # the loop's accounted phases, pre-declared so every metrics row carries
 # all t_<phase>_s columns from the first window (CSV header stability)
@@ -288,15 +298,28 @@ def train(hps: HParams,
           num_steps: Optional[int] = None,
           use_mesh: bool = True,
           resume: bool = True,
-          profile: bool = False) -> TrainState:
+          profile: bool = False,
+          trace_dir: Optional[str] = None) -> TrainState:
     """Train for ``num_steps`` (default ``hps.num_steps``); returns state.
 
     Resumes from the latest checkpoint in ``workdir`` when present
     (reference parity: resume-from-latest, SURVEY §5). ``profile=True``
     captures a ``jax.profiler`` trace of steps 10-20 (post-compile) into
     ``<workdir>/trace`` (SURVEY §5 "Tracing / profiling").
+
+    ``trace_dir`` (ISSUE 6) turns on the unified telemetry runtime: the
+    process core records the loop's goodput phases, the prefetch
+    producer, the async checkpointer and the padding counters, and the
+    run exports ``telemetry.jsonl`` + ``trace.json`` (Chrome trace)
+    into ``trace_dir`` at exit — read with ``scripts/trace_report.py``
+    or Perfetto. With ``profile=True`` the device trace lands in
+    ``<trace_dir>/device`` with alignment markers in the host stream.
+    Telemetry off (the default) is invisible: no files, identical
+    metrics rows. Multi-host runs record on the primary only.
     """
     num_steps = hps.num_steps if num_steps is None else num_steps
+    if trace_dir and is_primary():
+        tele.configure(trace_dir=trace_dir)
     # fail fast: an un-evaluable valid split would otherwise only raise at
     # the FIRST eval sweep, hours into training (everything needed for the
     # check is known now)
@@ -376,7 +399,12 @@ def train(hps: HParams,
                             num_chips=mesh.size if mesh is not None else 1)
     throughput.update(step)
     profile_span = None
-    if profile and workdir:
+    # device trace destination: beside the host telemetry when a shared
+    # trace_dir exists (so XProf and the host spans align per ISSUE 6),
+    # the legacy <workdir>/trace otherwise
+    device_dir = (os.path.join(trace_dir, "device") if trace_dir
+                  else (f"{workdir}/trace" if workdir else None))
+    if profile and device_dir:
         span = (step + 10, min(step + 20, num_steps))
         if span[0] < span[1]:  # enough post-compile steps left to trace
             profile_span = span
@@ -395,7 +423,10 @@ def train(hps: HParams,
     try:
         while step < num_steps:
             if profile_span and not trace_active and step >= profile_span[0]:
-                jax.profiler.start_trace(f"{workdir}/trace")
+                tele.get_telemetry().instant(
+                    tele.DEVICE_TRACE_START, cat=tele.PROFILER_CAT,
+                    args={"logdir": device_dir, "step": step})
+                jax.profiler.start_trace(device_dir)
                 trace_active = True
             with ledger.span("feeder_wait"):
                 batch = feeder.get()
@@ -451,6 +482,9 @@ def train(hps: HParams,
             if trace_active and step >= profile_span[1]:
                 jax.block_until_ready(metrics["loss"])
                 jax.profiler.stop_trace()
+                tele.get_telemetry().instant(
+                    tele.DEVICE_TRACE_STOP, cat=tele.PROFILER_CAT,
+                    args={"step": step})
                 trace_active = False
                 profile_span = None
 
@@ -529,6 +563,15 @@ def train(hps: HParams,
         # poisons any later start_trace in this process)
         if trace_active:
             jax.profiler.stop_trace()
+        # post-mortem telemetry export (best-effort — nothing in a
+        # finally may mask the propagating error): a crashed traced run
+        # still leaves its JSONL + Chrome trace on disk; the normal
+        # path re-exports at return with the post-loop spans included
+        if trace_dir and is_primary():
+            try:
+                tele.get_telemetry().export()
+            except Exception:  # noqa: BLE001
+                pass
 
     if write_dir:
         if ckpt is not None:
@@ -554,4 +597,13 @@ def train(hps: HParams,
         print("[test] " + " ".join(f"{k}={v:.4f}"
                                    for k, v in sorted(ev.items())),
               flush=True)
+    if trace_dir and is_primary():
+        paths = tele.get_telemetry().export()
+        print(f"[telemetry] wrote {paths['jsonl']} and {paths['chrome']} "
+              f"(read with scripts/trace_report.py or Perfetto)",
+              flush=True)
+        # restore the disabled default so a later untraced run in the
+        # same process does not keep recording into (and paying for) a
+        # stale core whose files are never re-exported
+        tele.disable()
     return state

@@ -21,6 +21,7 @@ from __future__ import annotations
 import csv
 import json
 import os
+import sys
 import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
@@ -34,6 +35,7 @@ class MetricsWriter:
         self._csv_path = None
         self._jsonl_path = None
         self._fields: Optional[Sequence[str]] = None
+        self._warned_drops: set = set()
         if workdir:
             os.makedirs(workdir, exist_ok=True)
             self._csv_path = os.path.join(workdir, f"{name}_metrics.csv")
@@ -62,6 +64,18 @@ class MetricsWriter:
                     # crash truncated it): (re)write the header
                     self._fields = list(row)
                     new = True
+            # the resume-alignment rule silently drops scalar keys absent
+            # from the adopted header; silence cost a debugging session
+            # (ISSUE 6 satellite) — warn ONCE per dropped key. The JSONL
+            # row above kept the full key set either way.
+            dropped = set(row).difference(self._fields)
+            dropped -= self._warned_drops
+            if dropped:
+                self._warned_drops |= dropped
+                print(f"[metrics] WARNING: {os.path.basename(self._csv_path)} "
+                      f"drops keys absent from its existing header "
+                      f"(CSV resume alignment; the JSONL keeps them): "
+                      f"{sorted(dropped)}", file=sys.stderr, flush=True)
             with open(self._csv_path, "a", newline="") as f:
                 w = csv.DictWriter(f, fieldnames=self._fields,
                                    extrasaction="ignore", restval="")

@@ -1,4 +1,4 @@
-"""Auxiliary subsystems: profiling, NaN guards (SURVEY.md §5)."""
+"""Auxiliary subsystems: profiling, telemetry, NaN guards (SURVEY.md §5)."""
 
 from sketch_rnn_tpu.utils.profiling import (
     GoodputLedger,
@@ -6,7 +6,15 @@ from sketch_rnn_tpu.utils.profiling import (
     Throughput,
     trace,
 )
+from sketch_rnn_tpu.utils.telemetry import (
+    Histogram,
+    Telemetry,
+    configure,
+    disable,
+    get_telemetry,
+)
 from sketch_rnn_tpu.utils.debug import check_finite, find_nonfinite
 
 __all__ = ["trace", "SpanTimer", "GoodputLedger", "Throughput",
-           "check_finite", "find_nonfinite"]
+           "Telemetry", "Histogram", "get_telemetry", "configure",
+           "disable", "check_finite", "find_nonfinite"]

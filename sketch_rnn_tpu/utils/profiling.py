@@ -4,6 +4,14 @@ The reference had no in-repo profiler and leaned on TF timeline /
 TensorBoard; the TPU-native equivalents are ``jax.profiler`` traces
 (viewable in XProf/TensorBoard) plus simple steps/sec / strokes/sec/chip
 counters — the BASELINE.json metric.
+
+Since ISSUE 6 the ledgers here are VIEWS over the unified telemetry
+core (``utils/telemetry.py``): each keeps its own aggregation store —
+the authoritative source for its ``window()``/``summary()`` metrics-row
+contract, bitwise-unchanged whether telemetry is on or off — and
+mirrors every measurement (spans for the timers, counters for the
+padding ledger) into the process-wide core, where the JSONL /
+Chrome-trace exporters and ``scripts/trace_report.py`` see one stream.
 """
 
 from __future__ import annotations
@@ -14,6 +22,8 @@ import time
 from typing import Dict, Iterator, Optional, Sequence
 
 import jax
+
+from sketch_rnn_tpu.utils.telemetry import get_telemetry
 
 
 @contextlib.contextmanager
@@ -36,10 +46,24 @@ class SpanTimer:
     ``admit`` slot writes, ``collect`` output gathering) so a bench run
     can attribute wall time without a device trace. ``summary()``
     returns ``{name: {count, total_s, mean_ms}}``.
+
+    Thread-safe (ISSUE 6 satellite): the serve engine's depth-1
+    pipelined dispatch lets span closes interleave across threads, and
+    the unlocked ``rec[0] += 1`` read-modify-write lost increments.
+
+    A view over the telemetry core (ISSUE 6): every closed span is also
+    emitted into the process-wide :mod:`~sketch_rnn_tpu.utils.telemetry`
+    core under ``category`` with the SAME ``t1 - t0`` this accumulator
+    adds, so an exported trace's per-name totals reconcile with
+    ``summary()`` exactly (the local store stays authoritative for the
+    ``window()``/``summary()`` row contracts, and keeps working — with
+    identical values — when telemetry is off, which is the default).
     """
 
-    def __init__(self):
+    def __init__(self, category: str = "host"):
+        self._lock = threading.Lock()
         self._spans: dict = {}
+        self.category = category
 
     @contextlib.contextmanager
     def span(self, name: str) -> Iterator[None]:
@@ -47,15 +71,23 @@ class SpanTimer:
         try:
             yield
         finally:
-            rec = self._spans.setdefault(name, [0, 0.0])
-            rec[0] += 1
-            rec[1] += time.perf_counter() - t0
+            t1 = time.perf_counter()
+            with self._lock:
+                rec = self._spans.setdefault(name, [0, 0.0])
+                rec[0] += 1
+                rec[1] += t1 - t0
+            tel = get_telemetry()
+            if tel.enabled:
+                tel.emit_span(name, self.category, t0, t1)
 
     def summary(self) -> dict:
+        with self._lock:
+            items = [(name, n, t)
+                     for name, (n, t) in sorted(self._spans.items())]
         return {
             name: {"count": n, "total_s": round(t, 6),
                    "mean_ms": round(1e3 * t / n, 4) if n else 0.0}
-            for name, (n, t) in sorted(self._spans.items())
+            for name, n, t in items
         }
 
 
@@ -78,7 +110,7 @@ class GoodputLedger(SpanTimer):
     """
 
     def __init__(self, phases: tuple = ()):
-        super().__init__()
+        super().__init__(category="train")
         # pre-declare phases that first fire late (ckpt_wait, eval): the
         # FIRST metrics row defines the CSV header, so a phase absent
         # from it would be dropped from the CSV forever (the writer's
@@ -89,10 +121,11 @@ class GoodputLedger(SpanTimer):
 
     def window(self, prefix: str = "t_") -> dict:
         out = {}
-        for name, (_, total) in sorted(self._spans.items()):
-            prev = self._window_mark.get(name, 0.0)
-            out[f"{prefix}{name}_s"] = round(total - prev, 6)
-            self._window_mark[name] = total
+        with self._lock:
+            for name, (_, total) in sorted(self._spans.items()):
+                prev = self._window_mark.get(name, 0.0)
+                out[f"{prefix}{name}_s"] = round(total - prev, 6)
+                self._window_mark[name] = total
         return out
 
 
@@ -152,6 +185,17 @@ class PaddingLedger:
             self._counts[int(tb)] = self._counts.get(int(tb), 0) + 1
             self._dispatched += int(rows) * int(tb)
             self._true += int(true_steps)
+        # telemetry view (ISSUE 6): the same increments route through
+        # the process core as counters (cat "data"), so an exported
+        # trace carries the padding-waste accounting; the local ints
+        # stay authoritative for window()/summary() and are untouched
+        # when telemetry is off (the default)
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter("dispatched_timesteps", int(rows) * int(tb),
+                        cat="data")
+            tel.counter("true_timesteps", int(true_steps), cat="data")
+            tel.counter(f"bucket_T{int(tb)}_n", 1, cat="data")
 
     def record_dispatch(self, micro_steps: int, dispatches: int) -> None:
         """One scheduler decision: ``micro_steps`` optimizer steps rode
@@ -160,6 +204,10 @@ class PaddingLedger:
         with self._lock:
             self._micro += int(micro_steps)
             self._calls += int(dispatches)
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter("micro_steps", int(micro_steps), cat="data")
+            tel.counter("dispatches", int(dispatches), cat="data")
 
     def note_epoch_plan(self, n_runs: int, n_batches: int) -> None:
         """Record the run structure of a freshly planned bucket epoch
@@ -167,6 +215,9 @@ class PaddingLedger:
         with self._lock:
             self._epoch_runs = int(n_runs)
             self._epoch_batches = int(n_batches)
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.gauge("runs_per_epoch", int(n_runs), cat="data")
 
     @staticmethod
     def _frac(dispatched: int, true: int) -> float:

@@ -1,0 +1,452 @@
+"""Unified telemetry runtime: spans, counters, streaming histograms.
+
+ISSUE 6's observability substrate. The runtime grew four hand-rolled
+timing stores (``SpanTimer``/``GoodputLedger``/``PaddingLedger`` in
+utils/profiling.py, the serve engine's end-of-run latency aggregate) and
+no exporter a human can open — the reference leaned on TF's timeline /
+TensorBoard tracing for exactly this (TensorFlow system paper,
+PAPERS.md). This module is the ONE telemetry contract everything emits
+into:
+
+- **Spans** — named, categorized wall-clock intervals (monotonic
+  ``perf_counter`` start/end, optional attribute dict, recording thread)
+  kept in a bounded ring buffer so a long run cannot grow memory without
+  bound; per-(category, name) count/total aggregates are maintained
+  independently of the ring, so breakdown totals stay exact even after
+  the ring drops old events.
+- **Counters** — monotonic totals (``counter``) and sampled gauges
+  (``gauge``); each update also lands a ring event, which is what
+  renders as a Chrome-trace counter track (e.g. live serve slots over
+  time).
+- **Streaming histograms** — log-bucket (growth ``2**(1/8)``, <=~4.5%
+  relative quantile error) p50/p95/p99 WITHOUT retaining samples, so
+  per-request latency distributions stream live at serving rates
+  instead of appearing only in a final summary dict.
+
+Two exporters, written into a shared ``trace_dir``:
+
+- ``telemetry.jsonl`` — newline-JSONL event stream (one meta line, then
+  span/instant/counter events, then aggregate/histogram summary lines);
+  the input of ``scripts/trace_report.py``.
+- ``trace.json`` — Chrome-trace ``traceEvents`` JSON; open in
+  ``chrome://tracing`` or Perfetto (https://ui.perfetto.dev). Threads
+  get named tracks (main loop, batch-prefetch, ckpt-writer), spans are
+  ``ph: "X"`` complete events, gauges are ``ph: "C"`` counter tracks.
+
+An optional ``jax.profiler`` device trace (:meth:`Telemetry.device_trace`)
+captures into ``<trace_dir>/device`` with instant markers dropped into
+the host stream at start/stop, so the XProf device timeline can be
+aligned against the host spans of the same run.
+
+Process-wide contract: the module holds one global instance, DISABLED by
+default — every probe site (ledgers, prefetch producer, async
+checkpointer, serve engine) checks ``enabled`` and costs one attribute
+read when off, so telemetry off is invisible: no files, no extra
+columns, bitwise-identical metrics (the tier-1 pin in
+tests/test_telemetry.py). ``configure(trace_dir=...)`` swaps in a fresh
+enabled instance (``cli train --trace_dir=...``,
+``cli serve-bench --trace_dir=...``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Tuple
+
+TELEMETRY_JSONL = "telemetry.jsonl"
+CHROME_TRACE = "trace.json"
+# the device-trace alignment marker protocol — ONE copy of the schema,
+# shared by Telemetry.device_trace and the training loop's split
+# start/stop sites (and whatever trace_report learns to read later)
+DEVICE_TRACE_START = "device_trace_start"
+DEVICE_TRACE_STOP = "device_trace_stop"
+PROFILER_CAT = "profiler"
+
+
+class Histogram:
+    """Streaming log-bucket histogram: quantiles without sample retention.
+
+    Observations land in geometric buckets ``[G**i, G**(i+1))`` with
+    ``G = 2**(1/8)``; a quantile is answered at its bucket's geometric
+    midpoint (clamped to the observed min/max), giving <=~4.5% relative
+    error at any stream length with O(#occupied buckets) memory —
+    the HdrHistogram idea, sized for second-scale latencies down to
+    microseconds. ``count``/``total``/``min``/``max`` are exact.
+    Non-positive observations (clock underflow on a zero-length wait)
+    count into a dedicated zero bucket that quantile answers as 0.0.
+
+    Not internally locked — :class:`Telemetry` serializes access.
+    """
+
+    GROWTH = 2.0 ** 0.125
+    _LOG_G = math.log(GROWTH)
+
+    __slots__ = ("count", "total", "vmin", "vmax", "_buckets", "_zero")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+        self._buckets: Dict[int, int] = {}
+        self._zero = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self.count += 1
+        self.total += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+        if v <= 0.0:
+            self._zero += 1
+            return
+        i = int(math.floor(math.log(v) / self._LOG_G))
+        self._buckets[i] = self._buckets.get(i, 0) + 1
+
+    def quantile(self, q: float) -> float:
+        """Approximate ``q``-quantile (``q`` in [0, 1]) of the stream."""
+        if self.count == 0:
+            return 0.0
+        rank = q * (self.count - 1)  # np.percentile's 'linear' rank
+        cum = self._zero
+        if rank < cum:
+            return 0.0
+        for i in sorted(self._buckets):
+            cum += self._buckets[i]
+            if rank < cum:
+                mid = self.GROWTH ** (i + 0.5)
+                return min(max(mid, self.vmin), self.vmax)
+        return self.vmax
+
+    def summary(self) -> Dict[str, float]:
+        if self.count == 0:
+            return {"count": 0, "mean": 0.0, "min": 0.0, "max": 0.0,
+                    "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        return {"count": self.count,
+                "mean": self.total / self.count,
+                "min": self.vmin, "max": self.vmax,
+                "p50": self.quantile(0.50),
+                "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+
+class _SpanCtx:
+    """Context manager returned by :meth:`Telemetry.span`; times the
+    block with ``perf_counter`` and records on exit (exceptions
+    included — the span still closes, Chrome traces stay well-formed)."""
+
+    __slots__ = ("_tel", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, tel: "Telemetry", name: str, cat: str,
+                 args: Optional[dict]):
+        self._tel = tel
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self._tel.emit_span(self._name, self._cat, self._t0,
+                            time.perf_counter(), self._args)
+
+
+class _NullCtx:
+    """Reusable no-op context: what a disabled core hands out, so the
+    off path allocates nothing and times nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc_info):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+class Telemetry:
+    """Thread-safe process-wide telemetry core (see module docstring).
+
+    All mutation goes through one lock; every probe first checks
+    :attr:`enabled` so a disabled core costs one attribute read per
+    probe site. Timestamps are ``time.perf_counter()`` seconds relative
+    to the instance's construction (``origin_perf``); ``origin_unix``
+    (wall clock at construction) rides in the export meta so events can
+    be correlated with log lines.
+    """
+
+    def __init__(self, capacity: int = 1 << 16, enabled: bool = True,
+                 trace_dir: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.enabled = enabled
+        self.capacity = capacity
+        self.trace_dir = trace_dir
+        self.dropped = 0
+        self.origin_perf = time.perf_counter()
+        self.origin_unix = time.time()
+        self._lock = threading.Lock()
+        self._events: deque = deque()
+        # exact per-(cat, name) span aggregates, independent of the ring
+        self._agg: Dict[Tuple[str, str], List[float]] = {}
+        self._counters: Dict[Tuple[str, str], float] = {}
+        self._hists: Dict[Tuple[str, str], Histogram] = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, cat: str = "host",
+             args: Optional[dict] = None):
+        """Context manager timing a block as one span (no-op when
+        disabled)."""
+        if not self.enabled:
+            return _NULL_CTX
+        return _SpanCtx(self, name, cat, args)
+
+    def emit_span(self, name: str, cat: str, t0: float, t1: float,
+                  args: Optional[dict] = None) -> None:
+        """Record an already-timed span (``t0``/``t1`` from
+        ``perf_counter``) — the path the ledger views use, so THEIR
+        accumulation and the core's see the identical ``t1 - t0``."""
+        if not self.enabled:
+            return
+        dur = t1 - t0
+        ev = {"type": "span", "name": name, "cat": cat,
+              "ts": t0 - self.origin_perf, "dur": dur,
+              "tid": threading.current_thread().name}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            rec = self._agg.setdefault((cat, name), [0, 0.0])
+            rec[0] += 1
+            rec[1] += dur
+            self._append(ev)
+
+    def instant(self, name: str, cat: str = "host",
+                args: Optional[dict] = None,
+                ts: Optional[float] = None) -> None:
+        """Record a zero-duration marker event (e.g. request enqueue)."""
+        if not self.enabled:
+            return
+        t = (time.perf_counter() if ts is None else ts) - self.origin_perf
+        ev = {"type": "instant", "name": name, "cat": cat, "ts": t,
+              "tid": threading.current_thread().name}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._append(ev)
+
+    def counter(self, name: str, delta: float = 1.0,
+                cat: str = "host") -> None:
+        """Increment a monotonic counter; the ring records the new
+        total (a Chrome counter track of the running value)."""
+        if not self.enabled:
+            return
+        ts = time.perf_counter() - self.origin_perf
+        with self._lock:
+            total = self._counters.get((cat, name), 0.0) + delta
+            self._counters[(cat, name)] = total
+            self._append({"type": "counter", "name": name, "cat": cat,
+                          "ts": ts, "value": total})
+
+    def gauge(self, name: str, value: float, cat: str = "host",
+              ts: Optional[float] = None) -> None:
+        """Sample an instantaneous value (e.g. live serve slots); the
+        latest sample is also kept under counters for snapshots."""
+        if not self.enabled:
+            return
+        t = (time.perf_counter() if ts is None else ts) - self.origin_perf
+        with self._lock:
+            self._counters[(cat, name)] = float(value)
+            self._append({"type": "counter", "name": name, "cat": cat,
+                          "ts": t, "value": float(value)})
+
+    def observe(self, name: str, value: float, cat: str = "host") -> None:
+        """Feed one observation into the named streaming histogram."""
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self._hists.get((cat, name))
+            if h is None:
+                h = self._hists[(cat, name)] = Histogram()
+            h.observe(value)
+
+    def _append(self, ev: dict) -> None:
+        # caller holds the lock
+        if len(self._events) >= self.capacity:
+            self._events.popleft()
+            self.dropped += 1
+        self._events.append(ev)
+
+    # -- reading -----------------------------------------------------------
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def aggregates(self) -> Dict[Tuple[str, str], Tuple[int, float]]:
+        """Exact span (count, total_s) per (category, name)."""
+        with self._lock:
+            return {k: (int(v[0]), float(v[1]))
+                    for k, v in self._agg.items()}
+
+    def counters(self) -> Dict[Tuple[str, str], float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def histogram(self, name: str, cat: str = "host"
+                  ) -> Optional[Dict[str, float]]:
+        """Live summary of one streaming histogram (None if unseen)."""
+        with self._lock:
+            h = self._hists.get((cat, name))
+            return None if h is None else h.summary()
+
+    # -- exporters ---------------------------------------------------------
+
+    def export_jsonl(self, path: str) -> None:
+        """Write the newline-JSONL event stream: one meta line, the ring
+        events in record order, then ``agg``/``counter_total``/``hist``
+        summary lines (exact even when the ring dropped events)."""
+        with self._lock:
+            events = list(self._events)
+            agg = {k: list(v) for k, v in self._agg.items()}
+            counters = dict(self._counters)
+            hists = {k: h.summary() for k, h in self._hists.items()}
+            dropped = self.dropped
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "type": "meta", "origin_unix": self.origin_unix,
+                "pid": os.getpid(), "capacity": self.capacity,
+                "dropped": dropped}) + "\n")
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+            for (cat, name), (n, total) in sorted(agg.items()):
+                f.write(json.dumps({
+                    "type": "agg", "cat": cat, "name": name,
+                    "count": int(n), "total_s": total}) + "\n")
+            for (cat, name), v in sorted(counters.items()):
+                f.write(json.dumps({
+                    "type": "counter_total", "cat": cat, "name": name,
+                    "value": v}) + "\n")
+            for (cat, name), s in sorted(hists.items()):
+                f.write(json.dumps({
+                    "type": "hist", "cat": cat, "name": name, **s}) + "\n")
+
+    def export_chrome_trace(self, path: str) -> None:
+        """Write a Chrome-trace ``traceEvents`` JSON (chrome://tracing /
+        Perfetto). Spans -> ``X`` complete events, instants -> ``i``,
+        counters/gauges -> ``C`` tracks; threads get name metadata."""
+        events = self.events()
+        pid = os.getpid()
+        tids: Dict[str, int] = {}
+        out: List[dict] = []
+
+        def tid_of(name: str) -> int:
+            if name not in tids:
+                tids[name] = len(tids)
+                out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                            "tid": tids[name],
+                            "args": {"name": name}})
+            return tids[name]
+
+        for ev in events:
+            ts_us = ev["ts"] * 1e6
+            if ev["type"] == "span":
+                rec = {"ph": "X", "name": ev["name"], "cat": ev["cat"],
+                       "pid": pid, "tid": tid_of(ev["tid"]),
+                       "ts": ts_us, "dur": ev["dur"] * 1e6}
+                if "args" in ev:
+                    rec["args"] = ev["args"]
+                out.append(rec)
+            elif ev["type"] == "instant":
+                rec = {"ph": "i", "name": ev["name"], "cat": ev["cat"],
+                       "pid": pid, "tid": tid_of(ev["tid"]),
+                       "ts": ts_us, "s": "t"}
+                if "args" in ev:
+                    rec["args"] = ev["args"]
+                out.append(rec)
+            elif ev["type"] == "counter":
+                out.append({"ph": "C", "name": ev["name"],
+                            "cat": ev["cat"], "pid": pid, "tid": 0,
+                            "ts": ts_us,
+                            "args": {ev["name"]: ev["value"]}})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+
+    def export(self, trace_dir: Optional[str] = None) -> Dict[str, str]:
+        """Write both exporters into ``trace_dir`` (default: the
+        configured one); returns ``{"jsonl": path, "chrome": path}``."""
+        d = trace_dir or self.trace_dir
+        if not d:
+            raise ValueError("no trace_dir configured or given")
+        os.makedirs(d, exist_ok=True)
+        paths = {"jsonl": os.path.join(d, TELEMETRY_JSONL),
+                 "chrome": os.path.join(d, CHROME_TRACE)}
+        self.export_jsonl(paths["jsonl"])
+        self.export_chrome_trace(paths["chrome"])
+        return paths
+
+    # -- device-trace alignment -------------------------------------------
+
+    @contextlib.contextmanager
+    def device_trace(self, subdir: str = "device") -> Iterator[None]:
+        """Capture a ``jax.profiler`` device trace into
+        ``<trace_dir>/<subdir>`` with instant markers in the host stream
+        at start/stop, so the XProf timeline aligns with the host spans
+        of the same run. No-op when disabled or without a trace_dir."""
+        if not (self.enabled and self.trace_dir):
+            yield
+            return
+        import jax
+
+        logdir = os.path.join(self.trace_dir, subdir)
+        self.instant(DEVICE_TRACE_START, cat=PROFILER_CAT,
+                     args={"logdir": logdir})
+        jax.profiler.start_trace(logdir)
+        try:
+            yield
+        finally:
+            jax.profiler.stop_trace()
+            self.instant(DEVICE_TRACE_STOP, cat=PROFILER_CAT)
+
+
+# -- the process-wide instance ----------------------------------------------
+
+_global = Telemetry(enabled=False)
+
+
+def get_telemetry() -> Telemetry:
+    """The process-wide core. Disabled (and empty) unless
+    :func:`configure` ran; probe sites resolve it at call time so a
+    late ``configure`` still catches every subsystem."""
+    return _global
+
+
+def configure(trace_dir: Optional[str] = None,
+              capacity: int = 1 << 16) -> Telemetry:
+    """Swap in a FRESH enabled core (old events do not leak across
+    runs) writing into ``trace_dir``; returns it."""
+    global _global
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+    _global = Telemetry(capacity=capacity, enabled=True,
+                        trace_dir=trace_dir)
+    return _global
+
+
+def disable() -> None:
+    """Restore the disabled default (tests; end of a traced run)."""
+    global _global
+    _global = Telemetry(enabled=False)

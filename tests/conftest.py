@@ -25,6 +25,18 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _hermetic_telemetry():
+    """The telemetry core is process-global (ISSUE 6) and DISABLED by
+    default; a test that configures it (trace_dir runs) must not leak
+    an enabled core into later tests — the off-by-default invisibility
+    contract is itself under test."""
+    yield
+    from sketch_rnn_tpu.utils import telemetry
+
+    telemetry.disable()
+
+
+@pytest.fixture(autouse=True)
 def _hermetic_bench_history(tmp_path, monkeypatch):
     """Tests must never append to the repo's COMMITTED bench history
     files — the r5 review found test-suite smoke rows accumulated in

@@ -444,6 +444,36 @@ def test_bench_summary_bucket_stacked_columns(tmp_path, capsys):
     assert "stacked=" not in without
 
 
+def test_bench_summary_serve_rows_with_latency_percentiles(tmp_path,
+                                                           capsys):
+    """ISSUE 6 satellite: serve_bench rows surface with sketches/sec,
+    the p50/p95/p99 latency columns (the SLA surface) and the speedup
+    over the legacy sampler; rows predating the percentile keys still
+    print, just without the latency block; distinct (B, K, n, dist)
+    configs key separately."""
+    from scripts import bench_summary
+
+    hist = tmp_path / "h.jsonl"
+    row = {"kind": "serve_bench", "dec_model": "lstm", "slots": 32,
+           "chunk": 8, "n_requests": 512, "len_dist": "bimodal",
+           "device_kind": "cpu", "engine_sketches_per_sec": 61.5,
+           "engine_latency_p50_s": 0.120, "engine_latency_p95_s": 0.480,
+           "engine_latency_p99_s": 0.910, "speedup": 2.41}
+    legacy = {k: v for k, v in row.items()
+              if not k.startswith("engine_latency")}
+    legacy.update(slots=64, engine_sketches_per_sec=50.0)
+    _write_hist(hist, [row, legacy])
+    assert bench_summary.main([str(hist)]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 2  # B=32 and B=64 key separately
+    full = next(l for l in lines if "B=32" in l)
+    assert "61.50 sk/s" in full
+    assert "lat[ms] 120/480/910" in full
+    assert "2.41x vs sampler" in full
+    old = next(l for l in lines if "B=64" in l)
+    assert "lat[ms]" not in old
+
+
 def test_bench_train_rejects_non_divisible_steps():
     """ADVICE r2: steps % steps_per_call != 0 must raise, not silently
     run fewer optimizer steps while computing throughput over `steps`."""

@@ -105,11 +105,14 @@ def test_cli_rejects_unknown_hparam(tmp_path):
 
 def test_cli_serve_bench_random_init(tmp_path, capsys):
     """serve-bench without a checkpoint: random init, JSON metrics out,
-    per-request JSONL written into the workdir."""
+    per-request JSONL written into the workdir — and with --trace_dir
+    (ISSUE 6) a telemetry JSONL + Chrome trace whose event-derived
+    latency percentiles match the engine summary."""
     wd = str(tmp_path / "serve_wd")
+    td = str(tmp_path / "serve_trace")
     assert main(["serve-bench", "--random_init", "-n", "6",
                  "--slots", "3", "--chunk", "2", "--log_metrics",
-                 f"--workdir={wd}",
+                 f"--workdir={wd}", f"--trace_dir={td}",
                  f"--hparams={HP},serve_slots=3,serve_chunk=2"]) == 0
     rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rep["kind"] == "serve_bench_cli"
@@ -120,6 +123,16 @@ def test_cli_serve_bench_random_init(tmp_path, capsys):
     assert os.path.exists(os.path.join(wd, "serve_metrics.jsonl"))
     with open(os.path.join(wd, "serve_metrics.jsonl")) as f:
         assert len(f.readlines()) == 6
+    # telemetry export: chrome trace loads; trace_report's exact
+    # per-request percentiles reconcile with the printed summary
+    assert json.load(open(os.path.join(td, "trace.json")))["traceEvents"]
+    from scripts import trace_report
+    rr = trace_report.report(trace_report.load(td))
+    lat = {r["metric"]: r for r in rr["latency"]}
+    assert lat["latency_s"]["count"] == 6
+    for p in (50, 95, 99):
+        assert round(lat["latency_s"][f"p{p}_s"], 6) == \
+            rep[f"latency_p{p}_s"]
 
 
 def test_graft_entry_compiles():

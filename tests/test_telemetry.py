@@ -1,0 +1,430 @@
+"""Telemetry runtime tests (ISSUE 6).
+
+Two load-bearing contracts:
+
+1. **Off is invisible** (the default): a telemetry-off train smoke
+   produces metrics rows key-for-key identical to the pre-PR schema,
+   with every deterministic column bitwise equal to a traced run's —
+   tracing can never change what is trained or logged, only observe it.
+2. **Views reconcile**: the ledgers (SpanTimer/GoodputLedger/
+   PaddingLedger) keep their exact public ``window()``/``summary()``
+   behavior while mirroring into the process core, whose exported
+   totals equal the ledger totals (same floats, same order).
+"""
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.utils import telemetry as tele
+from sketch_rnn_tpu.utils.profiling import (
+    GoodputLedger,
+    PaddingLedger,
+    SpanTimer,
+)
+from sketch_rnn_tpu.utils.telemetry import Histogram, Telemetry
+
+# keep in sync with tests/test_train.py TINY so jitted train steps are
+# shared through the process-wide executable cache across test modules
+TINY = dict(batch_size=16, max_seq_len=32, enc_rnn_size=16, dec_rnn_size=24,
+            z_size=8, num_mixture=3, hyper_rnn_size=8, hyper_embed_size=4)
+
+# the pre-PR train-smoke CSV schema for the TINY config (captured at the
+# PR-5 tree): telemetry-off runs must reproduce it KEY-FOR-KEY — new
+# telemetry may never leak columns into the default metrics contract
+PRE_PR_HEADER = [
+    "step", "wall_time", "bucket_T32_n", "dispatches_saved", "grad_norm",
+    "kl", "kl_raw", "kl_weight", "loss", "lr", "mean_run_len",
+    "offset_nll", "padded_frac", "pen_ce", "recon", "runs_per_epoch",
+    "steps_per_sec", "strokes_per_sec", "strokes_per_sec_per_chip",
+    "t_ckpt_wait_s", "t_dispatch_s", "t_eval_s", "t_feeder_wait_s",
+    "t_metrics_drain_s",
+]
+
+
+def tiny_hps(**kw) -> HParams:
+    return HParams(**{**TINY, **kw})
+
+
+def make_loader(hps, n=64, seed=0):
+    from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+
+    seqs, labels = make_synthetic_strokes(
+        n, num_classes=max(hps.num_classes, 1),
+        min_len=10, max_len=hps.max_seq_len - 2, seed=seed)
+    return DataLoader(seqs, hps, labels=labels, seed=seed)
+
+
+# -- histogram ---------------------------------------------------------------
+
+
+def test_histogram_streaming_quantiles_within_bucket_error():
+    """Log-bucket quantiles track np.percentile within the geometric
+    bucket's relative error bound (~4.5%), with exact count/mean/
+    min/max — at any scale (microseconds to seconds)."""
+    rng = np.random.default_rng(0)
+    for scale in (1e-6, 1e-3, 10.0):
+        xs = rng.lognormal(mean=0.0, sigma=1.0, size=5000) * scale
+        h = Histogram()
+        for x in xs:
+            h.observe(float(x))
+        s = h.summary()
+        assert s["count"] == 5000
+        assert s["mean"] == pytest.approx(xs.mean())
+        assert s["min"] == xs.min() and s["max"] == xs.max()
+        for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+            exact = np.percentile(xs, q)
+            assert s[key] == pytest.approx(exact, rel=0.05), (scale, q)
+
+
+def test_histogram_empty_zero_and_singleton():
+    h = Histogram()
+    assert h.summary() == {"count": 0, "mean": 0.0, "min": 0.0,
+                           "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+    h.observe(0.0)   # clock underflow on a zero-length wait
+    h.observe(-1e-9)
+    assert h.quantile(0.5) == 0.0
+    h2 = Histogram()
+    h2.observe(0.25)
+    # a single observation answers every quantile with (clamped) itself
+    assert h2.quantile(0.0) == h2.quantile(0.99) == 0.25
+
+
+# -- core recording ----------------------------------------------------------
+
+
+def test_disabled_core_records_nothing_and_is_default():
+    tel = tele.get_telemetry()
+    assert not tel.enabled  # process default: off
+    with tel.span("x", cat="t"):
+        pass
+    tel.counter("c")
+    tel.gauge("g", 3)
+    tel.observe("h", 0.5)
+    tel.instant("i")
+    assert tel.events() == []
+    assert tel.aggregates() == {} and tel.counters() == {}
+    assert tel.histogram("h") is None
+
+
+def test_span_agg_counter_gauge_instant_roundtrip():
+    tel = Telemetry()
+    with tel.span("work", cat="train", args={"k": 1}):
+        pass
+    tel.counter("n_batches", 2.0, cat="data")
+    tel.counter("n_batches", 3.0, cat="data")
+    tel.gauge("slots_live", 7, cat="serve")
+    tel.instant("enqueue", cat="serve", args={"uid": 4})
+    evs = tel.events()
+    assert [e["type"] for e in evs] == ["span", "counter", "counter",
+                                       "counter", "instant"]
+    span = evs[0]
+    assert span["name"] == "work" and span["cat"] == "train"
+    assert span["dur"] >= 0 and span["args"] == {"k": 1}
+    assert span["tid"] == threading.current_thread().name
+    # counters accumulate; the ring records the running total
+    assert tel.counters()[("data", "n_batches")] == 5.0
+    assert evs[2]["value"] == 5.0
+    # gauges record the sample itself
+    assert tel.counters()[("serve", "slots_live")] == 7.0
+    (count, total) = tel.aggregates()[("train", "work")]
+    assert count == 1 and total == span["dur"]
+
+
+def test_ring_buffer_bounded_but_aggregates_exact():
+    tel = Telemetry(capacity=10)
+    for i in range(25):
+        tel.emit_span("s", "c", 0.0, 1.0)
+    assert len(tel.events()) == 10
+    assert tel.dropped == 15
+    # the agg store is independent of the ring: totals stay exact
+    assert tel.aggregates()[("c", "s")] == (25, 25.0)
+
+
+def test_core_thread_safety_under_concurrent_emission():
+    tel = Telemetry(capacity=1 << 14)
+    n, threads = 500, 8
+
+    def work(t):
+        for i in range(n):
+            with tel.span("s", cat="x"):
+                pass
+            tel.counter("c", 1.0, cat="x")
+            tel.observe("h", 0.001 * (i + 1), cat="x")
+
+    ts = [threading.Thread(target=work, args=(t,)) for t in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert tel.aggregates()[("x", "s")][0] == n * threads
+    assert tel.counters()[("x", "c")] == n * threads
+    assert tel.histogram("h", cat="x")["count"] == n * threads
+
+
+def test_configure_swaps_in_fresh_core(tmp_path):
+    a = tele.configure(trace_dir=str(tmp_path))
+    with a.span("old"):
+        pass
+    b = tele.configure(trace_dir=str(tmp_path))
+    assert tele.get_telemetry() is b and b.events() == []  # no leak
+    tele.disable()
+    assert not tele.get_telemetry().enabled
+
+
+# -- exporters ---------------------------------------------------------------
+
+
+def _populated_core(tmp_path) -> Telemetry:
+    tel = tele.configure(trace_dir=str(tmp_path))
+    with tel.span("dispatch", cat="train"):
+        pass
+    tel.gauge("slots_live", 3, cat="serve")
+    tel.instant("complete", cat="serve", args={"uid": 0, "latency_s": 0.5})
+    tel.observe("latency_s", 0.5, cat="serve")
+    return tel
+
+
+def test_export_jsonl_schema(tmp_path):
+    tel = _populated_core(tmp_path)
+    paths = tel.export()
+    lines = [json.loads(l) for l in open(paths["jsonl"])]
+    assert lines[0]["type"] == "meta"
+    assert lines[0]["dropped"] == 0 and lines[0]["pid"] == os.getpid()
+    types = [l["type"] for l in lines]
+    assert types.count("span") == 1 and types.count("instant") == 1
+    agg = next(l for l in lines if l["type"] == "agg")
+    assert (agg["cat"], agg["name"], agg["count"]) == ("train",
+                                                      "dispatch", 1)
+    hist = next(l for l in lines if l["type"] == "hist")
+    assert hist["name"] == "latency_s" and hist["count"] == 1
+
+
+def test_export_chrome_trace_loads_and_is_wellformed(tmp_path):
+    tel = _populated_core(tmp_path)
+    paths = tel.export()
+    doc = json.load(open(paths["chrome"]))
+    evs = doc["traceEvents"]
+    assert evs, "empty trace"
+    phases = {e["ph"] for e in evs}
+    assert {"X", "C", "i", "M"} <= phases
+    for e in evs:
+        assert "pid" in e and "tid" in e and "name" in e
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and "ts" in e
+    # thread-name metadata makes named tracks in Perfetto
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert any(e["args"]["name"] == threading.current_thread().name
+               for e in meta)
+
+
+def test_device_trace_noop_when_disabled_or_dirless(tmp_path):
+    with tele.get_telemetry().device_trace():   # disabled: pure no-op
+        pass
+    tel = Telemetry(enabled=True, trace_dir=None)
+    with tel.device_trace():                    # no dir: no-op
+        pass
+    assert tel.events() == []
+
+
+# -- ledger views ------------------------------------------------------------
+
+
+def test_span_timer_emits_into_core_and_totals_reconcile(tmp_path):
+    tel = tele.configure(trace_dir=str(tmp_path))
+    st = SpanTimer(category="serve")
+    for _ in range(5):
+        with st.span("fetch"):
+            pass
+    with st.span("collect"):
+        pass
+    agg = tel.aggregates()
+    local = st.summary()
+    for name in ("fetch", "collect"):
+        count, total = agg[("serve", name)]
+        assert count == local[name]["count"]
+        # identical floats accumulated in identical order: the exported
+        # totals equal the ledger totals exactly (rounding aside)
+        assert round(total, 6) == local[name]["total_s"]
+
+
+def test_goodput_ledger_reconciles_and_rows_unchanged(tmp_path):
+    tel = tele.configure(trace_dir=str(tmp_path))
+    led = GoodputLedger(("dispatch", "ckpt_wait"))
+    import time
+    with led.span("dispatch"):
+        time.sleep(0.002)
+    with led.span("eval"):
+        pass
+    # row contract unchanged under telemetry: pre-declared + fired
+    w = led.window()
+    assert set(w) == {"t_dispatch_s", "t_ckpt_wait_s", "t_eval_s"}
+    s = led.summary()
+    for name in ("dispatch", "eval"):
+        count, total = tel.aggregates()[("train", name)]
+        assert count == s[name]["count"]
+        assert round(total, 6) == s[name]["total_s"]
+    # phases with no closed span (ckpt_wait) never hit the core
+    assert ("train", "ckpt_wait") not in tel.aggregates()
+
+
+def test_goodput_ledger_values_identical_with_telemetry_off():
+    """The view must not change ledger math: a ledger driven with the
+    core disabled accumulates the same structure it always did."""
+    assert not tele.get_telemetry().enabled
+    led = GoodputLedger(("dispatch",))
+    with led.span("dispatch"):
+        pass
+    s = led.summary()
+    assert set(s) == {"dispatch"}
+    assert s["dispatch"]["count"] == 1
+
+
+def test_padding_ledger_routes_counters_through_core(tmp_path):
+    tel = tele.configure(trace_dir=str(tmp_path))
+    led = PaddingLedger(edges=(16, 32))
+    led.record(16, rows=4, true_steps=40)
+    led.record(32, rows=4, true_steps=100)
+    led.record_dispatch(4, 1)
+    led.note_epoch_plan(3, 24)
+    c = tel.counters()
+    assert c[("data", "dispatched_timesteps")] == 4 * 16 + 4 * 32
+    assert c[("data", "true_timesteps")] == 140
+    assert c[("data", "bucket_T16_n")] == 1
+    assert c[("data", "micro_steps")] == 4
+    assert c[("data", "dispatches")] == 1
+    assert c[("data", "runs_per_epoch")] == 3
+    # the ledger's own window is untouched by the mirroring
+    w = led.window()
+    assert w["padded_frac"] == pytest.approx(1 - 140 / 192, abs=1e-6)
+    assert w["dispatches_saved"] == 3
+
+
+# -- train integration: off is invisible, on exports --------------------------
+
+
+def _run_smoke(tmp_path, name, trace_dir):
+    from sketch_rnn_tpu.train.loop import train
+
+    hps = tiny_hps(num_steps=4, log_every=2, save_every=10**9,
+                   eval_every=10**9)
+    d = str(tmp_path / name)
+    train(hps, make_loader(hps), workdir=d, use_mesh=False,
+          resume=False, trace_dir=trace_dir)
+    import csv
+    with open(os.path.join(d, "train_metrics.csv")) as f:
+        header = next(csv.reader(f))
+    with open(os.path.join(d, "train_metrics.jsonl")) as f:
+        rows = [json.loads(l) for l in f]
+    return header, rows
+
+
+def test_telemetry_off_train_smoke_bitwise_invisible(tmp_path):
+    """THE tier-1 invisibility pin: the default (telemetry-off) smoke
+    reproduces the pre-PR CSV schema key-for-key, every deterministic
+    column is bitwise identical to a traced run of the same seed, and
+    no telemetry file appears anywhere in the off run's workdir."""
+    header_off, rows_off = _run_smoke(tmp_path, "off", None)
+    trace_dir = str(tmp_path / "trace")
+    header_on, rows_on = _run_smoke(tmp_path, "on", trace_dir)
+
+    assert header_off == PRE_PR_HEADER     # schema pinned to pre-PR
+    assert header_on == PRE_PR_HEADER      # tracing adds NO columns
+    assert not any("telemetry" in f or f == "trace.json"
+                   for f in os.listdir(tmp_path / "off"))
+    assert os.path.exists(os.path.join(trace_dir, "telemetry.jsonl"))
+    assert os.path.exists(os.path.join(trace_dir, "trace.json"))
+
+    # every non-wall-clock column bitwise equal between off and on
+    timing = {"wall_time", "steps_per_sec", "strokes_per_sec",
+              "strokes_per_sec_per_chip"}
+    assert len(rows_off) == len(rows_on) == 2
+    for ro, rn in zip(rows_off, rows_on):
+        assert set(ro) == set(rn)
+        for k, v in ro.items():
+            if k in timing or k.startswith("t_"):
+                continue
+            assert v == rn[k], k
+
+
+def test_traced_train_run_exports_wellformed_and_reconciles(tmp_path):
+    """A --trace_dir train smoke emits a JSONL whose exact span totals
+    match the summed t_<phase>_s CSV columns (the GoodputLedger window
+    stream) for phases fully covered by windows, and a Chrome trace
+    that loads with span/counter events on named threads."""
+    trace_dir = str(tmp_path / "trace")
+    _, rows = _run_smoke(tmp_path, "run", trace_dir)
+
+    lines = [json.loads(l) for l in open(
+        os.path.join(trace_dir, "telemetry.jsonl"))]
+    agg = {(l["cat"], l["name"]): l for l in lines if l["type"] == "agg"}
+    # dispatch/feeder_wait spans all close before their window is read,
+    # so CSV window sums == exported exact totals (within the 6-dp
+    # rounding of each window value)
+    for phase in ("dispatch", "feeder_wait"):
+        csv_sum = sum(r[f"t_{phase}_s"] for r in rows)
+        assert agg[("train", phase)]["total_s"] == pytest.approx(
+            csv_sum, abs=1e-5)
+    # the feeder thread's assembly spans ride under cat "data" from the
+    # producer thread — visible as a separate named track
+    assert ("data", "assemble") in agg
+    span_tids = {e["tid"] for e in lines if e.get("type") == "span"}
+    assert "batch-prefetch" in span_tids
+
+    doc = json.load(open(os.path.join(trace_dir, "trace.json")))
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+
+def test_traced_serve_run_live_histograms_and_events(tmp_path):
+    """Per-request serving telemetry streams LIVE: during/after a run
+    the core's histograms hold every completed request, and the event
+    stream carries the full enqueue -> admit -> complete lifecycle
+    with exact latencies in the complete args."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import Request, ServeEngine
+
+    hps = tiny_hps(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+                   dec_rnn_size=16, z_size=6, serve_slots=4,
+                   serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    eng = ServeEngine(model, hps, params)
+
+    def req(i, cap):
+        rng = np.random.default_rng(i)
+        return Request(key=jax.random.key(1000 + i),
+                       z=rng.standard_normal(hps.z_size).astype(np.float32),
+                       temperature=0.8, max_len=cap)
+
+    reqs = [req(i, 4 + (3 * i) % 15) for i in range(10)]
+    tel = tele.configure(trace_dir=str(tmp_path))
+    out = eng.run(list(reqs))
+    m = out["metrics"]
+
+    h = tel.histogram("latency_s", cat="serve")
+    assert h["count"] == 10
+    assert h["p50"] == pytest.approx(m["latency_p50_s"], rel=0.10)
+    evs = tel.events()
+    names = [e["name"] for e in evs if e["type"] == "instant"]
+    assert names.count("enqueue") == 10
+    assert names.count("admit") == 10
+    assert names.count("complete") == 10
+    comp = {e["args"]["uid"]: e["args"] for e in evs
+            if e["type"] == "instant" and e["name"] == "complete"}
+    by_uid = {r.uid: r for r in out["results"]}
+    for uid, r in by_uid.items():
+        assert comp[uid]["latency_s"] == r.latency_s
+        assert comp[uid]["steps"] == r.steps
+    # exact percentiles recomputed from events match run()'s summary
+    lats = np.array([c["latency_s"] for c in comp.values()])
+    assert round(float(np.percentile(lats, 99)), 6) == m["latency_p99_s"]
+    # occupancy gauge sampled once per collected chunk
+    gauges = [e for e in evs if e["type"] == "counter"
+              and e["name"] == "slots_live"]
+    assert gauges and all(0 <= g["value"] <= hps.serve_slots
+                          for g in gauges)

@@ -1,0 +1,170 @@
+"""trace_report.py tests (ISSUE 6 CI satellite).
+
+The report is the human surface of the telemetry contract, so its
+numbers must RECONCILE with the authoritative sources: span-breakdown
+totals with ``GoodputLedger.summary()`` (within rounding), and the
+serve latency table with ``ServeEngine.run()``'s summary dict
+(exactly — same ``np.percentile`` over the same floats).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts import trace_report
+from sketch_rnn_tpu.utils import telemetry as tele
+from sketch_rnn_tpu.utils.profiling import GoodputLedger
+
+
+def test_report_smoke_on_generated_jsonl(tmp_path, capsys):
+    """End-to-end smoke: build a core, export, run main() — tables for
+    spans, occupancy and latency all render; --json round-trips."""
+    tel = tele.configure(trace_dir=str(tmp_path))
+    with tel.span("dispatch", cat="train"):
+        time.sleep(0.001)
+    for i, v in enumerate((2, 4, 3, 4)):
+        tel.gauge("slots_live", v, cat="serve", ts=tel.origin_perf + i)
+    for uid, lat in enumerate((0.2, 0.4, 0.9)):
+        tel.instant("complete", cat="serve",
+                    args={"uid": uid, "queue_wait_s": lat / 4,
+                          "decode_s": lat / 2, "latency_s": lat})
+        tel.observe("latency_s", lat, cat="serve")
+    paths = tel.export()
+
+    assert trace_report.main([paths["jsonl"]]) == 0
+    out = capsys.readouterr().out
+    assert "span breakdown" in out and "dispatch" in out
+    assert "slot occupancy" in out and "latency percentiles" in out
+
+    # dir form + --json
+    assert trace_report.main([str(tmp_path), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["occupancy"]["max"] == 4.0
+    assert rep["occupancy"]["mean"] == pytest.approx(13 / 4)
+    lat = {r["metric"]: r for r in rep["latency"]}
+    assert lat["latency_s"]["count"] == 3
+    assert lat["latency_s"]["p50_s"] == pytest.approx(0.4)
+    # streaming-histogram approximations ride along
+    assert lat["latency_s"]["hist_p50_s"] == pytest.approx(0.4, rel=0.05)
+
+
+def test_span_breakdown_reconciles_with_goodput_ledger(tmp_path):
+    """THE stall-attribution acceptance: per-phase totals printed from
+    the JSONL equal GoodputLedger.summary()'s totals within rounding
+    (identical floats accumulated in identical order on both sides)."""
+    tel = tele.configure(trace_dir=str(tmp_path))
+    led = GoodputLedger(("dispatch", "feeder_wait", "ckpt_wait"))
+    for _ in range(3):
+        with led.span("dispatch"):
+            time.sleep(0.001)
+    with led.span("feeder_wait"):
+        pass
+    with led.span("eval"):
+        time.sleep(0.001)
+    paths = tel.export()
+
+    rows = {(r["cat"], r["name"]): r
+            for r in trace_report.span_breakdown(trace_report.load(
+                paths["jsonl"]))}
+    s = led.summary()
+    fired = {k: v for k, v in s.items() if v["count"]}
+    assert set(fired) == {n for (c, n) in rows if c == "train"}
+    for name, rec in fired.items():
+        row = rows[("train", name)]
+        assert row["count"] == rec["count"]
+        assert row["total_s"] == pytest.approx(rec["total_s"], abs=1e-6)
+        # ring events present -> per-event sum agrees with the agg line
+        assert row["event_total_s"] == pytest.approx(row["total_s"],
+                                                     abs=1e-9)
+
+
+def test_load_tolerates_torn_tail_and_junk_lines(tmp_path):
+    tel = tele.configure(trace_dir=str(tmp_path))
+    with tel.span("x", cat="t"):
+        pass
+    paths = tel.export()
+    with open(paths["jsonl"], "a") as f:
+        f.write("not json at all\n")
+        f.write('{"type": "span", "name": "torn…')  # killed mid-write
+    data = trace_report.load(paths["jsonl"])
+    assert ("t", "x") in data["agg"]
+    assert all(e["name"] != "torn…" for e in data["events"])
+
+
+def test_report_warns_on_ring_drops(tmp_path, capsys):
+    tel = tele.configure(trace_dir=str(tmp_path), capacity=4)
+    for _ in range(10):
+        with tel.span("s", cat="c"):
+            pass
+    paths = tel.export()
+    assert trace_report.main([paths["jsonl"]]) == 0
+    out = capsys.readouterr().out
+    assert "dropped 6 events" in out
+    # agg totals stay exact despite the drops
+    data = trace_report.load(paths["jsonl"])
+    assert data["agg"][("c", "s")][0] == 10
+
+
+@pytest.fixture(scope="module")
+def served_trace(tmp_path_factory):
+    """One tiny traced serve run shared by the reconciliation tests
+    (the chunk-program compile is the expensive part)."""
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import Request, ServeEngine
+
+    hps = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+                  dec_rnn_size=16, z_size=6, num_mixture=3,
+                  serve_slots=4, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    eng = ServeEngine(model, hps, params)
+
+    def req(i, cap):
+        rng = np.random.default_rng(i)
+        return Request(key=jax.random.key(1000 + i),
+                       z=rng.standard_normal(hps.z_size).astype(np.float32),
+                       temperature=0.8, max_len=cap)
+
+    reqs = [req(i, 3 + (5 * i) % 13) for i in range(12)]
+    d = tmp_path_factory.mktemp("serve_trace")
+    tel = tele.configure(trace_dir=str(d))
+    out = eng.run(list(reqs))
+    paths = tel.export()
+    tele.disable()
+    return paths, out["metrics"]
+
+
+def test_serve_latency_table_matches_engine_summary(served_trace):
+    """Per-request event-derived p50/p95/p99 MATCH the engine's summary
+    dict — the acceptance pin that streaming telemetry and the
+    end-of-run aggregate can never tell different stories."""
+    paths, metrics = served_trace
+    rep = trace_report.report(trace_report.load(paths["jsonl"]))
+    lat = {r["metric"]: r for r in rep["latency"]}
+    assert lat["latency_s"]["count"] == metrics["completed"]
+    for p in (50, 95, 99):
+        assert round(lat["latency_s"][f"p{p}_s"], 6) == \
+            metrics[f"latency_p{p}_s"]
+    assert lat["queue_wait_s"]["mean_s"] == pytest.approx(
+        metrics["queue_wait_mean_s"], abs=1e-6)
+
+
+def test_serve_occupancy_timeline_present(served_trace):
+    paths, metrics = served_trace
+    rep = trace_report.report(trace_report.load(paths["jsonl"]))
+    occ = rep["occupancy"]
+    assert occ is not None
+    # one occupancy sample per COLLECTED chunk; the final drained
+    # in-flight (all-frozen) chunk counts in `chunks` but is never
+    # collected, so it carries no sample
+    assert occ["samples"] == metrics["chunks"] - 1
+    assert 0 < occ["mean"] <= 4
+    assert len(occ["sparkline"]) == min(60, occ["samples"])

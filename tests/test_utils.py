@@ -1,12 +1,16 @@
-"""Tests for aux subsystems: throughput counter, goodput ledger, NaN
-guards, metrics drain."""
+"""Tests for aux subsystems: throughput counter, goodput ledger, span
+timer, NaN guards, metrics drain/writer."""
 
+import threading
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from sketch_rnn_tpu.utils import (
     GoodputLedger,
+    SpanTimer,
     Throughput,
     check_finite,
     find_nonfinite,
@@ -26,6 +30,94 @@ def test_throughput_counter():
         rates["strokes_per_sec"] / 4)
     # non-advancing step resets instead of dividing by zero
     assert tp.update(10) is None
+
+
+def test_throughput_zero_dt_guard_and_rearm(monkeypatch):
+    """A zero-elapsed window returns None WITHOUT advancing the mark, so
+    the next real window still measures from the last good mark (the
+    untested edge in utils/profiling.py, ISSUE 6 satellite)."""
+    from sketch_rnn_tpu.utils import profiling
+
+    t = [100.0]
+    monkeypatch.setattr(profiling.time, "perf_counter", lambda: t[0])
+    tp = Throughput(strokes_per_step=10, num_chips=2)
+    assert tp.update(0) is None          # first call arms
+    assert tp.update(5) is None          # dt == 0: no division, None
+    t[0] = 101.0
+    r = tp.update(10)                    # measures 10 steps over 1 s
+    assert r["steps_per_sec"] == pytest.approx(10.0)
+    assert r["strokes_per_sec"] == pytest.approx(100.0)
+    assert r["strokes_per_sec_per_chip"] == pytest.approx(50.0)
+
+
+def test_throughput_step_regression_resets(monkeypatch):
+    """A step that goes BACKWARDS (restart/resume) re-arms instead of
+    reporting a negative rate."""
+    from sketch_rnn_tpu.utils import profiling
+
+    t = [0.0]
+    monkeypatch.setattr(profiling.time, "perf_counter", lambda: t[0])
+    tp = Throughput(strokes_per_step=1, num_chips=1)
+    tp.update(10)
+    t[0] = 1.0
+    assert tp.update(3) is None          # regression: reset, not -7/s
+    t[0] = 2.0
+    assert tp.update(5)["steps_per_sec"] == pytest.approx(2.0)
+
+
+def test_throughput_default_num_chips_is_device_count():
+    tp = Throughput(strokes_per_step=1)
+    assert tp.num_chips == jax.device_count()
+
+
+def test_span_timer_thread_safe_concurrent_closes():
+    """ISSUE 6 satellite regression: the serve engine's depth-1
+    pipelined dispatch interleaves span closes across threads; the
+    unlocked read-modify-write lost increments. Hammer one name from
+    many threads and demand an exact count/total."""
+    st = SpanTimer()
+    n, threads = 2000, 8
+
+    def work():
+        for _ in range(n):
+            with st.span("chunk"):
+                pass
+
+    ts = [threading.Thread(target=work) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    s = st.summary()
+    assert s["chunk"]["count"] == n * threads
+    assert s["chunk"]["total_s"] >= 0
+
+
+def test_goodput_ledger_window_mark_semantics():
+    """ISSUE 6 satellite: pin the mark bookkeeping edges — marks only
+    advance via window(); summary() never disturbs them; a phase that
+    FIRST fires mid-run reports its full total in its first window; a
+    custom prefix does not fork the mark state."""
+    import time
+
+    led = GoodputLedger(("dispatch",))
+    with led.span("dispatch"):
+        time.sleep(0.001)
+    led.summary()                        # reads totals, must not mark
+    w1 = led.window()
+    assert w1["t_dispatch_s"] >= 0.001   # summary() did not consume it
+
+    with led.span("late_phase"):         # joins after the first window
+        time.sleep(0.001)
+    w2 = led.window()
+    assert w2["t_dispatch_s"] == 0.0
+    assert w2["t_late_phase_s"] >= 0.001  # FULL total in first window
+
+    with led.span("dispatch"):
+        time.sleep(0.001)
+    w3 = led.window(prefix="x_")         # same marks, renamed keys
+    assert w3["x_dispatch_s"] >= 0.001
+    assert led.window()["t_dispatch_s"] == 0.0  # prefix didn't fork
 
 
 def test_goodput_ledger_windows_and_totals():
@@ -109,6 +201,42 @@ def test_metrics_drain_check_raise_after_persist():
     with pytest.raises(FloatingPointError, match="step 2"):
         d.push(4, {"loss": jnp.float32(1.0)})
     assert rows == [2]
+
+
+def test_metrics_writer_warns_once_per_dropped_key(tmp_path, capsys):
+    """ISSUE 6 satellite: the CSV resume-alignment rule silently
+    dropped scalar keys absent from the first row's header — now it
+    warns, exactly once per key, and the JSONL keeps the full row."""
+    import csv
+    import json
+
+    from sketch_rnn_tpu.train.metrics import MetricsWriter
+
+    w = MetricsWriter(str(tmp_path), "train")
+    w.write(1, {"a": 1.0})
+    w.write(2, {"a": 2.0, "b": 3.0})   # b not in header: warn
+    w.write(3, {"a": 3.0, "b": 4.0})   # same key: NO second warning
+    w.write(4, {"a": 4.0, "c": 5.0})   # new key: warn again
+    err = capsys.readouterr().err
+    assert err.count("drops keys") == 2
+    assert "'b'" in err and "'c'" in err
+    # CSV stays aligned to its header; JSONL kept everything
+    with open(tmp_path / "train_metrics.csv", newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert [r["a"] for r in rows] == ["1.0", "2.0", "3.0", "4.0"]
+    assert all("b" not in r for r in rows)
+    with open(tmp_path / "train_metrics.jsonl") as f:
+        jrows = [json.loads(l) for l in f]
+    assert jrows[1]["b"] == 3.0 and jrows[3]["c"] == 5.0
+
+
+def test_metrics_writer_no_warning_when_keys_stable(tmp_path, capsys):
+    from sketch_rnn_tpu.train.metrics import MetricsWriter
+
+    w = MetricsWriter(str(tmp_path), "train")
+    for s in (1, 2, 3):
+        w.write(s, {"a": float(s), "b": float(s)})
+    assert "drops keys" not in capsys.readouterr().err
 
 
 def test_check_finite_passes_and_raises():
