@@ -1,0 +1,241 @@
+"""Bench regression gate: fresh rows vs history, with noise bands.
+
+ISSUE 7 tentpole piece 3. The repo's perf claims live in BENCH_HISTORY
+/ BENCH_SMOKE_HISTORY rows and prose summaries; nothing CHECKS a fresh
+round against the record — a 2x regression is found by a human reading
+``bench_summary`` output. This gate makes the comparison a checkable
+artifact: it exits nonzero on a regression, so a driver (or CI) can
+fail a round instead of archiving it silently.
+
+Per-cell noise bands: the tunneled chip shows minutes-scale slowdown
+windows of up to 2x (NOTES.md), and CPU smoke rows are noisier still —
+a fixed tolerance would either fire constantly or catch nothing. Each
+config cell's band is therefore derived from ITS OWN history spread:
+
+    band  = max(min_band, 1 - worst_hist / best_hist)
+    floor = best_hist * (1 - band) * (1 - slack)
+
+i.e. a fresh value only regresses when it falls below the cell's own
+historically observed worst, minus a slack margin. Cells whose history
+is noisy get (honestly) wide bands; a tight accelerator series gets a
+tight gate. Rows the bench itself flagged implausible
+(``plausible: false`` slow-window records) and outage markers are
+excluded from both sides.
+
+Row kinds and their headline metrics (higher is better for all):
+``train`` -> strokes_per_sec_per_chip, ``serve_bench`` ->
+engine_sketches_per_sec, ``bucket_bench`` -> speedup_steps_per_sec,
+``sampler`` -> sketches_per_sec; config identity comes from
+``bench_summary.key_of`` — the gate and the summary can never key rows
+differently.
+
+Usage:
+    python scripts/bench_regress.py --fresh round.jsonl [--history ...]
+    python scripts/bench_regress.py --smoke    # tier-1 self-check
+
+``--fresh`` files hold the round's streamed rows (driver-captured
+stdout works: ``# ``-echo lines and chatter are tolerated). Without
+``--history`` the committed BENCH_HISTORY.jsonl + BENCH_SMOKE_HISTORY
+.jsonl are the baseline. ``--smoke`` runs the self-check mode the test
+suite wires in: the LAST committed row of each smoke-history cell is
+judged against that cell's earlier rows — proving the committed
+history itself ends in-band, with no bench run needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.bench_summary import iter_rows, key_of, metric_of  # noqa: E402
+
+GATED_KINDS = ("train", "sampler", "bucket_bench", "serve_bench")
+
+
+def _usable(r: dict) -> bool:
+    """Row carries a gateable headline number: a known kind, a metric,
+    and not a self-flagged slow-window record."""
+    if r.get("kind") not in GATED_KINDS:
+        return False
+    if r.get("plausible") is False:
+        return False
+    return metric_of(r) is not None
+
+
+def collect(paths: List[str]) -> Dict[Tuple, List[float]]:
+    """Per-cell metric series, in file/line order (history order)."""
+    out: Dict[Tuple, List[float]] = {}
+    for path in paths:
+        for r in iter_rows(path):
+            if _usable(r):
+                out.setdefault(key_of(r), []).append(float(metric_of(r)))
+    return out
+
+
+def band_of(values: List[float], min_band: float) -> float:
+    """The cell's noise band: relative spread of its history (1 -
+    worst/best), floored at ``min_band``. A single-row history gets the
+    floor only — there is no spread to learn from yet."""
+    best = max(values)
+    if best <= 0:
+        return 1.0  # degenerate history: never gate against it
+    return max(min_band, 1.0 - min(values) / best)
+
+
+def judge(hist: Dict[Tuple, List[float]],
+          fresh: List[Tuple[Tuple, float]],
+          min_history: int = 3, min_band: float = 0.10,
+          slack: float = 0.05) -> List[Dict]:
+    """Verdict rows, one per fresh measurement.
+
+    Verdicts: ``ok`` (inside the band), ``record`` (a new best),
+    ``REGRESS`` (below the floor — the gate), ``new`` (no history for
+    this cell), ``thin`` (fewer than ``min_history`` prior rows — the
+    band is not yet trustworthy; advisory only).
+    """
+    out = []
+    for key, value in fresh:
+        values = hist.get(key, [])
+        row = {"key": key, "fresh": value, "n_hist": len(values)}
+        if not values:
+            row.update(verdict="new", best=None, floor=None, band=None)
+        elif len(values) < min_history:
+            row.update(verdict="thin", best=max(values), floor=None,
+                       band=None)
+        else:
+            best = max(values)
+            band = band_of(values, min_band)
+            floor = best * (1.0 - band) * (1.0 - slack)
+            verdict = ("REGRESS" if value < floor
+                       else "record" if value > best else "ok")
+            row.update(verdict=verdict, best=best,
+                       floor=round(floor, 4), band=round(band, 4))
+        out.append(row)
+    return out
+
+
+def smoke_pairs(paths: List[str]
+                ) -> Tuple[Dict[Tuple, List[float]],
+                           List[Tuple[Tuple, float]]]:
+    """Self-check split: per cell, the LAST row is 'fresh', everything
+    before it is history. Cells left with fewer than ``judge``'s
+    ``min_history`` prior rows come back 'thin'/'new' (advisory),
+    never gated."""
+    series = collect(paths)
+    hist: Dict[Tuple, List[float]] = {}
+    fresh: List[Tuple[Tuple, float]] = []
+    for key, values in series.items():
+        hist[key] = values[:-1]
+        fresh.append((key, values[-1]))
+    return hist, fresh
+
+
+def print_table(rows: List[Dict]) -> None:
+    print(f"{'verdict':8s} {'fresh':>12s} {'best':>12s} {'floor':>12s} "
+          f"{'band':>6s} {'n':>3s}  config")
+    for r in sorted(rows, key=lambda r: (r["verdict"] != "REGRESS",
+                                         str(r["key"]))):
+        fmt = lambda v, p="": ("-" if v is None  # noqa: E731
+                               else f"{v:,.2f}{p}")
+        key = " ".join(str(p) for p in r["key"])
+        band = "-" if r.get("band") is None else f"{r['band']:.0%}"
+        print(f"{r['verdict']:8s} {fmt(r['fresh']):>12s} "
+              f"{fmt(r.get('best')):>12s} {fmt(r.get('floor')):>12s} "
+              f"{band:>6s} {r['n_hist']:3d}  {key}")
+
+
+def main(argv=None) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(
+        description="gate fresh bench rows against history noise bands; "
+                    "exit 1 on regression")
+    ap.add_argument("--fresh", nargs="+", default=[],
+                    help="file(s) of fresh result rows to judge "
+                         "(streamed bench stdout works)")
+    ap.add_argument("--history", nargs="+", default=[],
+                    help="history file(s); default: the committed "
+                         "BENCH_HISTORY.jsonl + BENCH_SMOKE_HISTORY"
+                         ".jsonl")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-check the committed smoke history: judge "
+                         "each cell's last row against its earlier rows")
+    ap.add_argument("--min_history", type=int, default=3,
+                    help="prior rows a cell needs before its band is "
+                         "trusted to gate (default 3)")
+    ap.add_argument("--min_band", type=float, default=0.10,
+                    help="noise-band floor as a fraction of best "
+                         "(default 0.10)")
+    ap.add_argument("--slack", type=float, default=0.05,
+                    help="extra margin under the band before a verdict "
+                         "flips to REGRESS (default 0.05)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable verdict rows instead of the "
+                         "table")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        if args.fresh:
+            print("bench_regress: --smoke judges the committed history "
+                  "itself; drop --fresh", file=sys.stderr)
+            return 2
+        paths = args.history or [
+            os.path.join(root, "BENCH_SMOKE_HISTORY.jsonl")]
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            print(f"bench_regress: history file(s) not found: "
+                  f"{missing} — run a bench first or pass --history",
+                  file=sys.stderr)
+            return 2
+        hist, fresh = smoke_pairs(paths)
+    else:
+        if not args.fresh:
+            print("bench_regress: pass --fresh <rows.jsonl> (or --smoke "
+                  "for the committed-history self-check)",
+                  file=sys.stderr)
+            return 2
+        missing = [p for p in args.fresh + args.history
+                   if not os.path.exists(p)]
+        if missing:
+            print(f"bench_regress: file(s) not found: {missing}",
+                  file=sys.stderr)
+            return 2
+        hist_paths = args.history or [
+            p for p in (os.path.join(root, "BENCH_HISTORY.jsonl"),
+                        os.path.join(root, "BENCH_SMOKE_HISTORY.jsonl"))
+            if os.path.exists(p)]
+        hist = collect(hist_paths)
+        fresh = []
+        for path in args.fresh:
+            for r in iter_rows(path):
+                if _usable(r):
+                    fresh.append((key_of(r), float(metric_of(r))))
+        if not fresh:
+            print("bench_regress: no gateable rows in --fresh input "
+                  f"(kinds {GATED_KINDS}, plausible, with a headline "
+                  f"metric)", file=sys.stderr)
+            return 2
+
+    rows = judge(hist, fresh, min_history=args.min_history,
+                 min_band=args.min_band, slack=args.slack)
+    regressions = [r for r in rows if r["verdict"] == "REGRESS"]
+    if args.json:
+        print(json.dumps({"rows": [{**r, "key": list(r["key"])}
+                                   for r in rows],
+                          "regressions": len(regressions)}))
+    else:
+        print_table(rows)
+        print(f"\n{len(rows)} cell(s) judged, "
+              f"{len(regressions)} regression(s)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head
+        sys.exit(0)

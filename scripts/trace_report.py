@@ -210,6 +210,13 @@ def print_report(rep: Dict) -> None:
         print()
 
 
+def _resolve_path(path: str) -> str:
+    """The JSONL ``load`` would read for ``path`` (dir -> the
+    telemetry.jsonl inside it)."""
+    return os.path.join(path, TELEMETRY_JSONL) if os.path.isdir(path) \
+        else path
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="stall breakdown / occupancy / latency report over "
@@ -219,7 +226,26 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable JSON instead of tables")
     args = ap.parse_args(argv)
-    data = load(args.path)
+    # usage errors exit with ONE actionable line, not a traceback
+    # (ISSUE 7 satellite): pointing the report at the wrong dir is the
+    # common operator slip and FileNotFoundError told them nothing
+    resolved = _resolve_path(args.path)
+    if not os.path.exists(resolved):
+        print(f"trace_report: no telemetry stream at {resolved} — "
+              f"produce one with `cli train --trace_dir=...` or "
+              f"`cli serve-bench --trace_dir=...`, then point this at "
+              f"the trace dir or the telemetry.jsonl inside it",
+              file=sys.stderr)
+        return 2
+    data = load(resolved)
+    if not (data["events"] or data["agg"] or data["counters"]
+            or data["hists"]):
+        what = ("holds only its meta line" if data["meta"]
+                else "holds no parseable telemetry lines")
+        print(f"trace_report: {resolved} {what} — the traced run "
+              f"recorded no events (did it do any work after "
+              f"configure, and export at exit?)", file=sys.stderr)
+        return 2
     rep = report(data)
     if args.json:
         print(json.dumps(rep))

@@ -170,7 +170,9 @@ def cmd_train(args) -> int:
           workdir=args.workdir, seed=args.seed,
           resume=not getattr(args, "no_resume", False),
           profile=getattr(args, "profile", False),
-          trace_dir=getattr(args, "trace_dir", "") or None)
+          trace_dir=getattr(args, "trace_dir", "") or None,
+          watchdog=getattr(args, "watchdog", False),
+          halt_on_anomaly=getattr(args, "halt_on_anomaly", False))
     return 0
 
 
@@ -332,13 +334,50 @@ def cmd_serve_bench(args) -> int:
     plumbing / throughput benchmarking without a checkpoint); otherwise
     the latest checkpoint in ``--workdir`` is restored like ``sample``.
     """
+    hps = _resolve_hps(args)
+    # SLO specs and the metrics port are usage input: fail before the
+    # (expensive) restore/compile, like sample's flag validation — a
+    # taken port must not cost the whole warmup first. The server is
+    # harmless this early (it serves meta-only until the core is
+    # configured below).
+    slo_tracker = None
+    if args.slo:
+        from sketch_rnn_tpu.serve.slo import SLOTracker, parse_slo
+        try:
+            slo_tracker = SLOTracker([parse_slo(s) for s in args.slo])
+        except ValueError as e:
+            print(f"[cli] {e}", file=sys.stderr)
+            return 2
+    server = None
+    if args.metrics_port is not None:
+        from sketch_rnn_tpu.serve.metrics_http import MetricsServer
+        try:
+            server = MetricsServer(port=args.metrics_port,
+                                   slo=slo_tracker).start()
+        except OSError as e:
+            print(f"[cli] cannot bind --metrics_port "
+                  f"{args.metrics_port}: {e}", file=sys.stderr)
+            return 2
+        print(f"[metrics] serving /metrics and /healthz on "
+              f"http://127.0.0.1:{server.port} (scrape while the "
+              f"bench runs, e.g. curl :{server.port}/metrics)",
+              file=sys.stderr)
+    try:
+        return _serve_bench_run(args, hps, slo_tracker, server)
+    finally:
+        if server is not None:
+            server.stop()
+
+
+def _serve_bench_run(args, hps, slo_tracker, server) -> int:
+    """The body of ``serve-bench`` after usage validation; the caller
+    owns the metrics server's lifetime (stopped on every exit path)."""
     import time
 
     from sketch_rnn_tpu.models.vae import SketchRNN
     from sketch_rnn_tpu.serve import Request, ServeEngine
     from sketch_rnn_tpu.train.metrics import MetricsWriter
 
-    hps = _resolve_hps(args)
     if args.random_init:
         model = SketchRNN(hps)
         state_params = model.init_params(jax.random.key(args.seed))
@@ -370,32 +409,78 @@ def cmd_serve_bench(args) -> int:
                 for r in requests])
     # telemetry (ISSUE 6): configured AFTER the warmup burst so the
     # exported per-request lifecycle (enqueue/admit/complete, latency
-    # histograms, slot occupancy) covers exactly the measured run
+    # histograms, slot occupancy) covers exactly the measured run.
+    # --metrics_port alone (no --trace_dir) still enables the core —
+    # the /metrics endpoint renders its counters/histograms live and
+    # would otherwise serve only meta + SLO series — but exports no
+    # files at exit.
     trace_dir = getattr(args, "trace_dir", "") or None
     tel = None
-    if trace_dir:
+    tele = None
+    if trace_dir or args.metrics_port is not None:
         from sketch_rnn_tpu.utils import telemetry as tele
         tel = tele.configure(trace_dir=trace_dir)
+    # health & SLO layer (ISSUE 7): the tracker is fed by the engine
+    # per completed request; the (already-bound) metrics server exposes
+    # the LIVE /metrics + /healthz view of this run, and the final
+    # scrape is archived as metrics.prom beside the trace (or in the
+    # workdir) — the checkable artifact that the endpoint and the
+    # end-of-run summary reconcile.
     t0 = time.time()
     try:
         out = engine.run(requests, recycle=not args.static,
-                         metrics_writer=writer)
+                         metrics_writer=writer, slo=slo_tracker)
     except BaseException:
-        # a mid-run crash still leaves the trace that explains it (the
-        # train loop's post-mortem discipline); best-effort so an export
-        # failure never masks the real error
+        # a mid-run crash still leaves the trace that explains it
+        # (the train loop's post-mortem discipline); best-effort so
+        # an export failure never masks the real error
         if tel is not None:
-            try:
-                tel.export()
-            except Exception:  # noqa: BLE001
-                pass
+            if trace_dir:
+                try:
+                    tel.export()
+                except Exception:  # noqa: BLE001
+                    pass
             tele.disable()
         raise
+    prom_path = None
+    if server is not None:
+        # archive the run's final scrape through the real HTTP
+        # surface (not a render_prometheus call): the artifact
+        # proves endpoint wiring end to end. Best-effort — a
+        # scrape/write hiccup must not discard the completed run's
+        # report and trace
+        try:
+            import urllib.request
+            prom_dir = trace_dir or args.workdir
+            os.makedirs(prom_dir, exist_ok=True)
+            prom_path = os.path.join(prom_dir, "metrics.prom")
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics",
+                    timeout=10) as resp:
+                scrape = resp.read().decode()
+            with open(prom_path, "w") as f:
+                f.write(scrape)
+            print(f"[metrics] archived final scrape to {prom_path}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            prom_path = None
+            print(f"[metrics] WARNING: could not archive the final "
+                  f"scrape: {e!r}", file=sys.stderr)
+    if slo_tracker is not None:
+        # an SLO that matched nothing (endpoint typo, or a future
+        # endpoint this engine does not serve) would otherwise report
+        # vacuous compliance forever — say so where the operator looks
+        for key, rec in sorted(slo_tracker.summary().items()):
+            if rec["total"] == 0:
+                print(f"[slo] WARNING: {key} matched no completed "
+                      f"request (endpoint {rec['endpoint']!r} unseen) "
+                      f"— its compliance is vacuous", file=sys.stderr)
     if tel is not None:
-        paths = tel.export()
-        print(f"[telemetry] wrote {paths['jsonl']} and {paths['chrome']} "
-              f"(read with scripts/trace_report.py or Perfetto)",
-              file=sys.stderr)
+        if trace_dir:
+            paths = tel.export()
+            print(f"[telemetry] wrote {paths['jsonl']} and "
+                  f"{paths['chrome']} (read with scripts/trace_report.py "
+                  f"or Perfetto)", file=sys.stderr)
         tele.disable()  # restore the process default
     report = {
         "kind": "serve_bench_cli",
@@ -407,7 +492,13 @@ def cmd_serve_bench(args) -> int:
         "started": t0,
         **out["metrics"],
     }
-    print(json.dumps(report))
+    if server is not None:
+        report["metrics_port"] = server.port
+        report["metrics_prom"] = prom_path
+    # json_safe: a breached p100 SLO carries an infinite burn rate, and
+    # the summary line must stay strict JSON for downstream parsers
+    from sketch_rnn_tpu.utils.telemetry import json_safe
+    print(json.dumps(json_safe(report), allow_nan=False))
     return 0
 
 
@@ -454,6 +545,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "blocking saves and eager metric conversion, for "
                         "debugging/bisection; results are identical "
                         "either way, only step time changes")
+    p.add_argument("--watchdog", action="store_true",
+                   help="arm the training health watchdog "
+                        "(train/watchdog.py): each logged metrics row "
+                        "is checked for NaN/inf, robust-z loss/grad "
+                        "spikes, goodput-phase stalls and throughput "
+                        "collapse; a trip warns, emits a telemetry "
+                        "incident event and writes "
+                        "<workdir>/incident.json (warn-only). Off by "
+                        "default and invisible when off")
+    p.add_argument("--halt_on_anomaly", action="store_true",
+                   help="watchdog trips STOP training (implies "
+                        "--watchdog) after forcing a post-mortem "
+                        "checkpoint into <workdir>/incident/ — the "
+                        "resume directory is never touched, so a "
+                        "diverged state cannot wedge resume-from-latest")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint")
@@ -510,6 +616,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable per-request serving telemetry and write "
                         "telemetry.jsonl + trace.json (Chrome trace) "
                         "here; read with scripts/trace_report.py")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve a live Prometheus /metrics + /healthz "
+                        "endpoint on 127.0.0.1:PORT for the run's "
+                        "duration (0 = ephemeral port, printed on "
+                        "stderr); enables the telemetry core even "
+                        "without --trace_dir (no files exported, the "
+                        "endpoint just renders live); the final scrape "
+                        "is archived as metrics.prom beside the trace "
+                        "(or workdir). Off by default: no listening "
+                        "socket")
+    p.add_argument("--slo", action="append", default=[],
+                   help="latency SLO spec, repeatable: "
+                        "[endpoint:[metric:]]pNN<=SECONDS (e.g. "
+                        "'p95<=0.25' or 'generate:decode_s:p99<=100ms')"
+                        "; compliance + error-budget burn rates land in "
+                        "/metrics, /healthz and the summary JSON")
     p.set_defaults(fn=cmd_serve_bench)
     return ap
 

@@ -8,6 +8,8 @@ from sketch_rnn_tpu.serve.engine import (
     generate_many,
     make_chunk_step,
 )
+from sketch_rnn_tpu.serve.metrics_http import MetricsServer
+from sketch_rnn_tpu.serve.slo import SLO, SLOTracker, parse_slo
 
 __all__ = [
     "Request",
@@ -15,4 +17,8 @@ __all__ = [
     "ServeEngine",
     "generate_many",
     "make_chunk_step",
+    "MetricsServer",
+    "SLO",
+    "SLOTracker",
+    "parse_slo",
 ]

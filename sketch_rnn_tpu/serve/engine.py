@@ -311,13 +311,17 @@ class ServeEngine:
     # -- the serving loop --------------------------------------------------
 
     def run(self, requests: List[Request], recycle: bool = True,
-            metrics_writer=None) -> Dict[str, Any]:
+            metrics_writer=None, slo=None) -> Dict[str, Any]:
         """Drive ``requests`` to completion; continuous batching when
         ``recycle`` (default), static freeze-until-batch-done otherwise.
 
         Returns ``{"results": [Result...], "metrics": {...aggregate}}``.
         ``metrics_writer``: optional ``train.metrics.MetricsWriter`` —
         one JSONL row per completed request.
+        ``slo``: optional ``serve.slo.SLOTracker`` — fed each completed
+        request's exact latency fields, so the live SLO/burn-rate view
+        (the ``/metrics`` endpoint, ISSUE 7) sees the same floats as
+        the returned Results; its summary rides in ``metrics["slo"]``.
         """
         t_start = time.perf_counter()
         self.spans = SpanTimer(category="serve")  # per-run (no warmup leak)
@@ -335,6 +339,10 @@ class ServeEngine:
         pool = self._prepare_pool(requests) if requests else None
         enq = {req.uid: t_start for req in requests}
         if tel.enabled:
+            # monotonic request counters feed the live /metrics endpoint
+            # (ISSUE 7); the scrape's completed total reconciles exactly
+            # with run()'s end-of-run `completed`
+            tel.counter("requests_enqueued", len(requests), cat="serve")
             for req in requests:
                 tel.instant("enqueue", cat="serve", ts=t_start,
                             args={"uid": req.uid})
@@ -471,7 +479,17 @@ class ServeEngine:
                         decode_s=now - admit_t[req.uid],
                         latency_s=now - enq[req.uid])
                     results.append(res)
+                    if slo is not None:
+                        # the SLO tracker sees the EXACT Result floats,
+                        # so /metrics burn rates and run()'s summary can
+                        # never tell different stories
+                        slo.observe("generate", {
+                            "queue_wait_s": res.queue_wait_s,
+                            "decode_s": res.decode_s,
+                            "latency_s": res.latency_s})
                     if tel.enabled:
+                        tel.counter("requests_completed", 1.0,
+                                    cat="serve")
                         # the complete event carries the EXACT Result
                         # latencies, so event-derived percentiles in
                         # trace_report.py match run()'s summary; the
@@ -529,13 +547,15 @@ class ServeEngine:
             "latency_p99_s": round(float(np.percentile(lat, 99)), 6),
             "spans": self.spans.summary(),
         }
+        if slo is not None:
+            metrics["slo"] = slo.summary()
         return {"results": results, "metrics": metrics}
 
 
 def generate_many(model, params, hps: HParams, requests: List[Request],
                   slots: int = 0, chunk: int = 0,
                   max_len: Optional[int] = None, greedy: bool = False,
-                  recycle: bool = True, metrics_writer=None
+                  recycle: bool = True, metrics_writer=None, slo=None
                   ) -> Dict[str, Any]:
     """One-call request-level API: build an engine, serve ``requests``.
 
@@ -546,4 +566,4 @@ def generate_many(model, params, hps: HParams, requests: List[Request],
     eng = ServeEngine(model, hps, params, slots=slots, chunk=chunk,
                       max_len=max_len, greedy=greedy)
     return eng.run(requests, recycle=recycle,
-                   metrics_writer=metrics_writer)
+                   metrics_writer=metrics_writer, slo=slo)

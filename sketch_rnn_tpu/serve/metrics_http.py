@@ -1,0 +1,285 @@
+"""Live ``/metrics`` + ``/healthz`` endpoint over the telemetry core.
+
+ISSUE 7 tentpole piece 1: PR 6's telemetry core already maintains every
+number an operator needs — monotonic counters, sampled gauges, exact
+span aggregates and streaming log-bucket histograms — but only exports
+them at process exit. This module puts a stdlib ``http.server`` thread
+in front of the LIVE core, so a serve-bench (and later the serving
+fleet) can be scraped mid-run:
+
+- ``GET /metrics`` — Prometheus text exposition (version 0.0.4)
+  rendered straight from one consistent :meth:`Telemetry.snapshot`;
+  NO new bookkeeping exists here — every series is a view of a store
+  the runtime already maintains. Streaming histograms export their
+  log buckets as cumulative ``le=`` buckets, so any Prometheus stack
+  recovers the same p50/p95/p99 the in-process summary reports (within
+  one geometric bucket, the documented <=~4.5% relative error).
+- ``GET /healthz`` — JSON liveness + the SLO verdict: ``ok`` while
+  every tracked SLO (serve/slo.py) with enough observations is in
+  compliance, ``degraded`` otherwise (HTTP 200 either way — health
+  probes distinguish by body; a refused connection means dead).
+
+The server resolves :func:`get_telemetry` per request, so it follows a
+late ``configure()`` / ``disable()`` exactly like every other probe
+site; with telemetry disabled ``/metrics`` serves the meta series only
+(``sketch_rnn_telemetry_enabled 0``) rather than erroring, which keeps
+scrape pipelines alive across un-traced runs.
+
+OFF by default, like the core: nothing in the runtime starts a server
+unless asked (``cli serve-bench --metrics_port=...``). Every started
+server registers in a module-level set so the tier-1 conftest guard can
+prove no test leaks a listening socket (:func:`stop_all`).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from sketch_rnn_tpu.utils.telemetry import (
+    Telemetry,
+    get_telemetry,
+    json_safe,
+)
+
+PREFIX = "sketch_rnn"
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+# every live server, for the conftest no-stray-sockets guard
+_LIVE: set = set()
+_LIVE_LOCK = threading.Lock()
+
+
+def _metric_name(cat: str, name: str, suffix: str = "") -> str:
+    """``sketch_rnn_<cat>_<name><suffix>`` with Prometheus-legal chars."""
+    base = f"{PREFIX}_{cat}_{name}{suffix}"
+    return _NAME_RE.sub("_", base)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers without a trailing .0 (exact
+    counts must scrape as exact counts), floats via repr (no rounding).
+    Non-finite values use the exposition-format literals — a p100 SLO's
+    infinite burn rate must not 500 every scrape (int(inf) raises)."""
+    f = float(v)
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if math.isnan(f):
+        return "NaN"
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(tel: Telemetry,
+                      slo: Optional[object] = None) -> str:
+    """Render the core's live state as Prometheus text exposition.
+
+    Pure function of one :meth:`Telemetry.snapshot` (single lock
+    acquisition — a scrape is internally consistent) plus an optional
+    :class:`~sketch_rnn_tpu.serve.slo.SLOTracker`. Series:
+
+    - counters  -> ``<prefix>_<cat>_<name>_total`` (counter)
+    - gauges    -> ``<prefix>_<cat>_<name>`` (gauge, latest sample)
+    - span aggs -> ``..._seconds_total`` + ``..._spans_total``
+    - histograms -> ``..._bucket{le=...}`` / ``_sum`` / ``_count``
+    - SLOs      -> ``<prefix>_slo_*{slo="endpoint:metric:pNN"}``
+    - meta      -> ``<prefix>_up``, ``_telemetry_enabled``,
+      ``_telemetry_dropped_events_total``, ``_uptime_seconds``
+    """
+    lines = []
+
+    def emit(name: str, mtype: str, samples, help_: str = ""):
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for labels, value in samples:
+            lines.append(f"{name}{labels} {_fmt(value)}")
+
+    snap = tel.snapshot()
+    emit(f"{PREFIX}_up", "gauge", [("", 1)],
+         "process is serving metrics")
+    emit(f"{PREFIX}_telemetry_enabled", "gauge",
+         [("", int(tel.enabled))],
+         "1 when the telemetry core records events")
+    emit(f"{PREFIX}_telemetry_dropped_events_total", "counter",
+         [("", snap["dropped"])],
+         "ring-buffer drops (aggregates stay exact)")
+    emit(f"{PREFIX}_uptime_seconds", "gauge",
+         [("", time.perf_counter() - tel.origin_perf)],
+         "seconds since the telemetry core was constructed")
+    for (cat, name), v in sorted(snap["counters"].items()):
+        emit(_metric_name(cat, name, "_total"), "counter", [("", v)])
+    for (cat, name), v in sorted(snap["gauges"].items()):
+        emit(_metric_name(cat, name), "gauge", [("", v)])
+    for (cat, name), (n, total) in sorted(snap["aggregates"].items()):
+        emit(_metric_name(cat, name, "_seconds_total"), "counter",
+             [("", total)], f"exact accumulated span seconds ({cat})")
+        emit(_metric_name(cat, name, "_spans_total"), "counter",
+             [("", n)])
+    for (cat, name), h in sorted(snap["hists"].items()):
+        base = _metric_name(cat, name)
+        s = h["summary"]
+        samples = [(f'{{le="{edge:.9g}"}}', cum)
+                   for edge, cum in h["buckets"]]
+        samples.append(('{le="+Inf"}', s["count"]))
+        lines.append(f"# TYPE {base} histogram")
+        for labels, value in samples:
+            lines.append(f"{base}_bucket{labels} {_fmt(value)}")
+        lines.append(f"{base}_sum {_fmt(h['total'])}")
+        lines.append(f"{base}_count {_fmt(s['count'])}")
+    if slo is not None:
+        series: Dict[str, list] = {
+            "objective_seconds": [], "target": [], "requests_total": [],
+            "breaches_total": [], "compliance": [], "met": [],
+            "burn_rate": [], "burn_rate_total": [],
+        }
+        for key, rec in sorted(slo.summary().items()):
+            lab = f'{{slo="{key}"}}'
+            series["objective_seconds"].append((lab, rec["objective_s"]))
+            series["target"].append((lab, rec["target"]))
+            series["requests_total"].append((lab, rec["total"]))
+            series["breaches_total"].append((lab, rec["breaches"]))
+            series["compliance"].append((lab, rec["compliance"]))
+            series["met"].append((lab, int(rec["met"])))
+            series["burn_rate"].append((lab, rec["burn_rate"]))
+            series["burn_rate_total"].append((lab, rec["burn_rate_total"]))
+        helps = {
+            "breaches_total": "requests over their latency objective",
+            "burn_rate": "rolling-window error-budget burn "
+                         "(1.0 = spending exactly the budget)",
+        }
+        for suffix, samples in series.items():
+            # only the request/breach tallies are monotonic; burn_rate_
+            # total is a lifetime RATIO and must scrape as a gauge
+            mtype = ("counter" if suffix in ("requests_total",
+                                             "breaches_total")
+                     else "gauge")
+            emit(f"{PREFIX}_slo_{suffix}", mtype, samples,
+                 helps.get(suffix, ""))
+    return "\n".join(lines) + "\n"
+
+
+def health_payload(tel: Telemetry,
+                   slo: Optional[object] = None) -> Dict:
+    """The ``/healthz`` body: liveness + the SLO verdict."""
+    degraded = slo is not None and not slo.healthy()
+    return {
+        "status": "degraded" if degraded else "ok",
+        "telemetry_enabled": bool(tel.enabled),
+        "dropped_events": tel.dropped,
+        "uptime_s": round(time.perf_counter() - tel.origin_perf, 3),
+        "slo": None if slo is None else json_safe(slo.summary()),
+    }
+
+
+class MetricsServer:
+    """Background ``/metrics`` + ``/healthz`` HTTP server.
+
+    ``port=0`` binds an ephemeral port (read :attr:`port` after
+    :meth:`start`). Binds ``127.0.0.1`` by default — this is an
+    operator/scraper surface, not a public one. ``telemetry`` defaults
+    to resolving the process core per request (the probe-site
+    discipline); pass an instance to pin one (tests).
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 slo: Optional[object] = None,
+                 telemetry: Optional[Telemetry] = None):
+        self.host = host
+        self._requested_port = port
+        self.slo = slo
+        self._telemetry = telemetry
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def _resolve_telemetry(self) -> Telemetry:
+        return self._telemetry if self._telemetry is not None \
+            else get_telemetry()
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no per-scrape stderr chatter
+                pass
+
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = render_prometheus(
+                        server._resolve_telemetry(),
+                        server.slo).encode()
+                    self._send(200, "text/plain; version=0.0.4;"
+                                    " charset=utf-8", body)
+                elif path == "/healthz":
+                    body = json.dumps(health_payload(
+                        server._resolve_telemetry(),
+                        server.slo)).encode()
+                    self._send(200, "application/json", body)
+                else:
+                    self._send(
+                        404, "text/plain",
+                        b"not found; try /metrics or /healthz\n")
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="metrics-http", daemon=True)
+        self._thread.start()
+        with _LIVE_LOCK:
+            _LIVE.add(self)
+        return self
+
+    def stop(self) -> None:
+        httpd, thread = self._httpd, self._thread
+        self._httpd = self._thread = None
+        with _LIVE_LOCK:
+            _LIVE.discard(self)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()
+
+    def __repr__(self) -> str:
+        state = ("down" if self._httpd is None
+                 else f"http://{self.host}:{self.port}")
+        return f"MetricsServer({state})"
+
+
+def live_servers() -> Tuple["MetricsServer", ...]:
+    with _LIVE_LOCK:
+        return tuple(_LIVE)
+
+
+def stop_all() -> Tuple[str, ...]:
+    """Stop every live server; returns their reprs (the conftest guard
+    asserts this is empty — a non-empty return names the leaker)."""
+    leaked = live_servers()
+    names = tuple(repr(s) for s in leaked)
+    for s in leaked:
+        s.stop()
+    return names

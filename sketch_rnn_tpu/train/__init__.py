@@ -20,6 +20,11 @@ from sketch_rnn_tpu.train.checkpoint import (
 )
 from sketch_rnn_tpu.train.loop import evaluate, evaluate_per_class, train
 from sketch_rnn_tpu.train.metrics import MetricsDrain, MetricsWriter
+from sketch_rnn_tpu.train.watchdog import (
+    AnomalyHalt,
+    Watchdog,
+    WatchdogMonitor,
+)
 
 __all__ = [
     "lr_schedule",
@@ -41,4 +46,7 @@ __all__ = [
     "train",
     "evaluate",
     "evaluate_per_class",
+    "AnomalyHalt",
+    "Watchdog",
+    "WatchdogMonitor",
 ]

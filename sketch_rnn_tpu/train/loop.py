@@ -83,6 +83,11 @@ from sketch_rnn_tpu.train.step import (
     make_multi_train_step,
     make_train_step,
 )
+from sketch_rnn_tpu.train.watchdog import (
+    INCIDENT_CKPT_DIR,
+    AnomalyHalt,
+    WatchdogMonitor,
+)
 from sketch_rnn_tpu.utils.debug import check_finite, param_count
 from sketch_rnn_tpu.utils.profiling import GoodputLedger, Throughput
 from sketch_rnn_tpu.utils import telemetry as tele
@@ -299,7 +304,9 @@ def train(hps: HParams,
           use_mesh: bool = True,
           resume: bool = True,
           profile: bool = False,
-          trace_dir: Optional[str] = None) -> TrainState:
+          trace_dir: Optional[str] = None,
+          watchdog: bool = False,
+          halt_on_anomaly: bool = False) -> TrainState:
     """Train for ``num_steps`` (default ``hps.num_steps``); returns state.
 
     Resumes from the latest checkpoint in ``workdir`` when present
@@ -316,6 +323,18 @@ def train(hps: HParams,
     ``<trace_dir>/device`` with alignment markers in the host stream.
     Telemetry off (the default) is invisible: no files, identical
     metrics rows. Multi-host runs record on the primary only.
+
+    ``watchdog`` (ISSUE 7) arms the training health watchdog
+    (train/watchdog.py) on the metrics drain: each logged row is fed
+    to a pure anomaly detector (NaN/inf, robust-z loss and grad-norm
+    spikes, goodput-phase stalls, throughput collapse); a trip emits a
+    telemetry incident event and writes ``<workdir>/incident.json``
+    (warn-only). ``halt_on_anomaly`` additionally stops training on a
+    trip, after forcing a post-mortem checkpoint into
+    ``<workdir>/incident/`` — deliberately NOT the resume directory,
+    so a diverged state can never become ``latest_checkpoint``. Both
+    off by default and bitwise-invisible when off: the drain's check
+    chain is exactly ``check_finite`` and no watchdog state exists.
     """
     num_steps = hps.num_steps if num_steps is None else num_steps
     if trace_dir and is_primary():
@@ -374,8 +393,22 @@ def train(hps: HParams,
     # divergence-leaves-its-record discipline) and a one-deep background
     # checkpoint writer — in the steady state the loop never blocks on a
     # device->host sync between dispatches
-    drain = MetricsDrain(writer, defer=hps.metrics_defer,
-                         check=check_finite)
+    # health watchdog (ISSUE 7): fed each drained row BEFORE
+    # check_finite, so a divergence leaves its incident.json post-mortem
+    # even when check_finite then stops the run. With the watchdog off
+    # (default) the check chain is exactly check_finite — bitwise the
+    # pre-watchdog loop.
+    wd_monitor = None
+    check = check_finite
+    if (watchdog or halt_on_anomaly) and is_primary():
+        wd_monitor = WatchdogMonitor(write_dir,
+                                     halt=halt_on_anomaly).arm()
+
+        def check(scalars, at_step, _wd=wd_monitor):
+            _wd(scalars, at_step)
+            check_finite(scalars, at_step)
+
+    drain = MetricsDrain(writer, defer=hps.metrics_defer, check=check)
     ckpt = (AsyncCheckpointer(write_dir)
             if write_dir and hps.async_checkpoint else None)
     ledger = GoodputLedger(GOODPUT_PHASES)
@@ -533,7 +566,22 @@ def train(hps: HParams,
         # finiteness guard — divergence still stops the run before the
         # final checkpoint commits) lands here
         drain.flush()
+    except AnomalyHalt as halt:
+        # --halt_on_anomaly tripped: force a post-mortem checkpoint of
+        # the live state into <workdir>/incident/ — NOT the resume
+        # directory, so a possibly-diverged state can never become
+        # latest_checkpoint and wedge resume-from-latest — then let the
+        # halt propagate (the finally below still drains/joins/exports)
+        if write_dir:
+            inc_dir = os.path.join(write_dir, INCIDENT_CKPT_DIR)
+            save_checkpoint(inc_dir, state, scale_factor, hps)
+            print(f"[watchdog] post-mortem checkpoint (step "
+                  f"{int(state.step)}) forced into {inc_dir}; resume "
+                  f"directory left untouched: {halt}", flush=True)
+        raise
     finally:
+        if wd_monitor is not None:
+            wd_monitor.disarm()
         feeder.close()
         # best-effort: persist the pending deferred window so a crash
         # post-mortem has its last metrics row (the synchronous loop

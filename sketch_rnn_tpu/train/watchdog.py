@@ -1,0 +1,357 @@
+"""Training health watchdog: anomaly detection over the metrics stream.
+
+ISSUE 7 tentpole piece 2. PR 3-6 made every training pathology
+*recorded* — NaN losses stop the run (``check_finite``), stalls land in
+the GoodputLedger's ``t_<phase>_s`` columns, throughput in
+``steps_per_sec`` — but nothing *watches* the stream: a loss spike at
+step 40k is found by a human reading the CSV after the run (the
+TensorFlow system paper treats continuous health monitoring as part of
+a production training system, not an afterthought).
+
+Two layers, split so the detection logic stays testable in isolation:
+
+- :class:`Watchdog` — a PURE detector. ``feed(step, row)`` takes one
+  metrics row (the exact dict the ``MetricsWriter`` persists) and
+  returns the anomalies it implies. No I/O, no telemetry, no wall
+  clock: deterministic for a deterministic row stream, which is what
+  lets a test inject a synthetic loss-spike corpus and pin the trip
+  step. Detectors:
+
+  * **non-finite** — any NaN/inf value in the row (named per metric);
+  * **spike** — rolling robust z-score (median + MAD over the last
+    ``window`` rows) on ``spike_metrics`` (loss, grad_norm by
+    default); only UPWARD excursions flag (a falling loss is the
+    point of training). MAD-based, so the baseline tolerates the
+    occasional prior spike without drifting (mean/stddev would);
+  * **stall** — the GoodputLedger phase columns: when the window's
+    accounted host time is dominated by non-compute phases
+    (feeder_wait / ckpt_wait / metrics_drain), the loop is starving,
+    not training;
+  * **throughput collapse** — ``steps_per_sec`` under
+    ``collapse_frac`` x its rolling median.
+
+- :class:`WatchdogMonitor` — the (thin) impure wrapper the training
+  loop installs on the metrics drain. On a trip it emits a telemetry
+  incident event (cat ``watchdog`` — visible live on the /metrics
+  endpoint via the ``incidents`` counter and in the exported trace),
+  writes a structured ``incident.json`` post-mortem (the anomalies,
+  the last-K metrics rows, the telemetry snapshot), prints one warning
+  — and, only with ``halt=True`` (``cli train --halt_on_anomaly``),
+  raises :class:`AnomalyHalt`, which the loop turns into a forced
+  post-mortem checkpoint under ``<workdir>/incident/`` (NOT the resume
+  directory: a possibly-diverged state must never become
+  ``latest_checkpoint``) before propagating.
+
+OFF by default and bitwise-invisible when off (the PR 6 pin extended):
+``train()`` builds no monitor unless asked, and a warn-only watchdog on
+a healthy run writes nothing and changes no logged value — it only
+reads rows the drain already produced. Rows arrive one window late
+under ``metrics_defer`` (the PR 3 contract), so detection latency is
+one log window — the same latency ``check_finite`` already has.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+from sketch_rnn_tpu.utils.telemetry import get_telemetry, json_safe
+
+INCIDENT_FILE = "incident.json"
+INCIDENT_CKPT_DIR = "incident"
+
+# the module-level registry of armed monitors, for the tier-1 conftest
+# guard: tests must never leak an armed watchdog (train() disarms in
+# its finally)
+_ARMED: set = set()
+
+
+class AnomalyHalt(RuntimeError):
+    """Raised by a halting monitor; carries the trip's anomalies."""
+
+    def __init__(self, step: int, anomalies: List["Anomaly"]):
+        self.step = step
+        self.anomalies = anomalies
+        names = ", ".join(f"{a.kind}:{a.metric}" for a in anomalies)
+        super().__init__(
+            f"watchdog halt at step {step}: {names} — see incident.json "
+            f"in the workdir for the post-mortem")
+
+
+@dataclasses.dataclass
+class Anomaly:
+    """One detected anomaly: what tripped, on which metric, and the
+    evidence (value vs threshold) a post-mortem needs."""
+
+    kind: str        # "nonfinite" | "spike" | "stall" | "throughput"
+    metric: str      # the offending metric/column name
+    step: int
+    value: float
+    threshold: float
+    detail: str
+
+    def to_json(self) -> Dict:
+        d = dataclasses.asdict(self)
+        # json.dump rejects inf/nan only under allow_nan=False, but a
+        # post-mortem must stay loadable by strict parsers either way
+        for k in ("value", "threshold"):
+            if not math.isfinite(d[k]):
+                d[k] = repr(d[k])
+        return d
+
+
+class Watchdog:
+    """Pure anomaly detector over the training metrics-row stream.
+
+    ``feed(step, row)`` returns the row's anomalies (usually empty) and
+    then absorbs the row into its rolling state. Rolling baselines use
+    median + MAD over the previous ``window`` rows and activate only
+    after ``min_history`` rows, so startup transients (the first
+    windows include compile time and an untrained loss cliff) cannot
+    trip. ``last_rows(k)`` returns the most recent rows for the
+    incident post-mortem.
+    """
+
+    def __init__(self,
+                 spike_metrics: Sequence[str] = ("loss", "grad_norm"),
+                 window: int = 32,
+                 min_history: int = 8,
+                 z_thresh: float = 8.0,
+                 stall_phases: Sequence[str] = ("feeder_wait",
+                                                "ckpt_wait",
+                                                "metrics_drain"),
+                 stall_frac: float = 0.75,
+                 stall_min_s: float = 1.0,
+                 collapse_metric: str = "steps_per_sec",
+                 collapse_frac: float = 0.25,
+                 keep_rows: int = 16):
+        if window < 2 or min_history < 2:
+            raise ValueError("window and min_history must be >= 2")
+        if min_history > window:
+            raise ValueError(f"min_history={min_history} exceeds "
+                             f"window={window}")
+        self.spike_metrics = tuple(spike_metrics)
+        self.window = window
+        self.min_history = min_history
+        self.z_thresh = z_thresh
+        self.stall_phases = tuple(stall_phases)
+        self.stall_frac = stall_frac
+        self.stall_min_s = stall_min_s
+        self.collapse_metric = collapse_metric
+        self.collapse_frac = collapse_frac
+        self._hist: Dict[str, deque] = {
+            m: deque(maxlen=window)
+            for m in (*self.spike_metrics, collapse_metric)}
+        self._rows: deque = deque(maxlen=keep_rows)
+        self._rows_seen = 0
+
+    # -- rolling-statistic helpers ----------------------------------------
+
+    @staticmethod
+    def _median(xs: List[float]) -> float:
+        s = sorted(xs)
+        n = len(s)
+        mid = n // 2
+        return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+    def _robust_z(self, x: float, hist: deque) -> Optional[float]:
+        """|x - median| / (1.4826 * MAD), sign-aware (positive above
+        the median). The denominator is floored at 1% of |median| so a
+        near-constant history (MAD ~ 0) answers float jitter with a
+        finite z instead of tripping on nothing."""
+        if len(hist) < self.min_history:
+            return None
+        xs = list(hist)
+        med = self._median(xs)
+        mad = self._median([abs(v - med) for v in xs])
+        denom = 1.4826 * mad + 0.01 * abs(med) + 1e-12
+        return (x - med) / denom
+
+    # -- detection ---------------------------------------------------------
+
+    def feed(self, step: int, row: Dict[str, float]) -> List[Anomaly]:
+        """Detect anomalies in ``row``, then absorb it into the rolling
+        state (detection always compares against PRIOR rows only, so a
+        spike cannot soften its own threshold)."""
+        out: List[Anomaly] = []
+        for k, v in sorted(row.items()):
+            if k != "wall_time" and not math.isfinite(float(v)):
+                out.append(Anomaly(
+                    kind="nonfinite", metric=k, step=step,
+                    value=float(v), threshold=math.inf,
+                    detail=f"{k} went non-finite"))
+        for m in self.spike_metrics:
+            if m not in row or not math.isfinite(float(row[m])):
+                continue
+            z = self._robust_z(float(row[m]), self._hist[m])
+            if z is not None and z > self.z_thresh:
+                out.append(Anomaly(
+                    kind="spike", metric=m, step=step,
+                    value=float(row[m]), threshold=self.z_thresh,
+                    detail=f"{m} robust z-score {z:.1f} > "
+                           f"{self.z_thresh:g} over the last "
+                           f"{len(self._hist[m])} rows"))
+        out.extend(self._check_stall(step, row))
+        out.extend(self._check_collapse(step, row))
+        # absorb AFTER detection; keep non-finite values out of the
+        # rolling baselines (one NaN would poison every later MAD)
+        for m in self._hist:
+            if m in row and math.isfinite(float(row[m])):
+                self._hist[m].append(float(row[m]))
+        self._rows.append({"step": step, **row})
+        self._rows_seen += 1
+        return out
+
+    def _check_stall(self, step: int,
+                     row: Dict[str, float]) -> List[Anomaly]:
+        # startup gate, like the z-score detectors: the first windows
+        # legitimately look stalled (prefetch queue filling, writer
+        # threads warming) — the docstring's no-startup-trips promise
+        # applies to every detector, not just the statistical ones
+        if self._rows_seen < self.min_history:
+            return []
+        phases = {k: float(v) for k, v in row.items()
+                  if k.startswith("t_") and k.endswith("_s")
+                  and math.isfinite(float(v))}
+        accounted = sum(phases.values())
+        if accounted < self.stall_min_s:
+            return []
+        stall_cols = [f"t_{p}_s" for p in self.stall_phases]
+        stall_s = sum(phases.get(c, 0.0) for c in stall_cols)
+        frac = stall_s / accounted
+        if frac <= self.stall_frac:
+            return []
+        worst = max(stall_cols, key=lambda c: phases.get(c, 0.0))
+        return [Anomaly(
+            kind="stall", metric=worst, step=step,
+            value=round(frac, 4), threshold=self.stall_frac,
+            detail=f"non-compute phases took {frac:.0%} of the window's "
+                   f"{accounted:.2f}s accounted host time (worst: "
+                   f"{worst}={phases.get(worst, 0.0):.2f}s)")]
+
+    def _check_collapse(self, step: int,
+                        row: Dict[str, float]) -> List[Anomaly]:
+        m = self.collapse_metric
+        if m not in row or not math.isfinite(float(row[m])):
+            return []
+        hist = self._hist[m]
+        if len(hist) < self.min_history:
+            return []
+        med = self._median(list(hist))
+        x = float(row[m])
+        if med > 0 and x < self.collapse_frac * med:
+            return [Anomaly(
+                kind="throughput", metric=m, step=step,
+                value=x, threshold=round(self.collapse_frac * med, 6),
+                detail=f"{m}={x:.3f} fell under {self.collapse_frac:g}x "
+                       f"the rolling median {med:.3f}")]
+        return []
+
+    def last_rows(self, k: Optional[int] = None) -> List[Dict]:
+        rows = list(self._rows)
+        return rows if k is None else rows[-k:]
+
+
+class WatchdogMonitor:
+    """The impure shell: detector -> incident artifacts (+ optional
+    halt). Installed as the metrics drain's check callback by
+    ``train()``; call signature matches ``check_finite``.
+    """
+
+    # a warn-only monitor on a persistently sick run trips every log
+    # window; the retained history (and what incident.json re-writes)
+    # must stay bounded or the post-mortem machinery itself becomes the
+    # hot-path cost. The file keeps the newest KEEP_ANOMALIES.
+    KEEP_ANOMALIES = 64
+
+    def __init__(self, workdir: Optional[str], halt: bool = False,
+                 detector: Optional[Watchdog] = None):
+        self.workdir = workdir
+        self.halt = halt
+        self.detector = detector if detector is not None else Watchdog()
+        self.incidents: deque = deque(maxlen=self.KEEP_ANOMALIES)
+        self.total_anomalies = 0
+        self.incident_path: Optional[str] = None
+
+    def arm(self) -> "WatchdogMonitor":
+        _ARMED.add(self)
+        return self
+
+    def disarm(self) -> None:
+        _ARMED.discard(self)
+
+    def __call__(self, scalars: Dict[str, float], step: int) -> None:
+        anomalies = self.detector.feed(step, scalars)
+        if not anomalies:
+            return
+        self.incidents.extend(anomalies)
+        self.total_anomalies += len(anomalies)
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter("incidents", len(anomalies), cat="watchdog")
+            for a in anomalies:
+                tel.instant("incident", cat="watchdog", args=a.to_json())
+        self.incident_path = self._write_incident(step, anomalies)
+        names = ", ".join(f"{a.kind}:{a.metric}" for a in anomalies)
+        where = (f"; post-mortem written to {self.incident_path}"
+                 if self.incident_path else "")
+        print(f"[watchdog] WARNING: anomaly at step {step}: {names}"
+              f"{where}", flush=True)
+        if self.halt:
+            raise AnomalyHalt(step, anomalies)
+
+    def _write_incident(self, step: int,
+                        anomalies: List[Anomaly]) -> Optional[str]:
+        """Write/refresh ``<workdir>/incident.json``: the offending
+        anomalies (latest trip), every anomaly so far, the last-K
+        metrics rows, and the telemetry snapshot when tracing is on.
+        Atomic (tmp + rename): a reader never sees a torn post-mortem.
+        """
+        if not self.workdir:
+            return None
+        tel = get_telemetry()
+        snap = None
+        if tel.enabled:
+            raw = tel.snapshot()
+            snap = {
+                "aggregates": {f"{c}/{n}": v for (c, n), v in
+                               sorted(raw["aggregates"].items())},
+                "counters": {f"{c}/{n}": v for (c, n), v in
+                             sorted(raw["counters"].items())},
+                "gauges": {f"{c}/{n}": v for (c, n), v in
+                           sorted(raw["gauges"].items())},
+                "hists": {f"{c}/{n}": h["summary"] for (c, n), h in
+                          sorted(raw["hists"].items())},
+            }
+        doc = {
+            "step": step,
+            "wall_time": time.time(),
+            "halt": self.halt,
+            "anomalies": [a.to_json() for a in anomalies],
+            # bounded tail (newest KEEP_ANOMALIES); the exact lifetime
+            # count rides alongside so a reader knows what was dropped
+            "total_anomalies": self.total_anomalies,
+            "recent_anomalies": [a.to_json() for a in self.incidents],
+            "last_rows": self.detector.last_rows(),
+            "telemetry": snap,
+        }
+        os.makedirs(self.workdir, exist_ok=True)
+        path = os.path.join(self.workdir, INCIDENT_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            # json_safe: last_rows carry the raw NaN/inf values that
+            # tripped the detector — strict consumers must still be
+            # able to read the post-mortem (allow_nan=False enforces)
+            json.dump(json_safe(doc), f, indent=2, allow_nan=False)
+        os.replace(tmp, path)
+        return path
+
+
+def armed_monitors() -> tuple:
+    """Live armed monitors (the conftest no-leak guard reads this)."""
+    return tuple(_ARMED)

@@ -69,6 +69,25 @@ DEVICE_TRACE_STOP = "device_trace_stop"
 PROFILER_CAT = "profiler"
 
 
+def json_safe(obj):
+    """Strict-JSON-safe copy: non-finite floats become repr strings.
+
+    Python's ``json.dumps`` happily emits the non-standard ``NaN`` /
+    ``Infinity`` tokens, which strict consumers (jq, ``JSON.parse``,
+    Go) reject — fatal for exactly the artifacts that carry non-finite
+    values by design (a NaN-loss incident post-mortem, an infinite
+    SLO burn rate in a health payload or bench report). Shared by the
+    watchdog, the /healthz payload and the serve-bench report.
+    """
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return repr(obj)
+    return obj
+
+
 class Histogram:
     """Streaming log-bucket histogram: quantiles without sample retention.
 
@@ -112,9 +131,19 @@ class Histogram:
         self._buckets[i] = self._buckets.get(i, 0) + 1
 
     def quantile(self, q: float) -> float:
-        """Approximate ``q``-quantile (``q`` in [0, 1]) of the stream."""
+        """Approximate ``q``-quantile of the stream.
+
+        Well-defined at every stream length (ISSUE 7 satellite): an
+        EMPTY histogram answers 0.0 (there is no sample to clamp to —
+        callers that need "no data" distinct from zero check ``count``),
+        a single-sample histogram answers that sample for every ``q``
+        (the bucket midpoint clamps to [vmin, vmax] == [v, v]), and
+        ``q`` outside [0, 1] clamps to the range instead of producing a
+        negative rank that would walk the buckets nonsensically.
+        """
         if self.count == 0:
             return 0.0
+        q = min(max(q, 0.0), 1.0)
         rank = q * (self.count - 1)  # np.percentile's 'linear' rank
         cum = self._zero
         if rank < cum:
@@ -125,6 +154,25 @@ class Histogram:
                 mid = self.GROWTH ** (i + 0.5)
                 return min(max(mid, self.vmin), self.vmax)
         return self.vmax
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(upper_edge, count_at_or_below)`` pairs — the
+        Prometheus histogram exposition shape (``le=`` buckets).
+
+        The zero bucket exports with edge 0.0; geometric buckets export
+        their exclusive upper edge ``G**(i+1)``. Empty histograms return
+        ``[]`` (the renderer still emits ``+Inf``/sum/count lines, so an
+        unseen series scrapes as a valid zero histogram rather than
+        erroring)."""
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        if self._zero:
+            cum = self._zero
+            out.append((0.0, cum))
+        for i in sorted(self._buckets):
+            cum += self._buckets[i]
+            out.append((self.GROWTH ** (i + 1), cum))
+        return out
 
     def summary(self) -> Dict[str, float]:
         if self.count == 0:
@@ -204,6 +252,10 @@ class Telemetry:
         self._agg: Dict[Tuple[str, str], List[float]] = {}
         self._counters: Dict[Tuple[str, str], float] = {}
         self._hists: Dict[Tuple[str, str], Histogram] = {}
+        # keys in _counters that hold a gauge's latest SAMPLE rather
+        # than a monotonic total — the /metrics renderer must type them
+        # differently (Prometheus gauge vs counter)
+        self._gauge_keys: set = set()
 
     # -- recording ---------------------------------------------------------
 
@@ -270,6 +322,7 @@ class Telemetry:
         t = (time.perf_counter() if ts is None else ts) - self.origin_perf
         with self._lock:
             self._counters[(cat, name)] = float(value)
+            self._gauge_keys.add((cat, name))
             self._append({"type": "counter", "name": name, "cat": cat,
                           "ts": t, "value": float(value)})
 
@@ -312,6 +365,28 @@ class Telemetry:
         with self._lock:
             h = self._hists.get((cat, name))
             return None if h is None else h.summary()
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """One consistent view of every aggregate store, taken under a
+        single lock acquisition — what the ``/metrics`` endpoint renders
+        and the watchdog embeds in ``incident.json``. Counters and
+        gauges come back separated (gauges hold their latest sample,
+        not a monotonic total), histograms as ``{summary, buckets}``.
+        """
+        with self._lock:
+            return {
+                "aggregates": {k: (int(v[0]), float(v[1]))
+                               for k, v in self._agg.items()},
+                "counters": {k: v for k, v in self._counters.items()
+                             if k not in self._gauge_keys},
+                "gauges": {k: v for k, v in self._counters.items()
+                           if k in self._gauge_keys},
+                "hists": {k: {"summary": h.summary(),
+                              "total": h.total,
+                              "buckets": h.buckets()}
+                          for k, h in self._hists.items()},
+                "dropped": self.dropped,
+            }
 
     # -- exporters ---------------------------------------------------------
 

@@ -37,6 +37,30 @@ def _hermetic_telemetry():
 
 
 @pytest.fixture(autouse=True)
+def _no_stray_health_surfaces():
+    """ISSUE 7 guard: the health/SLO layer is OFF by default — no test
+    may leak a listening /metrics socket or an armed watchdog monitor
+    into later tests (the serve/metrics_http.py registry and the
+    train/watchdog.py armed set exist for exactly this check). A leak
+    is shut down AND failed loudly, naming the leaker via the fixture's
+    teardown error. Incident files are covered separately: train()
+    builds no monitor unless asked, and the watchdog tests assert a
+    clean default run writes no incident.json."""
+    yield
+    from sketch_rnn_tpu.serve import metrics_http
+    from sketch_rnn_tpu.train import watchdog
+
+    leaked_servers = metrics_http.stop_all()
+    leaked_monitors = watchdog.armed_monitors()
+    for m in leaked_monitors:
+        m.disarm()
+    assert not leaked_servers, (
+        f"test leaked live metrics servers: {leaked_servers}")
+    assert not leaked_monitors, (
+        f"test leaked armed watchdog monitors: {leaked_monitors}")
+
+
+@pytest.fixture(autouse=True)
 def _hermetic_bench_history(tmp_path, monkeypatch):
     """Tests must never append to the repo's COMMITTED bench history
     files — the r5 review found test-suite smoke rows accumulated in

@@ -1,0 +1,175 @@
+"""bench_regress.py tests (ISSUE 7): the perf-claim gate.
+
+The gate's job is an exit code a driver can trust, so the pins are
+behavioral: regressions under the cell's own noise band exit 1,
+in-band noise exits 0, thin/new cells never gate, and the --smoke
+self-check stays green against the COMMITTED smoke history (the
+tier-1 wiring the satellite asks for — if a future bench round
+commits an out-of-band tail row, this test is the tripwire).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts import bench_regress
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _train_row(v, **kw):
+    return {"kind": "train", "dec_model": "lstm", "batch_size": 4096,
+            "seq_len": 250, "dtype": "bfloat16", "fused_rnn": True,
+            "resid_dtype": "bfloat16", "steps_per_call": 5,
+            "transfer_dtype": "int16", "steps": 25, "device_kind": "v5e",
+            "strokes_per_sec_per_chip": v, **kw}
+
+
+def _write(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(path)
+
+
+def test_in_band_fresh_passes_and_regression_fails(tmp_path, capsys):
+    hist = _write(tmp_path / "hist.jsonl",
+                  [_train_row(v) for v in (100.0, 104.0, 98.0, 101.0)])
+    ok = _write(tmp_path / "ok.jsonl", [_train_row(97.0)])
+    bad = _write(tmp_path / "bad.jsonl", [_train_row(50.0)])
+
+    assert bench_regress.main(
+        ["--fresh", ok, "--history", hist]) == 0
+    out = capsys.readouterr().out
+    assert "ok" in out and "REGRESS" not in out
+
+    assert bench_regress.main(
+        ["--fresh", bad, "--history", hist, "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["regressions"] == 1
+    (row,) = rep["rows"]
+    assert row["verdict"] == "REGRESS" and row["fresh"] == 50.0
+    # band: history spread (98/104 ~ 6%) floored at 10%, slack 5%:
+    # floor = 104 * 0.9 * 0.95
+    assert row["floor"] == pytest.approx(104 * 0.9 * 0.95)
+
+
+def test_record_and_band_from_noisy_history(tmp_path, capsys):
+    # noisy cell: spread 50% -> the band widens to the observed spread
+    hist = _write(tmp_path / "h.jsonl",
+                  [_train_row(v) for v in (200.0, 100.0, 180.0)])
+    fresh = _write(tmp_path / "f.jsonl", [_train_row(110.0)])
+    assert bench_regress.main(
+        ["--fresh", fresh, "--history", hist, "--json"]) == 0
+    (row,) = json.loads(capsys.readouterr().out)["rows"]
+    assert row["verdict"] == "ok" and row["band"] == 0.5
+
+    rec = _write(tmp_path / "r.jsonl", [_train_row(250.0)])
+    assert bench_regress.main(
+        ["--fresh", rec, "--history", hist, "--json"]) == 0
+    (row,) = json.loads(capsys.readouterr().out)["rows"]
+    assert row["verdict"] == "record"
+
+
+def test_thin_and_new_cells_never_gate(tmp_path, capsys):
+    hist = _write(tmp_path / "h.jsonl", [_train_row(100.0)])
+    fresh = _write(tmp_path / "f.jsonl", [
+        _train_row(1.0),                       # thin: 1 prior row
+        {**_train_row(1.0), "dec_model": "hyper"},  # new: no history
+    ])
+    assert bench_regress.main(
+        ["--fresh", fresh, "--history", hist, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    verdicts = sorted(r["verdict"] for r in rep["rows"])
+    assert verdicts == ["new", "thin"]
+
+
+def test_implausible_and_unavailable_rows_excluded(tmp_path, capsys):
+    hist = _write(tmp_path / "h.jsonl", [
+        _train_row(100.0), _train_row(101.0), _train_row(99.0),
+        # a slow-window record must not lower the band's floor, and an
+        # outage marker must not judge at all
+        _train_row(10.0, plausible=False),
+        {"kind": "unavailable", "dec_model": "lstm"},
+    ])
+    fresh = _write(tmp_path / "f.jsonl", [
+        _train_row(80.0),
+        _train_row(1.0, plausible=False),      # not judged
+    ])
+    assert bench_regress.main(
+        ["--fresh", fresh, "--history", hist, "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert len(rep["rows"]) == 1               # implausible fresh skipped
+    assert rep["rows"][0]["n_hist"] == 3       # implausible hist skipped
+    assert rep["rows"][0]["verdict"] == "REGRESS"
+
+
+def test_serve_and_bucket_rows_gate_on_their_headline(tmp_path, capsys):
+    serve = {"kind": "serve_bench", "dec_model": "lstm", "slots": 8,
+             "chunk": 8, "n_requests": 48, "len_dist": "bimodal",
+             "device_kind": "cpu"}
+    bucket = {"kind": "bucket_bench", "dec_model": "lstm",
+              "batch_size": 32, "max_seq_len": 128,
+              "bucket_edges": [16, 32], "device_kind": "cpu"}
+    hist = _write(tmp_path / "h.jsonl", [
+        {**serve, "engine_sketches_per_sec": v} for v in (300, 320, 310)
+    ] + [
+        {**bucket, "speedup_steps_per_sec": v} for v in (3.0, 3.2, 3.1)
+    ])
+    fresh = _write(tmp_path / "f.jsonl", [
+        {**serve, "engine_sketches_per_sec": 305.0},
+        {**bucket, "speedup_steps_per_sec": 1.1},
+    ])
+    assert bench_regress.main(
+        ["--fresh", fresh, "--history", hist, "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    by_kind = {r["key"][0]: r for r in rep["rows"]}
+    assert by_kind["serve"]["verdict"] == "ok"
+    assert by_kind["bucket"]["verdict"] == "REGRESS"
+
+
+def test_usage_errors_are_one_liners(tmp_path, capsys):
+    assert bench_regress.main([]) == 2
+    assert "--fresh" in capsys.readouterr().err
+    assert bench_regress.main(
+        ["--fresh", str(tmp_path / "missing.jsonl")]) == 2
+    assert "not found" in capsys.readouterr().err
+    empty = _write(tmp_path / "empty.jsonl", [])
+    assert bench_regress.main(["--fresh", empty]) == 2
+    assert "no gateable rows" in capsys.readouterr().err
+
+
+def test_smoke_self_check_against_committed_history(capsys):
+    """THE tier-1 wiring: the committed smoke history's tail rows sit
+    inside their own cells' noise bands. A future round that commits a
+    regressed tail row fails here — the perf claim becomes checkable
+    at test time, with no bench run needed."""
+    assert os.path.exists(os.path.join(ROOT, "BENCH_SMOKE_HISTORY.jsonl"))
+    assert bench_regress.main(["--smoke"]) == 0
+    out = capsys.readouterr().out
+    assert "0 regression(s)" in out
+    assert "cell(s) judged" in out
+
+
+def test_smoke_streamed_log_with_echo_lines_tolerated(tmp_path, capsys):
+    """Driver-captured stdout (streamed rows + '# ' echoes + chatter)
+    judges the same as a clean history file."""
+    log = tmp_path / "captured.log"
+    with open(log, "w") as f:
+        f.write("# bench starting\n")
+        f.write("# " + json.dumps(_train_row(100.0)) + "\n")
+        f.write(json.dumps(_train_row(102.0)) + "\n")
+        f.write('{"metric": "train_strokes_per_sec_per_chip", '
+                '"value": 102.0}\n')   # summary line: no kind, skipped
+        f.write('{"torn...\n')
+    hist = _write(tmp_path / "h.jsonl",
+                  [_train_row(v) for v in (100.0, 101.0, 99.0)])
+    assert bench_regress.main(
+        ["--fresh", str(log), "--history", hist, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert len(rep["rows"]) == 2
+    assert sorted(r["verdict"] for r in rep["rows"]) == ["ok", "record"]

@@ -135,6 +135,70 @@ def test_cli_serve_bench_random_init(tmp_path, capsys):
             rep[f"latency_p{p}_s"]
 
 
+def test_cli_serve_bench_bad_slo_is_usage_error(tmp_path, capsys):
+    # fails fast (before any model build/compile), one line on stderr
+    assert main(["serve-bench", "--random_init", "--slo", "nope",
+                 f"--workdir={tmp_path}"]) == 2
+    assert "SLO spec" in capsys.readouterr().err
+
+
+def test_cli_serve_bench_metrics_port_composes_with_trace_dir(tmp_path,
+                                                              capsys):
+    """ISSUE 7 satellite: --trace_dir + --metrics_port compose — the
+    run serves a live /metrics endpoint, archives its final scrape as
+    metrics.prom beside the trace, and the scrape's request counter +
+    latency histogram series reconcile with the printed summary."""
+    wd = str(tmp_path / "serve_wd")
+    td = str(tmp_path / "serve_trace")
+    assert main(["serve-bench", "--random_init", "-n", "6",
+                 "--slots", "3", "--chunk", "2", "--metrics_port", "0",
+                 "--slo", "p95<=30", f"--workdir={wd}",
+                 f"--trace_dir={td}",
+                 f"--hparams={HP},serve_slots=3,serve_chunk=2"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["completed"] == 6
+    assert rep["metrics_port"] > 0
+    # SLO summary rides in the report (engine fed the tracker the
+    # exact Result latencies; a 30s objective on a smoke run is met)
+    slo = rep["slo"]["generate:latency_s:p95"]
+    assert slo["total"] == 6 and slo["met"] is True
+    # the archived scrape is real exposition text with the request
+    # counter and a latency histogram series matching the summary
+    prom = rep["metrics_prom"]
+    assert prom == os.path.join(td, "metrics.prom")
+    text = open(prom).read()
+    assert ("sketch_rnn_serve_requests_completed_total 6" in text)
+    assert "# TYPE sketch_rnn_serve_latency_s histogram" in text
+    assert "sketch_rnn_serve_latency_s_count 6" in text
+    assert 'sketch_rnn_serve_latency_s_bucket{le="+Inf"} 6' in text
+    assert 'sketch_rnn_slo_requests_total{slo="generate:latency_s:p95"} 6' \
+        in text
+    # no server outlives the cli call (the conftest guard also checks)
+    from sketch_rnn_tpu.serve import metrics_http
+    assert metrics_http.live_servers() == ()
+
+
+def test_cli_serve_bench_metrics_port_without_trace_dir(tmp_path,
+                                                        capsys):
+    """--metrics_port alone still serves real data: the core is
+    enabled for the run (counters/histograms feed /metrics) but no
+    telemetry files are exported — metrics.prom lands in the workdir."""
+    wd = str(tmp_path / "serve_wd")
+    assert main(["serve-bench", "--random_init", "-n", "4",
+                 "--slots", "2", "--chunk", "2", "--metrics_port", "0",
+                 f"--workdir={wd}",
+                 f"--hparams={HP},serve_slots=2,serve_chunk=2"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["completed"] == 4
+    text = open(os.path.join(wd, "metrics.prom")).read()
+    assert "sketch_rnn_serve_requests_completed_total 4" in text
+    assert "sketch_rnn_serve_latency_s_count 4" in text
+    assert not os.path.exists(os.path.join(wd, "telemetry.jsonl"))
+    # the core was restored to the process default
+    from sketch_rnn_tpu.utils import telemetry as tele
+    assert not tele.get_telemetry().enabled
+
+
 def test_graft_entry_compiles():
     import __graft_entry__ as ge
     fn, args = ge.entry()

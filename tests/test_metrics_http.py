@@ -1,0 +1,362 @@
+"""Health & SLO layer tests (ISSUE 7): /metrics, /healthz, SLO tracker.
+
+Load-bearing contracts:
+
+1. **No new bookkeeping**: every /metrics series is a pure render of
+   one ``Telemetry.snapshot()`` — counters scrape as exact totals,
+   histograms as cumulative log buckets whose recovered quantiles
+   agree with the in-process summary within one geometric bucket.
+2. **Scrape == summary**: a scrape taken while (and after) a serve run
+   reconciles with ``ServeEngine.run()``'s end-of-run metrics — counts
+   equal exactly, percentiles within one log bucket (THE acceptance).
+3. **SLO math is deterministic**: compliance/burn-rate from a known
+   request stream is exact, and /healthz flips to degraded on a
+   violated objective.
+"""
+
+import json
+import math
+import re
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.serve import metrics_http
+from sketch_rnn_tpu.serve.metrics_http import (
+    MetricsServer,
+    health_payload,
+    render_prometheus,
+)
+from sketch_rnn_tpu.serve.slo import SLO, SLOTracker, parse_slo
+from sketch_rnn_tpu.utils import telemetry as tele
+from sketch_rnn_tpu.utils.telemetry import Histogram, Telemetry
+
+
+def _get(url: str) -> tuple:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _series(text: str) -> dict:
+    """Parse exposition text into {sample_line_name{labels}: float}."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        out[name] = float(value)
+    return out
+
+
+def _hist_quantile(text: str, base: str, q: float) -> float:
+    """Recover a quantile from the scraped cumulative buckets — what a
+    Prometheus ``histogram_quantile`` would see."""
+    pat = re.compile(re.escape(base) + r'_bucket\{le="([^"]+)"\} (\S+)')
+    buckets = [(float(le) if le != "+Inf" else math.inf, float(v))
+               for le, v in pat.findall(text)]
+    count = buckets[-1][1]
+    rank = q * (count - 1)
+    prev_edge = 0.0
+    for le, cum in buckets:
+        if rank < cum:
+            if le == 0.0 or math.isinf(le):
+                return prev_edge
+            # geometric midpoint of (le/G, le] — the Histogram's answer
+            return le / (Histogram.GROWTH ** 0.5)
+        prev_edge = le
+    return prev_edge
+
+
+# -- SLO tracker -------------------------------------------------------------
+
+
+def test_parse_slo_specs():
+    s = parse_slo("p95<=0.25")
+    assert (s.endpoint, s.metric, s.target, s.objective_s) == \
+        ("generate", "latency_s", 0.95, 0.25)
+    s = parse_slo("gen2:p99<=400ms")
+    assert (s.endpoint, s.target, s.objective_s) == ("gen2", 0.99, 0.4)
+    s = parse_slo("generate:decode_s:p50<=0.1")
+    assert (s.metric, s.target) == ("decode_s", 0.5)
+    for bad in ("p95", "q95<=0.1", "p95<=fast", "a:b:c:p95<=1"):
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+
+
+def test_slo_tracker_compliance_and_burn_rate():
+    # p80 <= 0.1s, budget 0.2; feed 10 requests, 3 over objective
+    tr = SLOTracker([SLO(objective_s=0.1, target=0.8)], window=8)
+    lats = [0.05] * 7 + [0.2, 0.3, 0.4]
+    for v in lats:
+        tr.observe("generate", {"latency_s": v})
+    rec = tr.summary()["generate:latency_s:p80"]
+    assert rec["total"] == 10 and rec["breaches"] == 3
+    assert rec["compliance"] == pytest.approx(0.7)
+    assert rec["met"] is False
+    # exact totals: 3/10 breach over 0.2 budget = 1.5x burn
+    assert rec["burn_rate_total"] == pytest.approx(1.5)
+    # rolling window (last 8): 3 breaches / 8 = 0.375 / 0.2
+    assert rec["window_n"] == 8
+    assert rec["burn_rate"] == pytest.approx(0.375 / 0.2)
+    assert not tr.healthy()
+
+
+def test_slo_tracker_healthy_paths():
+    tr = SLOTracker([SLO(objective_s=0.1, target=0.5)], min_requests=8)
+    assert tr.healthy()  # no data = healthy
+    for _ in range(4):
+        tr.observe("generate", {"latency_s": 1.0})
+    # violated but under min_requests: still healthy (warmup noise)
+    assert not tr.summary()["generate:latency_s:p50"]["met"]
+    assert tr.healthy()
+    for _ in range(4):
+        tr.observe("generate", {"latency_s": 1.0})
+    assert not tr.healthy()
+    # observations for other endpoints / missing metrics don't count
+    tr2 = SLOTracker([SLO(objective_s=0.1, endpoint="other")])
+    tr2.observe("generate", {"latency_s": 9.0})
+    tr2.observe("other", {"decode_s": 9.0})  # metric absent
+    assert tr2.summary()["other:latency_s:p95"]["total"] == 0
+
+
+def test_slo_zero_budget_burns_infinitely():
+    tr = SLOTracker([SLO(objective_s=0.1, target=1.0)])
+    tr.observe("generate", {"latency_s": 0.01})
+    key = "generate:latency_s:p100"
+    assert tr.summary()[key]["burn_rate_total"] == 0.0
+    tr.observe("generate", {"latency_s": 0.5})
+    assert tr.summary()[key]["burn_rate_total"] == math.inf
+    # an infinite burn rate must not break either surface: /metrics
+    # renders the exposition +Inf literal, /healthz stays strict JSON
+    text = render_prometheus(Telemetry(enabled=False), slo=tr)
+    assert 'sketch_rnn_slo_burn_rate_total{slo="' + key + '"} +Inf' \
+        in text
+    body = json.dumps(health_payload(Telemetry(enabled=False), slo=tr))
+    assert "Infinity" not in body
+    assert json.loads(body)["slo"][key]["burn_rate_total"] == "inf"
+    # the engine summary path (what serve-bench's report embeds) stays
+    # strict-JSON too once sanitized the same way
+    from sketch_rnn_tpu.utils.telemetry import json_safe
+    strict = json.dumps(json_safe({"slo": tr.summary()}),
+                        allow_nan=False)
+    assert json.loads(strict)["slo"][key]["burn_rate"] == "inf"
+
+
+def test_parse_slo_rejects_label_breaking_names():
+    # endpoint/metric become Prometheus label values and Result field
+    # lookups: junk must fail at parse time, not corrupt a scrape or
+    # silently track nothing
+    for bad in ('foo"bar:p95<=1', "generate::p95<=1", ":p95<=1",
+                "generate:la tency:p95<=1"):
+        with pytest.raises(ValueError, match="SLO"):
+            parse_slo(bad)
+    assert parse_slo("my-end.point:p95<=1").endpoint == "my-end.point"
+    # a typo'd metric would track nothing and report vacuous
+    # compliance forever — rejected against the Result latency fields
+    with pytest.raises(ValueError, match="decod_s"):
+        parse_slo("generate:decod_s:p95<=1")
+
+
+# -- histogram exposition (satellite: edge-case hardening) -------------------
+
+
+def test_histogram_buckets_cumulative_and_edges():
+    h = Histogram()
+    assert h.buckets() == []          # empty: well-defined, no error
+    assert h.quantile(0.5) == 0.0
+    assert h.quantile(-3.0) == 0.0 and h.quantile(7.0) == 0.0  # clamped
+    h.observe(0.0)
+    h.observe(0.5)
+    h.observe(0.5)
+    bks = h.buckets()
+    assert bks[0] == (0.0, 1)          # zero bucket exports edge 0.0
+    assert bks[-1][1] == 3             # cumulative reaches count
+    edges = [e for e, _ in bks]
+    assert edges == sorted(edges)
+    # single-sample histogram answers every quantile with the sample
+    h1 = Histogram()
+    h1.observe(0.125)
+    assert h1.quantile(0.0) == h1.quantile(1.0) == 0.125
+    assert h1.quantile(2.5) == 0.125   # out-of-range q clamps, no error
+    assert h1.buckets()[-1][1] == 1
+
+
+def test_render_prometheus_counters_gauges_hists_spans():
+    tel = Telemetry()
+    tel.counter("requests_completed", 3, cat="serve")
+    tel.gauge("slots_live", 7, cat="serve")
+    with tel.span("dispatch", cat="train"):
+        pass
+    for v in (0.1, 0.2, 0.4):
+        tel.observe("latency_s", v, cat="serve")
+    text = render_prometheus(tel)
+    s = _series(text)
+    # counters exact, typed counter; gauges typed gauge
+    assert s["sketch_rnn_serve_requests_completed_total"] == 3
+    assert "# TYPE sketch_rnn_serve_requests_completed_total counter" \
+        in text
+    assert s["sketch_rnn_serve_slots_live"] == 7
+    assert "# TYPE sketch_rnn_serve_slots_live gauge" in text
+    # span aggregates as seconds + count
+    assert s["sketch_rnn_train_dispatch_spans_total"] == 1
+    assert s["sketch_rnn_train_dispatch_seconds_total"] >= 0
+    # histogram: cumulative buckets end at count; sum exact
+    assert s["sketch_rnn_serve_latency_s_count"] == 3
+    assert s["sketch_rnn_serve_latency_s_sum"] == pytest.approx(0.7)
+    assert s['sketch_rnn_serve_latency_s_bucket{le="+Inf"}'] == 3
+    assert "# TYPE sketch_rnn_serve_latency_s histogram" in text
+    assert s["sketch_rnn_telemetry_enabled"] == 1
+    # recovered quantile within one log bucket of the live summary
+    got = _hist_quantile(text, "sketch_rnn_serve_latency_s", 0.5)
+    assert got == pytest.approx(tel.histogram("latency_s", "serve")["p50"],
+                                rel=1e-9)
+
+
+def test_render_prometheus_disabled_core_serves_meta_only():
+    text = render_prometheus(tele.get_telemetry())  # process default: off
+    s = _series(text)
+    assert s["sketch_rnn_up"] == 1
+    assert s["sketch_rnn_telemetry_enabled"] == 0
+
+
+def test_render_prometheus_slo_series():
+    tr = SLOTracker([SLO(objective_s=0.1, target=0.8)])
+    for v in (0.05, 0.05, 0.3):
+        tr.observe("generate", {"latency_s": v})
+    text = render_prometheus(Telemetry(enabled=False), slo=tr)
+    s = _series(text)
+    lab = '{slo="generate:latency_s:p80"}'
+    assert s[f"sketch_rnn_slo_requests_total{lab}"] == 3
+    assert s[f"sketch_rnn_slo_breaches_total{lab}"] == 1
+    assert s[f"sketch_rnn_slo_objective_seconds{lab}"] == 0.1
+    assert s[f"sketch_rnn_slo_compliance{lab}"] == pytest.approx(2 / 3)
+
+
+# -- the HTTP server ---------------------------------------------------------
+
+
+def test_server_healthz_metrics_and_404():
+    tel = tele.configure(trace_dir=None)
+    tel.counter("requests_completed", 5, cat="serve")
+    tr = SLOTracker([SLO(objective_s=10.0)])
+    with MetricsServer(port=0, slo=tr) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(f"{base}/healthz")
+        assert code == 200
+        h = json.loads(body)
+        assert h["status"] == "ok" and h["telemetry_enabled"] is True
+        assert "generate:latency_s:p95" in h["slo"]
+        code, body = _get(f"{base}/metrics")
+        assert code == 200
+        assert _series(body)[
+            "sketch_rnn_serve_requests_completed_total"] == 5
+        with pytest.raises(urllib.request.HTTPError) as e:
+            _get(f"{base}/nope")
+        assert e.value.code == 404
+    assert metrics_http.live_servers() == ()
+    tele.disable()
+
+
+def test_healthz_degrades_on_violated_slo():
+    tr = SLOTracker([SLO(objective_s=0.01, target=0.99)], min_requests=4)
+    for _ in range(6):
+        tr.observe("generate", {"latency_s": 1.0})
+    h = health_payload(Telemetry(enabled=False), slo=tr)
+    assert h["status"] == "degraded"
+
+
+def test_stop_all_reports_leaked_servers():
+    srv = MetricsServer(port=0).start()
+    assert metrics_http.live_servers() == (srv,)
+    leaked = metrics_http.stop_all()
+    assert len(leaked) == 1 and str(srv.port) in leaked[0]
+    assert metrics_http.live_servers() == ()
+    srv.stop()  # idempotent after stop_all
+
+
+# -- engine integration: scrape reconciles with run() summary ----------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import ServeEngine
+
+    hps = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+                  dec_rnn_size=16, z_size=6, num_mixture=3,
+                  serve_slots=4, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    return hps, ServeEngine(model, hps, params)
+
+
+def _requests(hps, n):
+    from sketch_rnn_tpu.serve import Request
+
+    def req(i, cap):
+        rng = np.random.default_rng(i)
+        return Request(key=jax.random.key(1000 + i),
+                       z=rng.standard_normal(hps.z_size).astype(np.float32),
+                       temperature=0.8, max_len=cap)
+
+    return [req(i, 4 + (3 * i) % 15) for i in range(n)]
+
+
+def test_scrape_mid_and_post_serve_reconciles_with_summary(tiny_engine):
+    """THE acceptance pin: /metrics scraped during and after a serve
+    run reconciles with run()'s end-of-run summary — request counts
+    equal exactly, histogram-recovered percentiles within one log
+    bucket of the exact np.percentile values."""
+    hps, eng = tiny_engine
+    reqs = _requests(hps, 12)
+    tele.configure(trace_dir=None)
+    tr = SLOTracker([SLO(objective_s=120.0, target=0.95)])
+    out = {}
+    scrapes = []
+    with MetricsServer(port=0, slo=tr) as srv:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        done = threading.Event()
+        scrapes.append(_get(url))  # at least one pre-run scrape
+
+        def scraper():
+            while not done.is_set():
+                code, text = _get(url)
+                scrapes.append((code, text))
+                time.sleep(0.02)
+
+        t = threading.Thread(target=scraper)
+        t.start()
+        try:
+            out.update(eng.run(list(reqs), slo=tr))
+        finally:
+            done.set()
+            t.join()
+        # every mid-run scrape answered 200 with parseable exposition
+        assert scrapes
+        for code, text in scrapes:
+            assert code == 200
+            assert "sketch_rnn_up 1" in text
+        _, final = _get(url)
+    m = out["metrics"]
+    s = _series(final)
+    assert s["sketch_rnn_serve_requests_enqueued_total"] == 12
+    assert s["sketch_rnn_serve_requests_completed_total"] == \
+        m["completed"] == 12
+    assert s["sketch_rnn_serve_latency_s_count"] == 12
+    lab = '{slo="generate:latency_s:p95"}'
+    assert s[f"sketch_rnn_slo_requests_total{lab}"] == 12
+    assert s[f"sketch_rnn_slo_breaches_total{lab}"] == 0
+    assert m["slo"]["generate:latency_s:p95"]["met"] is True
+    # percentiles: scrape-recovered quantile within one log bucket
+    # (growth 2^(1/8) ~ 9%, plus min/max clamping slack) of the exact
+    # end-of-run numbers
+    for q, key in ((0.5, "latency_p50_s"), (0.95, "latency_p95_s"),
+                   (0.99, "latency_p99_s")):
+        got = _hist_quantile(final, "sketch_rnn_serve_latency_s", q)
+        assert got == pytest.approx(m[key], rel=0.15), key
+    tele.disable()

@@ -93,6 +93,29 @@ def test_histogram_empty_zero_and_singleton():
     h2.observe(0.25)
     # a single observation answers every quantile with (clamped) itself
     assert h2.quantile(0.0) == h2.quantile(0.99) == 0.25
+    # out-of-range q clamps instead of mis-ranking (ISSUE 7 satellite);
+    # empty/single bucket exposition is well-defined too
+    assert h2.quantile(-1.0) == h2.quantile(2.0) == 0.25
+    assert Histogram().buckets() == []
+    assert h2.buckets() == [(pytest.approx(Histogram.GROWTH ** (
+        int(np.floor(np.log(0.25) / np.log(Histogram.GROWTH))) + 1)), 1)]
+
+
+def test_snapshot_separates_gauges_and_is_consistent():
+    tel = Telemetry()
+    tel.counter("reqs", 2.0, cat="serve")
+    tel.gauge("slots_live", 5, cat="serve")
+    tel.observe("lat", 0.5, cat="serve")
+    with tel.span("work", cat="train"):
+        pass
+    snap = tel.snapshot()
+    assert snap["counters"] == {("serve", "reqs"): 2.0}
+    assert snap["gauges"] == {("serve", "slots_live"): 5.0}
+    assert snap["aggregates"][("train", "work")][0] == 1
+    h = snap["hists"][("serve", "lat")]
+    assert h["summary"]["count"] == 1 and h["total"] == 0.5
+    assert h["buckets"][-1][1] == 1
+    assert snap["dropped"] == 0
 
 
 # -- core recording ----------------------------------------------------------

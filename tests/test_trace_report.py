@@ -98,6 +98,37 @@ def test_load_tolerates_torn_tail_and_junk_lines(tmp_path):
     assert all(e["name"] != "torn…" for e in data["events"])
 
 
+def test_missing_path_is_one_line_error_not_traceback(tmp_path, capsys):
+    """ISSUE 7 satellite: pointing the report at a missing/empty dir
+    exits 2 with ONE actionable stderr line (no traceback)."""
+    # missing dir / file
+    assert trace_report.main([str(tmp_path / "nope")]) == 2
+    err = capsys.readouterr().err
+    assert "no telemetry stream" in err and "--trace_dir" in err
+    assert "Traceback" not in err
+    # a dir without a telemetry.jsonl inside
+    assert trace_report.main([str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert "telemetry.jsonl" in err
+
+
+def test_empty_and_meta_only_streams_are_one_line_errors(tmp_path,
+                                                         capsys):
+    empty = tmp_path / "telemetry.jsonl"
+    empty.write_text("")
+    assert trace_report.main([str(empty)]) == 2
+    err = capsys.readouterr().err
+    assert "no parseable telemetry lines" in err
+    # meta-line-only stream (a run that configured but recorded nothing)
+    tel = tele.configure(trace_dir=str(tmp_path))
+    paths = tel.export()
+    tele.disable()
+    assert trace_report.main([paths["jsonl"]]) == 2
+    err = capsys.readouterr().err
+    assert "only its meta line" in err and "recorded no events" in err
+    assert "Traceback" not in err
+
+
 def test_report_warns_on_ring_drops(tmp_path, capsys):
     tel = tele.configure(trace_dir=str(tmp_path), capacity=4)
     for _ in range(10):

@@ -1,0 +1,291 @@
+"""Training watchdog tests (ISSUE 7).
+
+The detector is pure, so the acceptance scenario is pinned directly: a
+synthetic loss-spike corpus trips at exactly the injected step and the
+incident.json names the offending metric. The monitor/train() layers
+are pinned for artifacts (incident.json, telemetry incident events,
+the forced post-mortem checkpoint) and for the extended invisibility
+contract: a warn-only watchdog on a healthy run changes NOTHING — the
+metrics CSV is bitwise identical to a watchdog-off run and no incident
+file appears.
+"""
+
+import csv
+import json
+import math
+import os
+
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.train import watchdog as wdog
+from sketch_rnn_tpu.train.watchdog import (
+    AnomalyHalt,
+    Watchdog,
+    WatchdogMonitor,
+)
+from sketch_rnn_tpu.utils import telemetry as tele
+
+TINY = dict(batch_size=16, max_seq_len=32, enc_rnn_size=16,
+            dec_rnn_size=24, z_size=8, num_mixture=3, hyper_rnn_size=8,
+            hyper_embed_size=4)
+
+
+def rows_with_spike(n=40, spike_at=25, base=2.0, spike=40.0):
+    """A synthetic loss corpus: gently decaying noisy loss with one
+    injected spike."""
+    rows = []
+    for i in range(n):
+        loss = base - 0.01 * i + 0.02 * ((i * 7919) % 13 - 6) / 6
+        if i == spike_at:
+            loss = spike
+        rows.append({"loss": loss, "grad_norm": 1.0 + 0.01 * (i % 5),
+                     "steps_per_sec": 10.0})
+    return rows
+
+
+# -- pure detector -----------------------------------------------------------
+
+
+def test_loss_spike_trips_at_injected_step():
+    wd = Watchdog()
+    corpus = rows_with_spike(spike_at=25)
+    trips = {}
+    for i, row in enumerate(corpus):
+        anomalies = wd.feed(step=i * 20, row=row)
+        if anomalies:
+            trips[i] = anomalies
+    assert list(trips) == [25]                    # exactly the injection
+    (a,) = trips[25]
+    assert a.kind == "spike" and a.metric == "loss"
+    assert a.step == 25 * 20 and a.value == 40.0
+
+
+def test_clean_noisy_stream_never_trips():
+    wd = Watchdog()
+    for i, row in enumerate(rows_with_spike(n=60, spike_at=10**9)):
+        assert wd.feed(i, row) == []
+
+
+def test_detection_precedes_absorption():
+    """A spike is judged against PRIOR rows only — feeding the spike
+    row twice trips twice (the first trip did not soften the z)."""
+    wd = Watchdog(min_history=4)
+    for i in range(8):
+        wd.feed(i, {"loss": 1.0 + 0.001 * i})
+    assert wd.feed(8, {"loss": 50.0})
+    assert wd.feed(9, {"loss": 50.0})  # median still ~1.0 (MAD robust)
+
+
+def test_nonfinite_named_per_metric():
+    wd = Watchdog()
+    out = wd.feed(5, {"loss": float("nan"), "grad_norm": float("inf"),
+                      "recon": 1.0, "wall_time": float("nan")})
+    kinds = {(a.kind, a.metric) for a in out}
+    assert ("nonfinite", "loss") in kinds
+    assert ("nonfinite", "grad_norm") in kinds
+    assert all(m != "wall_time" for _, m in kinds)
+    # NaN never enters the rolling baselines
+    assert len(wd._hist["loss"]) == 0
+
+
+def test_stall_detection_from_goodput_columns():
+    wd = Watchdog(min_history=4, stall_min_s=0.5, stall_frac=0.75)
+    starved = {"t_dispatch_s": 0.1, "t_feeder_wait_s": 4.0,
+               "t_ckpt_wait_s": 0.5, "loss": 1.0}
+    # startup gate: even a fully starved FIRST window cannot trip (the
+    # prefetch queue filling at cold start legitimately looks stalled)
+    assert wd.feed(0, dict(starved)) == []
+    # healthy warmup windows: dispatch dominates
+    for i in range(1, 4):
+        assert wd.feed(i * 20, {"t_dispatch_s": 5.0,
+                                "t_feeder_wait_s": 0.2,
+                                "t_ckpt_wait_s": 0.0, "loss": 1.0}) == []
+    # past min_history, a starved window trips and names the worst phase
+    (a,) = wd.feed(80, dict(starved))
+    assert a.kind == "stall" and a.metric == "t_feeder_wait_s"
+    # below the absolute floor nothing fires (idle-but-fast windows)
+    assert wd.feed(100, {"t_dispatch_s": 0.001,
+                         "t_feeder_wait_s": 0.01}) == []
+
+
+def test_throughput_collapse():
+    wd = Watchdog(min_history=4, collapse_frac=0.25)
+    for i in range(6):
+        assert wd.feed(i, {"steps_per_sec": 10.0 + (i % 3)}) == []
+    (a,) = wd.feed(6, {"steps_per_sec": 1.0})
+    assert a.kind == "throughput" and a.metric == "steps_per_sec"
+    # a moderate dip stays quiet
+    wd2 = Watchdog(min_history=4, collapse_frac=0.25)
+    for i in range(6):
+        wd2.feed(i, {"steps_per_sec": 10.0})
+    assert wd2.feed(6, {"steps_per_sec": 5.0}) == []
+
+
+def test_last_rows_ring_bounded():
+    wd = Watchdog(keep_rows=4)
+    for i in range(10):
+        wd.feed(i, {"loss": 1.0})
+    rows = wd.last_rows()
+    assert len(rows) == 4 and rows[-1]["step"] == 9
+
+
+# -- monitor: incident artifacts ---------------------------------------------
+
+
+def test_monitor_writes_incident_json_naming_metric(tmp_path):
+    mon = WatchdogMonitor(str(tmp_path))
+    for i, row in enumerate(rows_with_spike(spike_at=25)):
+        mon(row, i * 20)   # drain check signature: (scalars, step)
+    path = os.path.join(tmp_path, "incident.json")
+    assert mon.incident_path == path and os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["step"] == 500 and doc["halt"] is False
+    (a,) = doc["anomalies"]
+    assert a["kind"] == "spike" and a["metric"] == "loss"
+    assert a["value"] == 40.0
+    # the post-mortem carries the offending row and its predecessors
+    assert doc["last_rows"][-1]["loss"] == 40.0
+    assert len(doc["last_rows"]) > 1
+    assert doc["telemetry"] is None   # tracing was off
+
+
+def test_monitor_emits_telemetry_incident_and_snapshot(tmp_path):
+    tel = tele.configure(trace_dir=str(tmp_path))
+    mon = WatchdogMonitor(str(tmp_path))
+    for i, row in enumerate(rows_with_spike(spike_at=25)):
+        mon(row, i)
+    assert tel.counters()[("watchdog", "incidents")] == 1
+    evs = [e for e in tel.events() if e["type"] == "instant"
+           and e["name"] == "incident"]
+    assert len(evs) == 1 and evs[0]["args"]["metric"] == "loss"
+    doc = json.load(open(mon.incident_path))
+    assert doc["telemetry"]["counters"]["watchdog/incidents"] == 1
+    tele.disable()
+
+
+def test_monitor_halt_raises_and_serializes_nonfinite(tmp_path):
+    mon = WatchdogMonitor(str(tmp_path), halt=True)
+    with pytest.raises(AnomalyHalt) as e:
+        mon({"loss": float("nan")}, 7)
+    assert e.value.step == 7
+    assert "loss" in str(e.value)
+    # the post-mortem must be STRICT JSON even though the offending
+    # row's raw NaN rides in last_rows (parse_constant fires on the
+    # non-standard NaN/Infinity tokens lenient loaders accept)
+    text = open(os.path.join(tmp_path, "incident.json")).read()
+    doc = json.loads(text, parse_constant=lambda s: pytest.fail(
+        f"non-strict JSON token {s} in incident.json"))
+    assert doc["halt"] is True
+    assert doc["anomalies"][0]["value"] == "nan"  # strict-JSON safe
+    assert doc["last_rows"][-1]["loss"] == "nan"
+
+
+def test_monitor_history_is_bounded_on_persistent_anomaly(tmp_path):
+    """A condition that trips every window must not grow memory or the
+    incident file without bound: the retained/serialized history caps
+    at KEEP_ANOMALIES while the exact lifetime count stays exact."""
+    mon = WatchdogMonitor(str(tmp_path))
+    n = WatchdogMonitor.KEEP_ANOMALIES + 40
+    for i in range(n):
+        mon({"loss": float("nan")}, i)
+    assert mon.total_anomalies == n
+    assert len(mon.incidents) == WatchdogMonitor.KEEP_ANOMALIES
+    doc = json.load(open(mon.incident_path))
+    assert doc["total_anomalies"] == n
+    assert len(doc["recent_anomalies"]) == WatchdogMonitor.KEEP_ANOMALIES
+    assert doc["recent_anomalies"][-1]["step"] == n - 1
+
+
+def test_monitor_without_workdir_warns_only(capsys):
+    mon = WatchdogMonitor(None)
+    mon({"loss": float("inf")}, 3)
+    assert mon.incident_path is None
+    assert "[watchdog] WARNING" in capsys.readouterr().out
+
+
+# -- train() integration -----------------------------------------------------
+
+
+def tiny_hps(**kw) -> HParams:
+    return HParams(**{**TINY, **kw})
+
+
+def make_loader(hps, n=64, seed=0):
+    from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+
+    seqs, labels = make_synthetic_strokes(
+        n, num_classes=max(hps.num_classes, 1),
+        min_len=10, max_len=hps.max_seq_len - 2, seed=seed)
+    return DataLoader(seqs, hps, labels=labels, seed=seed)
+
+
+def _run_smoke(tmp_path, name, **train_kw):
+    from sketch_rnn_tpu.train.loop import train
+
+    hps = tiny_hps(num_steps=4, log_every=2, save_every=10**9,
+                   eval_every=10**9)
+    d = str(tmp_path / name)
+    train(hps, make_loader(hps), workdir=d, use_mesh=False,
+          resume=False, **train_kw)
+    with open(os.path.join(d, "train_metrics.csv")) as f:
+        return d, list(csv.reader(f))
+
+
+def test_warn_only_watchdog_is_bitwise_invisible_on_healthy_run(tmp_path):
+    """The extended PR 6 pin: a healthy run with the watchdog armed
+    (warn-only) logs a CSV bitwise identical to the watchdog-off run
+    — same keys, same values except wall-clock columns — and leaves no
+    incident artifacts."""
+    d_off, rows_off = _run_smoke(tmp_path, "off")
+    d_on, rows_on = _run_smoke(tmp_path, "on", watchdog=True)
+    header_off, header_on = rows_off[0], rows_on[0]
+    assert header_on == header_off       # watchdog adds NO columns
+    timing_idx = {i for i, k in enumerate(header_off)
+                  if k in ("wall_time", "steps_per_sec",
+                           "strokes_per_sec", "strokes_per_sec_per_chip")
+                  or k.startswith("t_")}
+    assert len(rows_off) == len(rows_on)
+    for ro, rn in zip(rows_off[1:], rows_on[1:]):
+        for i, (vo, vn) in enumerate(zip(ro, rn)):
+            if i not in timing_idx:
+                assert vo == vn, header_off[i]
+    for d in (d_off, d_on):
+        assert not [f for f in os.listdir(d) if "incident" in f]
+    assert wdog.armed_monitors() == ()   # train() disarmed in finally
+
+
+def test_halt_on_anomaly_forces_incident_checkpoint(tmp_path, monkeypatch):
+    """--halt_on_anomaly end to end: a tripping detector stops train()
+    via AnomalyHalt, incident.json lands in the workdir, and the forced
+    post-mortem checkpoint lands in <workdir>/incident/ — NOT the
+    resume directory."""
+
+    class TripOnSecondRow(Watchdog):
+        def feed(self, step, row):
+            super().feed(step, row)
+            if step >= 4:
+                return [wdog.Anomaly(
+                    kind="spike", metric="loss", step=step,
+                    value=float(row.get("loss", 0.0)), threshold=8.0,
+                    detail="injected trip")]
+            return []
+
+    monkeypatch.setattr(wdog, "Watchdog", TripOnSecondRow)
+    from sketch_rnn_tpu.train.checkpoint import latest_checkpoint
+    from sketch_rnn_tpu.train.loop import train
+
+    hps = tiny_hps(num_steps=6, log_every=2, save_every=10**9,
+                   eval_every=10**9, metrics_defer=False)
+    d = str(tmp_path / "halt")
+    with pytest.raises(AnomalyHalt):
+        train(hps, make_loader(hps), workdir=d, use_mesh=False,
+              resume=False, halt_on_anomaly=True)
+    doc = json.load(open(os.path.join(d, "incident.json")))
+    assert doc["halt"] is True
+    assert doc["anomalies"][0]["metric"] == "loss"
+    # forced checkpoint: in incident/, and the resume dir holds none
+    inc = os.path.join(d, "incident")
+    assert latest_checkpoint(inc) is not None
+    assert latest_checkpoint(d) is None
+    assert wdog.armed_monitors() == ()
