@@ -114,11 +114,29 @@ def _is_smoke_record(record: dict) -> bool:
     return bool(record.get("smoke")) or record.get("device_kind") == "cpu"
 
 
+def _run_stamp() -> dict:
+    """run_id + host topology for every history row (ISSUE 8): the key
+    that joins a bench row to the trace shards / RUN.json of the same
+    invocation, and the fleet coordinate that makes a multi-host row
+    interpretable. Old rows simply lack the fields — every consumer
+    (bench_summary.key_of, bench_regress) reads keys positionally and
+    tolerates extras, tier-1-tested."""
+    from sketch_rnn_tpu.utils import runinfo
+
+    stamp = {"run_id": runinfo.get_run_id()}
+    try:
+        stamp["host_count"] = int(jax.process_count())
+        stamp["process_index"] = int(jax.process_index())
+    except Exception:  # noqa: BLE001 — stamping must never fail a bench
+        pass
+    return stamp
+
+
 def _hist_append(record: dict) -> dict:
     """Stamp, route, append; returns the stamped record so streaming
     emitters print the SAME row the history holds (a captured stdout
     log may be the only surviving record — it must carry wall_time)."""
-    record = {"wall_time": time.time(), **record}
+    record = {"wall_time": time.time(), **_run_stamp(), **record}
     path = _smoke_hist_path() if _is_smoke_record(record) else _hist_path()
     with open(path, "a") as f:
         f.write(json.dumps(record) + "\n")

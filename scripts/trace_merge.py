@@ -1,0 +1,432 @@
+"""Merge per-host telemetry shards into one fleet trace (ISSUE 8).
+
+A multi-controller run exports one telemetry shard per process
+(``telemetry.p0000.jsonl`` ... — utils/telemetry.py stamps each with
+``(process_index, host_count, run_id)``). This script joins N shards:
+
+- ``telemetry.merged.jsonl`` — one stream: a merged meta line (per-host
+  metas nested under ``hosts``, drop counts summed), every shard's
+  events tagged with their ``host`` index, and a GLOBAL summary whose
+  ``agg`` / ``counter_total`` / ``hist`` lines reconcile EXACTLY with
+  the per-shard summaries: span counts/totals and monotonic counters
+  are sums in host order (bitwise — the tier-1 reconciliation test),
+  histograms are rebuilt from their raw log buckets and merged with
+  :meth:`Histogram.merge` (exact on one lattice; a growth mismatch is
+  rejected, never resampled). Gauges are latest SAMPLES, not totals —
+  they are never summed: the merged line carries the per-host values
+  and their max.
+- ``trace.merged.json`` — one Chrome trace (chrome://tracing /
+  Perfetto) with a TRACK GROUP PER HOST: each shard renders under its
+  own pid with a ``process_name`` of ``host N`` — the fleet-wide
+  timeline view the TensorFlow system paper's monitoring is the
+  template for (PAPERS.md).
+
+``trace_report.py`` reads the merged stream directly (``--host N``
+filters one host's events back out).
+
+Usage:
+    python scripts/trace_merge.py <trace_dir | shard.jsonl ...>
+        [--out DIR] [--json] [--quiet]
+    python scripts/trace_merge.py --smoke     # tier-1 self-check over
+                                              # two committed shards
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sketch_rnn_tpu.utils.telemetry import (  # noqa: E402
+    Histogram,
+    TELEMETRY_JSONL,
+)
+
+MERGED_JSONL = "telemetry.merged.jsonl"
+MERGED_CHROME = "trace.merged.json"
+SMOKE_SHARDS = os.path.join("tests", "data", "fleet_shards")
+
+
+def find_shards(path: str) -> List[str]:
+    """Shard JSONLs under a trace_dir: ``telemetry*.jsonl`` minus any
+    previous merge output, sorted (process-suffix order)."""
+    root, ext = os.path.splitext(TELEMETRY_JSONL)
+    pattern = os.path.join(path, f"{root}*{ext}")
+    return sorted(p for p in glob.glob(pattern)
+                  if os.path.basename(p) != MERGED_JSONL)
+
+
+def load_shard(path: str) -> Dict:
+    """Parse one shard into {meta, events, agg, counters, gauges,
+    hists}; torn tail lines are skipped (same tolerance as
+    trace_report)."""
+    out: Dict = {"meta": {}, "events": [], "agg": {}, "counters": {},
+                 "gauges": {}, "hists": {}, "path": path}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            t = rec.get("type")
+            if t == "meta":
+                out["meta"] = rec
+            elif t in ("span", "instant", "counter"):
+                out["events"].append(rec)
+            elif t == "agg":
+                out["agg"][(rec["cat"], rec["name"])] = (
+                    int(rec["count"]), float(rec["total_s"]))
+            elif t == "counter_total":
+                store = "gauges" if rec.get("gauge") else "counters"
+                out[store][(rec["cat"], rec["name"])] = rec["value"]
+            elif t == "hist":
+                out["hists"][(rec["cat"], rec["name"])] = rec
+    return out
+
+
+def merge_shards(shards: List[Dict]) -> Dict:
+    """Fold N parsed shards into the merged structure (see module
+    docstring for the exactness contract). Shards are processed in
+    ascending ``process_index`` order regardless of input order, so
+    the float sums are deterministic."""
+    if not shards:
+        raise ValueError("no shards to merge")
+    shards = sorted(shards, key=lambda s: s["meta"].get("process_index", 0))
+    # common fleet clock: each shard's ts values are perf-counter
+    # seconds since ITS OWN core's construction, so two hosts started
+    # 30 s apart would both render from ts=0 and the merged timeline
+    # would show wrong cross-host overlap. origin_unix (wall clock at
+    # core construction) rebases every event onto one axis — exact up
+    # to wall-clock skew between hosts, which is the best a host-side
+    # merge can do (documented per host as ts_offset).
+    origins = [s["meta"].get("origin_unix") for s in shards]
+    known = [o for o in origins if o is not None]
+    t0 = min(known) if known else 0.0
+    hosts = []
+    run_ids = []
+    events: List[dict] = []
+    agg: Dict = {}
+    counters: Dict = {}
+    gauges: Dict = {}
+    hists: Dict = {}
+    for s, origin in zip(shards, origins):
+        meta = s["meta"]
+        host = int(meta.get("process_index", 0))
+        if any(h["process_index"] == host for h in hosts):
+            raise ValueError(
+                f"duplicate process_index {host} across shards "
+                f"({s['path']}): merging two exports of one host would "
+                f"double-count its totals")
+        offset = (origin - t0) if origin is not None else 0.0
+        hosts.append({"process_index": host,
+                      "pid": meta.get("pid"),
+                      "origin_unix": origin,
+                      "ts_offset": offset,
+                      "dropped": int(meta.get("dropped", 0)),
+                      "capacity": meta.get("capacity"),
+                      "path": os.path.basename(s["path"])})
+        rid = meta.get("run_id")
+        if rid is not None and rid not in run_ids:
+            run_ids.append(rid)
+        for ev in s["events"]:
+            ev = dict(ev)
+            ev["host"] = host
+            ev["ts"] = ev.get("ts", 0.0) + offset
+            events.append(ev)
+        for k, (n, total) in s["agg"].items():
+            pn, pt = agg.get(k, (0, 0.0))
+            agg[k] = (pn + n, pt + total)
+        for k, v in s["counters"].items():
+            counters[k] = counters.get(k, 0.0) + v
+        for k, v in s["gauges"].items():
+            gauges.setdefault(k, {})[host] = v
+        for k, rec in s["hists"].items():
+            raw = rec.get("raw")
+            if raw is None:
+                raise ValueError(
+                    f"shard {s['path']} histogram {k} has no raw "
+                    f"buckets (pre-ISSUE-8 export?) — cannot merge "
+                    f"exactly; re-export with the current runtime")
+            h = Histogram.from_dict(raw)
+            if k in hists:
+                hists[k].merge(h)  # growth mismatch raises here
+            else:
+                hists[k] = h
+    if len(run_ids) > 1:
+        print(f"trace_merge: WARNING: shards carry {len(run_ids)} "
+              f"distinct run_ids ({run_ids}) — merging streams from "
+              f"different runs; totals will mix runs", file=sys.stderr)
+    # events interleave across hosts on the rebased common clock
+    # (per-host ordering exact; cross-host exact up to wall skew)
+    events.sort(key=lambda e: (e.get("ts", 0.0), e["host"]))
+    # the run's DECLARED fleet size comes from the shard metas, not
+    # from how many shards the caller happened to have: a host that
+    # crashed before export (or a partial file list) must not silently
+    # shrink the recorded topology — warn that totals undercount
+    declared = max([int(s["meta"].get("host_count", 1))
+                    for s in shards] + [len(hosts)])
+    if len(hosts) < declared:
+        print(f"trace_merge: WARNING: merged {len(hosts)} shards but "
+              f"the shard metas declare a {declared}-host run — "
+              f"missing hosts' events and totals are NOT included",
+              file=sys.stderr)
+    return {
+        "meta": {"type": "meta", "merged": True,
+                 "host_count": declared,
+                 "shard_count": len(hosts),
+                 "run_id": run_ids[0] if run_ids else None,
+                 "run_ids": run_ids,
+                 "dropped": sum(h["dropped"] for h in hosts),
+                 "hosts": hosts},
+        "events": events,
+        "agg": agg,
+        "counters": counters,
+        "gauges": gauges,
+        "hists": hists,
+    }
+
+
+def write_merged_jsonl(merged: Dict, path: str) -> None:
+    with open(path, "w") as f:
+        f.write(json.dumps(merged["meta"]) + "\n")
+        for ev in merged["events"]:
+            f.write(json.dumps(ev) + "\n")
+        for (cat, name), (n, total) in sorted(merged["agg"].items()):
+            f.write(json.dumps({
+                "type": "agg", "cat": cat, "name": name,
+                "count": int(n), "total_s": total}) + "\n")
+        for (cat, name), v in sorted(merged["counters"].items()):
+            f.write(json.dumps({
+                "type": "counter_total", "cat": cat, "name": name,
+                "value": v}) + "\n")
+        for (cat, name), per_host in sorted(merged["gauges"].items()):
+            f.write(json.dumps({
+                "type": "counter_total", "cat": cat, "name": name,
+                "gauge": True, "value": max(per_host.values()),
+                "per_host": {str(h): v
+                             for h, v in sorted(per_host.items())}})
+                + "\n")
+        for (cat, name), h in sorted(merged["hists"].items()):
+            f.write(json.dumps({
+                "type": "hist", "cat": cat, "name": name,
+                **h.summary(), "total": h.total,
+                "raw": h.to_dict()}) + "\n")
+
+
+def write_merged_chrome(merged: Dict, path: str) -> None:
+    """One Chrome trace, one track group per host: pid = host index
+    (named ``host N``), tids unique per (host, recording thread)."""
+    out: List[dict] = []
+    tids: Dict = {}
+    named_hosts = set()
+
+    def tid_of(host: int, thread: str) -> int:
+        key = (host, thread)
+        if key not in tids:
+            tids[key] = sum(1 for h, _ in tids if h == host)
+            out.append({"ph": "M", "name": "thread_name", "pid": host,
+                        "tid": tids[key], "args": {"name": thread}})
+        return tids[key]
+
+    for h in merged["meta"]["hosts"]:
+        host = h["process_index"]
+        if host not in named_hosts:
+            named_hosts.add(host)
+            out.append({"ph": "M", "name": "process_name", "pid": host,
+                        "args": {"name": f"host {host} "
+                                         f"(pid {h.get('pid')})"}})
+    for ev in merged["events"]:
+        host = ev["host"]
+        ts_us = ev["ts"] * 1e6
+        if ev["type"] == "span":
+            rec = {"ph": "X", "name": ev["name"], "cat": ev["cat"],
+                   "pid": host, "tid": tid_of(host, ev["tid"]),
+                   "ts": ts_us, "dur": ev["dur"] * 1e6}
+            if "args" in ev:
+                rec["args"] = ev["args"]
+            out.append(rec)
+        elif ev["type"] == "instant":
+            rec = {"ph": "i", "name": ev["name"], "cat": ev["cat"],
+                   "pid": host, "tid": tid_of(host, ev["tid"]),
+                   "ts": ts_us, "s": "t"}
+            if "args" in ev:
+                rec["args"] = ev["args"]
+            out.append(rec)
+        elif ev["type"] == "counter":
+            out.append({"ph": "C", "name": ev["name"], "cat": ev["cat"],
+                        "pid": host, "tid": 0, "ts": ts_us,
+                        "args": {ev["name"]: ev["value"]}})
+    with open(path, "w") as f:
+        json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
+
+
+def global_summary(merged: Dict) -> Dict:
+    """The machine-readable reconciliation surface (``--json``)."""
+    return {
+        "meta": merged["meta"],
+        "agg": {f"{c}/{n}": {"count": v[0], "total_s": v[1]}
+                for (c, n), v in sorted(merged["agg"].items())},
+        "counters": {f"{c}/{n}": v
+                     for (c, n), v in sorted(merged["counters"].items())},
+        "gauges": {f"{c}/{n}": {"max": max(per.values()),
+                                "per_host": {str(h): x for h, x in
+                                             sorted(per.items())}}
+                   for (c, n), per in sorted(merged["gauges"].items())},
+        "hists": {f"{c}/{n}": {**h.summary(), "total": h.total}
+                  for (c, n), h in sorted(merged["hists"].items())},
+    }
+
+
+def _reconcile(shards: List[Dict], merged: Dict) -> List[str]:
+    """Cross-check merged totals against recomputed per-shard sums;
+    returns a list of discrepancy strings (empty = exact)."""
+    problems = []
+    shards = sorted(shards, key=lambda s: s["meta"].get("process_index", 0))
+    for k in merged["agg"]:
+        n = sum(s["agg"].get(k, (0, 0.0))[0] for s in shards)
+        t = 0.0
+        for s in shards:
+            t += s["agg"].get(k, (0, 0.0))[1]
+        if merged["agg"][k] != (n, t):
+            problems.append(f"agg {k}: merged {merged['agg'][k]} != "
+                            f"shard sum {(n, t)}")
+    for k in merged["counters"]:
+        v = 0.0
+        for s in shards:
+            v += s["counters"].get(k, 0.0)
+        if merged["counters"][k] != v:
+            problems.append(f"counter {k}: merged "
+                            f"{merged['counters'][k]} != shard sum {v}")
+    for k, h in merged["hists"].items():
+        cnt = sum(s["hists"][k]["raw"]["count"]
+                  for s in shards if k in s["hists"])
+        tot = 0.0
+        for s in shards:
+            if k in s["hists"]:
+                tot += s["hists"][k]["raw"]["total"]
+        if h.count != cnt or h.total != tot:
+            problems.append(f"hist {k}: merged ({h.count}, {h.total}) "
+                            f"!= shard sum ({cnt}, {tot})")
+    return problems
+
+
+def smoke() -> int:
+    """Self-check over the two committed synthetic shards: merge them
+    and require EXACT reconciliation (the tier-1 wiring, ISSUE 8
+    satellite) plus growth-mismatch rejection."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shard_dir = os.path.join(repo, SMOKE_SHARDS)
+    paths = find_shards(shard_dir)
+    if len(paths) < 2:
+        print(f"trace_merge --smoke: expected >= 2 committed shards in "
+              f"{shard_dir}, found {len(paths)}", file=sys.stderr)
+        return 1
+    shards = [load_shard(p) for p in paths]
+    merged = merge_shards(shards)
+    problems = _reconcile(shards, merged)
+    # mismatched growth must be rejected, not resampled
+    bad = load_shard(paths[0])
+    bad_hists = {k: dict(v) for k, v in bad["hists"].items()}
+    for k in bad_hists:
+        bad_hists[k]["raw"] = dict(bad_hists[k]["raw"],
+                                   growth=Histogram.GROWTH * 2)
+    bad["hists"] = bad_hists
+    bad["meta"] = dict(bad["meta"],
+                       process_index=max(h["process_index"]
+                                         for h in merged["meta"]["hosts"])
+                       + 1)
+    if bad["hists"]:
+        try:
+            merge_shards(shards + [bad])
+            problems.append("growth mismatch was NOT rejected")
+        except ValueError:
+            pass
+    if problems:
+        print("trace_merge --smoke FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print(f"trace_merge --smoke OK: {len(paths)} shards, "
+          f"{len(merged['events'])} events, {len(merged['agg'])} agg "
+          f"series reconcile exactly")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-host telemetry shards into one Chrome "
+                    "trace + reconciled global summary")
+    ap.add_argument("paths", nargs="*",
+                    help="a trace_dir holding telemetry*.jsonl shards, "
+                         "or explicit shard files")
+    ap.add_argument("--out", default="",
+                    help="output directory (default: the trace_dir / "
+                         "the first shard's directory)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged global summary as JSON")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the one-line success message")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-check over the committed synthetic "
+                         "shards (CI wiring); ignores other arguments")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    if not args.paths:
+        ap.error("need a trace_dir or shard files (or --smoke)")
+    if len(args.paths) == 1 and os.path.isdir(args.paths[0]):
+        shard_paths = find_shards(args.paths[0])
+        out_dir = args.out or args.paths[0]
+    else:
+        shard_paths = list(args.paths)
+        out_dir = args.out or os.path.dirname(
+            os.path.abspath(shard_paths[0]))
+    missing = [p for p in shard_paths if not os.path.exists(p)]
+    if missing or not shard_paths:
+        print(f"trace_merge: no shards to merge "
+              f"({'missing: ' + ', '.join(missing) if missing else 'none found'}) "
+              f"— produce them with `cli train --trace_dir=...` (each "
+              f"host exports telemetry[.pNNNN].jsonl)", file=sys.stderr)
+        return 2
+    shards = [load_shard(p) for p in shard_paths]
+    try:
+        merged = merge_shards(shards)
+    except ValueError as e:
+        print(f"trace_merge: {e}", file=sys.stderr)
+        return 2
+    problems = _reconcile(shards, merged)
+    if problems:  # internal invariant, loud by design
+        for p in problems:
+            print(f"trace_merge: RECONCILIATION FAILURE: {p}",
+                  file=sys.stderr)
+        return 1
+    os.makedirs(out_dir, exist_ok=True)
+    jsonl_path = os.path.join(out_dir, MERGED_JSONL)
+    chrome_path = os.path.join(out_dir, MERGED_CHROME)
+    write_merged_jsonl(merged, jsonl_path)
+    write_merged_chrome(merged, chrome_path)
+    if args.json:
+        print(json.dumps(global_summary(merged)))
+    elif not args.quiet:
+        m = merged["meta"]
+        print(f"merged {len(shards)} shards ({m['host_count']} hosts, "
+              f"run_id {m['run_id']}) -> {jsonl_path} and "
+              f"{chrome_path}; {len(merged['events'])} events, "
+              f"{m['dropped']} ring-dropped (per-shard agg totals "
+              f"remain exact)")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head
+        sys.exit(0)

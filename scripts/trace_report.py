@@ -42,16 +42,24 @@ from sketch_rnn_tpu.utils.telemetry import TELEMETRY_JSONL  # noqa: E402
 SPARK = " ▁▂▃▄▅▆▇█"
 
 
-def load(path: str) -> Dict:
+def load(path: str, host: Optional[int] = None) -> Dict:
     """Parse a telemetry JSONL into {meta, events, agg, counters, hists}.
 
     ``path`` may be the JSONL itself or a trace_dir containing
     ``telemetry.jsonl``. Torn tail lines (a killed run) are skipped.
+
+    Reads MERGED fleet streams (``scripts/trace_merge.py``) the same
+    way — merged events carry a ``host`` index. ``host`` filters to
+    one host's events (ISSUE 8 satellite): on a merged stream the
+    GLOBAL agg/counter/hist summary lines are dropped under the filter
+    (they aggregate every host), so the span table falls back to the
+    filtered per-event sums; on a single shard the filter matches the
+    shard's own ``process_index``.
     """
     if os.path.isdir(path):
         path = os.path.join(path, TELEMETRY_JSONL)
     out: Dict = {"meta": {}, "events": [], "agg": {}, "counters": {},
-                 "hists": {}}
+                 "hists": {}, "host_filter": host}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -64,7 +72,21 @@ def load(path: str) -> Dict:
             t = rec.get("type")
             if t == "meta":
                 out["meta"] = rec
-            elif t in ("span", "instant", "counter"):
+                continue
+            if host is not None:
+                if t in ("span", "instant", "counter"):
+                    ev_host = rec.get(
+                        "host", out["meta"].get("process_index", 0))
+                    if ev_host != host:
+                        continue
+                else:
+                    # summary lines are global on a merged stream and
+                    # single-host on a shard; under the filter only a
+                    # matching shard's summaries stay authoritative
+                    if out["meta"].get("merged") or \
+                            out["meta"].get("process_index", 0) != host:
+                        continue
+            if t in ("span", "instant", "counter"):
                 out["events"].append(rec)
             elif t == "agg":
                 out["agg"][(rec["cat"], rec["name"])] = (
@@ -162,9 +184,24 @@ def latency_table(data: Dict) -> List[Dict]:
     return rows
 
 
+def _drop_counts(meta: Dict) -> Dict:
+    """Ring-drop accounting surfaced in the machine-readable report
+    (ISSUE 8 satellite): the total plus — on a merged fleet stream —
+    the per-host breakdown, so an undercounting host is nameable."""
+    out = {"total": int(meta.get("dropped", 0) or 0)}
+    hosts = meta.get("hosts")
+    if hosts:
+        out["per_host"] = {str(h.get("process_index", i)):
+                           int(h.get("dropped", 0) or 0)
+                           for i, h in enumerate(hosts)}
+    return out
+
+
 def report(data: Dict) -> Dict:
     return {
         "meta": data["meta"],
+        "ring_dropped": _drop_counts(data["meta"]),
+        "host_filter": data.get("host_filter"),
         "spans": span_breakdown(data),
         "occupancy": occupancy(data),
         "latency": latency_table(data),
@@ -174,10 +211,17 @@ def report(data: Dict) -> Dict:
 
 
 def print_report(rep: Dict) -> None:
-    dropped = rep["meta"].get("dropped", 0)
+    if rep.get("host_filter") is not None:
+        print(f"(host {rep['host_filter']} only — span totals are "
+              f"per-event sums over that host's ring)\n")
+    drops = rep.get("ring_dropped") or {}
+    dropped = drops.get("total", rep["meta"].get("dropped", 0))
     if dropped:
-        print(f"WARNING: event ring dropped {dropped} events — per-event "
-              f"sums undercount; agg totals remain exact\n")
+        per = ("" if "per_host" not in drops else
+               " (" + ", ".join(f"host {h}: {n}" for h, n in
+                                sorted(drops["per_host"].items())) + ")")
+        print(f"WARNING: event ring dropped {dropped} events{per} — "
+              f"per-event sums undercount; agg totals remain exact\n")
     spans = rep["spans"]
     if spans:
         accounted = sum(r["total_s"] for r in spans)
@@ -221,10 +265,16 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="stall breakdown / occupancy / latency report over "
                     "a telemetry JSONL")
-    ap.add_argument("path", help="telemetry.jsonl or the trace_dir "
-                                 "holding it")
+    ap.add_argument("path", help="telemetry.jsonl (a shard or a "
+                                 "trace_merge merged stream) or the "
+                                 "trace_dir holding it")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable JSON instead of tables")
+    ap.add_argument("--host", type=int, default=None,
+                    help="restrict to one host's events (merged fleet "
+                         "streams tag every event with its host index; "
+                         "a single shard matches its own "
+                         "process_index)")
     args = ap.parse_args(argv)
     # usage errors exit with ONE actionable line, not a traceback
     # (ISSUE 7 satellite): pointing the report at the wrong dir is the
@@ -237,9 +287,14 @@ def main(argv=None) -> int:
               f"the trace dir or the telemetry.jsonl inside it",
               file=sys.stderr)
         return 2
-    data = load(resolved)
+    data = load(resolved, host=args.host)
     if not (data["events"] or data["agg"] or data["counters"]
             or data["hists"]):
+        if args.host is not None:
+            print(f"trace_report: no events for host {args.host} in "
+                  f"{resolved} — check the merged meta's `hosts` list "
+                  f"for the indices present", file=sys.stderr)
+            return 2
         what = ("holds only its meta line" if data["meta"]
                 else "holds no parseable telemetry lines")
         print(f"trace_report: {resolved} {what} — the traced run "

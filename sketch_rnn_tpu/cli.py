@@ -417,9 +417,19 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
     trace_dir = getattr(args, "trace_dir", "") or None
     tel = None
     tele = None
+    mem_sampler = None
     if trace_dir or args.metrics_port is not None:
+        from sketch_rnn_tpu.parallel.multihost import topology
         from sketch_rnn_tpu.utils import telemetry as tele
-        tel = tele.configure(trace_dir=trace_dir)
+        topo = topology()
+        tel = tele.configure(trace_dir=trace_dir,
+                             process_index=topo["process_index"],
+                             host_count=topo["host_count"])
+        # sampled device-memory gauges: /metrics shows live/peak HBM
+        # while the burst runs, so slot-count choices are
+        # memory-visible (no-op on stat-less backends)
+        mem_sampler = tele.MemorySampler().start()
+        mem_sampler.phase = "serve"
     # health & SLO layer (ISSUE 7): the tracker is fed by the engine
     # per completed request; the (already-bound) metrics server exposes
     # the LIVE /metrics + /healthz view of this run, and the final
@@ -434,6 +444,8 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
         # a mid-run crash still leaves the trace that explains it
         # (the train loop's post-mortem discipline); best-effort so
         # an export failure never masks the real error
+        if mem_sampler is not None:
+            mem_sampler.stop()
         if tel is not None:
             if trace_dir:
                 try:
@@ -442,6 +454,8 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
                     pass
             tele.disable()
         raise
+    if mem_sampler is not None:
+        mem_sampler.stop()
     prom_path = None
     if server is not None:
         # archive the run's final scrape through the real HTTP
@@ -475,15 +489,37 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
                 print(f"[slo] WARNING: {key} matched no completed "
                       f"request (endpoint {rec['endpoint']!r} unseen) "
                       f"— its compliance is vacuous", file=sys.stderr)
+    run_id = None
     if tel is not None:
+        run_id = tel.run_id
+        exported = {}
         if trace_dir:
-            paths = tel.export()
+            exported = paths = tel.export()
             print(f"[telemetry] wrote {paths['jsonl']} and "
                   f"{paths['chrome']} (read with scripts/trace_report.py "
                   f"or Perfetto)", file=sys.stderr)
         tele.disable()  # restore the process default
+        # run manifest (ISSUE 8): the artifact index that joins this
+        # bench's trace, prom scrape and report on one run_id. Only
+        # for observed runs (trace/metrics enabled) — the no-flags
+        # invisibility contract writes no files.
+        from sketch_rnn_tpu.utils import runinfo
+        man_dir = trace_dir or args.workdir
+        artifacts = {k: v for k, v in exported.items()}
+        if prom_path:
+            artifacts["metrics_prom"] = prom_path
+        if args.log_metrics:
+            artifacts["serve_metrics"] = [
+                os.path.join(args.workdir, f"serve_metrics.{e}")
+                for e in ("csv", "jsonl")]
+        runinfo.write_manifest(
+            man_dir, kind="serve_bench", hps=hps, run_id=run_id,
+            artifacts=artifacts,
+            extra={"n_requests": n, "slots": engine.slots,
+                   "chunk": engine.chunk})
     report = {
         "kind": "serve_bench_cli",
+        "run_id": run_id,
         "n_requests": n,
         "slots": engine.slots,
         "chunk": engine.chunk,

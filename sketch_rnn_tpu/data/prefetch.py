@@ -88,6 +88,14 @@ class Prefetcher:
                 continue
             if item is self._SENTINEL:
                 raise self._exc  # type: ignore[misc]
+            tel = get_telemetry()
+            if tel.enabled:
+                # look-ahead health (ISSUE 8): batches still queued at
+                # the moment the consumer takes one — a timeline
+                # hugging 0 means the producer can't keep pace (the
+                # feeder_wait stalls' cause, visible from /metrics)
+                tel.gauge("prefetch_queue_depth", self._q.qsize(),
+                          cat="data")
             return item
 
     def close(self) -> None:

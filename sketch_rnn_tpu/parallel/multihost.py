@@ -57,6 +57,19 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def topology() -> dict:
+    """This process's fleet coordinate (ISSUE 8): what the telemetry
+    core is stamped with (per-host shard naming), what RUN.json and
+    bench rows record, and what makes any multi-host artifact
+    joinable back to the process that produced it."""
+    return {
+        "process_index": jax.process_index(),
+        "host_count": jax.process_count(),
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+    }
+
+
 def local_batch_hps(hps: HParams) -> HParams:
     """Per-host loader hparams: each host assembles ``1/num_hosts`` of the
     global batch (``hps.batch_size`` stays the GLOBAL batch everywhere

@@ -59,7 +59,7 @@ from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.ops import mdn
 from sketch_rnn_tpu.sample.sampler import END_TOKEN, START_TOKEN
 from sketch_rnn_tpu.utils.profiling import SpanTimer
-from sketch_rnn_tpu.utils.telemetry import get_telemetry
+from sketch_rnn_tpu.utils.telemetry import JitCompileProbe, get_telemetry
 
 
 @dataclasses.dataclass
@@ -261,8 +261,24 @@ class ServeEngine:
                 "class_embed")
         self.params = jax.device_put(
             {k: params[k] for k in keep if k in params})
-        self._chunk_fn = make_chunk_step(model, hps, self.chunk,
-                                         self.params, greedy)
+        # compile probe (ISSUE 8): a traced cold start shows one
+        # "serve_chunk" compile span with the executable's flops / peak
+        # device bytes (the number that says how many slots fit in
+        # HBM), then cache hits per chunk. serve-bench's warm-up-then-
+        # configure order reports warm runs as hits instead of
+        # recompiling into the measured window. B/K are fixed per
+        # engine but the chunk program is ALSO shape-specialized on the
+        # request-pool size N (make_chunk_step docstring), so the
+        # geometry key is the pool leaf shapes — a second burst of a
+        # different size must compile (and be accounted as) its own
+        # executable, never dispatch the first burst's.
+        self._chunk_fn = JitCompileProbe(
+            make_chunk_step(model, hps, self.chunk, self.params, greedy),
+            "serve_chunk",
+            key_of=lambda a: tuple(tuple(p.shape) for p in a[6]
+                                   if p is not None),
+            label_of=lambda a: (f"(B{self.slots},K{self.chunk},"
+                                f"N{a[6][0].shape[0]})"))
         self.spans = SpanTimer(category="serve")
 
     # -- the request pool --------------------------------------------------

@@ -61,6 +61,14 @@ def _metric_name(cat: str, name: str, suffix: str = "") -> str:
     return _NAME_RE.sub("_", base)
 
 
+def _label_escape(v: str) -> str:
+    """Prometheus exposition label-value escaping (backslash, quote,
+    newline): run_id comes verbatim from SKETCH_RNN_RUN_ID, and an
+    unescaped quote would invalidate the WHOLE scrape."""
+    return (v.replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
 def _fmt(v: float) -> str:
     """Prometheus sample value: integers without a trailing .0 (exact
     counts must scrape as exact counts), floats via repr (no rounding).
@@ -102,6 +110,13 @@ def render_prometheus(tel: Telemetry,
     snap = tel.snapshot()
     emit(f"{PREFIX}_up", "gauge", [("", 1)],
          "process is serving metrics")
+    # run identity (ISSUE 8): the labels that join a scrape to the
+    # run's trace shards, bench rows and RUN.json manifest
+    run_lab = (f'{{run_id="{_label_escape(tel.run_id or "")}",'
+               f'host="{tel.process_index}",'
+               f'host_count="{tel.host_count}"}}')
+    emit(f"{PREFIX}_run_info", "gauge", [(run_lab, 1)],
+         "run_id + fleet coordinate of this process")
     emit(f"{PREFIX}_telemetry_enabled", "gauge",
          [("", int(tel.enabled))],
          "1 when the telemetry core records events")

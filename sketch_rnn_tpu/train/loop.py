@@ -68,7 +68,7 @@ from sketch_rnn_tpu.data.loader import DataLoader
 from sketch_rnn_tpu.data.prefetch import prefetch_batches
 from sketch_rnn_tpu.models.vae import SketchRNN
 from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
-from sketch_rnn_tpu.parallel.multihost import is_primary
+from sketch_rnn_tpu.parallel.multihost import is_primary, topology
 from sketch_rnn_tpu.train.async_ckpt import AsyncCheckpointer
 from sketch_rnn_tpu.train.checkpoint import (
     latest_checkpoint,
@@ -337,8 +337,18 @@ def train(hps: HParams,
     chain is exactly ``check_finite`` and no watchdog state exists.
     """
     num_steps = hps.num_steps if num_steps is None else num_steps
-    if trace_dir and is_primary():
-        tele.configure(trace_dir=trace_dir)
+    mem_sampler = None
+    if trace_dir:
+        # EVERY process records and exports its own shard (ISSUE 8):
+        # the core is stamped with this host's fleet coordinate, so N
+        # hosts sharing one trace_dir write telemetry.pNNNN.jsonl
+        # shards instead of colliding on one path (the pre-tentpole
+        # bug: the old primary-only gate hid every other host's
+        # timeline entirely). scripts/trace_merge.py joins them.
+        topo = topology()
+        tele.configure(trace_dir=trace_dir,
+                       process_index=topo["process_index"],
+                       host_count=topo["host_count"])
     # fail fast: an un-evaluable valid split would otherwise only raise at
     # the FIRST eval sweep, hours into training (everything needed for the
     # check is known now)
@@ -453,6 +463,15 @@ def train(hps: HParams,
     # the two are identical)
     crossed = lambda prev, every: step // every > prev // every
     last_saved_step = None  # highest step THIS run checkpointed
+    if trace_dir:
+        # sampled device-memory gauges (live/peak bytes, per-phase
+        # peaks) — the /metrics + trace view that makes bucket-edge and
+        # batch-size choices memory-visible; no-op on backends without
+        # memory stats (CPU). Started IMMEDIATELY before the try so the
+        # finally's stop() covers the thread's whole lifetime — a
+        # fail-fast raise during setup must not leak the sampler.
+        mem_sampler = tele.MemorySampler().start()
+        mem_sampler.phase = "train"
     try:
         while step < num_steps:
             if profile_span and not trace_active and step >= profile_span[0]:
@@ -534,9 +553,15 @@ def train(hps: HParams,
                     drain.push(step, metrics, extras)
 
             if valid_loader is not None and crossed(prev, hps.eval_every):
+                # per-phase memory attribution: the sweep's live-bytes
+                # peak lands under phase_peak_bytes_eval
+                if mem_sampler is not None:
+                    mem_sampler.phase = "eval"
                 with ledger.span("eval"):
                     ev = evaluate(state.params, valid_loader, eval_step,
                                   mesh, multi=eval_multi)
+                if mem_sampler is not None:
+                    mem_sampler.phase = "train"
                 eval_writer.write(step, ev)
                 eval_writer.log_console(step, ev)
 
@@ -611,11 +636,16 @@ def train(hps: HParams,
         # poisons any later start_trace in this process)
         if trace_active:
             jax.profiler.stop_trace()
+        # the memory sampler thread must not outlive the loop (the
+        # tier-1 conftest guard names leakers)
+        if mem_sampler is not None:
+            mem_sampler.stop()
         # post-mortem telemetry export (best-effort — nothing in a
         # finally may mask the propagating error): a crashed traced run
-        # still leaves its JSONL + Chrome trace on disk; the normal
-        # path re-exports at return with the post-loop spans included
-        if trace_dir and is_primary():
+        # still leaves its JSONL + Chrome trace on disk — EVERY host
+        # its own shard; the normal path re-exports at return with the
+        # post-loop spans included
+        if trace_dir:
             try:
                 tele.get_telemetry().export()
             except Exception:  # noqa: BLE001
@@ -645,11 +675,44 @@ def train(hps: HParams,
         print("[test] " + " ".join(f"{k}={v:.4f}"
                                    for k, v in sorted(ev.items())),
               flush=True)
-    if trace_dir and is_primary():
-        paths = tele.get_telemetry().export()
-        print(f"[telemetry] wrote {paths['jsonl']} and {paths['chrome']} "
-              f"(read with scripts/trace_report.py or Perfetto)",
-              flush=True)
+    if trace_dir:
+        tel = tele.get_telemetry()
+        paths = tel.export()  # every host exports its own shard
+        if is_primary():
+            n_hosts = tel.host_count
+            merge_hint = (" — merge the per-host shards with "
+                          "scripts/trace_merge.py" if n_hosts > 1 else "")
+            print(f"[telemetry] wrote {paths['jsonl']} and "
+                  f"{paths['chrome']} (read with scripts/trace_report.py "
+                  f"or Perfetto){merge_hint}", flush=True)
+            # run manifest (ISSUE 8): the artifact index joining this
+            # run's metrics, trace shards and incidents on one run_id.
+            # Primary-only and traced-runs-only — the telemetry-off
+            # invisibility pin (no files) extends to RUN.json.
+            from sketch_rnn_tpu.utils import runinfo
+            artifacts: Dict[str, object] = {
+                "telemetry_shards": [
+                    tele.shard_jsonl_name(i, n_hosts)
+                    for i in range(n_hosts)],
+                "chrome_traces": [
+                    tele.shard_chrome_name(i, n_hosts)
+                    for i in range(n_hosts)],
+            }
+            if workdir:
+                artifacts["metrics"] = [
+                    os.path.join(workdir, f"{n}_metrics.{ext}")
+                    for n in ("train", "valid") for ext in ("csv",
+                                                            "jsonl")]
+                incident = os.path.join(workdir, "incident.json")
+                if os.path.exists(incident):
+                    artifacts["incident"] = incident
+            if profile and device_dir:
+                artifacts["device_trace"] = device_dir
+            runinfo.write_manifest(
+                trace_dir, kind="train", hps=hps, run_id=tel.run_id,
+                artifacts=artifacts,
+                extra={"seed": seed, "num_steps": num_steps,
+                       "final_step": int(state.step)})
         # restore the disabled default so a later untraced run in the
         # same process does not keep recording into (and paying for) a
         # stale core whose files are never re-exported

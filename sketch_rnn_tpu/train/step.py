@@ -46,6 +46,7 @@ from sketch_rnn_tpu.parallel.mesh import (
 from sketch_rnn_tpu.train.schedules import kl_weight_schedule, lr_schedule
 from sketch_rnn_tpu.train.state import TrainState, make_optimizer
 from sketch_rnn_tpu.utils.compat import shard_map
+from sketch_rnn_tpu.utils.telemetry import JitCompileProbe
 
 Batch = Dict[str, jax.Array]
 Metrics = Dict[str, jax.Array]
@@ -72,11 +73,41 @@ def batch_geometry(batch: Batch) -> Tuple[int, int]:
 
 def geometry_cache_size(fn) -> Optional[int]:
     """Number of compiled executables held by a jitted step/eval fn
-    (None when the runtime does not expose it)."""
+    (None when the runtime does not expose it). Counts THROUGH a
+    :class:`~sketch_rnn_tpu.utils.telemetry.JitCompileProbe` wrapper —
+    the probe sums its own AOT executables with the inner jit cache."""
     try:
         return int(fn._cache_size())
     except AttributeError:
         return None
+
+
+def _probe_batch_key(args) -> Tuple:
+    """Compile-probe geometry key for step/eval calls: the BATCH dict's
+    leaf shapes (args[1]) — the only shapes that vary across dispatches
+    of one run (state/params geometries are fixed at build), and the
+    exact signature jit's own executable cache keys on for them,
+    including leaf presence (a weighted wrap-fill batch is a different
+    program than an unweighted one)."""
+    return tuple(sorted((k, tuple(v.shape)) for k, v in args[1].items()))
+
+
+def _probe_batch_label(args) -> str:
+    """Human-readable geometry for the compile span: ``(B, Tb)`` plus
+    the stack depth K for stacked [K, B, Tb+1, 5] dispatches."""
+    s = args[1]["strokes"].shape
+    b, t = int(s[-3]), int(s[-2]) - 1
+    return (f"K{int(s[0])}x(B{b},T{t})" if len(s) == 4
+            else f"(B{b},T{t})")
+
+
+def _probe(fn, name: str) -> JitCompileProbe:
+    """Wrap a jitted step/eval fn with the per-geometry compile probe
+    (ISSUE 8): compile spans + jit-cache hit/miss counters + per-
+    executable cost/memory stats when telemetry is on; a passthrough
+    (inner jit cache, bitwise the pre-probe path) when off."""
+    return JitCompileProbe(fn, name, key_of=_probe_batch_key,
+                           label_of=_probe_batch_label)
 
 
 def _vma_check(hps: HParams) -> bool:
@@ -168,17 +199,17 @@ def make_train_step(model, hps: HParams,
     """
     step_fn = _make_single_step_core(model, hps, mesh, make_optimizer(hps))
     if mesh is None:
-        return jax.jit(step_fn, donate_argnums=0)
+        return _probe(jax.jit(step_fn, donate_argnums=0), "train_step")
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
-    return jax.jit(
+    return _probe(jax.jit(
         step_fn,
         # pytree-prefix shardings: whole state replicated, whole batch
         # data-sharded, key replicated
         in_shardings=(repl, data, repl),
         out_shardings=(repl, repl),
         donate_argnums=0,
-    )
+    ), "train_step")
 
 
 def make_multi_train_step(model, hps: HParams,
@@ -257,13 +288,14 @@ def make_multi_train_step(model, hps: HParams,
         return state, metrics
 
     if mesh is None:
-        return jax.jit(multi_fn, donate_argnums=0)
+        return _probe(jax.jit(multi_fn, donate_argnums=0),
+                      "train_step_k")
     repl = replicated_sharding(mesh)
     stacked_data = stacked_batch_sharding(mesh)
-    return jax.jit(multi_fn,
-                   in_shardings=(repl, stacked_data, repl),
-                   out_shardings=(repl, repl),
-                   donate_argnums=0)
+    return _probe(jax.jit(multi_fn,
+                          in_shardings=(repl, stacked_data, repl),
+                          out_shardings=(repl, repl),
+                          donate_argnums=0), "train_step_k")
 
 
 def _make_eval_core(model, hps: HParams, mesh: Optional[Mesh]):
@@ -303,15 +335,18 @@ def _make_eval_core(model, hps: HParams, mesh: Optional[Mesh]):
     )
 
 
-def _jit_single_eval(core, mesh: Optional[Mesh]) -> EvalFn:
+def _jit_single_eval(core, mesh: Optional[Mesh],
+                     name: str = "eval_step") -> EvalFn:
     if mesh is None:
-        return jax.jit(core)
+        return _probe(jax.jit(core), name)
     repl = replicated_sharding(mesh)
-    return jax.jit(core, in_shardings=(repl, batch_sharding(mesh), repl),
-                   out_shardings=repl)
+    return _probe(jax.jit(core,
+                          in_shardings=(repl, batch_sharding(mesh), repl),
+                          out_shardings=repl), name)
 
 
-def _jit_multi_eval(core, mesh: Optional[Mesh]):
+def _jit_multi_eval(core, mesh: Optional[Mesh],
+                    name: str = "eval_step_k"):
     """K-batch eval call: ``(params, batches, key, idx) -> metrics`` with
     every metric stacked ``[K, ...]``.
 
@@ -334,12 +369,12 @@ def _jit_multi_eval(core, mesh: Optional[Mesh]):
         return stacked
 
     if mesh is None:
-        return jax.jit(multi_fn)
+        return _probe(jax.jit(multi_fn), name)
     repl = replicated_sharding(mesh)
-    return jax.jit(multi_fn,
-                   in_shardings=(repl, stacked_batch_sharding(mesh),
-                                 repl, repl),
-                   out_shardings=repl)
+    return _probe(jax.jit(multi_fn,
+                          in_shardings=(repl, stacked_batch_sharding(mesh),
+                                        repl, repl),
+                          out_shardings=repl), name)
 
 
 def make_eval_step(model, hps: HParams,
@@ -354,14 +389,16 @@ def make_eval_step(model, hps: HParams,
     global sums make every weighted metric exactly the global-batch value
     regardless of how the zero-weight wrap rows fall across shards.
     """
-    return _jit_single_eval(_make_eval_core(model, hps, mesh), mesh)
+    return _jit_single_eval(_make_eval_core(model, hps, mesh), mesh,
+                            "eval_step")
 
 
 def make_multi_eval_step(model, hps: HParams,
                          mesh: Optional[Mesh] = None):
     """K-batch jitted eval (see :func:`_jit_multi_eval`); pair it with
     ``hps.eval_steps_per_call`` as ``evaluate``'s ``multi=`` argument."""
-    return _jit_multi_eval(_make_eval_core(model, hps, mesh), mesh)
+    return _jit_multi_eval(_make_eval_core(model, hps, mesh), mesh,
+                           "eval_step_k")
 
 
 def _make_per_class_core(model, hps: HParams, mesh: Optional[Mesh]):
@@ -397,10 +434,12 @@ def make_per_class_eval_step(model, hps: HParams,
     striping). Per-class reduction happens inside the forward program
     (``model.eval_metrics_per_class``), psum'd over the mesh axis.
     """
-    return _jit_single_eval(_make_per_class_core(model, hps, mesh), mesh)
+    return _jit_single_eval(_make_per_class_core(model, hps, mesh), mesh,
+                            "per_class_eval")
 
 
 def make_multi_per_class_eval_step(model, hps: HParams,
                                    mesh: Optional[Mesh] = None):
     """K-batch jitted per-class eval (metrics stacked ``[K, C]``)."""
-    return _jit_multi_eval(_make_per_class_core(model, hps, mesh), mesh)
+    return _jit_multi_eval(_make_per_class_core(model, hps, mesh), mesh,
+                           "per_class_eval_k")

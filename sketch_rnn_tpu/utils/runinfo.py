@@ -1,0 +1,170 @@
+"""Run identity & manifests: one join key across every artifact.
+
+ISSUE 8 tentpole piece 3. The repo grew observability artifacts faster
+than it grew ways to correlate them: a training run leaves metrics
+CSV/JSONL, telemetry shards, maybe an incident.json; a serve-bench
+leaves a trace, a metrics.prom scrape and a report line; bench runs
+append history rows — and NOTHING ties them together, so "which trace
+explains this bench regression" is archaeology. This module gives
+every invocation:
+
+- **run_id** — one process-wide id (``get_run_id``), minted lazily per
+  process or inherited from ``SKETCH_RNN_RUN_ID`` (how a multi-host
+  launcher gives every worker the SAME id, and how a driver script can
+  stamp a whole experiment). It rides in telemetry meta lines, bench
+  history rows and the manifest.
+- **config_hash** — a short stable hash of the full HParams JSON, so
+  two runs are provably the-same-config without diffing 40 fields.
+- **host topology** — ``(process_index, host_count, device counts,
+  device kind)``, the fleet coordinate that makes shards and history
+  rows interpretable.
+- **RUN.json** (``write_manifest``) — the artifact index: which
+  metrics files, trace shards, prom scrape, incidents and bench rows
+  belong to this run_id. Written atomically (tmp + rename) so a
+  crashing run never leaves a torn manifest; re-writing merges the
+  artifact index, so train can register its metrics early and its
+  trace shards at exit.
+
+No jax / numpy imports at module scope — the telemetry core resolves
+run ids from here, and telemetry-shard subprocesses must stay light.
+Manifests are strictly opt-in at the call sites (a traced or scraped
+run): the bitwise-invisibility pin — telemetry off writes NO files —
+extends to RUN.json.
+"""
+
+from __future__ import annotations
+
+import binascii
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Optional
+
+RUN_MANIFEST = "RUN.json"
+RUN_ID_ENV = "SKETCH_RNN_RUN_ID"
+
+_run_id: Optional[str] = None
+
+
+def get_run_id() -> str:
+    """This process's run id (minted once, stable for the process).
+
+    ``SKETCH_RNN_RUN_ID`` in the environment wins — that is how every
+    host of a multi-controller launch (and every subprocess a driver
+    spawns) shares ONE id so their shards, rows and manifests join.
+    Otherwise: ``YYYYmmdd-HHMMSS-<6 hex>`` — sortable, collision-safe
+    across concurrent processes via the random suffix.
+    """
+    global _run_id
+    if _run_id is None:
+        env = os.environ.get(RUN_ID_ENV)
+        if env:
+            _run_id = env
+        else:
+            _run_id = (time.strftime("%Y%m%d-%H%M%S")
+                       + "-"
+                       + binascii.hexlify(os.urandom(3)).decode())
+    return _run_id
+
+
+def set_run_id(run_id: Optional[str]) -> None:
+    """Pin (or with None, reset) the process run id — tests, and
+    drivers that mint the id themselves before spawning workers."""
+    global _run_id
+    _run_id = run_id
+
+
+def config_hash(hps) -> Optional[str]:
+    """12-hex stable hash of the FULL HParams JSON (field order is
+    dataclass-declaration order, so equal configs hash equal); None
+    for callers without hparams (e.g. a bare trace-merge)."""
+    if hps is None:
+        return None
+    text = hps.to_json() if hasattr(hps, "to_json") else json.dumps(
+        hps, sort_keys=True)
+    return hashlib.sha256(text.encode()).hexdigest()[:12]
+
+
+def host_topology() -> Dict[str, object]:
+    """The fleet coordinate of this process: ONE source of truth —
+    :func:`parallel.multihost.topology` (what the telemetry core and
+    shard names are stamped with) plus the device kind — degraded to a
+    single-host/no-device stamp when jax is unusable, so manifest
+    writing can never be the thing that breaks a run."""
+    try:
+        import jax
+
+        from sketch_rnn_tpu.parallel.multihost import topology
+
+        return {**topology(), "device_kind": jax.devices()[0].device_kind}
+    except Exception:  # noqa: BLE001
+        return {"process_index": 0, "host_count": 1,
+                "device_count": 0, "local_device_count": 0,
+                "device_kind": None}
+
+
+def manifest_path(out_dir: str) -> str:
+    return os.path.join(out_dir, RUN_MANIFEST)
+
+
+def write_manifest(out_dir: str, kind: str,
+                   artifacts: Optional[Dict[str, object]] = None,
+                   hps=None, run_id: Optional[str] = None,
+                   extra: Optional[Dict[str, object]] = None) -> str:
+    """Write (or merge into) ``<out_dir>/RUN.json``; returns its path.
+
+    ``artifacts`` maps artifact names to paths (or lists of paths) —
+    the index that lets tooling walk from a run_id to every file the
+    run produced. A manifest already present for the SAME run_id is
+    merged (artifact keys update, extras update, first-created wins on
+    identity fields), so multiple call sites of one run compose; a
+    DIFFERENT run_id's manifest is replaced (the directory was reused
+    — the stale index must not claim the new run's files). Atomic via
+    tmp + ``os.replace`` so readers never see a torn manifest.
+    """
+    run_id = run_id or get_run_id()
+    os.makedirs(out_dir, exist_ok=True)
+    path = manifest_path(out_dir)
+    doc: Dict[str, object] = {
+        "run_id": run_id,
+        "kind": kind,
+        "created_unix": time.time(),
+        "config_hash": config_hash(hps),
+        "host": host_topology(),
+        "artifacts": {},
+    }
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = None
+        if isinstance(prev, dict) and prev.get("run_id") == run_id:
+            doc.update({k: prev[k] for k in
+                        ("kind", "created_unix", "config_hash", "host")
+                        if prev.get(k) is not None})
+            if isinstance(prev.get("artifacts"), dict):
+                doc["artifacts"] = dict(prev["artifacts"])
+            for k, v in prev.items():
+                if k not in doc:
+                    doc[k] = v
+    if artifacts:
+        doc["artifacts"].update(artifacts)
+    if extra:
+        doc.update(extra)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(out_dir: str) -> Optional[Dict]:
+    """Load ``<out_dir>/RUN.json`` (None when absent/unreadable)."""
+    try:
+        with open(manifest_path(out_dir)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -46,6 +46,22 @@ columns, bitwise-identical metrics (the tier-1 pin in
 tests/test_telemetry.py). ``configure(trace_dir=...)`` swaps in a fresh
 enabled instance (``cli train --trace_dir=...``,
 ``cli serve-bench --trace_dir=...``).
+
+Fleet awareness (ISSUE 8): every core is stamped with
+``(process_index, host_count, run_id)`` and exports PER-HOST SHARD
+files (``telemetry.p0001.jsonl`` under multi-controller — no path
+collisions; the bare single-host names are unchanged).
+``scripts/trace_merge.py`` merges N shards into one Chrome trace with
+per-host track groups plus a global summary that reconciles exactly
+with the per-shard summaries — which is why histograms serialize their
+raw log buckets (:meth:`Histogram.to_dict`) and support an exact
+:meth:`Histogram.merge`. ``run_id`` (utils/runinfo.py) is the join key
+between traces, metrics, bench rows and the ``RUN.json`` manifest.
+
+This module deliberately imports neither jax nor numpy at module
+scope: telemetry-shard subprocesses (tests/_multihost_worker.py's
+light mode) must start in milliseconds. The jax-touching helpers
+(:class:`JitCompileProbe`, :class:`MemorySampler`) import lazily.
 """
 
 from __future__ import annotations
@@ -61,6 +77,28 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 TELEMETRY_JSONL = "telemetry.jsonl"
 CHROME_TRACE = "trace.json"
+
+
+def shard_suffix(process_index: int, host_count: int) -> str:
+    """Filename suffix isolating one host's export shard.
+
+    Single-host runs keep the bare legacy names (every existing
+    consumer and committed trace stays valid); multi-controller runs
+    get ``.pNNNN`` so N processes writing one shared ``--trace_dir``
+    can never collide (the ISSUE 8 pre-tentpole bugfix)."""
+    if host_count <= 1:
+        return ""
+    return f".p{process_index:04d}"
+
+
+def shard_jsonl_name(process_index: int, host_count: int) -> str:
+    root, ext = os.path.splitext(TELEMETRY_JSONL)
+    return f"{root}{shard_suffix(process_index, host_count)}{ext}"
+
+
+def shard_chrome_name(process_index: int, host_count: int) -> str:
+    root, ext = os.path.splitext(CHROME_TRACE)
+    return f"{root}{shard_suffix(process_index, host_count)}{ext}"
 # the device-trace alignment marker protocol — ONE copy of the schema,
 # shared by Telemetry.device_trace and the training loop's split
 # start/stop sites (and whatever trace_report learns to read later)
@@ -106,9 +144,17 @@ class Histogram:
     GROWTH = 2.0 ** 0.125
     _LOG_G = math.log(GROWTH)
 
-    __slots__ = ("count", "total", "vmin", "vmax", "_buckets", "_zero")
+    __slots__ = ("count", "total", "vmin", "vmax", "_buckets", "_zero",
+                 "growth", "_log_g")
 
-    def __init__(self):
+    def __init__(self, growth: Optional[float] = None):
+        # growth is an INSTANCE property since ISSUE 8: shard merging
+        # is only exact between histograms on the same bucket lattice,
+        # so merge() must be able to see (and reject) a mismatch
+        self.growth = float(growth) if growth else self.GROWTH
+        if self.growth <= 1.0:
+            raise ValueError(f"growth must be > 1, got {self.growth}")
+        self._log_g = math.log(self.growth)
         self.count = 0
         self.total = 0.0
         self.vmin = math.inf
@@ -127,8 +173,63 @@ class Histogram:
         if v <= 0.0:
             self._zero += 1
             return
-        i = int(math.floor(math.log(v) / self._LOG_G))
+        i = int(math.floor(math.log(v) / self._log_g))
         self._buckets[i] = self._buckets.get(i, 0) + 1
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` into ``self`` EXACTLY (in place; returns self).
+
+        Two histograms on the same log-bucket lattice merge without any
+        approximation: per-bucket counts add, ``count``/``total``/
+        ``min``/``max`` combine exactly, so a fleet-merged histogram's
+        quantiles are precisely what one process observing the union
+        stream would report (the trace_merge reconciliation contract,
+        ISSUE 8). A growth mismatch is REJECTED — resampling between
+        lattices would silently break that exactness.
+        """
+        if other.growth != self.growth:
+            raise ValueError(
+                f"cannot merge histograms with different bucket growth "
+                f"({self.growth!r} vs {other.growth!r}): log-bucket "
+                f"merging is only exact on one lattice")
+        self.count += other.count
+        self.total += other.total
+        if other.vmin < self.vmin:
+            self.vmin = other.vmin
+        if other.vmax > self.vmax:
+            self.vmax = other.vmax
+        self._zero += other._zero
+        for i, n in other._buckets.items():
+            self._buckets[i] = self._buckets.get(i, 0) + n
+        return self
+
+    def to_dict(self) -> Dict:
+        """Loss-free serialized form (the shard export's ``raw`` field):
+        everything :meth:`from_dict` needs to rebuild this histogram
+        bit-for-bit, which is what makes cross-host merging exact."""
+        return {
+            "growth": self.growth,
+            "count": self.count,
+            "total": self.total,
+            "min": None if self.count == 0 else self.vmin,
+            "max": None if self.count == 0 else self.vmax,
+            "zero": self._zero,
+            "buckets": [[i, self._buckets[i]]
+                        for i in sorted(self._buckets)],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Histogram":
+        h = cls(growth=d.get("growth"))
+        h.count = int(d["count"])
+        h.total = float(d["total"])
+        if d.get("min") is not None:
+            h.vmin = float(d["min"])
+        if d.get("max") is not None:
+            h.vmax = float(d["max"])
+        h._zero = int(d.get("zero", 0))
+        h._buckets = {int(i): int(n) for i, n in d.get("buckets", [])}
+        return h
 
     def quantile(self, q: float) -> float:
         """Approximate ``q``-quantile of the stream.
@@ -151,7 +252,7 @@ class Histogram:
         for i in sorted(self._buckets):
             cum += self._buckets[i]
             if rank < cum:
-                mid = self.GROWTH ** (i + 0.5)
+                mid = self.growth ** (i + 0.5)
                 return min(max(mid, self.vmin), self.vmax)
         return self.vmax
 
@@ -171,7 +272,7 @@ class Histogram:
             out.append((0.0, cum))
         for i in sorted(self._buckets):
             cum += self._buckets[i]
-            out.append((self.GROWTH ** (i + 1), cum))
+            out.append((self.growth ** (i + 1), cum))
         return out
 
     def summary(self) -> Dict[str, float]:
@@ -237,12 +338,23 @@ class Telemetry:
     """
 
     def __init__(self, capacity: int = 1 << 16, enabled: bool = True,
-                 trace_dir: Optional[str] = None):
+                 trace_dir: Optional[str] = None,
+                 process_index: int = 0, host_count: int = 1,
+                 run_id: Optional[str] = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if not (0 <= process_index < max(host_count, 1)):
+            raise ValueError(f"process_index {process_index} out of range "
+                             f"for host_count {host_count}")
         self.enabled = enabled
         self.capacity = capacity
         self.trace_dir = trace_dir
+        # fleet stamp (ISSUE 8): rides in the export meta line and
+        # keys the per-host shard filenames, so N processes sharing one
+        # trace_dir produce N joinable (never colliding) streams
+        self.process_index = int(process_index)
+        self.host_count = int(host_count)
+        self.run_id = run_id
         self.dropped = 0
         self.origin_perf = time.perf_counter()
         self.origin_unix = time.time()
@@ -393,18 +505,31 @@ class Telemetry:
     def export_jsonl(self, path: str) -> None:
         """Write the newline-JSONL event stream: one meta line, the ring
         events in record order, then ``agg``/``counter_total``/``hist``
-        summary lines (exact even when the ring dropped events)."""
+        summary lines (exact even when the ring dropped events).
+
+        Fleet-merge additions (ISSUE 8): the meta line carries the
+        ``(process_index, host_count, run_id)`` stamp, gauge-valued
+        ``counter_total`` lines are flagged ``"gauge": true`` (a merge
+        must SUM counters but never sum latest-sample gauges), and
+        ``hist`` lines carry their loss-free ``raw`` log buckets so
+        ``scripts/trace_merge.py`` can rebuild and exactly merge them.
+        """
         with self._lock:
             events = list(self._events)
             agg = {k: list(v) for k, v in self._agg.items()}
             counters = dict(self._counters)
-            hists = {k: h.summary() for k, h in self._hists.items()}
+            gauge_keys = set(self._gauge_keys)
+            hists = {k: (h.summary(), h.total, h.to_dict())
+                     for k, h in self._hists.items()}
             dropped = self.dropped
         with open(path, "w") as f:
             f.write(json.dumps({
                 "type": "meta", "origin_unix": self.origin_unix,
                 "pid": os.getpid(), "capacity": self.capacity,
-                "dropped": dropped}) + "\n")
+                "dropped": dropped,
+                "process_index": self.process_index,
+                "host_count": self.host_count,
+                "run_id": self.run_id}) + "\n")
             for ev in events:
                 f.write(json.dumps(ev) + "\n")
             for (cat, name), (n, total) in sorted(agg.items()):
@@ -412,12 +537,15 @@ class Telemetry:
                     "type": "agg", "cat": cat, "name": name,
                     "count": int(n), "total_s": total}) + "\n")
             for (cat, name), v in sorted(counters.items()):
+                rec = {"type": "counter_total", "cat": cat, "name": name,
+                       "value": v}
+                if (cat, name) in gauge_keys:
+                    rec["gauge"] = True
+                f.write(json.dumps(rec) + "\n")
+            for (cat, name), (s, total, raw) in sorted(hists.items()):
                 f.write(json.dumps({
-                    "type": "counter_total", "cat": cat, "name": name,
-                    "value": v}) + "\n")
-            for (cat, name), s in sorted(hists.items()):
-                f.write(json.dumps({
-                    "type": "hist", "cat": cat, "name": name, **s}) + "\n")
+                    "type": "hist", "cat": cat, "name": name, **s,
+                    "total": total, "raw": raw}) + "\n")
 
     def export_chrome_trace(self, path: str) -> None:
         """Write a Chrome-trace ``traceEvents`` JSON (chrome://tracing /
@@ -462,13 +590,22 @@ class Telemetry:
 
     def export(self, trace_dir: Optional[str] = None) -> Dict[str, str]:
         """Write both exporters into ``trace_dir`` (default: the
-        configured one); returns ``{"jsonl": path, "chrome": path}``."""
+        configured one); returns ``{"jsonl": path, "chrome": path}``.
+
+        Paths are this host's SHARD (``telemetry.p0001.jsonl`` under
+        multi-controller, the bare legacy names single-host), so every
+        process of a fleet can export into one shared trace_dir;
+        ``scripts/trace_merge.py`` joins the shards afterwards."""
         d = trace_dir or self.trace_dir
         if not d:
             raise ValueError("no trace_dir configured or given")
         os.makedirs(d, exist_ok=True)
-        paths = {"jsonl": os.path.join(d, TELEMETRY_JSONL),
-                 "chrome": os.path.join(d, CHROME_TRACE)}
+        paths = {"jsonl": os.path.join(
+                     d, shard_jsonl_name(self.process_index,
+                                         self.host_count)),
+                 "chrome": os.path.join(
+                     d, shard_chrome_name(self.process_index,
+                                          self.host_count))}
         self.export_jsonl(paths["jsonl"])
         self.export_chrome_trace(paths["chrome"])
         return paths
@@ -510,14 +647,28 @@ def get_telemetry() -> Telemetry:
 
 
 def configure(trace_dir: Optional[str] = None,
-              capacity: int = 1 << 16) -> Telemetry:
+              capacity: int = 1 << 16,
+              process_index: int = 0, host_count: int = 1,
+              run_id: Optional[str] = None) -> Telemetry:
     """Swap in a FRESH enabled core (old events do not leak across
-    runs) writing into ``trace_dir``; returns it."""
+    runs) writing into ``trace_dir``; returns it.
+
+    ``(process_index, host_count)`` is the caller's fleet coordinate
+    (``parallel.multihost.topology()`` in the runtime) — it keys the
+    per-host shard filenames. ``run_id`` defaults to the process-wide
+    id from :mod:`~sketch_rnn_tpu.utils.runinfo`, the key that joins
+    this trace with metrics, bench rows and the RUN.json manifest."""
     global _global
+    if run_id is None:
+        from sketch_rnn_tpu.utils import runinfo
+
+        run_id = runinfo.get_run_id()
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
     _global = Telemetry(capacity=capacity, enabled=True,
-                        trace_dir=trace_dir)
+                        trace_dir=trace_dir,
+                        process_index=process_index,
+                        host_count=host_count, run_id=run_id)
     return _global
 
 
@@ -525,3 +676,298 @@ def disable() -> None:
     """Restore the disabled default (tests; end of a traced run)."""
     global _global
     _global = Telemetry(enabled=False)
+
+
+# -- compile accounting ------------------------------------------------------
+
+
+class JitCompileProbe:
+    """Wrap a jitted callable with per-geometry compile accounting.
+
+    Length-bucketed execution made compiled-program count a first-order
+    cost (one executable per (B, Tb), train/step.py), but nothing
+    observed WHEN compiles happen, how long they take, or what the
+    executables cost — the pjit/TPUv4 scaling paper's first ask
+    (PAPERS.md). This wrapper is the probe:
+
+    - Every call derives a cheap geometry key (``key_of(args)`` —
+      callers pass a lambda extracting only the VARYING shapes, e.g.
+      the batch leaves; default: shapes of every arg leaf).
+    - While telemetry is enabled, a first-seen geometry is compiled
+      through the AOT path (``fn.lower(...).compile()``) so its
+      ``cost_analysis()`` / ``memory_analysis()`` stats — flops, bytes
+      accessed, peak device bytes — can be read off the actual
+      executable; the compile is timed as ONE span (cat ``compile``)
+      carrying those stats in its args, a ``jit_cache_miss`` counter
+      ticks, and the executable lands in the probe's own cache. Repeat
+      geometries tick ``jit_cache_hit`` and dispatch the cached
+      executable — exactly one compile per geometry, same as jit's own
+      shape-keyed cache (the bucketed-smoke acceptance pin).
+    - While telemetry is disabled the call forwards straight to the
+      jitted ``fn`` (its internal cache; bitwise the pre-probe path)
+      but the geometry is still remembered: a run that enables tracing
+      AFTER warmup (serve-bench's documented order) reports warm
+      geometries as cache HITS instead of recompiling them into the
+      measured window.
+
+    Exposes ``_cache_size()`` (own executables + the inner jit cache)
+    so :func:`train.step.geometry_cache_size` counts through the probe
+    transparently.
+    """
+
+    _FALLBACK = object()  # geometry compiled inside fn's own jit cache
+
+    def __init__(self, fn, name: str, key_of=None, label_of=None):
+        self._fn = fn
+        self._name = name
+        self._key_of = key_of
+        self._label_of = label_of
+        self._cache: Dict = {}
+        self._lock = threading.Lock()
+
+    def _geom(self, args):
+        if self._key_of is not None:
+            return self._key_of(args)
+        import jax
+
+        return tuple(tuple(getattr(leaf, "shape", ()))
+                     for leaf in jax.tree_util.tree_leaves(args))
+
+    def __call__(self, *args):
+        key = self._geom(args)
+        with self._lock:
+            entry = self._cache.get(key)
+        tel = get_telemetry()
+        if entry is not None:
+            if tel.enabled:
+                tel.counter("jit_cache_hit", 1.0, cat="compile")
+            fn = self._fn if entry is self._FALLBACK else entry
+            return fn(*args)
+        if not tel.enabled:
+            # first dispatch with tracing off: the inner jit compiles
+            # and caches; remember the geometry so later-enabled runs
+            # count it warm instead of recompiling it
+            with self._lock:
+                self._cache.setdefault(key, self._FALLBACK)
+            return self._fn(*args)
+        tel.counter("jit_cache_miss", 1.0, cat="compile")
+        span_args = {"geometry": (self._label_of(args) if self._label_of
+                                  else repr(key))}
+        t0 = time.perf_counter()
+        try:
+            compiled = self._fn.lower(*args).compile()
+            span_args.update(executable_stats(compiled))
+            entry = compiled
+        except Exception as e:  # noqa: BLE001 — AOT is best-effort
+            # a backend without the AOT path still gets the span and
+            # the miss counter; the call itself must never fail here
+            span_args["aot_error"] = repr(e)
+            entry = self._FALLBACK
+        t1 = time.perf_counter()
+        tel.emit_span(self._name, "compile", t0, t1, args=span_args)
+        if span_args.get("peak_bytes") is not None:
+            # latest-compile peak device bytes as a gauge: the /metrics
+            # view that makes bucket-edge / slot-count choices
+            # memory-visible before a run OOMs
+            tel.gauge(f"{self._name}_peak_bytes",
+                      span_args["peak_bytes"], cat="compile")
+        with self._lock:
+            self._cache.setdefault(key, entry)
+        fn = self._fn if entry is self._FALLBACK else entry
+        return fn(*args)
+
+    def _cache_size(self) -> int:
+        try:
+            inner = int(self._fn._cache_size())
+        except AttributeError:
+            inner = 0
+        with self._lock:
+            own = sum(1 for v in self._cache.values()
+                      if v is not self._FALLBACK)
+        return inner + own
+
+    def __repr__(self) -> str:
+        return f"JitCompileProbe({self._name}, {len(self._cache)} geoms)"
+
+
+def executable_stats(compiled) -> Dict[str, float]:
+    """Flops / bytes / peak-device-bytes of one compiled executable.
+
+    Read from ``cost_analysis()`` (may be a per-device list) and
+    ``memory_analysis()`` (absent on some backends — missing pieces are
+    simply omitted). ``peak_bytes`` is the executable's device-memory
+    high-water estimate: arguments + outputs + temporaries (XLA's
+    ``CompiledMemoryStats``), the number that decides whether a bucket
+    edge or slot count fits in HBM."""
+    out: Dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            if ca.get("flops") is not None:
+                out["flops"] = float(ca["flops"])
+            if ca.get("bytes accessed") is not None:
+                out["bytes_accessed"] = float(ca["bytes accessed"])
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg = float(getattr(ma, "argument_size_in_bytes", 0) or 0)
+            outb = float(getattr(ma, "output_size_in_bytes", 0) or 0)
+            tmp = float(getattr(ma, "temp_size_in_bytes", 0) or 0)
+            out["argument_bytes"] = arg
+            out["output_bytes"] = outb
+            out["temp_bytes"] = tmp
+            out["peak_bytes"] = arg + outb + tmp
+    except Exception:  # noqa: BLE001
+        pass
+    return out
+
+
+# -- device-memory sampling --------------------------------------------------
+
+# every started sampler, for the conftest no-leaked-threads guard
+_SAMPLERS: set = set()
+_SAMPLERS_LOCK = threading.Lock()
+
+
+def _default_device_stats() -> Optional[Dict[str, float]]:
+    """Live/peak device bytes over this process's local devices via
+    ``jax`` memory stats: ``bytes_in_use`` SUMS across local devices
+    (total live footprint this host holds), ``peak_bytes_in_use`` is
+    the per-device MAX (each device's HBM is its own ceiling — a sum
+    would hide that one chip is about to OOM). None when the backend
+    exposes no stats (CPU)."""
+    import jax
+
+    in_use = 0.0
+    peak = 0.0
+    seen = False
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001
+            stats = None
+        if not stats:
+            continue
+        seen = True
+        in_use += float(stats.get("bytes_in_use", 0) or 0)
+        peak = max(peak, float(stats.get("peak_bytes_in_use", 0) or 0))
+    if not seen:
+        return None
+    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+
+
+class MemorySampler:
+    """Background device-memory gauge feeding the telemetry core.
+
+    Samples ``jax`` device memory stats every ``interval_s`` on a
+    daemon thread and records gauges (cat ``memory``):
+
+    - ``device_bytes_in_use`` — live bytes summed over local devices,
+    - ``device_peak_bytes`` — per-device peak high-water mark,
+    - ``phase_peak_bytes_<phase>`` — the max LIVE bytes observed while
+      :attr:`phase` held that label (the loop flips it train/eval), so
+      an operator can read "eval sweeps spike HBM by X" off /metrics.
+
+    Gauges land in the core's snapshot, so the ``/metrics`` endpoint
+    renders them live and exported traces carry the timeline as Chrome
+    counter tracks. Backends without memory stats (CPU) record nothing
+    — ``stats_fn`` is injectable for tests. Started samplers register
+    process-wide; :func:`stop_all_samplers` is the tier-1 conftest
+    guard against leaked sampler threads.
+    """
+
+    def __init__(self, interval_s: float = 0.5,
+                 telemetry: Optional[Telemetry] = None,
+                 stats_fn=None):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.interval_s = float(interval_s)
+        self._telemetry = telemetry
+        self._stats_fn = stats_fn or _default_device_stats
+        self.phase = "run"
+        self._phase_peak: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _tel(self) -> Telemetry:
+        return self._telemetry if self._telemetry is not None \
+            else get_telemetry()
+
+    def sample(self) -> Optional[Dict[str, float]]:
+        """Take one sample now (also the thread body's step); returns
+        the stats recorded, or None (disabled core / no backend
+        stats)."""
+        tel = self._tel()
+        if not tel.enabled:
+            return None
+        stats = self._stats_fn()
+        if not stats:
+            return None
+        in_use = float(stats.get("bytes_in_use", 0.0))
+        peak = float(stats.get("peak_bytes_in_use", 0.0))
+        phase = self.phase
+        prev = self._phase_peak.get(phase, 0.0)
+        if in_use > prev:
+            self._phase_peak[phase] = prev = in_use
+        tel.gauge("device_bytes_in_use", in_use, cat="memory")
+        tel.gauge("device_peak_bytes", peak, cat="memory")
+        tel.gauge(f"phase_peak_bytes_{phase}", prev, cat="memory")
+        return {"bytes_in_use": in_use, "peak_bytes_in_use": peak}
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 — sampling must never kill
+                pass           # the run it observes
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "MemorySampler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="memory-sampler",
+                                        daemon=True)
+        self._thread.start()
+        with _SAMPLERS_LOCK:
+            _SAMPLERS.add(self)
+        return self
+
+    def stop(self) -> None:
+        thread = self._thread
+        self._thread = None
+        with _SAMPLERS_LOCK:
+            _SAMPLERS.discard(self)
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MemorySampler":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()
+
+    def __repr__(self) -> str:
+        state = "live" if self._thread is not None else "stopped"
+        return f"MemorySampler({state}, phase={self.phase!r})"
+
+
+def live_samplers() -> Tuple["MemorySampler", ...]:
+    with _SAMPLERS_LOCK:
+        return tuple(_SAMPLERS)
+
+
+def stop_all_samplers() -> Tuple[str, ...]:
+    """Stop every live sampler; returns their reprs (the conftest guard
+    asserts this is empty — a non-empty return names the leaker)."""
+    leaked = live_samplers()
+    names = tuple(repr(s) for s in leaked)
+    for s in leaked:
+        s.stop()
+    return names

@@ -13,10 +13,61 @@ contributes 2 virtual CPU devices, runs 3 deterministic data-parallel
 training steps over the global 4-device mesh feeding only its OWN stripe
 of the corpus, and dumps its replicated parameters for the test to
 compare across processes and against a single-process run.
+
+Telemetry-shard mode (ISSUE 8, tier-1)::
+
+    python _multihost_worker.py shard <rank> <nproc> <outdir> [run_id]
+
+A LIGHT worker — no jax, no cluster — that plays one host of a fleet:
+it configures the telemetry core with its ``(rank, nproc)`` fleet
+coordinate, records a deterministic rank-seeded workload (spans,
+counters, gauges, histogram observations), and exports its per-host
+shard into the shared ``outdir``. tests/test_trace_merge.py launches
+two of these as REAL subprocesses and requires the merged global
+summary to reconcile exactly with the per-shard summaries.
 """
 
 import os
 import sys
+
+
+def shard_main() -> int:
+    rank, nproc = int(sys.argv[2]), int(sys.argv[3])
+    outdir = sys.argv[4]
+    run_id = sys.argv[5] if len(sys.argv) > 5 else "shard-test"
+
+    # runnable directly (no PYTHONPATH needed): the repo root is one
+    # level up from tests/
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    tel = tele.configure(trace_dir=outdir, process_index=rank,
+                         host_count=nproc, run_id=run_id)
+    # deterministic per-rank workload: ranks record DIFFERENT counts
+    # and values, so an exact merged reconciliation cannot pass by
+    # symmetry. Pre-computed t0/t1 pairs (not timers) make the span
+    # totals reproducible floats; anchoring them to the core's own
+    # origin makes the exported ts values the intended small offsets.
+    base = tel.origin_perf
+    for i in range(20 + 5 * rank):
+        t0 = base + 0.010 * i
+        tel.emit_span("dispatch", "train", t0, t0 + 0.002 + 1e-4 * rank)
+    for i in range(7 + rank):
+        t0 = base + 0.025 * i
+        tel.emit_span("assemble", "data", t0, t0 + 0.001)
+    tel.counter("micro_steps", 10.0 + rank, cat="data")
+    tel.counter("requests_completed", 3.0 * (rank + 1), cat="serve")
+    tel.gauge("slots_live", 4 + rank, cat="serve")
+    tel.instant("enqueue", cat="serve", args={"uid": rank},
+                ts=base + 0.5)
+    for i in range(30):
+        tel.observe("latency_s", 0.01 * (i + 1) * (rank + 1),
+                    cat="serve")
+    paths = tel.export()
+    print(f"[shard {rank}/{nproc}] exported {paths['jsonl']}",
+          flush=True)
+    return 0
 
 
 def main() -> int:
@@ -156,4 +207,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(shard_main() if sys.argv[1:2] == ["shard"] else main())

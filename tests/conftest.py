@@ -37,6 +37,20 @@ def _hermetic_telemetry():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_memory_samplers():
+    """ISSUE 8 guard: the device-memory sampler runs on a daemon
+    thread and registers process-wide (utils/telemetry.py _SAMPLERS);
+    a test that starts one must stop it — a leaked sampler keeps
+    recording gauges into whatever core later tests configure. Leaks
+    are drained AND failed loudly, naming the leaker."""
+    yield
+    from sketch_rnn_tpu.utils import telemetry
+
+    leaked = telemetry.stop_all_samplers()
+    assert not leaked, f"test leaked live memory samplers: {leaked}"
+
+
+@pytest.fixture(autouse=True)
 def _no_stray_health_surfaces():
     """ISSUE 7 guard: the health/SLO layer is OFF by default — no test
     may leak a listening /metrics socket or an armed watchdog monitor

@@ -245,6 +245,41 @@ def test_hist_append_routes_smoke_and_cpu_rows(tmp_path, monkeypatch):
         assert not bench._is_smoke_record(json.loads(line))
 
 
+def test_hist_append_stamps_run_id_and_topology(tmp_path, monkeypatch):
+    """ISSUE 8 satellite: every appended row carries run_id + host
+    topology (the trace/bench join key), rows of one process share one
+    run_id, and the new fields are TOLERATED by every consumer — old
+    rows (no stamp) and new rows key and pool identically."""
+    from scripts.bench_summary import key_of, metric_of
+    from sketch_rnn_tpu.utils import runinfo
+
+    canon = tmp_path / "canon.jsonl"
+    monkeypatch.setattr(bench, "_hist_path", lambda: str(canon))
+    monkeypatch.setattr(bench, "_smoke_hist_path",
+                        lambda: str(tmp_path / "smoke.jsonl"))
+    r1 = bench._hist_append({**_BASE, "strokes_per_sec_per_chip": 1.0})
+    r2 = bench._hist_append({**_BASE, "strokes_per_sec_per_chip": 2.0})
+    assert r1["run_id"] and r1["run_id"] == r2["run_id"]
+    assert r1["run_id"] == runinfo.get_run_id()
+    assert r1["host_count"] >= 1 and r1["process_index"] == 0
+    # an explicit caller-provided run_id wins over the stamp
+    r3 = bench._hist_append({**_BASE, "run_id": "mine",
+                             "strokes_per_sec_per_chip": 3.0})
+    assert r3["run_id"] == "mine"
+    # old (unstamped) and new rows are the same summary/regress cell
+    old = {**_BASE, "strokes_per_sec_per_chip": 4.0}
+    assert key_of(old) == key_of(r1)
+    assert metric_of(r1) == 1.0
+    # bench_regress's collection walks the same key_of over stamped
+    # rows: one cell despite mixed stamping
+    from scripts import bench_regress
+    _write_hist(tmp_path / "mixed.jsonl",
+                [r1, r2, r3, old])
+    cells = bench_regress.collect([str(tmp_path / "mixed.jsonl")])
+    assert len(cells) == 1
+    assert sorted(next(iter(cells.values()))) == [1.0, 2.0, 3.0, 4.0]
+
+
 def test_bench_summary_aggregates_partial_streamed_log(tmp_path, capsys):
     """VERDICT r5 weak #1: a driver-captured log from a run that died
     mid-matrix — streamed rows interleaved with progress chatter, a

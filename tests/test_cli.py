@@ -133,6 +133,19 @@ def test_cli_serve_bench_random_init(tmp_path, capsys):
     for p in (50, 95, 99):
         assert round(lat["latency_s"][f"p{p}_s"], 6) == \
             rep[f"latency_p{p}_s"]
+    # run manifest (ISSUE 8): RUN.json beside the trace indexes the
+    # run's artifacts under the SAME run_id the report and the
+    # telemetry meta line carry — the join key
+    from sketch_rnn_tpu.utils import runinfo
+    man = runinfo.read_manifest(td)
+    assert man is not None and man["kind"] == "serve_bench"
+    assert man["run_id"] == rep["run_id"]
+    assert man["config_hash"]
+    meta = json.loads(open(os.path.join(td, "telemetry.jsonl"))
+                      .readline())
+    assert meta["run_id"] == rep["run_id"]
+    assert os.path.basename(man["artifacts"]["jsonl"]) == \
+        "telemetry.jsonl"
 
 
 def test_cli_serve_bench_bad_slo_is_usage_error(tmp_path, capsys):

@@ -224,6 +224,21 @@ def test_render_prometheus_disabled_core_serves_meta_only():
     assert s["sketch_rnn_telemetry_enabled"] == 0
 
 
+def test_render_prometheus_run_info_labels_escaped():
+    """ISSUE 8: run_info carries the run identity; run_id comes
+    verbatim from SKETCH_RNN_RUN_ID so exposition-format specials must
+    be escaped or the whole scrape is invalid."""
+    tel = Telemetry(process_index=1, host_count=4, run_id="exp-1")
+    text = render_prometheus(tel)
+    assert ('sketch_rnn_run_info{run_id="exp-1",host="1",'
+            'host_count="4"} 1') in text
+    evil = Telemetry(run_id='a"b\\c\nd')
+    line = [l for l in render_prometheus(evil).splitlines()
+            if l.startswith("sketch_rnn_run_info")][0]
+    assert line == ('sketch_rnn_run_info{run_id="a\\"b\\\\c\\nd",'
+                    'host="0",host_count="1"} 1')
+
+
 def test_render_prometheus_slo_series():
     tr = SLOTracker([SLO(objective_s=0.1, target=0.8)])
     for v in (0.05, 0.05, 0.3):

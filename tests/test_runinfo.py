@@ -1,0 +1,76 @@
+"""Run-identity & manifest tests (ISSUE 8 tentpole piece 3)."""
+
+import json
+import os
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.utils import runinfo
+
+
+def test_run_id_stable_env_override_and_reset(monkeypatch):
+    runinfo.set_run_id(None)
+    a = runinfo.get_run_id()
+    assert a == runinfo.get_run_id()  # minted once per process
+    assert len(a.split("-")) == 3 and len(a.split("-")[-1]) == 6
+    runinfo.set_run_id(None)
+    monkeypatch.setenv(runinfo.RUN_ID_ENV, "launcher-42")
+    assert runinfo.get_run_id() == "launcher-42"  # fleet-shared id
+    runinfo.set_run_id(None)
+    monkeypatch.delenv(runinfo.RUN_ID_ENV)
+    b = runinfo.get_run_id()
+    assert b != a  # fresh mint after reset
+    runinfo.set_run_id(None)
+
+
+def test_config_hash_stable_and_discriminating():
+    h1 = runinfo.config_hash(HParams(batch_size=16))
+    assert h1 == runinfo.config_hash(HParams(batch_size=16))
+    assert h1 != runinfo.config_hash(HParams(batch_size=32))
+    assert len(h1) == 12
+    assert runinfo.config_hash(None) is None
+
+
+def test_host_topology_shape():
+    topo = runinfo.host_topology()
+    assert topo["process_index"] == 0 and topo["host_count"] == 1
+    assert topo["device_count"] >= 1  # the 8-virtual-device test mesh
+
+
+def test_manifest_write_merge_and_replace(tmp_path):
+    d = str(tmp_path)
+    p = runinfo.write_manifest(d, kind="train", run_id="r1",
+                               hps=HParams(batch_size=16),
+                               artifacts={"metrics": ["a.csv"]})
+    man = runinfo.read_manifest(d)
+    assert man["run_id"] == "r1" and man["kind"] == "train"
+    assert man["config_hash"] and man["artifacts"] == {
+        "metrics": ["a.csv"]}
+    created = man["created_unix"]
+    # SAME run_id: artifact index merges, identity fields stay
+    runinfo.write_manifest(d, kind="train", run_id="r1",
+                           artifacts={"trace": "t.jsonl"},
+                           extra={"final_step": 4})
+    man = runinfo.read_manifest(d)
+    assert man["artifacts"] == {"metrics": ["a.csv"],
+                                "trace": "t.jsonl"}
+    assert man["created_unix"] == created
+    assert man["final_step"] == 4
+    # DIFFERENT run_id (directory reuse): the stale index is replaced
+    runinfo.write_manifest(d, kind="serve_bench", run_id="r2",
+                           artifacts={"prom": "m.prom"})
+    man = runinfo.read_manifest(d)
+    assert man["run_id"] == "r2" and man["kind"] == "serve_bench"
+    assert man["artifacts"] == {"prom": "m.prom"}
+    # strict JSON on disk, no tmp litter
+    assert json.load(open(p))
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+
+
+def test_manifest_read_missing_and_torn(tmp_path):
+    assert runinfo.read_manifest(str(tmp_path)) is None
+    with open(runinfo.manifest_path(str(tmp_path)), "w") as f:
+        f.write('{"torn": ')
+    assert runinfo.read_manifest(str(tmp_path)) is None
+    # a torn manifest is replaced cleanly on the next write
+    runinfo.write_manifest(str(tmp_path), kind="train", run_id="x")
+    assert runinfo.read_manifest(str(tmp_path))["run_id"] == "x"

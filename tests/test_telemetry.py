@@ -358,7 +358,10 @@ def test_telemetry_off_train_smoke_bitwise_invisible(tmp_path):
 
     assert header_off == PRE_PR_HEADER     # schema pinned to pre-PR
     assert header_on == PRE_PR_HEADER      # tracing adds NO columns
-    assert not any("telemetry" in f or f == "trace.json"
+    # the pin extends to ISSUE 8's artifacts: no telemetry files, no
+    # shard files, and no RUN.json manifest in a telemetry-off run
+    assert not any("telemetry" in f or f.startswith("trace")
+                   or f == "RUN.json"
                    for f in os.listdir(tmp_path / "off"))
     assert os.path.exists(os.path.join(trace_dir, "telemetry.jsonl"))
     assert os.path.exists(os.path.join(trace_dir, "trace.json"))
@@ -398,6 +401,11 @@ def test_traced_train_run_exports_wellformed_and_reconciles(tmp_path):
     assert ("data", "assemble") in agg
     span_tids = {e["tid"] for e in lines if e.get("type") == "span"}
     assert "batch-prefetch" in span_tids
+    # the prefetch look-ahead gauge (ISSUE 8) samples queue depth per
+    # consumed batch, flagged as a gauge in the export
+    depth = [l for l in lines if l.get("type") == "counter_total"
+             and (l["cat"], l["name"]) == ("data", "prefetch_queue_depth")]
+    assert depth and depth[0].get("gauge") is True
 
     doc = json.load(open(os.path.join(trace_dir, "trace.json")))
     assert any(e["ph"] == "X" for e in doc["traceEvents"])
@@ -451,3 +459,174 @@ def test_traced_serve_run_live_histograms_and_events(tmp_path):
               and e["name"] == "slots_live"]
     assert gauges and all(0 <= g["value"] <= hps.serve_slots
                           for g in gauges)
+
+
+def test_traced_engine_serves_two_burst_sizes(tmp_path):
+    """Regression (ISSUE 8 review): the chunk program is shape-
+    specialized on the request-pool size N, so the compile probe must
+    key on the pool shapes — a traced engine serving a second,
+    different-sized burst needs its own executable, not the first
+    burst's (which would crash on the aval mismatch)."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import Request, ServeEngine
+
+    hps = tiny_hps(batch_size=8, max_seq_len=16, enc_rnn_size=12,
+                   dec_rnn_size=16, z_size=6, serve_slots=2,
+                   serve_chunk=2)
+    model = SketchRNN(hps)
+    eng = ServeEngine(model, hps, model.init_params(jax.random.key(0)))
+    tel = tele.configure(trace_dir=str(tmp_path))
+
+    def burst(n):
+        rng = np.random.default_rng(n)
+        return [Request(key=jax.random.key(100 * n + i),
+                        z=rng.standard_normal(hps.z_size)
+                        .astype(np.float32), max_len=4)
+                for i in range(n)]
+
+    assert eng.run(burst(3))["metrics"]["completed"] == 3
+    assert eng.run(burst(5))["metrics"]["completed"] == 5
+    # two pool geometries -> two compile spans, distinct N labels
+    spans = [e for e in tel.events() if e["type"] == "span"
+             and e["cat"] == "compile" and e["name"] == "serve_chunk"]
+    assert len(spans) == 2
+    assert {s["args"]["geometry"] for s in spans} == {
+        "(B2,K2,N3)", "(B2,K2,N5)"}
+
+
+# -- compile & memory accounting (ISSUE 8) -----------------------------------
+
+
+def test_compile_probe_bucketed_one_compile_per_geometry(tmp_path):
+    """THE compile-accounting acceptance pin: a traced bucketed smoke
+    run records exactly ONE compile span per dispatched (B, Tb)
+    geometry (then jit-cache hits), each span carrying the
+    executable's cost/memory stats (flops + peak device bytes)."""
+    from sketch_rnn_tpu.train.loop import train
+
+    hps = tiny_hps(bucket_edges=(16, 32), num_steps=6, log_every=3,
+                   save_every=10**9, eval_every=10**9)
+    trace_dir = str(tmp_path / "trace")
+    train(hps, make_loader(hps), workdir=str(tmp_path / "wd"),
+          use_mesh=False, resume=False, trace_dir=trace_dir)
+
+    lines = [json.loads(l) for l in open(
+        os.path.join(trace_dir, "telemetry.jsonl"))]
+    spans = [l for l in lines if l.get("type") == "span"
+             and l["cat"] == "compile" and l["name"] == "train_step"]
+    geoms = [s["args"]["geometry"] for s in spans]
+    assert len(spans) >= 2          # both bucket edges dispatched
+    assert len(geoms) == len(set(geoms))  # exactly one per geometry
+    for s in spans:
+        # per-executable stats read off the compiled program (the AOT
+        # path works on the CPU backend, so the pin is exact here)
+        assert s["args"]["flops"] > 0
+        assert s["args"]["peak_bytes"] > 0
+        assert s["dur"] > 0
+    counters = {(l["cat"], l["name"]): l["value"] for l in lines
+                if l.get("type") == "counter_total"}
+    # 6 dispatches total: one miss per geometry, hits for the rest
+    assert counters[("compile", "jit_cache_miss")] == len(spans)
+    assert counters[("compile", "jit_cache_hit")] == 6 - len(spans)
+    # the latest-compile peak rides as a /metrics-visible gauge
+    gauge_lines = [l for l in lines if l.get("type") == "counter_total"
+                   and l.get("gauge")]
+    assert any(l["name"] == "train_step_peak_bytes" for l in gauge_lines)
+
+
+def test_compile_probe_off_is_passthrough_and_counts_through(tmp_path):
+    """With telemetry off the probe forwards to the inner jit (its
+    cache; geometry_cache_size counts through), and a LATER-enabled
+    core reports the warm geometry as a hit instead of recompiling —
+    the serve-bench warmup-then-configure order."""
+    import jax
+
+    from sketch_rnn_tpu.utils.telemetry import JitCompileProbe
+
+    calls = []
+
+    probe = JitCompileProbe(
+        jax.jit(lambda x: x * 2), "f",
+        key_of=lambda a: tuple(a[0].shape))
+    assert not tele.get_telemetry().enabled
+    x = np.ones((4,), np.float32)
+    np.testing.assert_array_equal(np.asarray(probe(x)), x * 2)
+    assert probe._cache_size() == 1   # inner jit compiled it
+    tel = tele.configure(trace_dir=str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(probe(x)), x * 2)
+    c = tel.counters()
+    assert c[("compile", "jit_cache_hit")] == 1
+    assert ("compile", "jit_cache_miss") not in c
+    assert not [e for e in tel.events() if e["type"] == "span"]
+    # a NEW geometry while enabled: miss + compile span + AOT cache
+    y = np.ones((8,), np.float32)
+    np.testing.assert_array_equal(np.asarray(probe(y)), y * 2)
+    assert tel.counters()[("compile", "jit_cache_miss")] == 1
+    spans = [e for e in tel.events() if e["type"] == "span"]
+    assert len(spans) == 1 and spans[0]["name"] == "f"
+    assert probe._cache_size() == 2
+    del calls
+
+
+def test_memory_sampler_gauges_phases_and_registry(tmp_path):
+    tel = tele.configure(trace_dir=str(tmp_path))
+    feed = {"v": 100.0}
+    sampler = tele.MemorySampler(
+        interval_s=10.0,
+        stats_fn=lambda: {"bytes_in_use": feed["v"],
+                          "peak_bytes_in_use": feed["v"] * 2})
+    sampler.phase = "train"
+    assert sampler.sample() == {"bytes_in_use": 100.0,
+                                "peak_bytes_in_use": 200.0}
+    feed["v"] = 50.0
+    sampler.sample()
+    snap = tel.snapshot()
+    assert snap["gauges"][("memory", "device_bytes_in_use")] == 50.0
+    assert snap["gauges"][("memory", "device_peak_bytes")] == 100.0
+    # per-phase peak holds the max LIVE bytes seen in that phase
+    assert snap["gauges"][("memory", "phase_peak_bytes_train")] == 100.0
+    sampler.phase = "eval"
+    feed["v"] = 70.0
+    sampler.sample()
+    snap = tel.snapshot()
+    assert snap["gauges"][("memory", "phase_peak_bytes_eval")] == 70.0
+    assert snap["gauges"][("memory", "phase_peak_bytes_train")] == 100.0
+    # thread lifecycle + the process-wide registry the conftest guard
+    # drains: start registers, stop_all names and stops leakers
+    sampler.start()
+    assert sampler in tele.live_samplers()
+    names = tele.stop_all_samplers()
+    assert len(names) == 1 and "MemorySampler" in names[0]
+    assert tele.live_samplers() == ()
+
+
+def test_memory_sampler_noop_without_backend_stats(tmp_path):
+    tele.configure(trace_dir=str(tmp_path))
+    sampler = tele.MemorySampler(stats_fn=lambda: None)
+    assert sampler.sample() is None
+    assert tele.get_telemetry().snapshot()["gauges"] == {}
+    # disabled core: nothing recorded either
+    tele.disable()
+    s2 = tele.MemorySampler(
+        stats_fn=lambda: {"bytes_in_use": 1, "peak_bytes_in_use": 1})
+    assert s2.sample() is None
+
+
+def test_traced_train_writes_run_manifest_and_memory_gauges(tmp_path):
+    """A traced train run leaves RUN.json beside its trace: run_id
+    matches the telemetry meta line, artifacts index the metrics files
+    and the (single-host) shard names."""
+    from sketch_rnn_tpu.utils import runinfo
+
+    trace_dir = str(tmp_path / "trace")
+    _run_smoke(tmp_path, "wd", trace_dir)
+    man = runinfo.read_manifest(trace_dir)
+    assert man is not None
+    assert man["kind"] == "train" and man["config_hash"]
+    assert man["artifacts"]["telemetry_shards"] == ["telemetry.jsonl"]
+    meta = json.loads(open(
+        os.path.join(trace_dir, "telemetry.jsonl")).readline())
+    assert meta["run_id"] == man["run_id"]
+    assert meta["process_index"] == 0 and meta["host_count"] == 1
+    csvs = [p for p in man["artifacts"]["metrics"] if p.endswith(".csv")]
+    assert any(os.path.exists(p) for p in csvs)

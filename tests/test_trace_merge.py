@@ -1,0 +1,230 @@
+"""Fleet shard-merge tests (ISSUE 8).
+
+The load-bearing contract: a merged fleet stream's GLOBAL summary
+reconciles EXACTLY with the per-shard summaries — span counts/totals
+and monotonic counters bitwise (sums in host order), histograms merged
+on their shared log-bucket lattice (counts/totals exact, quantiles
+within one geometric bucket of the pooled-exact value). Proven both
+in-process and through REAL subprocesses (two `_multihost_worker.py
+shard` workers exporting into one shared trace_dir, exactly the
+multi-controller layout), plus the committed-shard --smoke self-check
+that wires the reconciliation into tier-1 CI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts import trace_merge, trace_report  # noqa: E402
+from sketch_rnn_tpu.utils import telemetry as tele  # noqa: E402
+from sketch_rnn_tpu.utils.telemetry import Histogram  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_shard(tmp_path, rank, nproc, spans=10, lat_scale=1.0):
+    """Export one in-process shard with a deterministic workload."""
+    tel = tele.configure(trace_dir=str(tmp_path), process_index=rank,
+                         host_count=nproc, run_id="t")
+    for i in range(spans):
+        tel.emit_span("dispatch", "train", 0.01 * i,
+                      0.01 * i + 0.003 + 1e-4 * rank)
+    tel.counter("micro_steps", 5.0 + rank, cat="data")
+    tel.gauge("slots_live", 3 + rank, cat="serve")
+    for i in range(25):
+        tel.observe("latency_s", lat_scale * 0.01 * (i + 1), cat="serve")
+    paths = tel.export()
+    tele.disable()
+    return paths["jsonl"]
+
+
+# -- shard naming ------------------------------------------------------------
+
+
+def test_shard_names_collision_free_and_single_host_legacy():
+    assert tele.shard_jsonl_name(0, 1) == "telemetry.jsonl"
+    assert tele.shard_chrome_name(0, 1) == "trace.json"
+    names = {tele.shard_jsonl_name(i, 4) for i in range(4)}
+    assert len(names) == 4
+    assert tele.shard_jsonl_name(2, 4) == "telemetry.p0002.jsonl"
+    assert tele.shard_chrome_name(2, 4) == "trace.p0002.json"
+
+
+def test_export_writes_per_host_shard_and_stamped_meta(tmp_path):
+    path = _make_shard(tmp_path, rank=1, nproc=2)
+    assert os.path.basename(path) == "telemetry.p0001.jsonl"
+    meta = json.loads(open(path).readline())
+    assert meta["process_index"] == 1 and meta["host_count"] == 2
+    assert meta["run_id"] == "t"
+
+
+# -- exact merge reconciliation ----------------------------------------------
+
+
+def test_merge_reconciles_exactly_in_process(tmp_path):
+    """Merged agg/counters are BITWISE the host-order sums of the
+    shards'; merged histogram count/total exact; merged quantiles
+    within one log bucket of the pooled-exact percentile."""
+    p0 = _make_shard(tmp_path, 0, 2, spans=10, lat_scale=1.0)
+    p1 = _make_shard(tmp_path, 1, 2, spans=17, lat_scale=3.0)
+    shards = [trace_merge.load_shard(p) for p in (p0, p1)]
+    merged = trace_merge.merge_shards(shards)
+
+    k = ("train", "dispatch")
+    n = shards[0]["agg"][k][0] + shards[1]["agg"][k][0]
+    total = shards[0]["agg"][k][1] + shards[1]["agg"][k][1]
+    assert merged["agg"][k] == (n, total)  # bitwise
+    assert merged["counters"][("data", "micro_steps")] == 5.0 + 6.0
+    # gauges are never summed: per-host samples + max
+    assert merged["gauges"][("serve", "slots_live")] == {0: 3.0, 1: 4.0}
+
+    h = merged["hists"][("serve", "latency_s")]
+    assert h.count == 50
+    tot = (shards[0]["hists"][("serve", "latency_s")]["raw"]["total"]
+           + shards[1]["hists"][("serve", "latency_s")]["raw"]["total"])
+    assert h.total == tot  # bitwise
+    # quantiles within one geometric bucket of the pooled exact value
+    pooled = np.concatenate([0.01 * np.arange(1, 26),
+                             0.03 * np.arange(1, 26)])
+    for q in (0.5, 0.95, 0.99):
+        exact = np.percentile(pooled, 100 * q)
+        assert exact / Histogram.GROWTH <= h.quantile(q) \
+            <= exact * Histogram.GROWTH
+
+    # the module's own reconciliation cross-check agrees
+    assert trace_merge._reconcile(shards, merged) == []
+
+
+def test_merge_outputs_and_report_over_merged_stream(tmp_path):
+    _make_shard(tmp_path, 0, 2, spans=4)
+    _make_shard(tmp_path, 1, 2, spans=6)
+    assert trace_merge.main([str(tmp_path), "--quiet"]) == 0
+    jsonl = os.path.join(str(tmp_path), trace_merge.MERGED_JSONL)
+    chrome = os.path.join(str(tmp_path), trace_merge.MERGED_CHROME)
+    assert os.path.exists(jsonl) and os.path.exists(chrome)
+
+    # per-host track groups in the Chrome trace
+    doc = json.load(open(chrome))
+    evs = doc["traceEvents"]
+    assert sorted({e["pid"] for e in evs}) == [0, 1]
+    pnames = [e for e in evs if e.get("name") == "process_name"]
+    assert {e["args"]["name"].split(" (")[0] for e in pnames} == \
+        {"host 0", "host 1"}
+
+    # trace_report reads the merged stream; agg totals are global
+    data = trace_report.load(jsonl)
+    assert data["meta"]["merged"] and data["meta"]["host_count"] == 2
+    rows = {(r["cat"], r["name"]): r
+            for r in trace_report.span_breakdown(data)}
+    assert rows[("train", "dispatch")]["count"] == 10
+
+    # --host filters one host's events back out
+    host1 = trace_report.load(jsonl, host=1)
+    rows1 = {(r["cat"], r["name"]): r
+             for r in trace_report.span_breakdown(host1)}
+    assert rows1[("train", "dispatch")]["count"] == 6
+
+
+def test_merge_rejects_growth_mismatch_and_duplicate_hosts(tmp_path):
+    p0 = _make_shard(tmp_path, 0, 2)
+    p1 = _make_shard(tmp_path, 1, 2)
+    s0, s1 = trace_merge.load_shard(p0), trace_merge.load_shard(p1)
+    bad = trace_merge.load_shard(p1)
+    for k in bad["hists"]:
+        bad["hists"][k] = dict(bad["hists"][k])
+        bad["hists"][k]["raw"] = dict(bad["hists"][k]["raw"],
+                                      growth=2.0)
+    with pytest.raises(ValueError, match="growth"):
+        trace_merge.merge_shards([s0, bad])
+    with pytest.raises(ValueError, match="duplicate process_index"):
+        trace_merge.merge_shards([s1, trace_merge.load_shard(p1)])
+
+
+def test_histogram_merge_exact_totals_and_edge_cases():
+    """Histogram.merge (ISSUE 8 satellite): exact totals, empty/single
+    shards well-defined, mismatched growth rejected."""
+    rng = np.random.default_rng(3)
+    xs, ys = rng.lognormal(size=500), rng.lognormal(size=700) * 4.0
+    a, b = Histogram(), Histogram()
+    for x in xs:
+        a.observe(float(x))
+    for y in ys:
+        b.observe(float(y))
+    merged = Histogram().merge(a).merge(b)  # empty-base merge works
+    assert merged.count == 1200
+    assert merged.total == a.total + b.total  # bitwise
+    assert merged.vmin == min(a.vmin, b.vmin)
+    assert merged.vmax == max(a.vmax, b.vmax)
+    pooled = np.concatenate([xs, ys])
+    for q in (0.5, 0.95, 0.99):
+        assert merged.quantile(q) == pytest.approx(
+            np.percentile(pooled, 100 * q), rel=0.05)
+    # single-observation and empty shards
+    single = Histogram()
+    single.observe(0.25)
+    m2 = Histogram().merge(single).merge(Histogram())
+    assert m2.count == 1 and m2.quantile(0.99) == 0.25
+    # round-trip through the serialized raw form is loss-free
+    rt = Histogram.from_dict(json.loads(json.dumps(merged.to_dict())))
+    assert rt.count == merged.count and rt.total == merged.total
+    assert rt.summary() == merged.summary()
+    with pytest.raises(ValueError, match="growth"):
+        Histogram().merge(Histogram(growth=1.5))
+
+
+# -- real subprocesses (the multi-controller layout) -------------------------
+
+
+def test_two_subprocess_shard_merge_reconciles(tmp_path):
+    """THE tier-1 fleet acceptance: two REAL worker processes (the
+    `_multihost_worker.py shard` mode) export shards into one shared
+    trace_dir — no path collision — and the merged global summary
+    reconciles exactly with the per-shard summaries."""
+    worker = os.path.join(REPO, "tests", "_multihost_worker.py")
+    outdir = str(tmp_path)
+    procs = [subprocess.Popen(
+        [sys.executable, worker, "shard", str(rank), "2", outdir, "sub"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for rank in range(2)]
+    for rank, p in enumerate(procs):
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, f"shard worker {rank} failed:\n{out}"
+
+    paths = trace_merge.find_shards(outdir)
+    assert [os.path.basename(p) for p in paths] == [
+        "telemetry.p0000.jsonl", "telemetry.p0001.jsonl"]
+    assert trace_merge.main([outdir, "--quiet"]) == 0
+    shards = [trace_merge.load_shard(p) for p in paths]
+    merged = trace_merge.merge_shards(shards)
+    assert trace_merge._reconcile(shards, merged) == []
+    # counts follow the worker's rank-seeded workload: 20+5*rank spans
+    assert merged["agg"][("train", "dispatch")][0] == 20 + 25
+    assert merged["counters"][("serve", "requests_completed")] == 9.0
+    assert merged["hists"][("serve", "latency_s")].count == 60
+    assert merged["meta"]["run_id"] == "sub"
+    # drop accounting surfaces in the report over the merged stream
+    rep = trace_report.report(trace_report.load(
+        os.path.join(outdir, trace_merge.MERGED_JSONL)))
+    assert rep["ring_dropped"] == {"total": 0,
+                                   "per_host": {"0": 0, "1": 0}}
+
+
+# -- CI wiring ---------------------------------------------------------------
+
+
+def test_trace_merge_smoke_over_committed_shards(capsys):
+    """The committed-shards self-check wired into tier-1 (ISSUE 8
+    satellite): `trace_merge --smoke` must reconcile exactly."""
+    assert trace_merge.main(["--smoke"]) == 0
+    assert "reconcile exactly" in capsys.readouterr().out
+
+
+def test_trace_merge_usage_errors(tmp_path, capsys):
+    assert trace_merge.main([str(tmp_path)]) == 2
+    assert "no shards" in capsys.readouterr().err

@@ -141,6 +141,27 @@ def test_report_warns_on_ring_drops(tmp_path, capsys):
     # agg totals stay exact despite the drops
     data = trace_report.load(paths["jsonl"])
     assert data["agg"][("c", "s")][0] == 10
+    # ISSUE 8 satellite: the drop count is a first-class --json field
+    assert trace_report.main([paths["jsonl"], "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ring_dropped"] == {"total": 6}
+
+
+def test_host_filter_on_single_shard(tmp_path, capsys):
+    """--host over a single shard matches the shard's own
+    process_index; a miss is a one-line error, not an empty report."""
+    tel = tele.configure(trace_dir=str(tmp_path), process_index=1,
+                         host_count=2)
+    with tel.span("dispatch", cat="train"):
+        pass
+    paths = tel.export()
+    assert trace_report.main([paths["jsonl"], "--host", "1",
+                              "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["host_filter"] == 1
+    assert [s["name"] for s in rep["spans"]] == ["dispatch"]
+    assert trace_report.main([paths["jsonl"], "--host", "0"]) == 2
+    assert "no events for host 0" in capsys.readouterr().err
 
 
 @pytest.fixture(scope="module")
