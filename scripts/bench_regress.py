@@ -54,7 +54,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from scripts.bench_summary import iter_rows, key_of, metric_of  # noqa: E402
 
-GATED_KINDS = ("train", "sampler", "bucket_bench", "serve_bench")
+# serve_fleet rows (ISSUE 9) key on replica count + offered rate via
+# bench_summary.key_of, so a 2-replica capacity record can only ever
+# gate a fresh 2-replica capacity row
+GATED_KINDS = ("train", "sampler", "bucket_bench", "serve_bench",
+               "serve_fleet")
 
 
 def _usable(r: dict) -> bool:

@@ -41,6 +41,18 @@ def key_of(r: dict):
                 f"B={r.get('slots')} K={r.get('chunk')} "
                 f"n={r.get('n_requests')} dist={r.get('len_dist')} "
                 f"dev={dev}")
+    if r.get("kind") == "serve_fleet":
+        # replica count AND offered rate key the cell (ISSUE 9): a
+        # 4-replica row must never pool with a 1-replica record, and a
+        # closed-burst capacity row (rate=0) is a different measurement
+        # than a rate-limited curve point
+        rate = r.get("offered_rate")
+        rate_s = f"{rate:g}" if isinstance(rate, (int, float)) else rate
+        return ("fleet", r.get("dec_model"),
+                f"R={r.get('replicas')} rate={rate_s} "
+                f"B={r.get('slots')} K={r.get('chunk')} "
+                f"n={r.get('n_requests')} dist={r.get('len_dist')} "
+                f"dev={dev}")
     if r.get("kind") == "sampler":
         # full_len rows (r3+) force max_len loop steps; earlier rows let
         # the untrained model early-exit after a few steps — not comparable
@@ -68,6 +80,10 @@ def metric_of(r: dict):
     if r.get("kind") == "serve_bench":
         # the engine's headline: continuous-batching sketches/sec
         return r.get("engine_sketches_per_sec")
+    if r.get("kind") == "serve_fleet":
+        # the fleet's headline: realized sketches/sec at this cell's
+        # (replicas, offered rate)
+        return r.get("sketches_per_sec")
     return r.get("strokes_per_sec_per_chip") or r.get("sketches_per_sec")
 
 
@@ -81,6 +97,29 @@ def _serve_lat_cols(r: dict) -> str:
         return ""
     return " lat[ms] " + "/".join(
         "-" if v is None else f"{1e3 * v:.0f}" for _, v in ps)
+
+
+def _fleet_cols(r: dict) -> str:
+    """Fleet-row columns (ISSUE 9): per-class p99 next to the realized
+    throughput, the shed fraction under overload, and — on capacity
+    rows — the ``scaling=`` efficiency (sketches/sec at R replicas /
+    (R x the single-replica record)) plus the deterministic
+    step-parallel speedup."""
+    cols = []
+    by_class = r.get("by_class") or {}
+    if by_class:
+        cols.append(" p99[ms] " + " ".join(
+            f"{c}={1e3 * v['p99_s']:.0f}"
+            for c, v in sorted(by_class.items())
+            if v.get("p99_s") is not None))
+    sf = r.get("shed_frac")
+    if sf:
+        cols.append(f" shed={sf:.1%}")
+    if r.get("scaling") is not None:
+        cols.append(f" scaling={r['scaling']}")
+    if r.get("step_parallel") is not None:
+        cols.append(f" steps||={r['step_parallel']}x")
+    return "".join(cols)
 
 
 def _stacked_cols(r: dict) -> str:
@@ -139,7 +178,7 @@ def main(argv=None) -> int:
             # strokes_per_sec_per_chip prints as a phantom train config
             # with None knobs
             if r.get("kind") not in ("train", "sampler", "bucket_bench",
-                                     "serve_bench"):
+                                     "serve_bench", "serve_fleet"):
                 continue
             v = metric_of(r)
             if v is None:
@@ -171,6 +210,15 @@ def main(argv=None) -> int:
             print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
                   f"best={metric_of(b):>11.2f} sk/s ({when}"
                   f"{_serve_lat_cols(b)}{sp_col})  "
+                  f"latest={metric_of(l):>11.2f}")
+            continue
+        if k[0] == "fleet":
+            # fleet cell: realized throughput at (replicas, offered
+            # rate) with the per-class SLA columns, shed fraction and
+            # (capacity rows) the replica-scaling efficiency
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"best={metric_of(b):>11.2f} sk/s ({when}"
+                  f"{_fleet_cols(b)})  "
                   f"latest={metric_of(l):>11.2f}")
             continue
         extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""

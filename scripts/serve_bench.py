@@ -173,11 +173,68 @@ def make_baseline_trial(model, hps, params, requests, slots, max_len):
     return trial
 
 
+def measure_host_parallel_ceiling(iters: int = 24,
+                                  size: int = 384) -> float:
+    """The box's achievable 2-thread parallel speedup on GIL-free
+    numpy compute (honesty calibration for the fleet smoke).
+
+    Fleet wall-clock scaling is bounded by the HOST's real parallelism:
+    a CI container that advertises 2 CPUs but schedules ~1 (this repo's
+    2-core box measures ~0.8x, i.e. none) cannot show replica speedup
+    no matter how good the scheduler is. The measured ceiling rides in
+    the fleet record so a reader can tell "the fleet does not scale"
+    apart from "the box cannot scale" — the GOODPUT.json precedent:
+    CPU smoke wall time is noise/ceiling-bound by design, the
+    authoritative scaling signal is the deterministic scheduling math
+    plus the real-mesh run.
+    """
+    a = np.random.default_rng(0).random((size, size)).astype(np.float32)
+
+    def burn(out, i):
+        x = a.copy()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            x = np.tanh(x @ a)
+        out[i] = time.perf_counter() - t0
+
+    out = [0.0, 0.0]
+    burn(out, 0)
+    t1 = out[0]
+    import threading
+    ths = [threading.Thread(target=burn, args=(out, i)) for i in (0, 1)]
+    t0 = time.perf_counter()
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    wall = time.perf_counter() - t0
+    return round(2.0 * t1 / wall, 3) if wall else 0.0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="continuous-batching vs batch-synchronous serving")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU config (seconds); same measurement")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode: sweep replica counts x offered "
+                         "Poisson arrival rates through the mesh-"
+                         "replicated fleet (serve/fleet.py) and write "
+                         "latency-vs-offered-load curves (+ an in-run "
+                         "placement/arrival bitwise parity block) into "
+                         "--out under the 'fleet' key")
+    ap.add_argument("--replicas", default="",
+                    help="fleet mode: comma-separated replica counts to "
+                         "sweep (default 1,2,4)")
+    ap.add_argument("--rates", default="",
+                    help="fleet mode: comma-separated offered rates in "
+                         "requests/sec; 0 = closed burst (the capacity "
+                         "arm). Default: 0,150,300,900 for --smoke, "
+                         "0,200,400,800 otherwise")
+    ap.add_argument("--classes", action="append", default=[],
+                    help="fleet mode admission class specs (parse_slo "
+                         "grammar, endpoint = class name); default "
+                         "interactive:p95<=0.5 + batch:p99<=5")
     ap.add_argument("--slots", type=int, default=0,
                     help="batch width B for BOTH paths (0 = mode default)")
     ap.add_argument("--chunk", type=int, default=0,
@@ -240,12 +297,15 @@ def main(argv=None) -> int:
     # p3 at index 2 — the sampler_latency.py trick): lengths are exactly
     # the drawn caps, so both paths do identical, deterministic work
     params["out_b"] = params["out_b"].at[2].set(-1e9)
+    if args.fleet:
+        return _run_fleet(args, hps, model, params, slots, chunk, n,
+                          lmin, lmax, hist_append, dist=dist)
     return _run(args, hps, model, params, slots, chunk, n, lmin, lmax,
                 hist_append, dist=dist)
 
 
-def _run(args, hps, model, params, slots, chunk, n, lmin, lmax,
-         hist_append, dist="power"):
+def _build_requests(args, hps, n, lmin, lmax, dist):
+    """The seeded skewed request mix both bench modes serve."""
     import jax
 
     from sketch_rnn_tpu.serve import Request
@@ -260,6 +320,300 @@ def _run(args, hps, model, params, slots, chunk, n, lmin, lmax,
                 temperature=args.temperature, max_len=int(lengths[i]))
         for i in range(n)
     ]
+    return lengths, requests
+
+
+def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
+               hist_append, dist="power"):
+    """Fleet mode: replica-count x offered-rate sweep.
+
+    Per replica count R the arms are:
+
+    1. **capacity** (rate 0): the full request set submitted BEFORE the
+       workers start — placement is then a deterministic function of
+       the request stream, so the per-replica device-step split (the
+       ``step_parallel`` signal: R=1 critical path / R critical path)
+       is exactly reproducible; extra trials re-run the burst for
+       best-of wall clock only. Wall-clock ``scaling`` is reported
+       against R=1 and read against ``host_parallel_ceiling`` (a box
+       that cannot run 2 numpy threads concurrently cannot show
+       replica speedup — the honest CPU-smoke caveat; the wall-clock
+       acceptance run is the real multi-chip mesh).
+    2. **offered-load curve points** (each rate > 0): a seeded
+       open-loop Poisson schedule replayed against the fleet —
+       p50/p95/p99 per admission class, shed fraction and realized
+       throughput at that offered load.
+
+    The in-run parity block (the bucket_bench discipline) then proves
+    request outputs are bitwise independent of replica placement and
+    arrival order: every capacity arm's strokes are compared against
+    the R=1 reference per uid, plus one shuffled-arrival burst.
+    """
+    import dataclasses
+
+    from sketch_rnn_tpu.serve.admission import parse_admission_classes
+    from sketch_rnn_tpu.serve.fleet import ServeFleet
+    from sketch_rnn_tpu.serve.loadgen import (OpenLoopLoadGen,
+                                              poisson_arrivals)
+
+    import jax
+
+    replicas_list = [int(x) for x in
+                     (args.replicas or "1,2,4").split(",") if x]
+    rates = [float(x) for x in
+             (args.rates or ("0,150,300,900" if args.smoke
+                             else "0,200,400,800")).split(",") if x]
+    if 0.0 not in rates:
+        rates = [0.0] + rates  # the capacity arm anchors scaling
+    class_specs = args.classes or ["interactive:p95<=0.5",
+                                   "batch:p99<=5"]
+    classes = parse_admission_classes(class_specs)
+    cls_order = [c.name for c in sorted(classes.values(),
+                                        key=lambda c: c.priority)]
+    ncls = len(cls_order)
+    ndev = len(jax.devices())
+    dropped = [r for r in replicas_list if r > ndev]
+    if dropped:
+        # the no-silent-caps discipline: a requested arm that cannot
+        # run must be SAID to have not run, not vanish from the record
+        print(f"# WARNING: dropping replica counts {dropped} — only "
+              f"{ndev} devices available", file=sys.stderr)
+    replicas_list = [r for r in replicas_list if r <= ndev]
+    if not replicas_list:
+        print(f"serve_bench: no usable replica counts (asked "
+              f"{dropped}, have {ndev} devices)", file=sys.stderr)
+        return 2
+
+    lengths, requests = _build_requests(args, hps, n, lmin, lmax, dist)
+    print(f"# fleet: serving {n} requests (lengths mean "
+          f"{lengths.mean():.1f} max {lengths.max()}), B={slots} "
+          f"K={chunk}, replicas {replicas_list}, rates {rates}, "
+          f"classes {class_specs}", file=sys.stderr)
+
+    def clone(i):
+        return dataclasses.replace(requests[i], uid=i, cls=None,
+                                   queue_pos=None, enqueue_ts=None)
+
+    def submit_all(fleet, order=None):
+        # force=True: the capacity/parity arms measure throughput and
+        # bitwise outputs, not admission policy — a completion racing
+        # this loop (live workers after a reset) must not let the
+        # deadline estimator shed requests these arms must complete
+        for i in (order if order is not None else range(n)):
+            fleet.submit(clone(i), cls=cls_order[i % ncls], force=True)
+
+    trials = 2
+    curves = []
+    ref_strokes = None          # uid -> strokes5 from the first burst
+    cap1 = None                 # R=1 capacity (sketches/sec)
+    cp1 = None                  # R=1 critical-path device steps
+    parity = {"placement_invariant": True, "arrival_invariant": None,
+              "replicas_checked": []}
+    scaling_by_r = {}
+
+    def check_parity(results, what):
+        if ref_strokes is None:
+            return
+        for uid, ref in ref_strokes.items():
+            rec = results.get(uid)
+            if rec is None:
+                raise RuntimeError(
+                    f"PARITY FAILURE: request {uid} never completed "
+                    f"under {what} (forced submission must not shed)")
+            if not np.array_equal(rec["result"].strokes5, ref):
+                raise RuntimeError(
+                    f"PARITY FAILURE: request {uid} strokes differ "
+                    f"under {what} — replica placement leaked into "
+                    f"outputs")
+
+    for R in replicas_list:
+        fleet = ServeFleet(model, hps, params, replicas=R, slots=slots,
+                           chunk=chunk, classes=classes)
+        fleet.warm(requests[0])
+        # -- capacity arm: deterministic pre-start burst ----------------
+        submit_all(fleet)
+        fleet.start()
+        if not fleet.drain(timeout=600):
+            raise RuntimeError("fleet drain timed out (capacity arm)")
+        s0 = fleet.summary()
+        res0 = fleet.results
+        if s0["completed"] != n:
+            raise RuntimeError(
+                f"capacity arm completed {s0['completed']}/{n} "
+                f"(pre-start submission must never shed)")
+        got_steps = {uid: rec["result"].steps
+                     for uid, rec in res0.items()}
+        want_steps = {i: int(lengths[i]) for i in range(n)}
+        if got_steps != want_steps:  # pen suppression / dropped work
+            bad = next(k for k in want_steps
+                       if got_steps.get(k) != want_steps[k])
+            raise RuntimeError(f"fleet executed wrong step counts "
+                               f"(first mismatch: uid {bad})")
+        if ref_strokes is None:
+            ref_strokes = {uid: rec["result"].strokes5
+                           for uid, rec in res0.items()}
+        else:
+            check_parity(res0, f"placement at {R} replicas")
+            parity["replicas_checked"].append(R)
+        cap_walls = [s0["wall_s"]]
+        for _ in range(trials - 1):
+            fleet.reset()
+            submit_all(fleet)
+            if not fleet.drain(timeout=600):
+                raise RuntimeError("fleet drain timed out (trial)")
+            cap_walls.append(fleet.summary()["wall_s"])
+        cap = round(n / min(cap_walls), 3)
+        cp = s0["critical_path_device_steps"]
+        row = {
+            "replicas": R, "offered_rate": 0.0,
+            "sketches_per_sec": cap,
+            "wall_s": min(cap_walls),
+            "completed": n, "shed": 0, "shed_frac": 0.0,
+            "latency_p50_s": s0["latency"]["p50_s"],
+            "latency_p95_s": s0["latency"]["p95_s"],
+            "latency_p99_s": s0["latency"]["p99_s"],
+            "by_class": {c: {"p99_s": v["p99_s"],
+                             "completed": v["completed"], "shed": 0}
+                         for c, v in s0["latency_by_class"].items()},
+            "critical_path_device_steps": cp,
+            "total_device_steps": s0["total_device_steps"],
+        }
+        # scaling/step_parallel are defined AGAINST THE R=1 ARM only —
+        # a sweep without R=1 reports capacity per cell but no
+        # efficiency ratios (dividing by the first swept count would
+        # silently mislabel the baseline)
+        if R == 1:
+            cap1, cp1 = cap, cp
+            row["scaling"] = 1.0
+            row["step_parallel"] = 1.0
+        elif cap1 is not None:
+            row["scaling"] = round(cap / (R * cap1), 3)
+            row["step_parallel"] = round(cp1 / cp, 3)
+            scaling_by_r[str(R)] = {
+                "capacity_sketches_per_sec": cap,
+                "scaling": row["scaling"],
+                "speedup": round(cap / cap1, 3),
+                "step_parallel": row["step_parallel"],
+            }
+        curves.append(row)
+        print(f"# R={R} capacity {cap} sk/s, critical-path steps {cp}"
+              + (f" (step_parallel {row['step_parallel']}x)"
+                 if "step_parallel" in row else " (no R=1 baseline)"),
+              file=sys.stderr)
+        # -- arrival-order parity: one shuffled burst (workers live) ----
+        if R > 1 and parity["arrival_invariant"] is None:
+            fleet.reset()
+            order = list(range(n))
+            np.random.default_rng(args.seed + 1).shuffle(order)
+            submit_all(fleet, order=order)
+            if not fleet.drain(timeout=600):
+                raise RuntimeError("fleet drain timed out (shuffle)")
+            check_parity(fleet.results, "shuffled arrival order")
+            parity["arrival_invariant"] = True
+            print(f"# R={R} shuffled-arrival parity OK",
+                  file=sys.stderr)
+        # -- offered-load curve points ----------------------------------
+        for rate in rates:
+            if rate <= 0:
+                continue
+            fleet.reset()
+            gen = OpenLoopLoadGen(
+                poisson_arrivals(n, rate, args.seed),
+                lambda i: fleet.submit(clone(i),
+                                       cls=cls_order[i % ncls])).start()
+            gen.join(timeout=600)
+            if not fleet.drain(timeout=600):
+                raise RuntimeError("fleet drain timed out (load arm)")
+            s = fleet.summary()
+            shed_by_class = s["shed_by_class"]
+            curves.append({
+                "replicas": R, "offered_rate": rate,
+                "sketches_per_sec": s["sketches_per_sec"],
+                "wall_s": s["wall_s"],
+                "completed": s["completed"], "shed": s["shed"],
+                "shed_frac": s["shed_frac"],
+                "latency_p50_s": s["latency"]["p50_s"],
+                "latency_p95_s": s["latency"]["p95_s"],
+                "latency_p99_s": s["latency"]["p99_s"],
+                "by_class": {c: {"p99_s": v["p99_s"],
+                                 "completed": v["completed"],
+                                 "shed": shed_by_class.get(c, 0)}
+                             for c, v in
+                             s["latency_by_class"].items()},
+                "loadgen_max_lag_s": round(gen.max_lag_s, 6),
+            })
+            print(f"# R={R} rate={rate}: "
+                  f"{s['sketches_per_sec']} sk/s, p99 "
+                  f"{s['latency']['p99_s']}s, shed {s['shed']}",
+                  file=sys.stderr)
+        fleet.close()
+
+    fleet_rec = {
+        "kind": "serve_fleet",
+        "smoke": bool(args.smoke),
+        "device_kind": jax.devices()[0].device_kind,
+        "dec_model": hps.dec_model,
+        "slots": slots,
+        "chunk": chunk,
+        "n_requests": n,
+        "len_dist": dist,
+        "len_mean": round(float(lengths.mean()), 2),
+        "len_max": int(lengths.max()),
+        "classes": class_specs,
+        "replicas_swept": replicas_list,
+        "rates_swept": rates,
+        "host_parallel_ceiling": measure_host_parallel_ceiling(),
+        "curves": curves,
+        "scaling": scaling_by_r,
+        "parity": parity,
+    }
+    if fleet_rec["host_parallel_ceiling"] < 1.5:
+        # the GOODPUT.json honesty discipline: on a host that cannot
+        # run even two numpy threads concurrently, wall-clock replica
+        # scaling and matched-rate p99 are ceiling-bound BY THE BOX —
+        # say so in the artifact instead of letting the numbers read
+        # as a fleet property
+        fleet_rec["caveats"] = [
+            f"host_parallel_ceiling "
+            f"{fleet_rec['host_parallel_ceiling']} < 1.5: this box "
+            f"cannot execute replicas concurrently, so wall-clock "
+            f"scaling and matched-rate p99 are host-bound; the "
+            f"authoritative CPU-smoke signals are step_parallel "
+            f"(deterministic critical-path scheduling math) and the "
+            f"bitwise parity block; the wall-clock scaling acceptance "
+            f"is the multi-chip mesh run"]
+    # one streamed history row per (replicas, offered_rate) cell — the
+    # bench_regress gate and bench_summary key on exactly these
+    base = {k: fleet_rec[k] for k in
+            ("kind", "smoke", "device_kind", "dec_model", "slots",
+             "chunk", "n_requests", "len_dist")}
+    for row in curves:
+        hist_append({**base, **row})
+    print(json.dumps(fleet_rec, indent=2))
+    if args.out:
+        # SERVE_BENCH.json GAINS the curves: the engine-vs-sampler
+        # record already there is preserved, the fleet record lands
+        # under its own key
+        doc = {}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    doc = loaded
+            except ValueError:
+                pass
+        doc["fleet"] = fleet_rec
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+    return 0
+
+
+def _run(args, hps, model, params, slots, chunk, n, lmin, lmax,
+         hist_append, dist="power"):
+    import jax
+
+    lengths, requests = _build_requests(args, hps, n, lmin, lmax, dist)
 
     print(f"# serving {n} requests, lengths mean {lengths.mean():.1f} "
           f"max {lengths.max()} (skew {lengths.max() / lengths.mean():.2f}x)"

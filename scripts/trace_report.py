@@ -37,7 +37,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from sketch_rnn_tpu.utils.telemetry import TELEMETRY_JSONL  # noqa: E402
+from sketch_rnn_tpu.utils.telemetry import (  # noqa: E402
+    TELEMETRY_JSONL,
+    replica_of_series,
+)
 
 SPARK = " ▁▂▃▄▅▆▇█"
 
@@ -153,6 +156,27 @@ def occupancy(data: Dict, name: str = "slots_live",
             "span_s": float(ts[-1] - ts[0]), "sparkline": spark}
 
 
+def occupancy_replicas(data: Dict, base: str = "slots_live",
+                       cat: str = "serve") -> List[Dict]:
+    """Per-replica occupancy timelines (ISSUE 9): a fleet run records
+    one ``slots_live_rNN`` gauge per replica engine (the naming
+    contract in utils/telemetry.py), rendered here as one sparkline
+    each so an uneven load split is visible at a glance. Single-engine
+    runs (bare ``slots_live``) return []."""
+    names = sorted(
+        {ev["name"] for ev in data["events"]
+         if ev["type"] == "counter" and ev["cat"] == cat
+         and replica_of_series(ev["name"], base) is not None},
+        key=lambda nm: replica_of_series(nm, base))
+    rows = []
+    for nm in names:
+        occ = occupancy(data, name=nm, cat=cat)
+        if occ is not None:
+            occ["replica"] = replica_of_series(nm, base)
+            rows.append(occ)
+    return rows
+
+
 def latency_table(data: Dict) -> List[Dict]:
     """Exact percentiles from serve ``complete`` events, per metric.
 
@@ -204,6 +228,7 @@ def report(data: Dict) -> Dict:
         "host_filter": data.get("host_filter"),
         "spans": span_breakdown(data),
         "occupancy": occupancy(data),
+        "occupancy_replicas": occupancy_replicas(data),
         "latency": latency_table(data),
         "counters": {f"{c}/{n}": v
                      for (c, n), v in sorted(data["counters"].items())},
@@ -241,6 +266,15 @@ def print_report(rep: Dict) -> None:
         print(f"mean {occ['mean']:.2f} / max {occ['max']:.0f} slots over "
               f"{occ['span_s']:.3f}s ({occ['samples']} chunks)")
         print(f"[{occ['sparkline']}]")
+        print()
+    occ_r = rep.get("occupancy_replicas") or []
+    if occ_r:
+        print("== serve slot occupancy (per replica) ==")
+        for o in occ_r:
+            print(f"replica {o['replica']}: mean {o['mean']:.2f} / max "
+                  f"{o['max']:.0f} slots over {o['span_s']:.3f}s "
+                  f"({o['samples']} chunks)")
+            print(f"[{o['sparkline']}]")
         print()
     lat = rep["latency"]
     if lat:

@@ -335,11 +335,11 @@ def cmd_serve_bench(args) -> int:
     the latest checkpoint in ``--workdir`` is restored like ``sample``.
     """
     hps = _resolve_hps(args)
-    # SLO specs and the metrics port are usage input: fail before the
-    # (expensive) restore/compile, like sample's flag validation — a
-    # taken port must not cost the whole warmup first. The server is
-    # harmless this early (it serves meta-only until the core is
-    # configured below).
+    # SLO specs, admission classes and the metrics port are usage
+    # input: fail before the (expensive) restore/compile, like sample's
+    # flag validation — a taken port must not cost the whole warmup
+    # first. The server is harmless this early (it serves meta-only
+    # until the core is configured below).
     slo_tracker = None
     if args.slo:
         from sketch_rnn_tpu.serve.slo import SLOTracker, parse_slo
@@ -347,6 +347,33 @@ def cmd_serve_bench(args) -> int:
             slo_tracker = SLOTracker([parse_slo(s) for s in args.slo])
         except ValueError as e:
             print(f"[cli] {e}", file=sys.stderr)
+            return 2
+    if args.fleet is None and (args.rate or args.classes):
+        print("[cli] --rate/--classes configure the fleet scheduler; "
+              "add --fleet", file=sys.stderr)
+        return 2
+    if args.fleet is not None:
+        if args.static:
+            print("[cli] --static (freeze-until-batch-done) has no "
+                  "fleet equivalent; drop one of --static/--fleet",
+                  file=sys.stderr)
+            return 2
+        from sketch_rnn_tpu.serve.admission import parse_admission_classes
+        try:
+            parse_admission_classes(args.classes)
+        except ValueError as e:
+            print(f"[cli] {e}", file=sys.stderr)
+            return 2
+        if args.rate < 0:
+            print(f"[cli] --rate must be >= 0, got {args.rate}",
+                  file=sys.stderr)
+            return 2
+        if args.fleet > len(jax.devices()):
+            # usage input fails BEFORE the expensive restore/compile,
+            # like the SLO/class specs above
+            print(f"[cli] --fleet {args.fleet} needs {args.fleet} "
+                  f"devices but only {len(jax.devices())} are "
+                  f"available", file=sys.stderr)
             return 2
     server = None
     if args.metrics_port is not None:
@@ -367,6 +394,123 @@ def cmd_serve_bench(args) -> int:
     finally:
         if server is not None:
             server.stop()
+
+
+def _serve_telemetry_start(args):
+    """Enable the telemetry core (+ device-memory sampler) for an
+    observed serve run. Returns ``(trace_dir, tel, tele, mem_sampler)``
+    (all None/''-ish when neither --trace_dir nor --metrics_port asked
+    for observability).
+
+    MUST be called AFTER every engine/fleet warmup (ISSUE 9 satellite:
+    this ordering was inlined in the single-engine path only, and a
+    second serving path could silently compile inside the measured
+    window): the exported per-request lifecycle then covers exactly the
+    measured run, and the JitCompileProbe — which remembers geometries
+    seen while disabled — reports the warm programs as cache HITS
+    instead of recompiling. --metrics_port alone (no --trace_dir) still
+    enables the core — the /metrics endpoint renders its counters/
+    histograms live and would otherwise serve only meta + SLO series —
+    but exports no files at exit.
+    """
+    trace_dir = getattr(args, "trace_dir", "") or None
+    tel = None
+    tele = None
+    mem_sampler = None
+    if trace_dir or args.metrics_port is not None:
+        from sketch_rnn_tpu.parallel.multihost import topology
+        from sketch_rnn_tpu.utils import telemetry as tele
+        topo = topology()
+        tel = tele.configure(trace_dir=trace_dir,
+                             process_index=topo["process_index"],
+                             host_count=topo["host_count"])
+        # sampled device-memory gauges: /metrics shows live/peak HBM
+        # while the burst runs, so slot-count choices are
+        # memory-visible (no-op on stat-less backends)
+        mem_sampler = tele.MemorySampler().start()
+        mem_sampler.phase = "serve"
+    return trace_dir, tel, tele, mem_sampler
+
+
+def _serve_telemetry_abort(trace_dir, tel, tele, mem_sampler) -> None:
+    """Crash-path teardown: a mid-run failure still leaves the trace
+    that explains it (the train loop's post-mortem discipline);
+    best-effort so an export failure never masks the real error."""
+    if mem_sampler is not None:
+        mem_sampler.stop()
+    if tel is not None:
+        if trace_dir:
+            try:
+                tel.export()
+            except Exception:  # noqa: BLE001
+                pass
+        tele.disable()
+
+
+def _serve_bench_fleet(args, hps, model, state_params, requests,
+                       slo_tracker):
+    """The fleet measured section: build + warm the fleet, THEN enable
+    telemetry (via the shared helper — the can't-recompile-into-the-
+    window ordering), then replay the open-loop schedule and drain.
+
+    Returns ``(out_metrics, fleet_report, request_rows,
+    telemetry_handles)``.
+    """
+    from sketch_rnn_tpu.serve.admission import parse_admission_classes
+    from sketch_rnn_tpu.serve.fleet import ServeFleet
+    from sketch_rnn_tpu.serve.loadgen import (OpenLoopLoadGen,
+                                              poisson_arrivals)
+
+    classes = parse_admission_classes(args.classes)
+    cls_order = [c.name for c in sorted(classes.values(),
+                                        key=lambda c: c.priority)]
+    fleet = ServeFleet(model, hps, state_params,
+                       replicas=args.fleet, slots=args.slots,
+                       chunk=args.chunk, greedy=args.greedy,
+                       classes=classes, slo=slo_tracker)
+    fleet.warm(requests[0])
+    handles = _serve_telemetry_start(args)
+    try:
+        for i, r in enumerate(requests):
+            r.uid = i
+
+        def _submit(i):
+            fleet.submit(requests[i], cls=cls_order[i % len(cls_order)])
+
+        with fleet:
+            gen = OpenLoopLoadGen(
+                poisson_arrivals(len(requests), args.rate, args.seed),
+                _submit).start()
+            gen.join()
+            fleet.drain()
+            fsum = fleet.summary()
+            rows = [{"uid": uid, "replica": rec["replica"],
+                     "class": rec.get("class"),
+                     "queue_pos": rec.get("queue_pos"),
+                     "steps": rec["result"].steps,
+                     "length": rec["result"].length,
+                     "queue_wait_s": rec["result"].queue_wait_s,
+                     "decode_s": rec["result"].decode_s,
+                     "latency_s": rec["result"].latency_s}
+                    for uid, rec in sorted(fleet.results.items())]
+    except BaseException:
+        _serve_telemetry_abort(*handles)
+        raise
+    fsum["offered_rate"] = args.rate
+    fsum["loadgen_max_lag_s"] = round(gen.max_lag_s, 6)
+    out_metrics = {
+        "completed": fsum["completed"],
+        "wall_s": fsum["wall_s"],
+        "sketches_per_sec": fsum["sketches_per_sec"],
+        "requests_shed": fsum["shed"],
+        "shed_frac": fsum["shed_frac"],
+        "latency_p50_s": fsum["latency"]["p50_s"],
+        "latency_p95_s": fsum["latency"]["p95_s"],
+        "latency_p99_s": fsum["latency"]["p99_s"],
+    }
+    if slo_tracker is not None:
+        out_metrics["slo"] = slo_tracker.summary()
+    return out_metrics, fsum, rows, handles
 
 
 def _serve_bench_run(args, hps, slo_tracker, server) -> int:
@@ -397,63 +541,50 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
                 label=args.label, temperature=args.temperature)
         for i in range(n)
     ]
-    engine = ServeEngine(model, hps, state_params, slots=args.slots,
-                         chunk=args.chunk, greedy=args.greedy)
     writer = (MetricsWriter(args.workdir, name="serve")
               if args.log_metrics else None)
-    # warmup: compile outside the timed run. The chunk program is
-    # shape-specialized on the request-pool size, so the warm burst
-    # must have the SAME request count — clones capped at one step.
     import dataclasses
-    engine.run([dataclasses.replace(r, uid=None, max_len=1)
-                for r in requests])
-    # telemetry (ISSUE 6): configured AFTER the warmup burst so the
-    # exported per-request lifecycle (enqueue/admit/complete, latency
-    # histograms, slot occupancy) covers exactly the measured run.
-    # --metrics_port alone (no --trace_dir) still enables the core —
-    # the /metrics endpoint renders its counters/histograms live and
-    # would otherwise serve only meta + SLO series — but exports no
-    # files at exit.
-    trace_dir = getattr(args, "trace_dir", "") or None
-    tel = None
-    tele = None
-    mem_sampler = None
-    if trace_dir or args.metrics_port is not None:
-        from sketch_rnn_tpu.parallel.multihost import topology
-        from sketch_rnn_tpu.utils import telemetry as tele
-        topo = topology()
-        tel = tele.configure(trace_dir=trace_dir,
-                             process_index=topo["process_index"],
-                             host_count=topo["host_count"])
-        # sampled device-memory gauges: /metrics shows live/peak HBM
-        # while the burst runs, so slot-count choices are
-        # memory-visible (no-op on stat-less backends)
-        mem_sampler = tele.MemorySampler().start()
-        mem_sampler.phase = "serve"
-    # health & SLO layer (ISSUE 7): the tracker is fed by the engine
-    # per completed request; the (already-bound) metrics server exposes
-    # the LIVE /metrics + /healthz view of this run, and the final
-    # scrape is archived as metrics.prom beside the trace (or in the
-    # workdir) — the checkable artifact that the endpoint and the
-    # end-of-run summary reconcile.
+    fleet_report = None
     t0 = time.time()
-    try:
-        out = engine.run(requests, recycle=not args.static,
-                         metrics_writer=writer, slo=slo_tracker)
-    except BaseException:
-        # a mid-run crash still leaves the trace that explains it
-        # (the train loop's post-mortem discipline); best-effort so
-        # an export failure never masks the real error
-        if mem_sampler is not None:
-            mem_sampler.stop()
-        if tel is not None:
-            if trace_dir:
-                try:
-                    tel.export()
-                except Exception:  # noqa: BLE001
-                    pass
-            tele.disable()
-        raise
+    if args.fleet is not None:
+        # mesh-replicated fleet (ISSUE 9): R device-pinned engines, one
+        # SLA-aware scheduler, open-loop Poisson arrivals at --rate.
+        # The fleet feeds the SLO tracker class-keyed endpoints (one
+        # per admission class), so /healthz judges the classes the
+        # operator declared.
+        out_metrics, fleet_report, rows, handles = _serve_bench_fleet(
+            args, hps, model, state_params, requests, slo_tracker)
+        trace_dir, tel, tele, mem_sampler = handles
+        slots_v, chunk_v = fleet_report["slots"], fleet_report["chunk"]
+        if writer is not None:
+            for i, row in enumerate(rows):
+                writer.write(i + 1, row)
+    else:
+        engine = ServeEngine(model, hps, state_params, slots=args.slots,
+                             chunk=args.chunk, greedy=args.greedy)
+        slots_v, chunk_v = engine.slots, engine.chunk
+        # warmup: compile outside the timed run. The chunk program is
+        # shape-specialized on the request-pool size, so the warm burst
+        # must have the SAME request count — clones capped at one step.
+        engine.run([dataclasses.replace(r, uid=None, max_len=1)
+                    for r in requests])
+        # telemetry configured AFTER warmup (shared helper — ISSUE 9
+        # satellite: the ordering is the helper's contract now)
+        trace_dir, tel, tele, mem_sampler = _serve_telemetry_start(args)
+        # health & SLO layer (ISSUE 7): the tracker is fed by the
+        # engine per completed request; the (already-bound) metrics
+        # server exposes the LIVE /metrics + /healthz view of this run,
+        # and the final scrape is archived as metrics.prom beside the
+        # trace (or in the workdir) — the checkable artifact that the
+        # endpoint and the end-of-run summary reconcile.
+        t0 = time.time()
+        try:
+            out = engine.run(requests, recycle=not args.static,
+                             metrics_writer=writer, slo=slo_tracker)
+        except BaseException:
+            _serve_telemetry_abort(trace_dir, tel, tele, mem_sampler)
+            raise
+        out_metrics = out["metrics"]
     if mem_sampler is not None:
         mem_sampler.stop()
     prom_path = None
@@ -512,22 +643,26 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
             artifacts["serve_metrics"] = [
                 os.path.join(args.workdir, f"serve_metrics.{e}")
                 for e in ("csv", "jsonl")]
+        extra = {"n_requests": n, "slots": slots_v, "chunk": chunk_v}
+        if fleet_report is not None:
+            extra["replicas"] = fleet_report["replicas"]
+            extra["offered_rate"] = fleet_report["offered_rate"]
         runinfo.write_manifest(
             man_dir, kind="serve_bench", hps=hps, run_id=run_id,
-            artifacts=artifacts,
-            extra={"n_requests": n, "slots": engine.slots,
-                   "chunk": engine.chunk})
+            artifacts=artifacts, extra=extra)
     report = {
         "kind": "serve_bench_cli",
         "run_id": run_id,
         "n_requests": n,
-        "slots": engine.slots,
-        "chunk": engine.chunk,
+        "slots": slots_v,
+        "chunk": chunk_v,
         "static": bool(args.static),
         "scale_factor": scale,
         "started": t0,
-        **out["metrics"],
+        **out_metrics,
     }
+    if fleet_report is not None:
+        report["fleet"] = fleet_report
     if server is not None:
         report["metrics_port"] = server.port
         report["metrics_prom"] = prom_path
@@ -643,6 +778,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--static", action="store_true",
                    help="disable slot recycling (freeze-until-batch-done "
                         "schedule, for comparison)")
+    p.add_argument("--fleet", type=int, nargs="?", const=0, default=None,
+                   help="serve through a mesh-replicated fleet of N "
+                        "device-pinned engines (bare/0 = one per "
+                        "device): one host scheduler, SLA-aware "
+                        "admission (least-loaded placement, "
+                        "shed-on-overload), per-replica queues")
+    p.add_argument("--rate", type=float, default=0.0,
+                   help="open-loop Poisson arrival rate in requests/sec "
+                        "for --fleet (deterministic seeded schedule, "
+                        "decoupled from completions; 0 = closed burst: "
+                        "every request arrives at t=0)")
+    p.add_argument("--classes", action="append", default=[],
+                   help="admission class spec for --fleet, repeatable; "
+                        "parse_slo grammar with the endpoint naming the "
+                        "class (e.g. 'interactive:p95<=250ms'); first "
+                        "spec = highest drain priority; requests are "
+                        "assigned round-robin over the classes; with "
+                        "--slo, SLO endpoints match class names. "
+                        "Default: one no-deadline 'default' class")
     p.add_argument("--random_init", action="store_true",
                    help="fresh random params instead of a checkpoint")
     p.add_argument("--log_metrics", action="store_true",

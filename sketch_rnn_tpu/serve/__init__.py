@@ -1,6 +1,11 @@
 """Serving subsystem: continuous-batching generation (ROADMAP north
 star — "serves heavy traffic"; engine design in ARCHITECTURE.md)."""
 
+from sketch_rnn_tpu.serve.admission import (
+    AdmissionClass,
+    AdmissionController,
+    parse_admission_classes,
+)
 from sketch_rnn_tpu.serve.engine import (
     Request,
     Result,
@@ -8,15 +13,23 @@ from sketch_rnn_tpu.serve.engine import (
     generate_many,
     make_chunk_step,
 )
+from sketch_rnn_tpu.serve.fleet import ServeFleet
+from sketch_rnn_tpu.serve.loadgen import OpenLoopLoadGen, poisson_arrivals
 from sketch_rnn_tpu.serve.metrics_http import MetricsServer
 from sketch_rnn_tpu.serve.slo import SLO, SLOTracker, parse_slo
 
 __all__ = [
+    "AdmissionClass",
+    "AdmissionController",
+    "OpenLoopLoadGen",
     "Request",
     "Result",
     "ServeEngine",
+    "ServeFleet",
     "generate_many",
     "make_chunk_step",
+    "parse_admission_classes",
+    "poisson_arrivals",
     "MetricsServer",
     "SLO",
     "SLOTracker",

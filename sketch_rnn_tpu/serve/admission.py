@@ -1,0 +1,224 @@
+"""SLA-aware admission: deadline/priority classes, least-loaded placement,
+shed-on-overload.
+
+ISSUE 9 tentpole piece: the fleet scheduler (serve/fleet.py) fronts R
+replica engines with per-replica queues, and this module is the pure
+decision layer between an arriving request and those queues. The design
+follows the standard serving-SLO playbook (the Gemma-on-TPU comparison
+in PAPERS.md reports exactly these knobs): every request carries an
+**admission class** — a named latency deadline plus a priority — and
+the controller answers one question per arrival: *which replica queue,
+or shed now?*
+
+- **Classes reuse the ``parse_slo`` grammar** (serve/slo.py): an
+  admission class IS a latency SLO whose endpoint field names the
+  class — ``interactive:p95<=250ms`` declares class ``interactive``
+  with a 250 ms deadline. Priority is spec order (first = most
+  important); the replica worker drains its queues in priority order,
+  and each class's completions feed a per-class latency histogram and
+  (optionally) an :class:`~sketch_rnn_tpu.serve.slo.SLOTracker` keyed
+  by class name.
+- **Least-loaded placement**: the controller tracks per-replica backlog
+  (queued + running requests) and routes to the minimum (ties break to
+  the lowest replica index — deterministic). Backlog is the ONLY
+  placement signal, which is what makes replica placement provably
+  invisible to outputs: it picks WHERE, never WHAT (the engine's
+  per-request fold_in RNG already guarantees the rest).
+- **Shed-on-overload**: a request is refused at the door when its
+  class deadline is already unmeetable — estimated wait (backlog x
+  the observed per-request service time / slots) exceeds the deadline
+  — or when every replica's queue is at the hard cap. Shedding early
+  is the point: a request that will blow its deadline anyway should
+  cost zero device steps (open-loop load does not slow down because
+  the server is slow — see serve/loadgen.py). Sheds are counted
+  (``requests_shed_total`` + per-class) by the fleet.
+
+The controller is deliberately PURE host-side state (no jax, no
+threads, no clock reads): the fleet serializes calls under its own
+lock and injects completion observations, so every decision is a
+deterministic function of the arrival/completion history — which is
+what the placement-invariance tests rely on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+from sketch_rnn_tpu.serve.slo import SLO, parse_slo
+
+# the class every request lands in when no classes are configured: no
+# deadline (never shed on latency), lowest priority is irrelevant with
+# one class
+DEFAULT_CLASS = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionClass:
+    """One admission class: a named deadline + drain priority.
+
+    ``slo`` carries the deadline (``objective_s``) and the quantile
+    target the class is judged by; ``priority`` orders queue draining
+    (0 = most important = drained first).
+    """
+
+    name: str
+    slo: SLO
+    priority: int = 0
+
+    @property
+    def deadline_s(self) -> float:
+        return self.slo.objective_s
+
+
+def parse_admission_classes(specs: Sequence[str]
+                            ) -> Dict[str, AdmissionClass]:
+    """Parse ``--classes`` specs into an ordered class table.
+
+    Each spec uses the ``parse_slo`` grammar with the endpoint field
+    naming the class (``interactive:p95<=250ms``,
+    ``batch:latency_s:p99<=2``); priority is spec order. An empty list
+    yields the single no-deadline :data:`DEFAULT_CLASS`.
+    """
+    out: Dict[str, AdmissionClass] = {}
+    for i, spec in enumerate(specs):
+        slo = parse_slo(spec)
+        if slo.endpoint in out:
+            raise ValueError(f"duplicate admission class "
+                             f"{slo.endpoint!r} (from {spec!r})")
+        out[slo.endpoint] = AdmissionClass(name=slo.endpoint, slo=slo,
+                                           priority=i)
+    if not out:
+        out[DEFAULT_CLASS] = AdmissionClass(
+            name=DEFAULT_CLASS,
+            slo=SLO(objective_s=math.inf, target=0.95,
+                    endpoint=DEFAULT_CLASS),
+            priority=0)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """One admission decision. ``replica`` is None iff shed."""
+
+    replica: Optional[int]
+    queue_pos: int = 0            # requests ahead on the chosen replica
+    est_wait_s: Optional[float] = None
+    shed_reason: Optional[str] = None
+
+    @property
+    def shed(self) -> bool:
+        return self.replica is None
+
+
+class AdmissionController:
+    """Pure least-loaded + shed-on-overload placement over R replicas.
+
+    NOT internally locked — the fleet serializes ``place``/``note_done``
+    under its scheduler lock. ``queue_cap`` bounds per-replica backlog
+    (0 = unbounded); ``shed_margin`` scales the deadline before the
+    estimated-wait comparison (1.0 = shed exactly when the estimate
+    exceeds the deadline; >1 sheds later, <1 earlier). The service-time
+    estimate is an EWMA over completed requests' ``decode_s``; until
+    the first completion lands there is no estimate and only the hard
+    queue cap sheds (a cold fleet must not refuse its first burst).
+    """
+
+    def __init__(self, classes: Dict[str, AdmissionClass],
+                 n_replicas: int, slots: int, queue_cap: int = 0,
+                 shed_margin: float = 1.0, ewma: float = 0.2):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if not 0.0 < ewma <= 1.0:
+            raise ValueError(f"ewma must be in (0, 1], got {ewma}")
+        self.classes = dict(classes)
+        self.n_replicas = n_replicas
+        self.slots = slots
+        self.queue_cap = int(queue_cap)
+        self.shed_margin = float(shed_margin)
+        self._ewma = float(ewma)
+        self._backlog: List[int] = [0] * n_replicas
+        self.service_s: Optional[float] = None   # EWMA decode_s
+        self.admitted = 0
+        self.shed: Dict[str, int] = {c: 0 for c in self.classes}
+
+    @property
+    def backlog(self) -> List[int]:
+        return list(self._backlog)
+
+    @property
+    def shed_total(self) -> int:
+        return sum(self.shed.values())
+
+    def est_wait_s(self, replica: int) -> Optional[float]:
+        """Expected queueing delay on ``replica``: its backlog worked
+        off at ``slots`` concurrent requests of the observed service
+        time (None until a completion calibrates the estimate)."""
+        if self.service_s is None:
+            return None
+        return self._backlog[replica] * self.service_s / self.slots
+
+    def place(self, cls_name: str, force: bool = False) -> Placement:
+        """Decide one arrival: least-loaded replica, or shed.
+
+        ``force`` admits unconditionally (same least-loaded placement,
+        shed checks skipped) — the bench's parity/capacity arms use it
+        so a completion racing the submit loop can never shed a request
+        those arms must complete.
+        """
+        cls = self.classes.get(cls_name)
+        if cls is None:
+            raise KeyError(
+                f"unknown admission class {cls_name!r}; configured: "
+                f"{sorted(self.classes)}")
+        # least-loaded, ties to the lowest index (deterministic)
+        replica = min(range(self.n_replicas),
+                      key=lambda r: (self._backlog[r], r))
+        depth = self._backlog[replica]
+        wait = self.est_wait_s(replica)
+        if not force:
+            if self.queue_cap and depth >= self.queue_cap:
+                self.shed[cls_name] += 1
+                return Placement(replica=None, shed_reason="queue_full")
+            if (wait is not None and math.isfinite(cls.deadline_s)
+                    and wait > cls.deadline_s * self.shed_margin):
+                self.shed[cls_name] += 1
+                return Placement(replica=None, est_wait_s=wait,
+                                 shed_reason="deadline")
+        self._backlog[replica] += 1
+        self.admitted += 1
+        return Placement(replica=replica, queue_pos=depth,
+                         est_wait_s=wait)
+
+    def note_done(self, replica: int, decode_s: float) -> None:
+        """Feed one completion: frees backlog, calibrates the
+        service-time EWMA the shed estimate runs on."""
+        if self._backlog[replica] <= 0:
+            raise RuntimeError(
+                f"replica {replica} completed a request with zero "
+                f"tracked backlog — placement/completion accounting "
+                f"desynced")
+        self._backlog[replica] -= 1
+        d = float(decode_s)
+        self.service_s = (d if self.service_s is None
+                          else (1 - self._ewma) * self.service_s
+                          + self._ewma * d)
+
+    def summary(self) -> Dict:
+        """Aggregate admission state for reports and /metrics."""
+        return {
+            "admitted": self.admitted,
+            "shed_total": self.shed_total,
+            "shed_by_class": dict(self.shed),
+            "backlog": self.backlog,
+            "service_est_s": (None if self.service_s is None
+                              else round(self.service_s, 6)),
+            "queue_cap": self.queue_cap,
+            "classes": {c.name: {"deadline_s": c.deadline_s,
+                                 "target": c.slo.target,
+                                 "priority": c.priority}
+                        for c in self.classes.values()},
+        }

@@ -59,7 +59,12 @@ from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.ops import mdn
 from sketch_rnn_tpu.sample.sampler import END_TOKEN, START_TOKEN
 from sketch_rnn_tpu.utils.profiling import SpanTimer
-from sketch_rnn_tpu.utils.telemetry import JitCompileProbe, get_telemetry
+from sketch_rnn_tpu.utils.telemetry import (
+    JitCompileProbe,
+    class_series,
+    get_telemetry,
+    replica_series,
+)
 
 
 @dataclasses.dataclass
@@ -68,6 +73,16 @@ class Request:
 
     ``key`` is the request's OWN PRNG key (determinism contract above).
     ``max_len`` caps emitted strokes (default: the engine's max_len).
+
+    The last three fields are ADMISSION metadata stamped by the fleet
+    scheduler (serve/fleet.py) — they explain *why* a request waited
+    (class, position in the fleet queue, true arrival instant) and ride
+    the telemetry ``complete`` events, but none of them can affect the
+    request's strokes (the determinism contract covers them: scheduling
+    metadata changes WHEN, never WHAT). ``enqueue_ts`` (a
+    ``perf_counter`` instant) backdates the latency clock to the
+    fleet-arrival time; unset, the clock starts at ``run()`` entry
+    exactly as before.
     """
 
     key: jax.Array
@@ -76,6 +91,9 @@ class Request:
     temperature: float = 1.0
     max_len: Optional[int] = None
     uid: Optional[int] = None
+    cls: Optional[str] = None
+    queue_pos: Optional[int] = None
+    enqueue_ts: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -243,12 +261,22 @@ class ServeEngine:
 
     def __init__(self, model, hps: HParams, params, slots: int = 0,
                  chunk: int = 0, max_len: Optional[int] = None,
-                 greedy: bool = False):
+                 greedy: bool = False, device=None,
+                 replica_id: Optional[int] = None):
         self.model = model
         self.hps = hps
         self.slots = int(slots or hps.serve_slots)
         self.chunk = int(chunk or hps.serve_chunk)
         self.max_len = int(max_len or hps.max_seq_len)
+        # fleet replication (ISSUE 9): ``device`` pins this engine's
+        # params + request pool to one mesh device, so its chunk
+        # program executes there and NOWHERE else — each replica is its
+        # own collective-free program (the mesh-sharded-sampler
+        # discipline). ``replica_id`` keys the per-replica telemetry
+        # series (slots_live_rNN) and rides the complete events.
+        self.device = device
+        self.replica_id = replica_id
+        self._slots_gauge = replica_series("slots_live", replica_id)
         if self.slots < 1 or self.chunk < 1:
             raise ValueError(
                 f"slots and chunk must be >= 1, got {self.slots}/"
@@ -260,7 +288,7 @@ class ServeEngine:
         keep = ("dec", "out_w", "out_b", "dec_init_w", "dec_init_b",
                 "class_embed")
         self.params = jax.device_put(
-            {k: params[k] for k in keep if k in params})
+            {k: params[k] for k in keep if k in params}, device)
         # compile probe (ISSUE 8): a traced cold start shows one
         # "serve_chunk" compile span with the executable's flops / peak
         # device bytes (the number that says how many slots fit in
@@ -292,7 +320,7 @@ class ServeEngine:
     # index vector and reset mask; and the per-chunk fetch is one
     # batched device_get of (t, done, strokes).
 
-    def _prepare_pool(self, requests: List[Request]):
+    def _prepare_pool(self, requests: List[Request], pad: int = 0):
         """Build + upload the request pool ``[N, ...]`` in one put.
 
         Key data is fetched per request host-side (not via one stacked
@@ -300,8 +328,20 @@ class ServeEngine:
         for a server seeing variable burst sizes); per-request
         ``max_len`` caps are validated here so admission is just two
         array writes.
+
+        ``pad`` (fleet mode) pads the pool arrays to a FIXED row count
+        so every micro-burst a replica serves reuses one compiled
+        program regardless of its request count — the chunk program is
+        shape-specialized on the pool size (see make_chunk_step), and a
+        replica seeing Poisson-varying burst sizes would otherwise
+        compile per distinct size. Pad rows are inert: ``slot_idx``
+        only ever points at real rows, so padding cannot change any
+        request's strokes (the invariance suite pins this).
         """
         hps = self.hps
+        n = len(requests)
+        if pad and pad < n:
+            raise ValueError(f"pool pad {pad} < request count {n}")
         key_data = np.stack([np.asarray(jax.random.key_data(req.key))
                              for req in requests])
         z = None
@@ -322,12 +362,25 @@ class ServeEngine:
             raise ValueError(
                 f"requests {over[:5]} exceed engine max_len "
                 f"{self.max_len}")
-        return jax.device_put((key_data, z, labels, temps, caps))
+        if pad and pad > n:
+            extra = pad - n
+            pad_rows = lambda a, fill: np.concatenate(  # noqa: E731
+                [a, np.full((extra,) + a.shape[1:], fill, a.dtype)])
+            key_data = pad_rows(key_data, 0)
+            if z is not None:
+                z = pad_rows(z, 0.0)
+            if labels is not None:
+                labels = pad_rows(labels, 0)
+            temps = pad_rows(temps, 1.0)
+            caps = pad_rows(caps, 1)
+        return jax.device_put((key_data, z, labels, temps, caps),
+                              self.device)
 
     # -- the serving loop --------------------------------------------------
 
     def run(self, requests: List[Request], recycle: bool = True,
-            metrics_writer=None, slo=None) -> Dict[str, Any]:
+            metrics_writer=None, slo=None, pool_pad: int = 0
+            ) -> Dict[str, Any]:
         """Drive ``requests`` to completion; continuous batching when
         ``recycle`` (default), static freeze-until-batch-done otherwise.
 
@@ -338,6 +391,9 @@ class ServeEngine:
         request's exact latency fields, so the live SLO/burn-rate view
         (the ``/metrics`` endpoint, ISSUE 7) sees the same floats as
         the returned Results; its summary rides in ``metrics["slo"]``.
+        ``pool_pad``: pad the request pool to this fixed row count so
+        variable-size bursts share one compiled program (fleet mode;
+        see ``_prepare_pool``).
         """
         t_start = time.perf_counter()
         self.spans = SpanTimer(category="serve")  # per-run (no warmup leak)
@@ -352,15 +408,20 @@ class ServeEngine:
             if req.uid is None:
                 req.uid = i
         queue = deque(enumerate(requests))
-        pool = self._prepare_pool(requests) if requests else None
-        enq = {req.uid: t_start for req in requests}
+        pool = (self._prepare_pool(requests, pad=pool_pad)
+                if requests else None)
+        # the latency clock starts at the request's true arrival when
+        # the fleet stamped one (enqueue_ts), else at run() entry —
+        # bitwise-unchanged for every pre-fleet caller
+        enq = {req.uid: (t_start if req.enqueue_ts is None
+                         else req.enqueue_ts) for req in requests}
         if tel.enabled:
             # monotonic request counters feed the live /metrics endpoint
             # (ISSUE 7); the scrape's completed total reconciles exactly
             # with run()'s end-of-run `completed`
             tel.counter("requests_enqueued", len(requests), cat="serve")
             for req in requests:
-                tel.instant("enqueue", cat="serve", ts=t_start,
+                tel.instant("enqueue", cat="serve", ts=enq[req.uid],
                             args={"uid": req.uid})
         admit_t: Dict[int, float] = {}
         slot_req: List[Optional[Request]] = [None] * self.slots
@@ -375,6 +436,13 @@ class ServeEngine:
         prev = jnp.broadcast_to(START_TOKEN, (nslots, 5))
         t_dev = jnp.zeros((nslots,), jnp.int32)
         done_dev = jnp.ones((nslots,), bool)   # all slots start empty
+        if self.device is not None:
+            # pin the loop state alongside the pool: every array the
+            # chunk program touches is committed to THIS replica's
+            # device, so concurrent replicas can never contend for (or
+            # silently migrate to) the process default device
+            carry, prev, t_dev, done_dev = jax.device_put(
+                (carry, prev, t_dev, done_dev), self.device)
         slot_idx = np.zeros((nslots,), np.int32)
         reset = np.zeros((nslots,), bool)
         # the dispatch index each slot's occupant FIRST runs in: under
@@ -480,8 +548,10 @@ class ServeEngine:
                 if tel.enabled:
                     # per-chunk occupancy sample: how many slots held a
                     # request during this chunk — trace_report.py's
-                    # slot-occupancy timeline, a Chrome counter track
-                    tel.gauge("slots_live", int(eligible.sum()),
+                    # slot-occupancy timeline, a Chrome counter track.
+                    # Fleet replicas record their own series
+                    # (slots_live_rNN) so the timeline is per-replica.
+                    tel.gauge(self._slots_gauge, int(eligible.sum()),
                               cat="serve", ts=now)
                 for b in np.nonzero(eligible & done)[0]:
                     req = slot_req[b]
@@ -509,19 +579,36 @@ class ServeEngine:
                         # the complete event carries the EXACT Result
                         # latencies, so event-derived percentiles in
                         # trace_report.py match run()'s summary; the
-                        # histograms stream the same values live
+                        # histograms stream the same values live.
+                        # Admission metadata (class / fleet queue
+                        # position / replica id) rides along when the
+                        # fleet stamped it, so a trace explains WHY a
+                        # request waited — never what it computed.
+                        ev_args = {"uid": res.uid,
+                                   "steps": res.steps,
+                                   "length": res.length,
+                                   "queue_wait_s": res.queue_wait_s,
+                                   "decode_s": res.decode_s,
+                                   "latency_s": res.latency_s}
+                        if req.cls is not None:
+                            ev_args["class"] = req.cls
+                        if req.queue_pos is not None:
+                            ev_args["queue_pos"] = req.queue_pos
+                        if self.replica_id is not None:
+                            ev_args["replica"] = self.replica_id
                         tel.instant("complete", cat="serve", ts=now,
-                                    args={"uid": res.uid,
-                                          "steps": res.steps,
-                                          "length": res.length,
-                                          "queue_wait_s": res.queue_wait_s,
-                                          "decode_s": res.decode_s,
-                                          "latency_s": res.latency_s})
+                                    args=ev_args)
                         tel.observe("queue_wait_s", res.queue_wait_s,
                                     cat="serve")
                         tel.observe("decode_s", res.decode_s, cat="serve")
                         tel.observe("latency_s", res.latency_s,
                                     cat="serve")
+                        if req.cls is not None:
+                            # per-class latency histogram: the SLA
+                            # surface an admission class is judged by
+                            tel.observe(
+                                class_series("latency_s", req.cls),
+                                res.latency_s, cat="serve")
                     slot_req[b] = None
                     occupied[b] = False
                     n_live -= 1

@@ -1,0 +1,498 @@
+"""Mesh-replicated serving fleet: per-device engines behind one scheduler.
+
+ISSUE 9 tentpole. The continuous-batching engine (serve/engine.py) is a
+single-device program; the ROADMAP's north star is serving heavy
+traffic, and the paper's decoder is tiny per-request — so fleet
+throughput is a SCHEDULING problem (the Gemma-on-TPU comparison in
+PAPERS.md), solved here with the same collective-free replication the
+mesh-sharded sampler proved (pjit/TPUv4 scaling paper: below the
+model-parallel threshold, independent per-device programs beat any
+cross-device collective):
+
+- **One replica per mesh device.** Each replica is a full
+  :class:`~sketch_rnn_tpu.serve.engine.ServeEngine` pinned to its
+  device (params, request pool and loop state all committed there), so
+  R replicas run R independent chunk programs with ZERO cross-device
+  communication — scaling is bounded by devices, not interconnect.
+- **One host-side scheduler.** ``submit()`` stamps arrival time and
+  admission class, asks the :class:`~sketch_rnn_tpu.serve.admission.
+  AdmissionController` for a placement (least-loaded replica queue, or
+  shed-on-overload), and wakes that replica's worker thread. Workers
+  drain their queues in class-priority order into fixed-size
+  **micro-bursts**: up to ``pool_cap`` requests served through one
+  ``engine.run(..., pool_pad=pool_cap)`` call, so every burst of any
+  size reuses the replica's single compiled program (the chunk program
+  is shape-specialized on pool size). Burst size adapts to load —
+  light traffic gets small low-latency bursts, heavy traffic amortizes
+  full pools.
+- **Placement is provably invisible to outputs.** The engine's
+  per-request ``fold_in(request_key, t)`` RNG makes strokes a pure
+  function of the request; the scheduler only ever chooses WHERE and
+  WHEN. The invariance suite pins bitwise-identical strokes at 1, 2
+  and 4 replicas and under shuffled arrival order.
+
+Telemetry (wired through the PR 6-8 core, all off-by-default): each
+replica's engine records its own ``slots_live_rNN`` occupancy gauge
+(trace_report.py renders a per-replica timeline), completions feed
+per-class latency histograms and the admission metadata on every
+``complete`` event, and the scheduler counts
+``requests_admitted_total`` / ``requests_shed_total`` (+ per-class) —
+all scrapeable live via ``serve/metrics_http.py``'s ``/metrics`` +
+``/healthz`` when a server is attached.
+
+Every started fleet registers process-wide so the tier-1 conftest
+guard can prove no test leaks worker threads (:func:`stop_all`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.serve.admission import (
+    AdmissionClass,
+    AdmissionController,
+    DEFAULT_CLASS,
+    parse_admission_classes,
+)
+from sketch_rnn_tpu.serve.engine import Request, ServeEngine
+from sketch_rnn_tpu.utils.telemetry import class_series, get_telemetry
+
+# every live fleet, for the conftest no-stray-threads guard
+_LIVE: set = set()
+_LIVE_LOCK = threading.Lock()
+
+
+class _Replica:
+    """One device's engine + its per-class queues (scheduler-owned)."""
+
+    def __init__(self, idx: int, device, engine: ServeEngine,
+                 class_order: Sequence[str]):
+        self.idx = idx
+        self.device = device
+        self.engine = engine
+        # drained in priority order (the scheduler's class_order is
+        # already priority-sorted)
+        self.queues: Dict[str, deque] = {c: deque() for c in class_order}
+        self.cond: Optional[threading.Condition] = None  # set by fleet
+        self.thread: Optional[threading.Thread] = None
+        # accumulated engine metrics across micro-bursts
+        self.completed = 0
+        self.bursts = 0
+        self.chunks = 0
+        self.device_steps = 0
+        self.live_slot_steps = 0.0
+
+    def pending(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def pop_batch(self, cap: int) -> List[Request]:
+        """Up to ``cap`` queued requests in class-priority order."""
+        batch: List[Request] = []
+        for q in self.queues.values():
+            while q and len(batch) < cap:
+                batch.append(q.popleft())
+            if len(batch) >= cap:
+                break
+        return batch
+
+
+class ServeFleet:
+    """R device-pinned engines, one SLA-aware scheduler, thread workers.
+
+    Lifecycle: construct -> (optionally) ``warm`` -> ``submit`` any
+    number of requests (before or after ``start``) -> ``start`` ->
+    ``drain`` -> ``close`` (or use as a context manager). Submissions
+    before ``start`` are placed deterministically (backlog changes only
+    through submits), which the closed-burst invariance/scaling arms
+    rely on.
+    """
+
+    def __init__(self, model, hps: HParams, params, replicas: int = 0,
+                 slots: int = 0, chunk: int = 0,
+                 max_len: Optional[int] = None, greedy: bool = False,
+                 classes: Optional[Dict[str, AdmissionClass]] = None,
+                 devices: Optional[Sequence[Any]] = None,
+                 pool_cap: int = 0, queue_cap: int = 0,
+                 shed_margin: float = 1.0, slo=None):
+        import jax  # lazy, the serve-module discipline
+
+        devices = list(devices if devices is not None else jax.devices())
+        n = int(replicas) if replicas else len(devices)
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1, got {n}")
+        if n > len(devices):
+            raise ValueError(
+                f"{n} replicas need {n} devices but only "
+                f"{len(devices)} are available")
+        self.hps = hps
+        self.slots = int(slots or hps.serve_slots)
+        self.chunk = int(chunk or hps.serve_chunk)
+        # micro-burst ceiling == the one pool size every burst pads to;
+        # 4x slots amortizes the per-burst fixed costs (pool upload,
+        # pipeline fill, the all-but-empty drain tail) at saturation
+        # while keeping light-traffic bursts small (a burst only holds
+        # what was queued when the worker woke)
+        self.pool_cap = int(pool_cap or 4 * self.slots)
+        if self.pool_cap < 1:
+            raise ValueError(f"pool_cap must be >= 1, got {self.pool_cap}")
+        self.classes = dict(classes) if classes else \
+            parse_admission_classes([])
+        class_order = [c.name for c in sorted(self.classes.values(),
+                                              key=lambda c: c.priority)]
+        self._default_class = class_order[0] if len(class_order) == 1 \
+            else None
+        self._admission = AdmissionController(
+            self.classes, n_replicas=n, slots=self.slots,
+            queue_cap=queue_cap, shed_margin=shed_margin)
+        self._slo = slo
+        self._lock = threading.Lock()
+        self._done_cv = threading.Condition(self._lock)
+        self._replicas: List[_Replica] = []
+        for r in range(n):
+            with jax.default_device(devices[r]):
+                eng = ServeEngine(model, hps, params, slots=self.slots,
+                                  chunk=self.chunk, max_len=max_len,
+                                  greedy=greedy, device=devices[r],
+                                  replica_id=r)
+            rep = _Replica(r, devices[r], eng, class_order)
+            rep.cond = threading.Condition(self._lock)
+            self._replicas.append(rep)
+        self._next_uid = 0
+        self._seen_uids: set = set()
+        self._submitted = 0
+        self._shed: List[Dict] = []
+        self._results: Dict[int, Dict] = {}     # uid -> record
+        self._stop = False
+        self._started = False
+        self._error: Optional[BaseException] = None
+        self._t_first_submit: Optional[float] = None
+        self._t_last_done: Optional[float] = None
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self._replicas)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def warm(self, template: Request) -> None:
+        """Compile every replica's chunk program OUTSIDE the measured
+        window: one 1-step burst per replica at the fleet's fixed
+        ``pool_cap`` — the exact (B, K, N) geometry every later
+        micro-burst dispatches, so a measured run can never compile.
+        ``template`` supplies valid request fields (z for conditional
+        models); its strokes are discarded."""
+        import jax
+
+        for rep in self._replicas:
+            clone = dataclasses.replace(
+                template, uid=None, max_len=1, cls=None, queue_pos=None,
+                enqueue_ts=None)
+            with jax.default_device(rep.device):
+                rep.engine.run([clone], pool_pad=self.pool_cap)
+
+    def start(self) -> "ServeFleet":
+        if self._started:
+            return self
+        self._started = True
+        with _LIVE_LOCK:
+            _LIVE.add(self)
+        for rep in self._replicas:
+            rep.thread = threading.Thread(
+                target=self._worker, args=(rep,),
+                name=f"fleet-replica-{rep.idx}", daemon=True)
+            rep.thread.start()
+        return self
+
+    def reset(self) -> None:
+        """Clear results/shed/admission state between measurement arms
+        (the compiled replica engines are the expensive part and are
+        kept). Only legal while idle — no queued or in-flight work."""
+        with self._lock:
+            if any(rep.pending() for rep in self._replicas):
+                raise RuntimeError("reset with queued work")
+            if len(self._results) + len(self._shed) < self._submitted:
+                raise RuntimeError("reset with requests in flight")
+            self._admission = AdmissionController(
+                self.classes, n_replicas=self.n_replicas,
+                slots=self.slots, queue_cap=self._admission.queue_cap,
+                shed_margin=self._admission.shed_margin)
+            self._next_uid = 0
+            self._seen_uids = set()
+            self._submitted = 0
+            self._shed = []
+            self._results = {}
+            self._t_first_submit = None
+            self._t_last_done = None
+            for rep in self._replicas:
+                rep.completed = rep.bursts = rep.chunks = 0
+                rep.device_steps = 0
+                rep.live_slot_steps = 0.0
+
+    def close(self) -> None:
+        """Stop the workers (any queued-but-unstarted work is
+        abandoned) and unregister."""
+        with self._lock:
+            self._stop = True
+            for rep in self._replicas:
+                rep.cond.notify_all()
+            self._done_cv.notify_all()
+        for rep in self._replicas:
+            if rep.thread is not None:
+                rep.thread.join(timeout=30)
+        with _LIVE_LOCK:
+            _LIVE.discard(self)
+
+    def __enter__(self) -> "ServeFleet":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
+
+    def __repr__(self) -> str:
+        return (f"ServeFleet({self.n_replicas} replicas x "
+                f"B{self.slots}/K{self.chunk}, pool {self.pool_cap}, "
+                f"{'running' if self._started and not self._stop else 'idle'})")
+
+    # -- the scheduler -----------------------------------------------------
+
+    def submit(self, req: Request, cls: Optional[str] = None,
+               force: bool = False) -> bool:
+        """Admit one request: route to the least-loaded replica queue or
+        shed. Returns True iff admitted. Thread-safe (the load
+        generator calls this from its replay thread). ``force`` skips
+        the shed checks (same placement — the bench's parity/capacity
+        arms must complete every request)."""
+        cls_name = cls or req.cls or self._default_class
+        if cls_name is None:
+            raise ValueError(
+                f"request needs an admission class (configured: "
+                f"{sorted(self.classes)})")
+        tel = get_telemetry()
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("fleet is closed")
+            if self._error is not None:
+                raise RuntimeError("fleet worker failed") from self._error
+            if req.uid is None:
+                req.uid = self._next_uid
+            if req.uid in self._seen_uids:
+                # a duplicate would overwrite its twin's result record
+                # and wedge drain() (done can never reach submitted) —
+                # fail loudly at the door instead
+                raise ValueError(f"duplicate request uid {req.uid}")
+            self._seen_uids.add(req.uid)
+            self._next_uid = max(self._next_uid, req.uid + 1)
+            req.cls = cls_name
+            if req.enqueue_ts is None:
+                req.enqueue_ts = time.perf_counter()
+            if self._t_first_submit is None:
+                self._t_first_submit = req.enqueue_ts
+            self._submitted += 1
+            decision = self._admission.place(cls_name, force=force)
+            if decision.shed:
+                self._shed.append({"uid": req.uid, "class": cls_name,
+                                   "reason": decision.shed_reason,
+                                   "est_wait_s": decision.est_wait_s})
+                if tel.enabled:
+                    # renders as ..._requests_shed_total on /metrics
+                    # (the exposition layer appends _total to counters)
+                    tel.counter("requests_shed", 1.0, cat="serve")
+                    tel.counter(class_series("requests_shed", cls_name),
+                                1.0, cat="serve")
+                self._done_cv.notify_all()
+                return False
+            req.queue_pos = decision.queue_pos
+            rep = self._replicas[decision.replica]
+            rep.queues[cls_name].append(req)
+            if tel.enabled:
+                tel.counter("requests_admitted", 1.0, cat="serve")
+            rep.cond.notify()
+            return True
+
+    def _worker(self, rep: _Replica) -> None:
+        """One replica's drain loop: wait for queued work, pop a
+        micro-burst in class-priority order, serve it to completion on
+        this replica's device, book the completions."""
+        import jax
+
+        while True:
+            with self._lock:
+                while not rep.pending() and not self._stop:
+                    rep.cond.wait()
+                if self._stop:
+                    return
+                batch = rep.pop_batch(self.pool_cap)
+            try:
+                with jax.default_device(rep.device):
+                    out = rep.engine.run(batch, pool_pad=self.pool_cap)
+            except BaseException as e:  # noqa: BLE001
+                with self._lock:
+                    self._error = e
+                    self._stop = True
+                    for other in self._replicas:
+                        other.cond.notify_all()
+                    self._done_cv.notify_all()
+                return
+            now = time.perf_counter()
+            m = out["metrics"]
+            with self._lock:
+                for res in out["results"]:
+                    rec = {"result": res, "replica": rep.idx}
+                    for r in batch:
+                        if r.uid == res.uid:
+                            rec["class"] = r.cls
+                            rec["queue_pos"] = r.queue_pos
+                            break
+                    self._results[res.uid] = rec
+                    self._admission.note_done(rep.idx, res.decode_s)
+                    if self._slo is not None:
+                        # class-keyed endpoints: a fleet SLO names the
+                        # admission class it judges
+                        self._slo.observe(rec.get("class") or
+                                          DEFAULT_CLASS, {
+                            "queue_wait_s": res.queue_wait_s,
+                            "decode_s": res.decode_s,
+                            "latency_s": res.latency_s})
+                rep.completed += m["completed"]
+                rep.bursts += 1
+                rep.chunks += m["chunks"]
+                rep.device_steps += m["device_steps"]
+                rep.live_slot_steps += (m["slot_utilization"]
+                                        * m["chunks"] * self.chunk
+                                        * self.slots)
+                self._t_last_done = now
+                self._done_cv.notify_all()
+
+    # -- completion & reporting --------------------------------------------
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted request completed or shed;
+        False on timeout. Re-raises a worker failure, and raises if the
+        fleet is closed out from under the drain (close() abandons
+        queued work, so the remainder can never complete)."""
+        deadline = None if timeout is None else \
+            time.perf_counter() + timeout
+        with self._lock:
+            while True:
+                if self._error is not None:
+                    raise RuntimeError(
+                        "fleet worker failed") from self._error
+                done = len(self._results) + len(self._shed)
+                if done >= self._submitted:
+                    return True
+                if self._stop:
+                    raise RuntimeError(
+                        f"fleet closed while draining "
+                        f"({self._submitted - done} requests abandoned)")
+                if deadline is not None:
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        return False
+                    self._done_cv.wait(left)
+                else:
+                    self._done_cv.wait()
+
+    @property
+    def results(self) -> Dict[int, Dict]:
+        """uid -> {result, replica, class, queue_pos} for every
+        completed request."""
+        with self._lock:
+            return dict(self._results)
+
+    @property
+    def shed(self) -> List[Dict]:
+        with self._lock:
+            return list(self._shed)
+
+    def summary(self) -> Dict[str, Any]:
+        """Fleet-level aggregate: throughput, per-class latency
+        percentiles, shed accounting, per-replica occupancy and the
+        deterministic critical-path device-step count (the CPU-smoke
+        scaling signal — see scripts/serve_bench.py)."""
+        with self._lock:
+            recs = list(self._results.values())
+            shed = list(self._shed)
+            submitted = self._submitted
+            reps = [(r.idx, r.completed, r.bursts, r.chunks,
+                     r.device_steps, r.live_slot_steps)
+                    for r in self._replicas]
+            t0, t1 = self._t_first_submit, self._t_last_done
+        wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
+        by_class: Dict[str, List[float]] = {}
+        for rec in recs:
+            by_class.setdefault(rec.get("class") or DEFAULT_CLASS,
+                                []).append(rec["result"].latency_s)
+        lat_all = [rec["result"].latency_s for rec in recs]
+
+        def pct(xs: List[float]) -> Dict[str, Optional[float]]:
+            if not xs:
+                # zero completions (everything shed) must read as "no
+                # data", never as a perfect 0ms p99
+                return {"p50_s": None, "p95_s": None, "p99_s": None,
+                        "mean_s": None}
+            a = np.asarray(xs)
+            return {"p50_s": round(float(np.percentile(a, 50)), 6),
+                    "p95_s": round(float(np.percentile(a, 95)), 6),
+                    "p99_s": round(float(np.percentile(a, 99)), 6),
+                    "mean_s": round(float(a.mean()), 6)}
+
+        shed_by_class: Dict[str, int] = {}
+        for s in shed:
+            shed_by_class[s["class"]] = shed_by_class.get(s["class"],
+                                                          0) + 1
+        per_replica = [{
+            "replica": idx, "completed": comp, "bursts": bursts,
+            "chunks": chunks, "device_steps": steps,
+            "slot_utilization": round(
+                live / max(chunks * self.chunk * self.slots, 1), 4),
+        } for idx, comp, bursts, chunks, steps, live in reps]
+        return {
+            "replicas": self.n_replicas,
+            "slots": self.slots,
+            "chunk": self.chunk,
+            "pool_cap": self.pool_cap,
+            "submitted": submitted,
+            "completed": len(recs),
+            "shed": len(shed),
+            "shed_frac": round(len(shed) / submitted, 4) if submitted
+            else 0.0,
+            "shed_by_class": shed_by_class,
+            "wall_s": round(wall, 6),
+            "sketches_per_sec": round(len(recs) / wall, 3) if wall
+            else 0.0,
+            "latency": pct(lat_all),
+            "latency_by_class": {c: {**pct(v), "completed": len(v)}
+                                 for c, v in sorted(by_class.items())},
+            "per_replica": per_replica,
+            # the fleet's critical path in DEVICE STEPS: max over
+            # replicas — deterministic for a closed burst, and the
+            # scheduling-math scaling signal on boxes whose wall clock
+            # cannot show parallelism (see serve_bench.py)
+            "critical_path_device_steps": max(
+                (r["device_steps"] for r in per_replica), default=0),
+            "total_device_steps": sum(r["device_steps"]
+                                      for r in per_replica),
+            "admission": self._admission.summary(),
+        }
+
+
+def live_fleets() -> tuple:
+    with _LIVE_LOCK:
+        return tuple(_LIVE)
+
+
+def stop_all() -> tuple:
+    """Close every live fleet; returns their reprs (the conftest guard
+    asserts this is empty — a non-empty return names the leaker)."""
+    leaked = live_fleets()
+    names = tuple(repr(f) for f in leaked)
+    for f in leaked:
+        f.close()
+    return names

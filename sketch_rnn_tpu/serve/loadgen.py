@@ -1,0 +1,155 @@
+"""Deterministic open-loop Poisson load generator.
+
+ISSUE 9 tentpole piece: a serving benchmark that feeds the next request
+only after the previous one completes (closed-loop) lets a slow server
+slow down its own load and report flattering latencies — the
+coordinated-omission trap. This generator is **open-loop**: the arrival
+schedule is drawn ONCE from a seeded Poisson process (exponential
+inter-arrivals at ``rate_hz``) and replayed against the fleet's
+``submit`` regardless of completions, so offered load is a property of
+the benchmark, not of the server's health — the precondition for an
+honest latency-vs-offered-load curve (the Gemma-on-TPU serving
+comparison in PAPERS.md is the reporting template).
+
+Determinism: :func:`poisson_arrivals` is a pure function of
+``(n, rate_hz, seed)``, so two runs at the same offered load submit the
+same requests at the same scheduled instants; what varies is only the
+wall-clock jitter of the replay thread, which the generator measures
+(``max_lag_s``) rather than hides. ``rate_hz <= 0`` degenerates to the
+closed-burst schedule (every request at t=0) — the capacity-measurement
+arm.
+
+Every started generator registers process-wide so the tier-1 conftest
+guard can prove no test leaks a replay thread (:func:`stop_all`, the
+serve/metrics_http.py discipline).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+# every live generator, for the conftest no-stray-threads guard
+_LIVE: set = set()
+_LIVE_LOCK = threading.Lock()
+
+
+def poisson_arrivals(n: int, rate_hz: float, seed: int) -> np.ndarray:
+    """Cumulative arrival offsets (seconds) for ``n`` requests.
+
+    Exponential inter-arrivals at ``rate_hz`` (a Poisson process),
+    deterministic in ``(n, rate_hz, seed)``. ``rate_hz <= 0`` means a
+    closed burst: every request arrives at t=0.
+    """
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    if rate_hz <= 0:
+        return np.zeros((n,), np.float64)
+    gaps = np.random.default_rng(seed).exponential(1.0 / rate_hz, size=n)
+    return np.cumsum(gaps)
+
+
+class OpenLoopLoadGen:
+    """Replay an arrival schedule against ``submit(i)`` on its own thread.
+
+    ``arrivals`` are cumulative offsets from :func:`poisson_arrivals`
+    (or any schedule); ``submit`` is called with the request INDEX —
+    the caller closes over its request list, so the generator never
+    touches request objects. Open-loop: the thread sleeps to each
+    scheduled instant and never waits on completions; if the host
+    stalls past an arrival the request fires immediately and the
+    shortfall is recorded in ``max_lag_s`` (honesty over smoothing).
+    """
+
+    def __init__(self, arrivals: Sequence[float],
+                 submit: Callable[[int], object],
+                 name: str = "loadgen"):
+        self.arrivals = np.asarray(arrivals, np.float64)
+        if len(self.arrivals) and np.any(np.diff(self.arrivals) < 0):
+            raise ValueError("arrivals must be non-decreasing")
+        self._submit = submit
+        self.name = name
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.submitted = 0
+        self.max_lag_s = 0.0
+        self.started_ts: Optional[float] = None
+
+    def _run(self) -> None:
+        t0 = self.started_ts
+        try:
+            for i, at in enumerate(self.arrivals):
+                while True:
+                    lag = (time.perf_counter() - t0) - at
+                    if lag >= 0:
+                        break
+                    if self._stop.wait(min(-lag, 0.05)):
+                        return
+                if self._stop.is_set():
+                    return
+                self.max_lag_s = max(self.max_lag_s, lag)
+                self._submit(i)
+                self.submitted += 1
+        finally:
+            with _LIVE_LOCK:
+                _LIVE.discard(self)
+
+    def start(self) -> "OpenLoopLoadGen":
+        if self._thread is not None:
+            raise RuntimeError("load generator already started")
+        self.started_ts = time.perf_counter()
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        with _LIVE_LOCK:
+            _LIVE.add(self)
+        self._thread.start()
+        return self
+
+    @property
+    def done(self) -> bool:
+        return self._thread is not None and not self._thread.is_alive()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the schedule to finish replaying; True when done."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def stop(self) -> None:
+        """Abandon any un-submitted arrivals and join the thread."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        with _LIVE_LOCK:
+            _LIVE.discard(self)
+
+    def __enter__(self) -> "OpenLoopLoadGen":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()
+
+    def __repr__(self) -> str:
+        state = ("idle" if self._thread is None
+                 else "done" if self.done else "replaying")
+        return (f"OpenLoopLoadGen({self.name}: {self.submitted}/"
+                f"{len(self.arrivals)} {state})")
+
+
+def live_generators() -> Tuple["OpenLoopLoadGen", ...]:
+    with _LIVE_LOCK:
+        return tuple(_LIVE)
+
+
+def stop_all() -> Tuple[str, ...]:
+    """Stop every live generator; returns their reprs (the conftest
+    guard asserts this is empty — a non-empty return names the leaker)."""
+    leaked = live_generators()
+    names = tuple(repr(g) for g in leaked)
+    for g in leaked:
+        g.stop()
+    return names

@@ -42,8 +42,12 @@ class MetricsWriter:
             self._jsonl_path = os.path.join(workdir, f"{name}_metrics.jsonl")
 
     def write(self, step: int, scalars: Dict[str, float]) -> None:
+        # strings pass through (serve rows carry admission-class names,
+        # ISSUE 9); everything else must coerce to float — the train
+        # path stays strictly numeric (what the watchdog consumes)
         row = {"step": int(step), "wall_time": time.time()}
-        row.update({k: float(v) for k, v in sorted(scalars.items())})
+        row.update({k: (v if isinstance(v, str) else float(v))
+                    for k, v in sorted(scalars.items())})
         if self._jsonl_path:
             with open(self._jsonl_path, "a") as f:
                 f.write(json.dumps(row) + "\n")

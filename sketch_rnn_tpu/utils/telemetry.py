@@ -70,6 +70,7 @@ import contextlib
 import json
 import math
 import os
+import re
 import threading
 import time
 from collections import deque
@@ -77,6 +78,46 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 TELEMETRY_JSONL = "telemetry.jsonl"
 CHROME_TRACE = "trace.json"
+
+# -- series naming (ISSUE 9) -------------------------------------------------
+#
+# The serving fleet multiplexes one telemetry core across R replica
+# engines and C admission classes; per-replica gauges and per-class
+# histograms are DISTINCT series, keyed by name suffix. The naming
+# contract lives here (one copy) so the emitters (serve/engine.py,
+# serve/fleet.py) and the readers (scripts/trace_report.py, /metrics
+# consumers) can never drift: `slots_live_r03` is replica 3's
+# occupancy gauge, `latency_s_interactive` is the `interactive`
+# class's latency histogram.
+
+_SERIES_SAFE = re.compile(r"[^A-Za-z0-9_]")
+
+
+def replica_series(name: str, replica: Optional[int] = None) -> str:
+    """Per-replica series name: ``slots_live`` -> ``slots_live_r03``.
+
+    ``replica=None`` returns ``name`` unchanged (the single-engine
+    series keep their legacy names — committed traces stay readable)."""
+    if replica is None:
+        return name
+    return f"{name}_r{int(replica):02d}"
+
+
+def replica_of_series(name: str, base: str) -> Optional[int]:
+    """Inverse of :func:`replica_series`: the replica index encoded in
+    ``name`` (None when ``name`` is not a per-replica series of
+    ``base``)."""
+    m = re.match(re.escape(base) + r"_r(\d+)$", name)
+    return int(m.group(1)) if m else None
+
+
+def class_series(name: str, cls: Optional[str] = None) -> str:
+    """Per-admission-class series name: ``latency_s`` ->
+    ``latency_s_interactive`` (class sanitized to Prometheus-legal
+    chars; ``None``/empty keeps the aggregate series name)."""
+    if not cls:
+        return name
+    return f"{name}_{_SERIES_SAFE.sub('_', str(cls))}"
 
 
 def shard_suffix(process_index: int, host_count: int) -> str:

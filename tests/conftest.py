@@ -75,6 +75,25 @@ def _no_stray_health_surfaces():
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_fleet_threads():
+    """ISSUE 9 guard: fleet replica workers and open-loop load
+    generators run on their own threads and register process-wide
+    (serve/fleet.py and serve/loadgen.py registries) — a leaked worker
+    keeps dispatching into whatever device/telemetry state later tests
+    set up, exactly like a leaked metrics server. Leaks are drained AND
+    failed loudly, naming the leaker."""
+    yield
+    from sketch_rnn_tpu.serve import fleet, loadgen
+
+    leaked_gens = loadgen.stop_all()
+    leaked_fleets = fleet.stop_all()
+    assert not leaked_gens, (
+        f"test leaked live load generators: {leaked_gens}")
+    assert not leaked_fleets, (
+        f"test leaked live serve fleets: {leaked_fleets}")
+
+
+@pytest.fixture(autouse=True)
 def _hermetic_bench_history(tmp_path, monkeypatch):
     """Tests must never append to the repo's COMMITTED bench history
     files — the r5 review found test-suite smoke rows accumulated in

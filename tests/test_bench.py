@@ -520,3 +520,39 @@ def test_bench_train_rejects_non_divisible_steps():
         bench.bench_train("layer_norm", steps=10, batch_per_chip=64,
                           seq_len=16, dtype="float32", remat=False,
                           prefetch_depth=0, steps_per_call=0)
+
+
+def test_bench_summary_fleet_rows(tmp_path, capsys):
+    """ISSUE 9 satellite: serve_fleet rows key per (replicas, offered
+    rate) cell and print the offered-load column, per-class p99, shed
+    fraction and — on capacity rows — the scaling efficiency +
+    deterministic step-parallel speedup."""
+    from scripts import bench_summary
+
+    hist = tmp_path / "h.jsonl"
+    base = {"kind": "serve_fleet", "dec_model": "lstm", "slots": 32,
+            "chunk": 8, "n_requests": 512, "len_dist": "bimodal",
+            "device_kind": "cpu"}
+    cap2 = {**base, "replicas": 2, "offered_rate": 0.0,
+            "sketches_per_sec": 367.1, "shed_frac": 0.0,
+            "scaling": 0.711, "step_parallel": 1.971,
+            "by_class": {"interactive": {"p99_s": 0.61},
+                         "batch": {"p99_s": 1.43}}}
+    load2 = {**base, "replicas": 2, "offered_rate": 300.0,
+             "sketches_per_sec": 204.2, "shed_frac": 0.113,
+             "by_class": {"interactive": {"p99_s": 0.42},
+                          "batch": {"p99_s": 0.61}}}
+    cap1 = {**base, "replicas": 1, "offered_rate": 0.0,
+            "sketches_per_sec": 258.1, "shed_frac": 0.0,
+            "scaling": 1.0, "step_parallel": 1.0, "by_class": {}}
+    _write_hist(hist, [cap2, load2, cap1])
+    assert bench_summary.main([str(hist)]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.strip()]
+    assert len(lines) == 3  # three distinct (R, rate) cells
+    c2 = next(l for l in lines if "R=2 rate=0" in l)
+    assert "367.10 sk/s" in c2
+    assert "scaling=0.711" in c2 and "steps||=1.971x" in c2
+    assert "interactive=610" in c2 and "batch=1430" in c2
+    l2 = next(l for l in lines if "R=2 rate=300" in l)
+    assert "shed=11.3%" in l2 and "scaling=" not in l2

@@ -173,3 +173,30 @@ def test_smoke_streamed_log_with_echo_lines_tolerated(tmp_path, capsys):
     rep = json.loads(capsys.readouterr().out)
     assert len(rep["rows"]) == 2
     assert sorted(r["verdict"] for r in rep["rows"]) == ["ok", "record"]
+
+
+def test_fleet_rows_gate_per_replica_and_rate_cell(tmp_path, capsys):
+    """ISSUE 9 satellite: serve_fleet rows gate on their realized
+    sketches/sec, keyed by (replicas, offered rate) — a fresh
+    2-replica regression fires against the 2-replica history while the
+    1-replica cell of the same round stays ok."""
+    base = {"kind": "serve_fleet", "dec_model": "lstm", "slots": 32,
+            "chunk": 8, "n_requests": 512, "len_dist": "bimodal",
+            "device_kind": "cpu", "offered_rate": 0.0}
+    hist = _write(tmp_path / "h.jsonl", [
+        {**base, "replicas": 2, "sketches_per_sec": v}
+        for v in (360.0, 380.0, 370.0)
+    ] + [
+        {**base, "replicas": 1, "sketches_per_sec": v}
+        for v in (250.0, 260.0, 255.0)
+    ])
+    fresh = _write(tmp_path / "f.jsonl", [
+        {**base, "replicas": 2, "sketches_per_sec": 150.0},
+        {**base, "replicas": 1, "sketches_per_sec": 252.0},
+    ])
+    assert bench_regress.main(
+        ["--fresh", fresh, "--history", hist, "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    by_r = {r["key"][2].split()[0]: r for r in rep["rows"]}
+    assert by_r["R=2"]["verdict"] == "REGRESS"
+    assert by_r["R=1"]["verdict"] == "ok"

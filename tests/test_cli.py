@@ -212,6 +212,62 @@ def test_cli_serve_bench_metrics_port_without_trace_dir(tmp_path,
     assert not tele.get_telemetry().enabled
 
 
+def test_cli_serve_bench_fleet(tmp_path, capsys):
+    """ISSUE 9: serve-bench --fleet serves the burst through R
+    device-pinned replica engines behind the SLA-aware scheduler; the
+    report carries the fleet summary (per-class percentiles, shed
+    accounting, per-replica occupancy), the per-request metrics rows
+    carry replica + class, and the trace renders a PER-REPLICA
+    occupancy timeline."""
+    wd = str(tmp_path / "serve_wd")
+    td = str(tmp_path / "serve_trace")
+    assert main(["serve-bench", "--random_init", "-n", "8",
+                 "--fleet", "2", "--rate", "500",
+                 "--classes", "interactive:p95<=10",
+                 "--classes", "batch:p99<=60",
+                 "--log_metrics", f"--workdir={wd}",
+                 f"--trace_dir={td}",
+                 f"--hparams={HP},serve_slots=2,serve_chunk=2"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["kind"] == "serve_bench_cli"
+    assert rep["completed"] == 8 and rep["requests_shed"] == 0
+    f = rep["fleet"]
+    assert f["replicas"] == 2 and f["offered_rate"] == 500.0
+    assert f["submitted"] == 8
+    assert set(f["latency_by_class"]) == {"interactive", "batch"}
+    assert len(f["per_replica"]) == 2
+    assert f["total_device_steps"] > 0
+    assert f["admission"]["admitted"] == 8
+    # per-request rows carry the admission metadata
+    with open(os.path.join(wd, "serve_metrics.jsonl")) as fh:
+        rows = [json.loads(line) for line in fh]
+    assert len(rows) == 8
+    assert {r["replica"] for r in rows} <= {0, 1}
+    assert {r["class"] for r in rows} == {"interactive", "batch"}
+    # the trace shows one occupancy timeline per replica
+    from scripts import trace_report
+    rr = trace_report.report(trace_report.load(td))
+    occ = rr["occupancy_replicas"]
+    assert [o["replica"] for o in occ] == [0, 1]
+    assert all(o["samples"] > 0 for o in occ)
+    # manifest extras record the fleet shape
+    from sketch_rnn_tpu.utils import runinfo
+    man = runinfo.read_manifest(td)
+    assert man["replicas"] == 2
+    assert man["offered_rate"] == 500.0
+
+
+def test_cli_serve_bench_fleet_usage_errors(tmp_path, capsys):
+    # --rate/--classes without --fleet: one line, before any compile
+    assert main(["serve-bench", "--random_init", "--rate", "100",
+                 f"--workdir={tmp_path}"]) == 2
+    assert "--fleet" in capsys.readouterr().err
+    # bad class spec fails fast like a bad --slo
+    assert main(["serve-bench", "--random_init", "--fleet", "2",
+                 "--classes", "nope", f"--workdir={tmp_path}"]) == 2
+    assert "SLO spec" in capsys.readouterr().err
+
+
 def test_graft_entry_compiles():
     import __graft_entry__ as ge
     fn, args = ge.entry()

@@ -1,0 +1,312 @@
+"""Fleet scheduler unit tests (ISSUE 9): admission classes, least-loaded
+placement, shed-on-overload, the open-loop load generator, and fleet
+lifecycle/shed behavior. The bitwise placement-invariance acceptance
+suite lives in tests/test_serve.py (it extends the engine invariance
+tests); everything here is the host-side scheduling layer, so most
+tests never touch jax.
+"""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.serve.admission import (
+    DEFAULT_CLASS,
+    AdmissionController,
+    parse_admission_classes,
+)
+from sketch_rnn_tpu.serve.loadgen import (
+    OpenLoopLoadGen,
+    live_generators,
+    poisson_arrivals,
+)
+
+
+# -- admission classes -------------------------------------------------------
+
+
+def test_parse_admission_classes_grammar_and_priority():
+    classes = parse_admission_classes(
+        ["interactive:p95<=250ms", "batch:latency_s:p99<=2"])
+    assert list(classes) == ["interactive", "batch"]
+    inter = classes["interactive"]
+    assert inter.deadline_s == 0.25 and inter.priority == 0
+    assert inter.slo.target == 0.95
+    assert classes["batch"].deadline_s == 2.0
+    assert classes["batch"].priority == 1
+
+
+def test_parse_admission_classes_default_and_errors():
+    classes = parse_admission_classes([])
+    assert list(classes) == [DEFAULT_CLASS]
+    assert math.isinf(classes[DEFAULT_CLASS].deadline_s)
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_admission_classes(["a:p95<=1", "a:p99<=2"])
+    with pytest.raises(ValueError, match="bad SLO"):
+        parse_admission_classes(["nope"])
+
+
+# -- the admission controller ------------------------------------------------
+
+
+def _controller(**kw):
+    classes = parse_admission_classes(
+        kw.pop("specs", ["interactive:p95<=0.5", "batch:p99<=10"]))
+    return AdmissionController(classes, **{
+        "n_replicas": 2, "slots": 4, **kw})
+
+
+def test_least_loaded_placement_is_deterministic():
+    c = _controller()
+    placements = [c.place("batch").replica for _ in range(6)]
+    # backlog-balanced, ties to the lowest index
+    assert placements == [0, 1, 0, 1, 0, 1]
+    assert c.backlog == [3, 3]
+    # a completion frees replica 1 -> next arrival routes there
+    c.note_done(1, decode_s=0.01)
+    assert c.place("batch").replica == 1
+
+
+def test_queue_pos_reports_requests_ahead():
+    c = _controller()
+    assert c.place("batch").queue_pos == 0
+    assert c.place("batch").queue_pos == 0  # other replica
+    assert c.place("batch").queue_pos == 1
+
+
+def test_hard_queue_cap_sheds():
+    c = _controller(queue_cap=2)
+    for _ in range(4):
+        assert not c.place("batch").shed
+    p = c.place("batch")
+    assert p.shed and p.shed_reason == "queue_full"
+    assert c.shed_total == 1 and c.shed["batch"] == 1
+    assert c.admitted == 4
+
+
+def test_deadline_shed_needs_service_estimate():
+    """A cold controller (no completions) must not shed on deadline —
+    only the hard cap can refuse before the estimate is calibrated."""
+    c = _controller()
+    for _ in range(50):
+        assert not c.place("interactive").shed
+    # calibrate: 0.2s per request at 4 slots -> est wait for backlog 25
+    # is 25 * 0.2 / 4 = 1.25s > the 0.5s interactive deadline
+    c.note_done(0, decode_s=0.2)
+    p = c.place("interactive")
+    assert p.shed and p.shed_reason == "deadline"
+    assert p.est_wait_s > 0.5
+    # the lax batch deadline (10s) still admits
+    assert not c.place("batch").shed
+
+
+def test_note_done_detects_desync():
+    c = _controller()
+    with pytest.raises(RuntimeError, match="desync"):
+        c.note_done(0, decode_s=0.1)
+
+
+def test_controller_summary_shape():
+    c = _controller()
+    c.place("batch")
+    s = c.summary()
+    assert s["admitted"] == 1 and s["shed_total"] == 0
+    assert s["classes"]["interactive"]["deadline_s"] == 0.5
+    assert s["classes"]["interactive"]["priority"] == 0
+
+
+# -- the open-loop load generator --------------------------------------------
+
+
+def test_poisson_arrivals_deterministic_and_rate():
+    a = poisson_arrivals(2000, 100.0, seed=7)
+    b = poisson_arrivals(2000, 100.0, seed=7)
+    np.testing.assert_array_equal(a, b)
+    assert np.all(np.diff(a) >= 0)
+    # mean inter-arrival ~ 1/rate
+    assert 0.8 / 100 < np.diff(a).mean() < 1.2 / 100
+    assert not np.array_equal(a, poisson_arrivals(2000, 100.0, seed=8))
+    # closed burst: everything at t=0
+    np.testing.assert_array_equal(poisson_arrivals(5, 0.0, seed=0),
+                                  np.zeros(5))
+
+
+def test_loadgen_replays_schedule_open_loop():
+    got = []
+    lock = threading.Lock()
+
+    def submit(i):
+        with lock:
+            got.append(i)
+        time.sleep(0.002)  # a "slow server" must not slow arrivals
+
+    gen = OpenLoopLoadGen(poisson_arrivals(40, 2000.0, seed=0), submit)
+    t0 = time.perf_counter()
+    gen.start()
+    assert gen.join(timeout=30)
+    wall = time.perf_counter() - t0
+    assert got == list(range(40))
+    assert gen.submitted == 40
+    # open-loop: 40 arrivals at 2000/s finish in ~20ms of schedule;
+    # even with the sleeping submit the replay is schedule-paced (plus
+    # submit time), nowhere near 40 * (sleep + gap) closed-loop pacing
+    assert wall < 5.0
+    assert gen.max_lag_s >= 0.0
+    assert gen not in live_generators()
+
+
+def test_loadgen_stop_abandons_remaining():
+    gen = OpenLoopLoadGen([0.0, 60.0], lambda i: None).start()
+    deadline = time.perf_counter() + 5
+    while gen.submitted < 1 and time.perf_counter() < deadline:
+        time.sleep(0.005)
+    gen.stop()
+    assert gen.submitted == 1
+    assert gen not in live_generators()
+
+
+def test_loadgen_rejects_unsorted_schedule():
+    with pytest.raises(ValueError, match="non-decreasing"):
+        OpenLoopLoadGen([1.0, 0.5], lambda i: None)
+
+
+# -- fleet lifecycle (one tiny jax model) ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_fleet_setup():
+    import jax
+
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+
+    hps = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+                  dec_rnn_size=16, z_size=6, num_mixture=3,
+                  serve_slots=2, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    return hps, model, params
+
+
+def _req(i, z_dim, cap=4):
+    import jax
+
+    rng = np.random.default_rng(i)
+    from sketch_rnn_tpu.serve import Request
+    return Request(key=jax.random.key(1000 + i),
+                   z=rng.standard_normal(z_dim).astype(np.float32),
+                   temperature=0.8, max_len=cap, uid=i)
+
+
+def test_fleet_sheds_on_queue_cap_and_counts(tiny_fleet_setup):
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    hps, model, params = tiny_fleet_setup
+    fleet = ServeFleet(model, hps, params, replicas=1, queue_cap=3)
+    tel = tele.configure(trace_dir=None)
+    try:
+        admitted = [fleet.submit(_req(i, hps.z_size)) for i in range(8)]
+        # workers not started: backlog only grows, cap must bite
+        assert admitted == [True] * 3 + [False] * 5
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        s = fleet.summary()
+        assert s["completed"] == 3 and s["shed"] == 5
+        assert s["shed_frac"] == round(5 / 8, 4)
+        assert s["shed_by_class"] == {DEFAULT_CLASS: 5}
+        assert {x["uid"] for x in fleet.shed} == {3, 4, 5, 6, 7}
+        counters = tel.counters()
+        assert counters[("serve", "requests_shed")] == 5
+        assert counters[("serve", "requests_shed_default")] == 5
+        assert counters[("serve", "requests_admitted")] == 3
+    finally:
+        fleet.close()
+        tele.disable()
+
+
+def test_fleet_reset_requires_idle_and_clears(tiny_fleet_setup):
+    from sketch_rnn_tpu.serve import ServeFleet
+
+    hps, model, params = tiny_fleet_setup
+    fleet = ServeFleet(model, hps, params, replicas=1)
+    try:
+        fleet.submit(_req(0, hps.z_size))
+        with pytest.raises(RuntimeError, match="queued work"):
+            fleet.reset()
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        assert fleet.summary()["completed"] == 1
+        fleet.reset()
+        s = fleet.summary()
+        assert s["completed"] == 0 and s["submitted"] == 0
+        assert s["total_device_steps"] == 0
+        # and it serves again after the reset
+        fleet.submit(_req(1, hps.z_size))
+        assert fleet.drain(timeout=120)
+        assert fleet.summary()["completed"] == 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_validation_errors(tiny_fleet_setup):
+    import jax
+
+    from sketch_rnn_tpu.serve import ServeFleet
+
+    hps, model, params = tiny_fleet_setup
+    with pytest.raises(ValueError, match="devices"):
+        ServeFleet(model, hps, params,
+                   replicas=len(jax.devices()) + 1)
+    fleet = ServeFleet(model, hps, params, replicas=1)
+    fleet.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        fleet.submit(_req(0, hps.z_size))
+
+
+def test_force_place_skips_shed_checks():
+    """The bench's parity/capacity arms submit with force=True: same
+    least-loaded placement, shed checks skipped — a calibrated
+    estimator or a full queue can never drop a request those arms must
+    complete."""
+    c = _controller(queue_cap=1)
+    assert not c.place("interactive").shed      # replica 0 fills
+    assert not c.place("interactive").shed      # replica 1 fills
+    assert c.place("interactive").shed          # cap bites normally
+    p = c.place("interactive", force=True)      # ...but not under force
+    assert not p.shed and p.replica in (0, 1)
+    c.note_done(0, decode_s=100.0)              # absurd service time
+    assert c.place("interactive").shed          # deadline sheds
+    assert not c.place("interactive", force=True).shed
+
+
+def test_fleet_rejects_duplicate_uids(tiny_fleet_setup):
+    """A duplicate uid would overwrite its twin's result record and
+    wedge drain() forever — refused at the door instead."""
+    from sketch_rnn_tpu.serve import ServeFleet
+
+    hps, model, params = tiny_fleet_setup
+    fleet = ServeFleet(model, hps, params, replicas=1)
+    try:
+        fleet.submit(_req(0, hps.z_size))
+        with pytest.raises(ValueError, match="duplicate request uid"):
+            fleet.submit(_req(0, hps.z_size))
+    finally:
+        fleet.close()
+
+
+def test_drain_raises_when_closed_underneath(tiny_fleet_setup):
+    """close() abandons queued work; a concurrent (or subsequent)
+    drain must fail loudly instead of waiting forever for requests
+    that can no longer complete."""
+    from sketch_rnn_tpu.serve import ServeFleet
+
+    hps, model, params = tiny_fleet_setup
+    fleet = ServeFleet(model, hps, params, replicas=1)
+    fleet.submit(_req(0, hps.z_size))   # queued, workers never started
+    fleet.close()
+    with pytest.raises(RuntimeError, match="closed while draining"):
+        fleet.drain(timeout=5)

@@ -183,6 +183,136 @@ def test_metrics_writer_rows(cond_setup, tmp_path):
             "latency_s"} <= set(lines[0])
 
 
+def test_pool_pad_is_bitwise_invisible(cond_setup):
+    """Fleet micro-bursts pad the request pool to a fixed size so every
+    burst shares one compiled program; pad rows are inert and must not
+    change any request's strokes (or be admitted as work)."""
+    hps, model, params, eng = cond_setup
+    reqs = [_req(i, hps.z_size, cap=3 + (5 * i) % 14) for i in range(5)]
+    ref = _by_uid(eng.run([_clone(r) for r in reqs]))
+    padded = _by_uid(eng.run([_clone(r) for r in reqs], pool_pad=12))
+    assert len(padded) == 5
+    for uid, r in ref.items():
+        np.testing.assert_array_equal(padded[uid].strokes5, r.strokes5)
+
+
+def test_enqueue_ts_backdates_latency_only(cond_setup):
+    """A fleet-stamped arrival instant moves the latency clock's zero,
+    never the strokes; unset, Results are bitwise as before (the
+    satellite's keep-Result-fields-unchanged contract)."""
+    import time
+
+    hps, model, params, eng = cond_setup
+    req = _req(0, hps.z_size, cap=8)
+    ref = eng.run([_clone(req)])["results"][0]
+    early = dataclasses.replace(_clone(req),
+                                enqueue_ts=time.perf_counter() - 5.0)
+    back = eng.run([early])["results"][0]
+    np.testing.assert_array_equal(back.strokes5, ref.strokes5)
+    assert back.queue_wait_s >= 5.0 and back.latency_s >= 5.0
+    assert ref.queue_wait_s < 5.0
+
+
+def test_placement_invariance_across_replicas_and_arrival_order():
+    """ISSUE 9 acceptance invariant, extending the solo/batch/
+    mid-flight suite: the same seeded request set produces
+    bitwise-identical strokes at 1, 2 and 4 fleet replicas and under
+    shuffled arrival order — replica placement is provably invisible
+    to outputs."""
+    from sketch_rnn_tpu.serve import ServeFleet
+
+    hps = tiny_hps(serve_slots=2, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    reqs = [_req(i, hps.z_size, cap=3 + (5 * i) % 9) for i in range(10)]
+    # reference: the plain single engine (no fleet, no pool padding)
+    eng = ServeEngine(model, hps, params)
+    ref = _by_uid(eng.run([dataclasses.replace(r, uid=i)
+                           for i, r in enumerate(reqs)]))
+
+    def run_fleet(replicas, order=None):
+        fleet = ServeFleet(model, hps, params, replicas=replicas)
+        try:
+            for i in (order if order is not None
+                      else range(len(reqs))):
+                fleet.submit(dataclasses.replace(reqs[i], uid=i))
+            fleet.start()
+            assert fleet.drain(timeout=120)
+            return fleet.results
+        finally:
+            fleet.close()
+
+    for replicas in (1, 2, 4):
+        got = run_fleet(replicas)
+        assert len(got) == len(reqs)
+        replicas_used = {rec["replica"] for rec in got.values()}
+        if replicas > 1:
+            assert len(replicas_used) > 1  # really spread across devices
+        for uid, r in ref.items():
+            np.testing.assert_array_equal(
+                got[uid]["result"].strokes5, r.strokes5,
+                err_msg=f"uid {uid} diverged at {replicas} replicas")
+    # shuffled arrival order on 2 replicas
+    order = list(range(len(reqs)))
+    np.random.default_rng(3).shuffle(order)
+    got = run_fleet(2, order=order)
+    for uid, r in ref.items():
+        np.testing.assert_array_equal(
+            got[uid]["result"].strokes5, r.strokes5,
+            err_msg=f"uid {uid} diverged under shuffled arrival")
+
+
+def test_complete_events_carry_admission_metadata():
+    """ISSUE 9 satellite: fleet-served requests' telemetry complete
+    events explain why they waited — class, fleet queue position,
+    replica id — and the per-replica occupancy gauges + per-class
+    latency histograms exist; Result latency fields stay the engine's
+    exact floats."""
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.serve.admission import parse_admission_classes
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    hps = tiny_hps(serve_slots=2, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    reqs = [_req(i, hps.z_size, cap=4) for i in range(6)]
+    classes = parse_admission_classes(["interactive:p95<=5",
+                                       "batch:p99<=30"])
+    fleet = ServeFleet(model, hps, params, replicas=2, classes=classes)
+    fleet.warm(reqs[0])
+    tel = tele.configure(trace_dir=None)
+    try:
+        for i, r in enumerate(reqs):
+            fleet.submit(dataclasses.replace(r, uid=i),
+                         cls=("interactive", "batch")[i % 2])
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        results = fleet.results
+        completes = [ev for ev in tel.events()
+                     if ev["type"] == "instant"
+                     and ev["name"] == "complete"]
+        assert len(completes) == 6
+        for ev in completes:
+            args = ev["args"]
+            assert args["class"] in ("interactive", "batch")
+            assert args["replica"] in (0, 1)
+            assert args["queue_pos"] >= 0
+            # the event's floats ARE the Result's floats
+            res = results[args["uid"]]["result"]
+            assert args["latency_s"] == res.latency_s
+            assert args["queue_wait_s"] == res.queue_wait_s
+        counters = tel.counters()
+        assert counters[("serve", "requests_admitted")] == 6
+        gauge_names = {name for cat, name in counters
+                       if cat == "serve" and name.startswith("slots_live")}
+        assert {"slots_live_r00", "slots_live_r01"} <= gauge_names
+        assert tel.histogram("latency_s_interactive",
+                             cat="serve")["count"] == 3
+    finally:
+        fleet.close()
+        tele.disable()
+
+
 @pytest.mark.parametrize("dec", ["layer_norm", "hyper"])
 def test_other_decoder_cells(dec):
     """The chunk program runs every decoder cell type (the carry pytree

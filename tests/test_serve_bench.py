@@ -36,6 +36,44 @@ def test_bimodal_lengths_max_4x_mean():
     assert 3.5 <= a.max() / a.mean() <= 4.5
 
 
+def test_serve_bench_fleet_end_to_end_small(tmp_path, capsys):
+    """A shrunken fleet sweep (ISSUE 9): curves land per
+    (replicas, rate) cell, the in-run parity block passes (bitwise
+    placement + arrival invariance), the deterministic step-parallel
+    speedup clears the scheduling-math bar at 2 replicas, and the
+    existing engine record in --out is PRESERVED (the fleet record
+    lands under its own key)."""
+    out = tmp_path / "SB.json"
+    out.write_text(json.dumps(
+        {"kind": "serve_bench", "engine_sketches_per_sec": 123.0}))
+    rc = serve_bench.main([
+        "--smoke", "--fleet", "--slots", "4", "--chunk", "2",
+        "--requests", "48", "--min_len", "2", "--max_len", "16",
+        "--replicas", "1,2", "--rates", "0,400", "--out", str(out)])
+    assert rc == 0
+    doc = json.load(open(out))
+    # the pre-existing engine record survived the merge
+    assert doc["kind"] == "serve_bench"
+    assert doc["engine_sketches_per_sec"] == 123.0
+    f = doc["fleet"]
+    assert f["kind"] == "serve_fleet" and f["smoke"] is True
+    cells = {(c["replicas"], c["offered_rate"]) for c in f["curves"]}
+    assert cells == {(1, 0.0), (1, 400.0), (2, 0.0), (2, 400.0)}
+    # the parity block ran and passed (a failure raises in-run)
+    assert f["parity"]["placement_invariant"] is True
+    assert f["parity"]["arrival_invariant"] is True
+    assert f["parity"]["replicas_checked"] == [2]
+    # the deterministic scheduling-math scaling signal: the fleet's
+    # critical path in device steps must drop ~2x at 2 replicas
+    # (least-loaded placement splits the skewed mix)
+    assert f["scaling"]["2"]["step_parallel"] >= 1.7
+    # per-class SLA surface present on every curve point
+    for c in f["curves"]:
+        assert {"interactive", "batch"} == set(c["by_class"])
+        assert c["latency_p50_s"] <= c["latency_p99_s"]
+    assert f["host_parallel_ceiling"] > 0
+
+
 @pytest.mark.parametrize("dist", ["power", "bimodal"])
 def test_serve_bench_end_to_end_small(tmp_path, capsys, dist):
     """A shrunken smoke run: both paths execute, the record is

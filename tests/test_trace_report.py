@@ -220,3 +220,31 @@ def test_serve_occupancy_timeline_present(served_trace):
     assert occ["samples"] == metrics["chunks"] - 1
     assert 0 < occ["mean"] <= 4
     assert len(occ["sparkline"]) == min(60, occ["samples"])
+
+
+def test_per_replica_occupancy_timelines(tmp_path, capsys):
+    """ISSUE 9 satellite: a fleet trace records one slots_live_rNN
+    gauge per replica engine and the report renders one timeline each,
+    ordered by replica index; the bare single-engine gauge never leaks
+    into the per-replica list (and vice versa)."""
+    d = str(tmp_path / "fleet_trace")
+    tel = tele.configure(trace_dir=d)
+    for i in range(6):
+        tel.gauge("slots_live_r01", 2 + (i % 2), cat="serve")
+        tel.gauge("slots_live_r00", 1 + (i % 3), cat="serve")
+    tel.gauge("slots_live", 3, cat="serve")   # a single-engine series
+    paths = tel.export()
+    tele.disable()
+    rep = trace_report.report(trace_report.load(paths["jsonl"]))
+    occ = rep["occupancy_replicas"]
+    assert [o["replica"] for o in occ] == [0, 1]
+    assert occ[0]["name"] == "slots_live_r00"
+    assert occ[0]["samples"] == 6 and occ[1]["samples"] == 6
+    assert occ[1]["max"] == 3.0
+    # the aggregate timeline still reports the bare series only
+    assert rep["occupancy"]["samples"] == 1
+    # and the human rendering prints one sparkline per replica
+    assert trace_report.main([paths["jsonl"]]) == 0
+    out = capsys.readouterr().out
+    assert "per replica" in out
+    assert "replica 0:" in out and "replica 1:" in out
