@@ -56,9 +56,13 @@ from scripts.bench_summary import iter_rows, key_of, metric_of  # noqa: E402
 
 # serve_fleet rows (ISSUE 9) key on replica count + offered rate via
 # bench_summary.key_of, so a 2-replica capacity record can only ever
-# gate a fresh 2-replica capacity row
+# gate a fresh 2-replica capacity row. resilience rows (ISSUE 10) carry
+# a binary ok metric (1.0 = the fault cell hit its expected recovery
+# outcome): with an all-1.0 history the cell's floor sits at
+# best * (1 - min_band) * (1 - slack) ≈ 0.855, so any future 0.0 —
+# a recovery path silently broken — gates as REGRESS
 GATED_KINDS = ("train", "sampler", "bucket_bench", "serve_bench",
-               "serve_fleet")
+               "serve_fleet", "resilience")
 
 
 def _usable(r: dict) -> bool:
@@ -71,12 +75,25 @@ def _usable(r: dict) -> bool:
     return metric_of(r) is not None
 
 
-def collect(paths: List[str]) -> Dict[Tuple, List[float]]:
-    """Per-cell metric series, in file/line order (history order)."""
+def _baseline_ok(r: dict) -> bool:
+    """Rows usable as a cell's BASELINE (the history side). A FAILED
+    resilience row (ok=false, metric 0.0) is evidence of damage, not a
+    baseline: pooling it would blow the cell's band to 1.0 (floor 0)
+    and permanently disable the gate for that cell — the one failure
+    mode a recovery gate must not have. Such rows still gate as FRESH
+    measurements."""
+    return not (r.get("kind") == "resilience" and not r.get("ok"))
+
+
+def collect(paths: List[str],
+            baseline: bool = False) -> Dict[Tuple, List[float]]:
+    """Per-cell metric series, in file/line order (history order).
+    ``baseline=True`` additionally drops rows unusable as a gate
+    baseline (:func:`_baseline_ok`)."""
     out: Dict[Tuple, List[float]] = {}
     for path in paths:
         for r in iter_rows(path):
-            if _usable(r):
+            if _usable(r) and (not baseline or _baseline_ok(r)):
                 out.setdefault(key_of(r), []).append(float(metric_of(r)))
     return out
 
@@ -127,15 +144,22 @@ def smoke_pairs(paths: List[str]
                 ) -> Tuple[Dict[Tuple, List[float]],
                            List[Tuple[Tuple, float]]]:
     """Self-check split: per cell, the LAST row is 'fresh', everything
-    before it is history. Cells left with fewer than ``judge``'s
+    before it is history (baseline-filtered — a committed failed
+    resilience row must still FAIL the self-check as fresh, never
+    soften the band as history). Cells left with fewer than ``judge``'s
     ``min_history`` prior rows come back 'thin'/'new' (advisory),
     never gated."""
-    series = collect(paths)
+    series: Dict[Tuple, List[Tuple[float, bool]]] = {}
+    for path in paths:
+        for r in iter_rows(path):
+            if _usable(r):
+                series.setdefault(key_of(r), []).append(
+                    (float(metric_of(r)), _baseline_ok(r)))
     hist: Dict[Tuple, List[float]] = {}
     fresh: List[Tuple[Tuple, float]] = []
     for key, values in series.items():
-        hist[key] = values[:-1]
-        fresh.append((key, values[-1]))
+        hist[key] = [v for v, ok in values[:-1] if ok]
+        fresh.append((key, values[-1][0]))
     return hist, fresh
 
 
@@ -212,7 +236,7 @@ def main(argv=None) -> int:
             p for p in (os.path.join(root, "BENCH_HISTORY.jsonl"),
                         os.path.join(root, "BENCH_SMOKE_HISTORY.jsonl"))
             if os.path.exists(p)]
-        hist = collect(hist_paths)
+        hist = collect(hist_paths, baseline=True)
         fresh = []
         for path in args.fresh:
             for r in iter_rows(path):

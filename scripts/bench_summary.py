@@ -59,6 +59,12 @@ def key_of(r: dict):
         return ("sampler", r.get("dec_model"),
                 f"B={r.get('batch_size')} full={bool(r.get('full_len'))} "
                 f"dev={dev}")
+    if r.get("kind") == "resilience":
+        # one cell per (fault site, injection mode): the in-process
+        # raise cell and the subprocess hard-kill cell of the same site
+        # are different measurements (ISSUE 10)
+        return ("resilience", r.get("site"),
+                f"mode={r.get('mode')} dev={dev}")
     # steps_per_call / transfer_dtype change what is being measured (feed
     # amortization), so K=5 rows must not pool with K=1 rows; old rows
     # predate the knobs and default to 1 / float32. `steps` keys too
@@ -84,6 +90,13 @@ def metric_of(r: dict):
         # the fleet's headline: realized sketches/sec at this cell's
         # (replicas, offered rate)
         return r.get("sketches_per_sec")
+    if r.get("kind") == "resilience":
+        # binary outcome metric: 1.0 = the cell hit its expected
+        # recovery outcome, 0.0 = it missed. Deterministic, so the
+        # regression gate's band math (best=1.0, floored band) flags
+        # ANY future miss as a REGRESS while repeat passes stay "ok".
+        ok = r.get("ok")
+        return None if ok is None else (1.0 if ok else 0.0)
     return r.get("strokes_per_sec_per_chip") or r.get("sketches_per_sec")
 
 
@@ -178,7 +191,8 @@ def main(argv=None) -> int:
             # strokes_per_sec_per_chip prints as a phantom train config
             # with None knobs
             if r.get("kind") not in ("train", "sampler", "bucket_bench",
-                                     "serve_bench", "serve_fleet"):
+                                     "serve_bench", "serve_fleet",
+                                     "resilience"):
                 continue
             v = metric_of(r)
             if v is None:
@@ -220,6 +234,16 @@ def main(argv=None) -> int:
                   f"best={metric_of(b):>11.2f} sk/s ({when}"
                   f"{_fleet_cols(b)})  "
                   f"latest={metric_of(l):>11.2f}")
+            continue
+        if k[0] == "resilience":
+            # fault-matrix cell: the latest outcome is the signal (ok
+            # is binary); recovery cost in DEVICE STEPS, never wall-
+            # clock (ISSUE 10)
+            cost = l.get("recovery_cost_steps")
+            cost_col = f" cost={cost} steps" if cost is not None else ""
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"latest={l.get('outcome'):>11s} "
+                  f"(expected {l.get('expected')}{cost_col})")
             continue
         extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""
         # records the bench itself flagged as never reaching 70% of the

@@ -31,6 +31,9 @@ def main(argv=None) -> int:
     ap.add_argument("--num_test", type=int, default=2500)
     ap.add_argument("--limit", type=int, default=None,
                     help="cap drawings read per file")
+    ap.add_argument("--skip_bad_records", action="store_true",
+                    help="skip corrupt ndjson lines (counted + warned) "
+                         "instead of failing the file on the first one")
     args = ap.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
     failed = []
@@ -41,7 +44,8 @@ def main(argv=None) -> int:
             sizes = convert_ndjson(path, dest, epsilon=args.epsilon,
                                    max_points=args.max_points,
                                    num_valid=args.num_valid,
-                                   num_test=args.num_test, limit=args.limit)
+                                   num_test=args.num_test, limit=args.limit,
+                                   skip_bad=args.skip_bad_records)
             print(f"[convert] {path} -> {dest} {sizes}")
         except Exception as e:  # noqa: BLE001 — report, keep converting
             print(f"[convert] FAILED {path}: {e}", file=sys.stderr)

@@ -1,0 +1,494 @@
+"""Resilience benchmark: the fault matrix + the crash-equivalence proof.
+
+ISSUE 10 tentpole piece 3. The repo's recovery paths — retry-with-
+backoff checkpoint commits, torn-save fallback, fleet failover,
+watchdog post-mortems, resume-from-latest — are only real if something
+EXERCISES them. This harness drives the deterministic fault injector
+(utils/faults.py) through a matrix of ``site x expected outcome`` cells
+and proves, per cell, that recovery happened the way the code claims:
+
+- ``train.step`` crash + resume ........ **recovered**: ``train()`` is
+  killed mid-run at an injected fault, resumed from the latest
+  checkpoint, and the final state must be LEAF-BITWISE equal to the
+  uninterrupted run's — exact, not approximate, because per-step RNG is
+  ``fold_in(key, step)`` and ``resume_align`` replays the identical
+  batch stream (the crash-equivalent resume contract).
+- ``ckpt.commit`` transient ............ **recovered**: the first
+  commit attempt fails, the bounded retry rewrites it, training never
+  notices; final state and checkpoint bytes equal the baseline's.
+- ``ckpt.torn`` mid-save ............... **recovered**: the commit
+  dies between the sidecar and msgpack renames; ``latest_checkpoint``
+  falls back to the previous COMPLETE checkpoint and resume completes
+  bitwise-equal.
+- ``ckpt.writer`` permanent ............ **clean-halt**: every write
+  fails; training stops loudly exactly one save cadence late (the
+  async contract), with no corrupt checkpoint left behind.
+- ``metrics.row`` NaN + watchdog ....... **clean-halt** with
+  attribution: the injected NaN row trips the watchdog, whose
+  ``incident.json`` must record the triggering fault site in its
+  evidence (the injection->detection loop).
+- ``fleet.worker`` replica death ....... **degraded**: a 2-replica
+  serve fleet loses replica 0 mid-burst; failover requeues its
+  requests, ``drain()`` completes, ``health()`` reports degraded, and
+  every completed request's strokes are BITWISE identical to the
+  no-fault fleet's (chaos parity).
+- ``train.step kind=exit`` (full mode) . **recovered**: the same
+  crash cell through a real SUBPROCESS ``cli train --fault_plan
+  train.step@S:kind=exit`` — ``os._exit``, no finally blocks, the
+  honest kill -9 — resumed by a second cli invocation; final
+  checkpoint bytes equal the uninterrupted subprocess run's.
+
+Recovery costs are DETERMINISTIC signals — device steps replayed
+(``lost_steps = halt_step - resumed_from``), retries used, requests
+requeued — never wall-clock: this box cannot show parallel/IO timing
+honestly (the measured no-CPU-parallelism ceiling, GOODPUT.json
+precedent), and step-count arithmetic is exact everywhere.
+
+Writes RESILIENCE.json (``--out``) and appends one ``kind:
+"resilience"`` history row per cell (smoke/CPU rows route to
+BENCH_SMOKE_HISTORY.jsonl), which ``scripts/bench_regress.py`` gates —
+a future PR that breaks a recovery path flips that cell's ``ok`` to
+false and the gate exits nonzero. ``--smoke`` (wired into tier-1) runs
+the in-process cells only; the default adds the subprocess hard-kill
+cell.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = 0
+LOADER_SEED = 1
+
+# the smoke config's hparam overrides, as BOTH a dict (in-process arms)
+# and the --hparams string the subprocess cell passes to the cli — one
+# definition so the two can never drift
+SMOKE_HPS = {
+    "conditional": False, "dec_model": "lstm", "dec_rnn_size": 32,
+    "enc_rnn_size": 32, "z_size": 8, "num_mixture": 2,
+    "batch_size": 8, "max_seq_len": 24,
+    "num_steps": 24, "save_every": 6, "log_every": 2,
+    "eval_every": 10 ** 9, "steps_per_call": 1, "eval_steps_per_call": 1,
+    "prefetch_depth": 2, "ckpt_retry_backoff_s": 0.0,
+    "serve_slots": 2, "serve_chunk": 2,
+}
+
+
+def smoke_hps():
+    from sketch_rnn_tpu.config import get_default_hparams
+
+    return get_default_hparams().replace(**SMOKE_HPS)
+
+
+def hps_cli_string() -> str:
+    return ",".join(f"{k}={str(v).lower() if isinstance(v, bool) else v}"
+                    for k, v in SMOKE_HPS.items())
+
+
+def _leaves(state):
+    import jax
+
+    return [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(jax.device_get(state))]
+
+
+def _bitwise(a, b) -> bool:
+    return (a is not None and b is not None and len(a) == len(b)
+            and all(np.array_equal(x, y) for x, y in zip(a, b)))
+
+
+def run_train(hps, workdir, plan=None, fault_seed=0, resume=False,
+              watchdog=False):
+    """One train() arm behind the injector: a FRESH identically-seeded
+    loader per arm (every arm replays the same corpus stream from 0 —
+    resume arms are re-aligned by the loop's ``resume_align``).
+    Returns ``(state_or_None, error_or_None, injector_summary)``."""
+    from sketch_rnn_tpu.data.loader import synthetic_loader
+    from sketch_rnn_tpu.train.loop import train
+    from sketch_rnn_tpu.utils import faults
+
+    loader, scale = synthetic_loader(hps, 3 * hps.batch_size,
+                                     seed=LOADER_SEED, augment=True)
+    inj = faults.configure(plan, seed=fault_seed) if plan else None
+    state, err = None, None
+    try:
+        state = train(hps, loader, valid_loader=None, scale_factor=scale,
+                      workdir=workdir, seed=SEED, use_mesh=False,
+                      resume=resume, watchdog=watchdog)
+    except BaseException as e:  # noqa: BLE001 — the matrix classifies it
+        err = e
+    finally:
+        summary = inj.summary() if inj is not None else None
+        faults.disable()
+    return state, err, summary
+
+
+def cell_crash_resume(hps, tmp, base_leaves, crash_at=15):
+    """Kill train() at an injected fault mid-run; resume; final state
+    must be leaf-bitwise equal to the uninterrupted run's."""
+    from sketch_rnn_tpu.train.checkpoint import latest_checkpoint
+    from sketch_rnn_tpu.utils.faults import InjectedFault
+
+    d = os.path.join(tmp, "crash")
+    _, err, summary = run_train(hps, d, plan=f"train.step@{crash_at}")
+    crashed = isinstance(err, InjectedFault)
+    resumed_from = latest_checkpoint(d) or 0
+    state, err2, _ = run_train(hps, d, resume=True)
+    equal = err2 is None and _bitwise(_leaves(state), base_leaves)
+    ok = crashed and equal and resumed_from > 0
+    return {
+        "site": "train.step", "plan": f"train.step@{crash_at}",
+        "mode": "raise", "expected": "recovered",
+        "outcome": "recovered" if ok else "FAILED",
+        "ok": ok, "crashed": crashed,
+        "crash_step": crash_at, "resumed_from_step": resumed_from,
+        # deterministic recovery cost: device steps re-executed
+        "lost_steps": crash_at - resumed_from,
+        "recovery_cost_steps": crash_at - resumed_from,
+        "final_state_bitwise_equal": equal,
+        "fired": summary["fired"] if summary else [],
+    }
+
+
+def cell_ckpt_transient(hps, tmp, base_leaves):
+    """First commit attempt fails; the bounded retry absorbs it —
+    training completes bitwise-identical to the baseline."""
+    d = os.path.join(tmp, "transient")
+    state, err, summary = run_train(hps, d, plan="ckpt.commit@0")
+    retried = bool(summary and summary["fired"])
+    equal = err is None and _bitwise(_leaves(state), base_leaves)
+    ok = retried and equal
+    return {
+        "site": "ckpt.commit", "plan": "ckpt.commit@0",
+        "mode": "raise", "expected": "recovered",
+        "outcome": "recovered" if ok else "FAILED",
+        "ok": ok, "error": repr(err) if err else None,
+        "retries_used": len(summary["fired"]) if summary else 0,
+        "recovery_cost_steps": 0,
+        "final_state_bitwise_equal": equal,
+    }
+
+
+def cell_ckpt_torn(hps, tmp, base_leaves):
+    """The commit dies between the sidecar and msgpack renames at the
+    SECOND save; resume must fall back to the previous complete
+    checkpoint and finish bitwise-equal."""
+    from sketch_rnn_tpu.train.checkpoint import latest_checkpoint
+
+    d = os.path.join(tmp, "torn")
+    # retries=0: the torn raise must propagate (a retry would absorb it
+    # — that case is cell_ckpt_transient's)
+    hps0 = hps.replace(ckpt_retries=0)
+    _, err, summary = run_train(hps0, d, plan="ckpt.torn@1")
+    # async contract: the stored writer failure surfaces at the NEXT
+    # save — one cadence after the torn one
+    halted = isinstance(err, RuntimeError) and "checkpoint" in str(err)
+    resumed_from = latest_checkpoint(d) or 0
+    torn_step = 2 * hps.save_every          # save #2 (0-based fired @1)
+    halt_step = 3 * hps.save_every          # surfaced one save late
+    state, err2, _ = run_train(hps, d, resume=True)
+    equal = err2 is None and _bitwise(_leaves(state), base_leaves)
+    ok = (halted and equal and resumed_from == hps.save_every)
+    return {
+        "site": "ckpt.torn", "plan": "ckpt.torn@1",
+        "mode": "raise", "expected": "recovered",
+        "outcome": "recovered" if ok else "FAILED",
+        "ok": ok, "halted_loudly": halted,
+        "error": repr(err) if err else None,
+        "torn_step": torn_step,
+        "resumed_from_step": resumed_from,
+        "lost_steps": halt_step - resumed_from,
+        "recovery_cost_steps": halt_step - resumed_from,
+        "final_state_bitwise_equal": equal,
+        "fired": summary["fired"] if summary else [],
+    }
+
+
+def cell_writer_permanent(hps, tmp):
+    """EVERY write fails: training must stop loudly, one save cadence
+    late (the async-checkpoint contract), leaving no corrupt state."""
+    from sketch_rnn_tpu.train.checkpoint import latest_checkpoint
+
+    d = os.path.join(tmp, "permanent")
+    _, err, summary = run_train(hps, d, plan="ckpt.writer:every=1")
+    # the async contract: the failed save #1 is stored, and surfaces
+    # when save #2 joins the writer — one cadence late, as a loud
+    # RuntimeError (the writer never reached a second invocation)
+    halted = isinstance(err, RuntimeError) and "checkpoint" in str(err)
+    fires = len(summary["fired"]) if summary else 0
+    # a permanent failure must never look like a checkpoint: the resume
+    # dir stays empty rather than holding a half-written state
+    no_ckpt = latest_checkpoint(d) is None
+    ok = halted and no_ckpt and fires >= 1
+    return {
+        "site": "ckpt.writer", "plan": "ckpt.writer:every=1",
+        "mode": "raise", "expected": "clean-halt",
+        "outcome": "clean-halt" if ok else "FAILED",
+        "ok": ok, "halted_loudly": halted,
+        "error": repr(err) if err else None,
+        "halted_one_save_late": halted and fires == 1,
+        "no_checkpoint_left": no_ckpt,
+        "recovery_cost_steps": None,
+    }
+
+
+def cell_watchdog_nan(hps, tmp):
+    """An injected NaN metrics row must trip the watchdog, whose
+    incident.json records the triggering fault site as evidence —
+    then training stops on the non-finite row (clean halt)."""
+    d = os.path.join(tmp, "nan")
+    _, err, summary = run_train(hps, d, plan="metrics.row@2:kind=nan",
+                                watchdog=True)
+    halted = isinstance(err, FloatingPointError)
+    inc_path = os.path.join(d, "incident.json")
+    attributed = False
+    if os.path.exists(inc_path):
+        with open(inc_path) as f:
+            inc = json.load(f)
+        attributed = any(f["site"] == "metrics.row"
+                         for f in (inc.get("faults") or {})
+                         .get("fired", []))
+    ok = halted and attributed
+    return {
+        "site": "metrics.row", "plan": "metrics.row@2:kind=nan",
+        "mode": "nan", "expected": "clean-halt",
+        "outcome": "clean-halt" if ok else "FAILED",
+        "ok": ok, "halted_loudly": halted,
+        "error": repr(err) if err else None,
+        "incident_written": os.path.exists(inc_path),
+        "fault_site_in_evidence": attributed,
+        "recovery_cost_steps": None,
+    }
+
+
+def cell_fleet_failover(hps, tmp, n_requests=6):
+    """Replica 0 dies mid-burst; failover must complete the drain on
+    the survivor with BITWISE-identical strokes (chaos parity) and a
+    degraded health verdict."""
+    import jax
+
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve.engine import Request
+    from sketch_rnn_tpu.serve.fleet import ServeFleet
+    from sketch_rnn_tpu.utils import faults
+
+    if len(jax.devices()) < 2:
+        return {"site": "fleet.worker", "expected": "degraded",
+                "outcome": "skipped", "ok": True,
+                "skipped": f"needs >= 2 devices, have "
+                           f"{len(jax.devices())}"}
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(SEED))
+    kreq = jax.random.key(123)
+
+    def make_requests():
+        return [Request(key=jax.random.fold_in(kreq, i), max_len=8,
+                        uid=i) for i in range(n_requests)]
+
+    def serve(plan):
+        if plan:
+            faults.configure(plan)
+        try:
+            fleet = ServeFleet(model, hps, params, replicas=2,
+                               slots=hps.serve_slots,
+                               chunk=hps.serve_chunk,
+                               retry_backoff_s=0.0)
+            for r in make_requests():
+                fleet.submit(r)     # pre-start: deterministic placement
+            with fleet:
+                fleet.drain(timeout=120)
+                results = fleet.results
+                summary = fleet.summary()
+                health = fleet.health()
+        finally:
+            faults.disable()
+        return results, summary, health
+
+    res0, sum0, health0 = serve(None)
+    res1, sum1, health1 = serve("fleet.worker.r0@0")
+    parity = (sorted(res0) == sorted(res1) == list(range(n_requests))
+              and all(np.array_equal(res0[u]["result"].strokes5,
+                                     res1[u]["result"].strokes5)
+                      for u in res0))
+    degraded = (not health1["healthy"]
+                and sum1["replicas_dead"] == 1
+                and health0["healthy"])
+    drained = sum1["completed"] == n_requests and sum1["failed"] == 0
+    ok = parity and degraded and drained
+    return {
+        "site": "fleet.worker", "plan": "fleet.worker.r0@0",
+        "mode": "raise", "expected": "degraded",
+        "outcome": "degraded" if ok else "FAILED",
+        "ok": ok, "completed": sum1["completed"],
+        "requeues": sum1["requeues"], "failed": sum1["failed"],
+        "replicas_dead": sum1["replicas_dead"],
+        "strokes_bitwise_equal": parity,
+        "healthz_degraded": degraded,
+        # deterministic cost: extra device steps the failover run spent
+        # vs the no-fault run (requeued pool re-dispatch)
+        "recovery_cost_device_steps":
+            sum1["total_device_steps"] - sum0["total_device_steps"],
+    }
+
+
+def cell_subprocess_kill(tmp, crash_at=15):
+    """The crash cell with a REAL kill: ``cli train --fault_plan
+    train.step@S:kind=exit`` hard-exits (os._exit — no finally blocks),
+    a second cli invocation resumes, and the final checkpoint bytes
+    must equal an uninterrupted subprocess run's."""
+    hp = hps_cli_string()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def cli(workdir, *extra):
+        cmd = [sys.executable, "-m", "sketch_rnn_tpu.cli", "train",
+               "--synthetic", f"--workdir={workdir}",
+               f"--hparams={hp}", f"--seed={SEED}", *extra]
+        return subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=600)
+
+    from sketch_rnn_tpu.train.checkpoint import _paths, latest_checkpoint
+    from sketch_rnn_tpu.utils.faults import EXIT_CODE
+
+    base_d = os.path.join(tmp, "sub_base")
+    crash_d = os.path.join(tmp, "sub_crash")
+    p_base = cli(base_d, "--no_resume")
+    p_crash = cli(crash_d, "--no_resume",
+                  f"--fault_plan=train.step@{crash_at}:kind=exit")
+    hard_killed = p_crash.returncode == EXIT_CODE
+    resumed_from = latest_checkpoint(crash_d) or 0
+    p_resume = cli(crash_d)   # resume from latest (the cli default)
+    final = latest_checkpoint(base_d)
+    equal = False
+    if p_base.returncode == 0 and p_resume.returncode == 0 and final:
+        a = open(_paths(base_d, final)[0], "rb").read()
+        b_path = _paths(crash_d, final)[0]
+        equal = os.path.exists(b_path) and a == open(b_path, "rb").read()
+    ok = (p_base.returncode == 0 and hard_killed
+          and p_resume.returncode == 0 and equal and resumed_from > 0)
+    return {
+        "site": "train.step", "plan": f"train.step@{crash_at}:kind=exit",
+        "mode": "subprocess-exit", "expected": "recovered",
+        "outcome": "recovered" if ok else "FAILED",
+        "ok": ok, "hard_killed": hard_killed,
+        "exit_code": p_crash.returncode,
+        "crash_step": crash_at, "resumed_from_step": resumed_from,
+        "lost_steps": crash_at - resumed_from,
+        "recovery_cost_steps": crash_at - resumed_from,
+        "final_ckpt_bytes_equal": equal,
+        "stderr_tail": ("" if ok else
+                        "\n".join((p_crash.stderr or "").splitlines()[-5:]
+                                  + (p_resume.stderr or "")
+                                  .splitlines()[-5:])),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fault matrix + crash-equivalence harness; exits "
+                    "nonzero when any cell misses its expected outcome")
+    ap.add_argument("--smoke", action="store_true",
+                    help="in-process cells only (tier-1 wiring); the "
+                         "default additionally runs the subprocess "
+                         "hard-kill cell")
+    ap.add_argument("--out", default="RESILIENCE.json",
+                    help="result JSON path ('' = stdout only)")
+    ap.add_argument("--workdir", default="",
+                    help="scratch dir (default: a fresh temp dir)")
+    args = ap.parse_args(argv)
+
+    # the fleet cell needs >= 2 devices; on a CPU box, virtualize them
+    # BEFORE jax imports (the tests' conftest does the same — under
+    # pytest jax is already imported and already 8-way)
+    if "jax" not in sys.modules:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if ("--xla_force_host_platform_device_count" not in flags
+                and os.environ["JAX_PLATFORMS"] == "cpu"):
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+
+    from scripts._measure import hist_append
+
+    hps = smoke_hps()
+    tmp = args.workdir or tempfile.mkdtemp(prefix="resilience_")
+
+    print("# baseline: the uninterrupted run", file=sys.stderr)
+    base_state, base_err, _ = run_train(hps, os.path.join(tmp, "base"))
+    if base_err is not None:
+        print(f"resilience_bench: baseline run failed: {base_err!r}",
+              file=sys.stderr)
+        return 1
+    base_leaves = _leaves(base_state)
+
+    cells = []
+    for name, fn in (
+            ("crash+resume", lambda: cell_crash_resume(hps, tmp,
+                                                       base_leaves)),
+            ("ckpt transient", lambda: cell_ckpt_transient(hps, tmp,
+                                                           base_leaves)),
+            ("ckpt torn", lambda: cell_ckpt_torn(hps, tmp, base_leaves)),
+            ("writer permanent", lambda: cell_writer_permanent(hps,
+                                                               tmp)),
+            ("watchdog nan", lambda: cell_watchdog_nan(hps, tmp)),
+            ("fleet failover", lambda: cell_fleet_failover(hps, tmp)),
+    ):
+        print(f"# cell: {name}", file=sys.stderr)
+        cells.append(fn())
+    if not args.smoke:
+        print("# cell: subprocess hard-kill (os._exit)", file=sys.stderr)
+        cells.append(cell_subprocess_kill(tmp))
+
+    device_kind = jax.devices()[0].device_kind
+    stamp = time.time()
+    for c in cells:
+        row = {"kind": "resilience", "smoke": bool(args.smoke),
+               "device_kind": device_kind, "wall_time": stamp,
+               "num_steps": hps.num_steps, "save_every": hps.save_every,
+               **{k: c.get(k) for k in
+                  ("site", "mode", "expected", "outcome", "ok",
+                   "recovery_cost_steps", "resumed_from_step",
+                   "lost_steps")}}
+        hist_append(row)
+        print(json.dumps(row))
+
+    rec = {
+        "kind": "resilience_bench",
+        "smoke": bool(args.smoke),
+        "device_kind": device_kind,
+        "n_chips": jax.device_count(),
+        "wall_time": stamp,
+        "config": dict(SMOKE_HPS),
+        "seed": SEED,
+        "cells": cells,
+        "all_ok": all(c["ok"] for c in cells),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=2)
+            f.write("\n")
+    print(json.dumps({"all_ok": rec["all_ok"],
+                      "cells": {c["site"]: c["outcome"] for c in cells}}))
+    if not rec["all_ok"]:
+        bad = [c for c in cells if not c["ok"]]
+        print(f"# RESILIENCE FAILURE: {len(bad)} cell(s) missed their "
+              f"expected outcome: "
+              f"{[(c['site'], c.get('error')) for c in bad]}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,11 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "> 5 so transfer_dtype=int16 works; 0 = legacy "
                         "float-natured corpus)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--skip_bad_records", action="store_true",
+                   help="skip corrupt .npz records instead of failing "
+                        "on the first one (counted in the "
+                        "records_skipped telemetry counter + one "
+                        "warning per file)")
 
 
 def _resolve_hps(args) -> HParams:
@@ -130,7 +135,9 @@ def _load_data(hps: HParams, args,
                                      integer_grid=grid)
         return train_l, valid_l, test_l, scale
     return load_dataset(lhps, scale_factor=scale_factor,
-                        host_id=host, num_hosts=nhosts)
+                        host_id=host, num_hosts=nhosts,
+                        skip_bad_records=getattr(args, "skip_bad_records",
+                                                 False))
 
 
 def _restore(hps: HParams, workdir: str):
@@ -142,37 +149,69 @@ def _restore(hps: HParams, workdir: str):
     return model, state, scale, meta
 
 
+def _arm_faults(args) -> int:
+    """Arm the process-wide fault injector from ``--fault_plan`` (a
+    chaos run, ISSUE 10). Returns an exit code: 0 = armed or no plan,
+    2 = bad spec (usage error, before any expensive work). The caller
+    owns the disarm (``faults.disable()`` in its finally)."""
+    plan = getattr(args, "fault_plan", "")
+    if not plan:
+        return 0
+    from sketch_rnn_tpu.utils import faults
+    try:
+        inj = faults.configure(plan, seed=getattr(args, "fault_seed", 0))
+    except ValueError as e:
+        print(f"[cli] bad --fault_plan: {e}", file=sys.stderr)
+        return 2
+    print(f"[faults] armed: {inj!r}", file=sys.stderr)
+    return 0
+
+
 def cmd_train(args) -> int:
     from sketch_rnn_tpu.parallel import multihost as mh
     from sketch_rnn_tpu.train import train
+    from sketch_rnn_tpu.utils import faults
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
-    if getattr(args, "bucket_edges", ""):
-        # convenience spelling of --hparams bucket_edges=...: accept
-        # comma OR semicolon separators (the hparam tuple syntax is ';')
-        hps = hps.parse(
-            f"bucket_edges={args.bucket_edges.replace(',', ';')}")
-    if getattr(args, "steps_per_call", 0):
-        # convenience spelling of --hparams steps_per_call=K; with
-        # --bucket_edges this turns on the bucket-run scheduler (stacked
-        # same-geometry dispatch, ISSUE 5)
-        hps = hps.replace(steps_per_call=args.steps_per_call)
-    if getattr(args, "sync_io", False):
-        # bisection/debugging escape hatch: force the fully synchronous
-        # loop (blocking saves, eager metric conversion) in one flag
-        # instead of two hparam overrides
-        hps = hps.replace(async_checkpoint=False, metrics_defer=False)
-    train_l, valid_l, test_l, scale = _load_data(hps, args)
-    print(f"[cli] host {mh.process_index()}/{mh.process_count()}: "
-          f"{len(train_l)} train / {len(valid_l)} valid sketches, "
-          f"scale={scale:.4f}, devices={jax.device_count()}", flush=True)
-    train(hps, train_l, valid_l, test_l, scale_factor=scale,
-          workdir=args.workdir, seed=args.seed,
-          resume=not getattr(args, "no_resume", False),
-          profile=getattr(args, "profile", False),
-          trace_dir=getattr(args, "trace_dir", "") or None,
-          watchdog=getattr(args, "watchdog", False),
-          halt_on_anomaly=getattr(args, "halt_on_anomaly", False))
+    rc = _arm_faults(args)
+    if rc:
+        return rc
+    # the injector is process-global; in-process callers (tests,
+    # drivers) must not inherit an armed plan from this run — the
+    # finally covers EVERYTHING after arming, so a setup failure (bad
+    # data_dir, bad --bucket_edges) can't leak the plan either
+    try:
+        if getattr(args, "bucket_edges", ""):
+            # convenience spelling of --hparams bucket_edges=...:
+            # accept comma OR semicolon separators (the hparam tuple
+            # syntax is ';')
+            hps = hps.parse(
+                f"bucket_edges={args.bucket_edges.replace(',', ';')}")
+        if getattr(args, "steps_per_call", 0):
+            # convenience spelling of --hparams steps_per_call=K; with
+            # --bucket_edges this turns on the bucket-run scheduler
+            # (stacked same-geometry dispatch, ISSUE 5)
+            hps = hps.replace(steps_per_call=args.steps_per_call)
+        if getattr(args, "sync_io", False):
+            # bisection/debugging escape hatch: force the fully
+            # synchronous loop (blocking saves, eager metric
+            # conversion) in one flag instead of two hparam overrides
+            hps = hps.replace(async_checkpoint=False,
+                              metrics_defer=False)
+        train_l, valid_l, test_l, scale = _load_data(hps, args)
+        print(f"[cli] host {mh.process_index()}/{mh.process_count()}: "
+              f"{len(train_l)} train / {len(valid_l)} valid sketches, "
+              f"scale={scale:.4f}, devices={jax.device_count()}",
+              flush=True)
+        train(hps, train_l, valid_l, test_l, scale_factor=scale,
+              workdir=args.workdir, seed=args.seed,
+              resume=not getattr(args, "no_resume", False),
+              profile=getattr(args, "profile", False),
+              trace_dir=getattr(args, "trace_dir", "") or None,
+              watchdog=getattr(args, "watchdog", False),
+              halt_on_anomaly=getattr(args, "halt_on_anomaly", False))
+    finally:
+        faults.disable()
     return 0
 
 
@@ -375,23 +414,30 @@ def cmd_serve_bench(args) -> int:
                   f"devices but only {len(jax.devices())} are "
                   f"available", file=sys.stderr)
             return 2
+    rc = _arm_faults(args)  # chaos runs: bad specs fail before binding
+    if rc:
+        return rc
+    from sketch_rnn_tpu.utils import faults
     server = None
-    if args.metrics_port is not None:
-        from sketch_rnn_tpu.serve.metrics_http import MetricsServer
-        try:
-            server = MetricsServer(port=args.metrics_port,
-                                   slo=slo_tracker).start()
-        except OSError as e:
-            print(f"[cli] cannot bind --metrics_port "
-                  f"{args.metrics_port}: {e}", file=sys.stderr)
-            return 2
-        print(f"[metrics] serving /metrics and /healthz on "
-              f"http://127.0.0.1:{server.port} (scrape while the "
-              f"bench runs, e.g. curl :{server.port}/metrics)",
-              file=sys.stderr)
+    # never leak an armed plan to in-process callers: the finally
+    # covers everything after arming, including a failed port bind
     try:
+        if args.metrics_port is not None:
+            from sketch_rnn_tpu.serve.metrics_http import MetricsServer
+            try:
+                server = MetricsServer(port=args.metrics_port,
+                                       slo=slo_tracker).start()
+            except OSError as e:
+                print(f"[cli] cannot bind --metrics_port "
+                      f"{args.metrics_port}: {e}", file=sys.stderr)
+                return 2
+            print(f"[metrics] serving /metrics and /healthz on "
+                  f"http://127.0.0.1:{server.port} (scrape while the "
+                  f"bench runs, e.g. curl :{server.port}/metrics)",
+                  file=sys.stderr)
         return _serve_bench_run(args, hps, slo_tracker, server)
     finally:
+        faults.disable()
         if server is not None:
             server.stop()
 
@@ -448,7 +494,7 @@ def _serve_telemetry_abort(trace_dir, tel, tele, mem_sampler) -> None:
 
 
 def _serve_bench_fleet(args, hps, model, state_params, requests,
-                       slo_tracker):
+                       slo_tracker, server=None):
     """The fleet measured section: build + warm the fleet, THEN enable
     telemetry (via the shared helper — the can't-recompile-into-the-
     window ordering), then replay the open-loop schedule and drain.
@@ -468,6 +514,10 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
                        replicas=args.fleet, slots=args.slots,
                        chunk=args.chunk, greedy=args.greedy,
                        classes=classes, slo=slo_tracker)
+    if server is not None:
+        # /healthz now answers from the LIVE fleet: a replica death
+        # mid-run flips the verdict to degraded (ISSUE 10)
+        server.health_source = fleet.health
     fleet.warm(requests[0])
     handles = _serve_telemetry_start(args)
     try:
@@ -553,7 +603,8 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
         # per admission class), so /healthz judges the classes the
         # operator declared.
         out_metrics, fleet_report, rows, handles = _serve_bench_fleet(
-            args, hps, model, state_params, requests, slo_tracker)
+            args, hps, model, state_params, requests, slo_tracker,
+            server=server)
         trace_dir, tel, tele, mem_sampler = handles
         slots_v, chunk_v = fleet_report["slots"], fleet_report["chunk"]
         if writer is not None:
@@ -731,6 +782,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint into <workdir>/incident/ — the "
                         "resume directory is never touched, so a "
                         "diverged state cannot wedge resume-from-latest")
+    p.add_argument("--fault_plan", default="",
+                   help="chaos run (utils/faults.py): arm deterministic "
+                        "fault injection, e.g. 'train.step@12:kind=exit' "
+                        "(hard-crash at step 12), 'ckpt.commit@1' "
+                        "(transient commit failure, retried), "
+                        "'metrics.row@3:kind=nan' (NaN a logged loss). "
+                        "Sites: train.step, ckpt.commit, ckpt.torn, "
+                        "ckpt.writer, data.batch, metrics.write, "
+                        "metrics.row. Off by default: no injection, "
+                        "bitwise-identical runs")
+    p.add_argument("--fault_seed", type=int, default=0,
+                   help="seed of the fault plan's deterministic "
+                        "p=... firing decisions")
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("eval", help="evaluate a checkpoint")
@@ -822,6 +886,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "'p95<=0.25' or 'generate:decode_s:p99<=100ms')"
                         "; compliance + error-budget burn rates land in "
                         "/metrics, /healthz and the summary JSON")
+    p.add_argument("--fault_plan", default="",
+                   help="chaos run (utils/faults.py): e.g. "
+                        "'fleet.worker.r0@0' kills replica 0's first "
+                        "burst — with --fleet the scheduler fails its "
+                        "requests over to the survivors, drain() "
+                        "completes, /healthz reports degraded, and the "
+                        "retried strokes are bitwise identical to the "
+                        "no-fault run. Off by default")
+    p.add_argument("--fault_seed", type=int, default=0,
+                   help="seed of the fault plan's deterministic "
+                        "p=... firing decisions")
     p.set_defaults(fn=cmd_serve_bench)
     return ap
 

@@ -136,6 +136,27 @@ class HParams:
     #   identical (late fetch, not lossy); check_finite stops training
     #   at most one window after a divergence. false = convert eagerly
     #   at the window (the pre-r6 synchronous behavior).
+    ckpt_retries: int = 2              # bounded retries for a TRANSIENT
+    #   checkpoint-commit I/O failure (ISSUE 10): the commit is
+    #   idempotent (tmp + rename per file), so a torn first attempt is
+    #   simply rewritten. A failure that survives the budget still
+    #   stops training loudly — sync saves immediately, async saves one
+    #   cadence late (train/async_ckpt.py). 0 = fail on first error
+    #   (the pre-resilience behavior).
+    ckpt_retry_backoff_s: float = 0.05  # base of the deterministic
+    #   exponential backoff between checkpoint-commit retries
+    #   (min(2s, base * 2**attempt) — utils/faults.backoff_s). 0 =
+    #   retry immediately (tests).
+    resume_align: bool = True          # crash-equivalent resume (ISSUE
+    #   10): on resume from step R, fast-forward the training feed by R
+    #   batches so the resumed run consumes EXACTLY the batches the
+    #   uninterrupted run would have from step R on — combined with the
+    #   per-step fold_in(key, step) RNG this makes kill+resume
+    #   reproduce the uninterrupted final state leaf-bitwise
+    #   (scripts/resilience_bench.py proves it). Costs R host batch
+    #   assemblies at startup (~ms each; minutes at step ~500k) —
+    #   false restores the legacy fresh-stream resume, which converges
+    #   to the same loss but is not bitwise replayable.
 
     # --- TPU / parallelism (component 18) ---
     transfer_dtype: str = "float32"    # host->device dtype of the TRAIN
@@ -230,6 +251,10 @@ class HParams:
         if self.bucket_run_len < 0:
             raise ValueError(f"bucket_run_len must be >= 0, got "
                              f"{self.bucket_run_len}")
+        if self.ckpt_retries < 0 or self.ckpt_retry_backoff_s < 0:
+            raise ValueError(
+                f"ckpt_retries and ckpt_retry_backoff_s must be >= 0, "
+                f"got {self.ckpt_retries}/{self.ckpt_retry_backoff_s}")
 
     # -- overrides ---------------------------------------------------------
 

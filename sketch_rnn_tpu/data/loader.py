@@ -24,6 +24,7 @@ with a single sharded transfer per step (SURVEY §3.1 boundary notes).
 from __future__ import annotations
 
 import os
+import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -31,18 +32,53 @@ import numpy as np
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.data import native_batcher as NB
 from sketch_rnn_tpu.data import strokes as S
+from sketch_rnn_tpu.utils.faults import fault_point
 from sketch_rnn_tpu.utils.profiling import PaddingLedger
+from sketch_rnn_tpu.utils.telemetry import get_telemetry
 
 
-def _purify(stroke3_list, max_seq_len: int, limit: float = 1000.0):
-    """Drop too-long sequences; clamp absurd offsets to ±limit."""
+def _purify(stroke3_list, max_seq_len: int, limit: float = 1000.0,
+            source: Optional[str] = None, skip_bad: bool = False):
+    """Drop too-long sequences; clamp absurd offsets to ±limit.
+
+    Hardening (ISSUE 10 satellite): a corrupt record — wrong rank,
+    wrong column count, non-numeric — used to surface as a raw numpy
+    traceback from deep inside batching. Now it fails with ONE line
+    naming ``source`` and the record index; with ``skip_bad`` it is
+    skipped instead, counted in the ``records_skipped`` telemetry
+    counter (cat ``data``) and summarized in a single warning.
+    """
     out = []
-    for s in stroke3_list:
-        if len(s) == 0 or len(s) > max_seq_len:
+    skipped = 0
+    for i, s in enumerate(stroke3_list):
+        try:
+            # empty records are DROPPED, not corrupt — the pre-existing
+            # filter contract (np.array([]) is 1-D, so the shape check
+            # below must not see them)
+            if len(s) == 0:
+                continue
+            s = np.array(s, dtype=np.float32)
+            if s.ndim != 2 or s.shape[1] != 3:
+                raise ValueError(f"expected an [N, 3] stroke-3 array, "
+                                 f"got shape {s.shape}")
+        except (ValueError, TypeError) as e:
+            where = f"{source or '<in-memory corpus>'} record {i}"
+            if not skip_bad:
+                raise ValueError(
+                    f"corrupt stroke record: {where}: {e}") from None
+            skipped += 1
             continue
-        s = np.array(s, dtype=np.float32)
+        if len(s) > max_seq_len:
+            continue
         s[:, 0:2] = np.clip(s[:, 0:2], -limit, limit)
         out.append(s)
+    if skipped:
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter("records_skipped", float(skipped), cat="data")
+        print(f"[data] WARNING: skipped {skipped} corrupt record(s) in "
+              f"{source or '<in-memory corpus>'} (--skip_bad_records)",
+              file=sys.stderr, flush=True)
     return out
 
 
@@ -190,6 +226,10 @@ class DataLoader:
         # ``pad_to``: pad only to this bucket edge instead of max_seq_len
         # (length-bucketed execution; every row must fit — callers bin by
         # raw length, and augmentation only ever SHORTENS a sequence).
+        # fault site (ISSUE 10): a batch-assembly failure — fires on
+        # the prefetch producer thread in a real run, so a chaos plan
+        # exercises the Prefetcher's cross-thread error propagation
+        fault_point("data.batch")
         pad = self.hps.max_seq_len if pad_to is None else int(pad_to)
         if int16_scale is not None and not (int16_scale > 0):
             # mirrors the prefetch guard for direct random_batch callers:
@@ -300,6 +340,30 @@ class DataLoader:
         idx = self.rng.choice(len(self.strokes), self.hps.batch_size,
                               replace=len(self.strokes) < self.hps.batch_size)
         return self._assemble(idx, int16_scale=int16_scale)
+
+    def fast_forward(self, n_batches: int) -> None:
+        """Advance the training feed by ``n_batches`` batches, discarding
+        them — crash-equivalent resume alignment (ISSUE 10).
+
+        A resumed run builds a FRESH loader whose RNG stream starts at
+        batch 0, but resumes training at step R — so without alignment
+        its step-R batch would be the stream's batch 0, not the batch
+        the uninterrupted run drew at step R, and the final states
+        could never match. Consuming R batches through the real
+        :meth:`next_batch` path (assembly included — the augmentation
+        stream draws inside ``_assemble``) makes the resumed feed
+        byte-identical to the uninterrupted run's from step R on;
+        ``scripts/resilience_bench.py`` is the caller that proves the
+        resulting final state leaf-bitwise equal. The padding ledger's
+        window is reset afterwards so the discarded batches cannot leak
+        into the resumed run's first ``padded_frac`` metrics row.
+        """
+        if n_batches < 0:
+            raise ValueError(f"n_batches must be >= 0, got {n_batches}")
+        for _ in range(n_batches):
+            self.next_batch()
+        if n_batches:
+            self.padding_ledger.window()
 
     # -- length-bucketed batching (ISSUE 4) --------------------------------
 
@@ -567,6 +631,7 @@ def load_dataset(hps: HParams,
                  host_id: int = 0,
                  num_hosts: int = 1,
                  scale_factor: Optional[float] = None,
+                 skip_bad_records: bool = False,
                  ) -> Tuple[DataLoader, DataLoader, DataLoader, float]:
     """Read category ``.npz`` files and build train/valid/test loaders.
 
@@ -579,6 +644,12 @@ def load_dataset(hps: HParams,
     normalized by the train split's scale factor (SURVEY §3.5) — or by a
     given ``scale_factor`` (eval/sample against a checkpoint must reuse the
     checkpointed value, which is part of the model contract).
+
+    Hardening (ISSUE 10 satellite): an unreadable/truncated ``.npz`` or
+    a corrupt record fails with ONE line naming the file (and record
+    index) instead of a decompression traceback; ``skip_bad_records``
+    skips corrupt records instead, counted in the ``records_skipped``
+    telemetry counter (``cli --skip_bad_records``).
     """
     data_dir = data_dir or hps.data_dir
     splits = {"train": ([], []), "valid": ([], []), "test": ([], [])}
@@ -588,9 +659,31 @@ def load_dataset(hps: HParams,
             raise FileNotFoundError(
                 f"{path} not found; QuickDraw .npz files are required "
                 f"(or use make_synthetic_strokes for a synthetic corpus)")
-        with np.load(path, allow_pickle=True, encoding="latin1") as npz:
+        try:
+            npz = np.load(path, allow_pickle=True, encoding="latin1")
+        except Exception as e:  # noqa: BLE001 — np.load raises zipfile/
+            # pickle/OSError zoo on damage; the user needs the file name
+            raise RuntimeError(
+                f"{path}: unreadable .npz ({type(e).__name__}: {e}) — "
+                f"corrupt or truncated download?") from None
+        with npz:
             for split in splits:
-                seqs = _purify(list(npz[split]), hps.max_seq_len)
+                try:
+                    # materializing the array decompresses the zip
+                    # member — truncation/bit-rot surfaces HERE
+                    arr = list(npz[split])
+                except KeyError:
+                    raise RuntimeError(
+                        f"{path}: no {split!r} array — not a sketch-rnn "
+                        f".npz (needs train/valid/test)") from None
+                except Exception as e:  # noqa: BLE001
+                    raise RuntimeError(
+                        f"{path}: corrupt {split!r} array "
+                        f"({type(e).__name__}: {e}) — truncated or "
+                        f"damaged .npz member") from None
+                seqs = _purify(arr, hps.max_seq_len,
+                               source=f"{path}[{split}]",
+                               skip_bad=skip_bad_records)
                 splits[split][0].extend(seqs)
                 splits[split][1].extend([label] * len(seqs))
 

@@ -131,20 +131,48 @@ def drawing_to_stroke3(drawing: Sequence[Sequence[Sequence[float]]],
 
 
 def iter_ndjson(lines: Iterable[str],
-                recognized_only: bool = True):
+                recognized_only: bool = True,
+                source: str = "<ndjson>",
+                skip_bad: bool = False):
     """Yield ``(word, stroke3-ready drawing)`` from ndjson lines.
 
     ``recognized_only`` keeps only drawings the QuickDraw classifier
     recognized (the canonical datasets do the same).
+
+    Hardening (ISSUE 10 satellite): a corrupt line — torn JSON from a
+    truncated download, or a record without a ``drawing`` — fails with
+    ONE line naming ``source`` and the line number instead of a raw
+    ``json.loads`` traceback; ``skip_bad`` skips such lines instead,
+    counted in the ``records_skipped`` telemetry counter (cat ``data``).
     """
-    for line in lines:
+    from sketch_rnn_tpu.utils.telemetry import get_telemetry
+
+    skipped = 0
+    for lineno, line in enumerate(lines, start=1):
         line = line.strip()
         if not line:
             continue
-        rec = json.loads(line)
+        try:
+            rec = json.loads(line)
+            drawing = rec["drawing"]
+        except (ValueError, KeyError, TypeError) as e:
+            if not skip_bad:
+                raise ValueError(
+                    f"corrupt ndjson record: {source} line {lineno}: "
+                    f"{type(e).__name__}: {e}") from None
+            skipped += 1
+            tel = get_telemetry()
+            if tel.enabled:
+                tel.counter("records_skipped", 1.0, cat="data")
+            continue
         if recognized_only and not rec.get("recognized", True):
             continue
-        yield rec.get("word", ""), rec["drawing"]
+        yield rec.get("word", ""), drawing
+    if skipped:
+        import sys
+        print(f"[data] WARNING: skipped {skipped} corrupt ndjson "
+              f"line(s) in {source} (skip_bad)", file=sys.stderr,
+              flush=True)
 
 
 def convert_ndjson(in_path: str, out_path: str,
@@ -153,16 +181,20 @@ def convert_ndjson(in_path: str, out_path: str,
                    num_valid: int = 2500,
                    num_test: int = 2500,
                    limit: Optional[int] = None,
-                   seed: int = 0) -> dict:
+                   seed: int = 0,
+                   skip_bad: bool = False) -> dict:
     """Convert one category ``.ndjson`` file to a sketch-rnn ``.npz``.
 
     Writes ``train``/``valid``/``test`` object arrays of int16 stroke-3
     sequences (the exact layout ``data.loader.load_dataset`` reads and
     the reference's prebuilt files use). Returns split sizes.
+    ``skip_bad`` skips corrupt lines (counted) instead of failing on
+    the first one — see :func:`iter_ndjson`.
     """
     seqs: List[np.ndarray] = []
     with open(in_path) as f:
-        for _, drawing in iter_ndjson(f):
+        for _, drawing in iter_ndjson(f, source=in_path,
+                                      skip_bad=skip_bad):
             s3 = drawing_to_stroke3(drawing, epsilon=epsilon,
                                     max_points=max_points, quantize=True)
             if len(s3) < 2:

@@ -141,6 +141,7 @@ class AdmissionController:
         self.shed_margin = float(shed_margin)
         self._ewma = float(ewma)
         self._backlog: List[int] = [0] * n_replicas
+        self._dead: set = set()
         self.service_s: Optional[float] = None   # EWMA decode_s
         self.admitted = 0
         self.shed: Dict[str, int] = {c: 0 for c in self.classes}
@@ -148,6 +149,29 @@ class AdmissionController:
     @property
     def backlog(self) -> List[int]:
         return list(self._backlog)
+
+    @property
+    def dead(self) -> List[int]:
+        return sorted(self._dead)
+
+    @property
+    def live_replicas(self) -> List[int]:
+        return [r for r in range(self.n_replicas) if r not in self._dead]
+
+    def mark_dead(self, replica: int) -> int:
+        """Shrink capacity: ``replica`` leaves the placement set (ISSUE
+        10 failover). Its tracked backlog is dropped (returned, so the
+        fleet can re-place exactly those requests) — every estimate
+        from here on (least-loaded min, queue-cap shed, est_wait) sees
+        only surviving replicas. Idempotent."""
+        if not 0 <= replica < self.n_replicas:
+            raise ValueError(f"replica {replica} out of range "
+                             f"0..{self.n_replicas - 1}")
+        if replica in self._dead:
+            return 0
+        self._dead.add(replica)
+        dropped, self._backlog[replica] = self._backlog[replica], 0
+        return dropped
 
     @property
     def shed_total(self) -> int:
@@ -161,25 +185,35 @@ class AdmissionController:
             return None
         return self._backlog[replica] * self.service_s / self.slots
 
-    def place(self, cls_name: str, force: bool = False) -> Placement:
+    def place(self, cls_name: str, force: bool = False,
+              requeue: bool = False) -> Placement:
         """Decide one arrival: least-loaded replica, or shed.
 
         ``force`` admits unconditionally (same least-loaded placement,
         shed checks skipped) — the bench's parity/capacity arms use it
         so a completion racing the submit loop can never shed a request
-        those arms must complete.
+        those arms must complete. ``requeue`` (failover, ISSUE 10)
+        additionally skips the ``admitted`` count: a requeued request
+        was already admitted once, and re-counting it would report
+        admitted > submitted on exactly the degraded runs operators
+        read the admission summary on.
         """
         cls = self.classes.get(cls_name)
         if cls is None:
             raise KeyError(
                 f"unknown admission class {cls_name!r}; configured: "
                 f"{sorted(self.classes)}")
-        # least-loaded, ties to the lowest index (deterministic)
-        replica = min(range(self.n_replicas),
-                      key=lambda r: (self._backlog[r], r))
+        live = self.live_replicas
+        if not live:
+            raise RuntimeError(
+                "no live replicas to place on — every replica was "
+                "marked dead (the fleet stops accepting before this)")
+        # least-loaded among SURVIVORS, ties to the lowest index
+        # (deterministic; dead replicas left the placement set)
+        replica = min(live, key=lambda r: (self._backlog[r], r))
         depth = self._backlog[replica]
         wait = self.est_wait_s(replica)
-        if not force:
+        if not force and not requeue:
             if self.queue_cap and depth >= self.queue_cap:
                 self.shed[cls_name] += 1
                 return Placement(replica=None, shed_reason="queue_full")
@@ -188,8 +222,9 @@ class AdmissionController:
                 self.shed[cls_name] += 1
                 return Placement(replica=None, est_wait_s=wait,
                                  shed_reason="deadline")
+        if not requeue:
+            self.admitted += 1
         self._backlog[replica] += 1
-        self.admitted += 1
         return Placement(replica=replica, queue_pos=depth,
                          est_wait_s=wait)
 
@@ -214,6 +249,8 @@ class AdmissionController:
             "shed_total": self.shed_total,
             "shed_by_class": dict(self.shed),
             "backlog": self.backlog,
+            "dead_replicas": self.dead,
+            "live_replicas": len(self.live_replicas),
             "service_est_s": (None if self.service_s is None
                               else round(self.service_s, 6)),
             "queue_cap": self.queue_cap,

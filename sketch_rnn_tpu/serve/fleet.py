@@ -40,6 +40,20 @@ per-class latency histograms and the admission metadata on every
 all scrapeable live via ``serve/metrics_http.py``'s ``/metrics`` +
 ``/healthz`` when a server is attached.
 
+- **Failover (ISSUE 10).** A replica whose burst fails — injected via
+  the ``fleet.worker.rNN`` fault site (utils/faults.py) or real — is
+  marked dead instead of killing the fleet: its queued and in-flight
+  requests are requeued to the survivors under a bounded per-request
+  ``retry_budget`` with deterministic exponential backoff, the
+  admission controller shrinks to the surviving capacity
+  (``mark_dead``), ``drain()`` completes against the survivors, and
+  ``health()`` feeds ``/healthz`` a ``degraded`` verdict. Because
+  placement is invisible to outputs (above), a retried request's
+  strokes are BITWISE identical to the no-fault run's — the chaos
+  parity pin in tests/test_fleet.py. Only the death of the last
+  replica (or an exhausted retry budget, recorded per request in
+  ``failed``) surfaces as a failure.
+
 Every started fleet registers process-wide so the tier-1 conftest
 guard can prove no test leaks worker threads (:func:`stop_all`).
 """
@@ -47,6 +61,7 @@ guard can prove no test leaks worker threads (:func:`stop_all`).
 from __future__ import annotations
 
 import dataclasses
+import sys
 import threading
 import time
 from collections import deque
@@ -62,6 +77,7 @@ from sketch_rnn_tpu.serve.admission import (
     parse_admission_classes,
 )
 from sketch_rnn_tpu.serve.engine import Request, ServeEngine
+from sketch_rnn_tpu.utils.faults import backoff_s, fault_point
 from sketch_rnn_tpu.utils.telemetry import class_series, get_telemetry
 
 # every live fleet, for the conftest no-stray-threads guard
@@ -82,6 +98,11 @@ class _Replica:
         self.queues: Dict[str, deque] = {c: deque() for c in class_order}
         self.cond: Optional[threading.Condition] = None  # set by fleet
         self.thread: Optional[threading.Thread] = None
+        # failover state (ISSUE 10): a dead replica's worker has
+        # exited; its requests were requeued or failed, and the
+        # admission controller no longer places on it
+        self.dead = False
+        self.death: Optional[str] = None
         # accumulated engine metrics across micro-bursts
         self.completed = 0
         self.bursts = 0
@@ -120,7 +141,9 @@ class ServeFleet:
                  classes: Optional[Dict[str, AdmissionClass]] = None,
                  devices: Optional[Sequence[Any]] = None,
                  pool_cap: int = 0, queue_cap: int = 0,
-                 shed_margin: float = 1.0, slo=None):
+                 shed_margin: float = 1.0, slo=None,
+                 retry_budget: int = 2,
+                 retry_backoff_s: float = 0.05):
         import jax  # lazy, the serve-module discipline
 
         devices = list(devices if devices is not None else jax.devices())
@@ -164,11 +187,19 @@ class ServeFleet:
             rep = _Replica(r, devices[r], eng, class_order)
             rep.cond = threading.Condition(self._lock)
             self._replicas.append(rep)
+        if retry_budget < 0:
+            raise ValueError(f"retry_budget must be >= 0, got "
+                             f"{retry_budget}")
+        self.retry_budget = int(retry_budget)
+        self.retry_backoff_s = float(retry_backoff_s)
         self._next_uid = 0
         self._seen_uids: set = set()
         self._submitted = 0
         self._shed: List[Dict] = []
         self._results: Dict[int, Dict] = {}     # uid -> record
+        self._failed: Dict[int, Dict] = {}      # uid -> failure record
+        self._retries: Dict[int, int] = {}      # uid -> requeue count
+        self._requeues = 0
         self._stop = False
         self._started = False
         self._error: Optional[BaseException] = None
@@ -217,8 +248,16 @@ class ServeFleet:
         with self._lock:
             if any(rep.pending() for rep in self._replicas):
                 raise RuntimeError("reset with queued work")
-            if len(self._results) + len(self._shed) < self._submitted:
+            if self._done_locked() < self._submitted:
                 raise RuntimeError("reset with requests in flight")
+            if any(rep.dead for rep in self._replicas):
+                # a dead replica's worker thread has exited and cannot
+                # be restarted by reset — the measurement arms that use
+                # reset() assume full capacity
+                raise RuntimeError(
+                    f"reset on a degraded fleet (dead replicas: "
+                    f"{[r.idx for r in self._replicas if r.dead]}); "
+                    f"build a fresh fleet instead")
             self._admission = AdmissionController(
                 self.classes, n_replicas=self.n_replicas,
                 slots=self.slots, queue_cap=self._admission.queue_cap,
@@ -228,6 +267,9 @@ class ServeFleet:
             self._submitted = 0
             self._shed = []
             self._results = {}
+            self._failed = {}
+            self._retries = {}
+            self._requeues = 0
             self._t_first_submit = None
             self._t_last_done = None
             for rep in self._replicas:
@@ -235,19 +277,39 @@ class ServeFleet:
                 rep.device_steps = 0
                 rep.live_slot_steps = 0.0
 
-    def close(self) -> None:
+    def close(self, timeout: float = 30.0) -> List[str]:
         """Stop the workers (any queued-but-unstarted work is
-        abandoned) and unregister."""
+        abandoned) and unregister.
+
+        Joins each worker under one shared ``timeout`` budget and
+        REPORTS stragglers instead of hanging (ISSUE 10 satellite): a
+        worker wedged inside a device call cannot be force-killed from
+        Python, so the caller gets the straggler names (also warned on
+        stdout) and the process's daemon-thread teardown reaps them at
+        exit. Returns the straggler thread names (empty = clean)."""
         with self._lock:
             self._stop = True
             for rep in self._replicas:
                 rep.cond.notify_all()
             self._done_cv.notify_all()
+        deadline = time.perf_counter() + timeout
+        stragglers: List[str] = []
         for rep in self._replicas:
-            if rep.thread is not None:
-                rep.thread.join(timeout=30)
+            t = rep.thread
+            if t is None:
+                continue
+            t.join(timeout=max(0.0, deadline - time.perf_counter()))
+            if t.is_alive():
+                stragglers.append(t.name)
+        if stragglers:
+            # stderr: serve-bench's stdout is a JSON report stream
+            print(f"[fleet] WARNING: close() timed out after {timeout}s "
+                  f"waiting for worker thread(s) {stragglers}; they are "
+                  f"daemonic and die with the process", file=sys.stderr,
+                  flush=True)
         with _LIVE_LOCK:
             _LIVE.discard(self)
+        return stragglers
 
     def __enter__(self) -> "ServeFleet":
         return self.start()
@@ -319,7 +381,15 @@ class ServeFleet:
     def _worker(self, rep: _Replica) -> None:
         """One replica's drain loop: wait for queued work, pop a
         micro-burst in class-priority order, serve it to completion on
-        this replica's device, book the completions."""
+        this replica's device, book the completions.
+
+        Failover (ISSUE 10): a burst failure — injected
+        (``fleet.worker.rNN`` fault site) or real — no longer kills the
+        fleet. The replica is marked dead, its queued AND in-flight
+        requests fail over to the survivors (:meth:`_on_replica_death`)
+        and this worker exits; only the death of the LAST replica (or
+        an exhausted per-request retry budget, recorded per request) is
+        fleet-fatal."""
         import jax
 
         while True:
@@ -330,15 +400,13 @@ class ServeFleet:
                     return
                 batch = rep.pop_batch(self.pool_cap)
             try:
+                # fault site: kill THIS replica's burst (plans target a
+                # specific replica: "fleet.worker.r0@0")
+                fault_point(f"fleet.worker.r{rep.idx}")
                 with jax.default_device(rep.device):
                     out = rep.engine.run(batch, pool_pad=self.pool_cap)
             except BaseException as e:  # noqa: BLE001
-                with self._lock:
-                    self._error = e
-                    self._stop = True
-                    for other in self._replicas:
-                        other.cond.notify_all()
-                    self._done_cv.notify_all()
+                self._on_replica_death(rep, batch, e)
                 return
             now = time.perf_counter()
             m = out["metrics"]
@@ -370,11 +438,97 @@ class ServeFleet:
                 self._t_last_done = now
                 self._done_cv.notify_all()
 
+    def _on_replica_death(self, rep: _Replica, batch: List[Request],
+                          exc: BaseException) -> None:
+        """Fail one replica over to the survivors.
+
+        Marks the replica dead (admission shrinks to the surviving
+        capacity), then re-places its stranded requests — the in-flight
+        burst (``engine.run`` is transactional: a raise books nothing)
+        plus everything still queued — under the bounded per-request
+        retry budget with deterministic exponential backoff. A request
+        whose budget is exhausted is recorded in ``failed`` (it counts
+        as done, so ``drain()`` still completes and reports honestly);
+        the death of the LAST replica is fleet-fatal and surfaces as
+        the pre-failover "fleet worker failed" raise."""
+        tel = get_telemetry()
+        with self._lock:
+            rep.dead = True
+            rep.death = repr(exc)
+            stranded = list(batch)
+            for q in rep.queues.values():
+                stranded.extend(q)
+                q.clear()
+            self._admission.mark_dead(rep.idx)
+            live = [r for r in self._replicas if not r.dead]
+            if tel.enabled:
+                tel.counter("replica_deaths", 1.0, cat="serve")
+            # stderr: serve-bench's stdout is a JSON report stream
+            print(f"[fleet] WARNING: replica {rep.idx} died mid-burst "
+                  f"({exc!r}); failing {len(stranded)} request(s) over "
+                  f"to {len(live)} surviving replica(s)",
+                  file=sys.stderr, flush=True)
+            if not live:
+                self._error = exc
+                self._stop = True
+                for other in self._replicas:
+                    other.cond.notify_all()
+                self._done_cv.notify_all()
+                return
+            requeue: List[Request] = []
+            max_attempt = 0
+            for r in stranded:
+                n = self._retries.get(r.uid, 0) + 1
+                self._retries[r.uid] = n
+                if n <= self.retry_budget:
+                    requeue.append(r)
+                    max_attempt = max(max_attempt, n)
+                else:
+                    self._failed[r.uid] = {
+                        "uid": r.uid, "class": r.cls,
+                        "replica": rep.idx,
+                        "retries": n - 1,
+                        "reason": f"retry budget ({self.retry_budget}) "
+                                  f"exhausted",
+                        "error": repr(exc)}
+                    if tel.enabled:
+                        tel.counter("requests_failed", 1.0, cat="serve")
+        # deterministic backoff OUTSIDE the lock (the dying worker is
+        # the only thread that sleeps; submits/completions proceed):
+        # the schedule is a pure function of the worst attempt index
+        if requeue and self.retry_backoff_s > 0:
+            time.sleep(backoff_s(self.retry_backoff_s, max_attempt - 1))
+        with self._lock:
+            for r in requeue:
+                # already-admitted requests never re-shed OR re-count:
+                # failover is the fleet's fault, not the client's
+                # (requeue placement — same least-loaded rule over the
+                # survivors, no shed checks, no second admitted tick)
+                decision = self._admission.place(r.cls, requeue=True)
+                r.queue_pos = decision.queue_pos
+                target = self._replicas[decision.replica]
+                target.queues[r.cls].append(r)
+                self._requeues += 1
+                if tel.enabled:
+                    tel.counter("requests_requeued", 1.0, cat="serve")
+                target.cond.notify()
+            # failed requests count toward done — wake any drainer
+            self._done_cv.notify_all()
+
     # -- completion & reporting --------------------------------------------
 
+    def _done_locked(self) -> int:
+        """Requests accounted for (caller holds the lock): completed,
+        shed at the door, or failed after exhausting the retry budget."""
+        return len(self._results) + len(self._shed) + len(self._failed)
+
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """Block until every submitted request completed or shed;
-        False on timeout. Re-raises a worker failure, and raises if the
+        """Block until every submitted request completed, shed, or
+        (failover, ISSUE 10) exhausted its retry budget; False on
+        timeout. A replica death that failover absorbed does NOT raise
+        — the drain completes against the surviving capacity and
+        ``summary()``/``failed`` report the damage. Re-raises only a
+        FLEET-fatal failure (the last replica died), and raises if the
         fleet is closed out from under the drain (close() abandons
         queued work, so the remainder can never complete)."""
         deadline = None if timeout is None else \
@@ -384,7 +538,7 @@ class ServeFleet:
                 if self._error is not None:
                     raise RuntimeError(
                         "fleet worker failed") from self._error
-                done = len(self._results) + len(self._shed)
+                done = self._done_locked()
                 if done >= self._submitted:
                     return True
                 if self._stop:
@@ -411,6 +565,32 @@ class ServeFleet:
         with self._lock:
             return list(self._shed)
 
+    @property
+    def failed(self) -> Dict[int, Dict]:
+        """uid -> failure record for requests whose retry budget was
+        exhausted by replica deaths (ISSUE 10; empty on healthy runs)."""
+        with self._lock:
+            return dict(self._failed)
+
+    def health(self) -> Dict[str, Any]:
+        """Live health verdict for ``/healthz`` (serve/metrics_http.py):
+        ``healthy`` is False while any replica is dead, the fleet is
+        fatally errored, or requests have been failed — the endpoint
+        then reports ``degraded`` with this block as evidence."""
+        with self._lock:
+            dead = [{"replica": r.idx, "error": r.death}
+                    for r in self._replicas if r.dead]
+            return {
+                "healthy": not dead and self._error is None
+                and not self._failed,
+                "replicas": self.n_replicas,
+                "replicas_live": self.n_replicas - len(dead),
+                "replicas_dead": dead,
+                "requests_failed": len(self._failed),
+                "requests_requeued": self._requeues,
+                "fatal": repr(self._error) if self._error else None,
+            }
+
     def summary(self) -> Dict[str, Any]:
         """Fleet-level aggregate: throughput, per-class latency
         percentiles, shed accounting, per-replica occupancy and the
@@ -419,9 +599,11 @@ class ServeFleet:
         with self._lock:
             recs = list(self._results.values())
             shed = list(self._shed)
+            failed = list(self._failed.values())
+            requeues = self._requeues
             submitted = self._submitted
             reps = [(r.idx, r.completed, r.bursts, r.chunks,
-                     r.device_steps, r.live_slot_steps)
+                     r.device_steps, r.live_slot_steps, r.dead)
                     for r in self._replicas]
             t0, t1 = self._t_first_submit, self._t_last_done
         wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
@@ -452,9 +634,11 @@ class ServeFleet:
             "chunks": chunks, "device_steps": steps,
             "slot_utilization": round(
                 live / max(chunks * self.chunk * self.slots, 1), 4),
-        } for idx, comp, bursts, chunks, steps, live in reps]
+            "dead": dead,
+        } for idx, comp, bursts, chunks, steps, live, dead in reps]
         return {
             "replicas": self.n_replicas,
+            "replicas_dead": sum(1 for r in per_replica if r["dead"]),
             "slots": self.slots,
             "chunk": self.chunk,
             "pool_cap": self.pool_cap,
@@ -464,6 +648,11 @@ class ServeFleet:
             "shed_frac": round(len(shed) / submitted, 4) if submitted
             else 0.0,
             "shed_by_class": shed_by_class,
+            # failover accounting (ISSUE 10): zero on healthy runs
+            "failed": len(failed),
+            "failed_requests": failed,
+            "requeues": requeues,
+            "retry_budget": self.retry_budget,
             "wall_s": round(wall, 6),
             "sketches_per_sec": round(len(recs) / wall, 3) if wall
             else 0.0,

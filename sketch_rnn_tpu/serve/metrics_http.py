@@ -179,15 +179,28 @@ def render_prometheus(tel: Telemetry,
 
 
 def health_payload(tel: Telemetry,
-                   slo: Optional[object] = None) -> Dict:
-    """The ``/healthz`` body: liveness + the SLO verdict."""
+                   slo: Optional[object] = None,
+                   health: Optional[object] = None) -> Dict:
+    """The ``/healthz`` body: liveness + the SLO verdict (+ the fleet
+    failover verdict, ISSUE 10).
+
+    ``health`` is an optional callable returning a dict with a
+    ``healthy`` bool (``ServeFleet.health``): ``status`` reports
+    ``degraded`` when EITHER a tracked SLO is out of compliance or the
+    health source says so (dead replicas, failed requests), with the
+    source's block included as evidence."""
     degraded = slo is not None and not slo.healthy()
+    extra = None
+    if health is not None:
+        extra = health() if callable(health) else dict(health)
+        degraded = degraded or not extra.get("healthy", True)
     return {
         "status": "degraded" if degraded else "ok",
         "telemetry_enabled": bool(tel.enabled),
         "dropped_events": tel.dropped,
         "uptime_s": round(time.perf_counter() - tel.origin_perf, 3),
         "slo": None if slo is None else json_safe(slo.summary()),
+        "fleet": None if extra is None else json_safe(extra),
     }
 
 
@@ -203,10 +216,15 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  slo: Optional[object] = None,
-                 telemetry: Optional[Telemetry] = None):
+                 telemetry: Optional[Telemetry] = None,
+                 health_source: Optional[object] = None):
         self.host = host
         self._requested_port = port
         self.slo = slo
+        # optional health callable (ServeFleet.health) consulted per
+        # /healthz request; assignable AFTER start() — the cli binds
+        # the port before the (expensive) fleet build, then attaches
+        self.health_source = health_source
         self._telemetry = telemetry
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -243,7 +261,8 @@ class MetricsServer:
                 elif path == "/healthz":
                     body = json.dumps(health_payload(
                         server._resolve_telemetry(),
-                        server.slo)).encode()
+                        server.slo,
+                        server.health_source)).encode()
                     self._send(200, "application/json", body)
                 else:
                     self._send(

@@ -44,6 +44,7 @@ import jax.numpy as jnp
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.train.checkpoint import write_checkpoint
 from sketch_rnn_tpu.train.state import TrainState
+from sketch_rnn_tpu.utils.faults import fault_point
 from sketch_rnn_tpu.utils.telemetry import get_telemetry
 
 
@@ -142,11 +143,20 @@ class AsyncCheckpointer:
                hps: HParams) -> None:
         try:
             tel = get_telemetry()
+            # fault site (ISSUE 10): a writer-thread death BEFORE the
+            # commit path's own retry loop — exercises the stored-
+            # failure -> raise-one-save-late contract end to end
+            fault_point("ckpt.writer")
             with tel.span("fetch", cat="ckpt"):
                 host_state = jax.device_get(snap)
             with tel.span("commit", cat="ckpt"):
+                # transient commit I/O failures retry with bounded
+                # deterministic backoff (ISSUE 10); only a PERMANENT
+                # failure (budget exhausted) lands in _exc and stops
+                # training one save late
                 self.last_path = write_checkpoint(
                     self.ckpt_dir, host_state, scale_factor, hps,
-                    keep=self.keep)
+                    keep=self.keep, retries=hps.ckpt_retries,
+                    retry_backoff_s=hps.ckpt_retry_backoff_s)
         except BaseException as e:  # noqa: BLE001 — must cross the thread
             self._exc = e

@@ -31,6 +31,7 @@ from flax import serialization
 
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.train.state import TrainState
+from sketch_rnn_tpu.utils.faults import fault_point, retry_call
 
 _CKPT_RE = re.compile(r"^ckpt_(\d+)\.msgpack$")
 
@@ -46,22 +47,26 @@ def _paths(ckpt_dir: str, step: int) -> Tuple[str, str]:
 
 
 def save_checkpoint(ckpt_dir: str, state: TrainState, scale_factor: float,
-                    hps: HParams, keep: int = 3) -> str:
+                    hps: HParams, keep: int = 3, retries: int = 0,
+                    retry_backoff_s: float = 0.05) -> str:
     """Write the state; prune to the ``keep`` most recent. Returns path.
 
     Synchronous: the device->host fetch and the file write both happen on
     the calling thread. The training loop's overlapped path
     (``train.async_ckpt.AsyncCheckpointer``) fetches and commits on a
     background thread through the same :func:`write_checkpoint`, so both
-    paths produce byte-identical files.
+    paths produce byte-identical files. ``retries``/``retry_backoff_s``
+    pass through to the commit's transient-failure retry loop.
     """
     return write_checkpoint(ckpt_dir, jax.device_get(state), scale_factor,
-                            hps, keep=keep)
+                            hps, keep=keep, retries=retries,
+                            retry_backoff_s=retry_backoff_s)
 
 
 def write_checkpoint(ckpt_dir: str, host_state: TrainState,
                      scale_factor: float, hps: HParams,
-                     keep: int = 3) -> str:
+                     keep: int = 3, retries: int = 0,
+                     retry_backoff_s: float = 0.05) -> str:
     """Serialize an already-fetched HOST pytree and atomically commit it.
 
     The single commit discipline shared by the sync and async save paths:
@@ -70,23 +75,44 @@ def write_checkpoint(ckpt_dir: str, host_state: TrainState,
     orphan json and resume falls back to the previous complete
     checkpoint), then the msgpack — each via temp file + rename so a kill
     mid-write never corrupts ``latest_checkpoint``.
+
+    Fault tolerance (ISSUE 10): the whole commit is idempotent (every
+    write is tmp + rename keyed by step), so ``retries > 0`` retries a
+    TRANSIENT I/O failure with bounded deterministic backoff — a retry
+    after a torn first attempt simply rewrites both files. Permanent
+    failures re-raise after the budget, preserving the
+    failure-stops-training-loudly contract. Fault sites: ``ckpt.commit``
+    (the whole commit fails, inside the retry loop) and ``ckpt.torn``
+    (a crash in the torn instant between the sidecar and msgpack
+    renames — what :func:`latest_checkpoint`'s completeness rule
+    exists for).
     """
-    os.makedirs(ckpt_dir, exist_ok=True)
-    step = int(host_state.step)
-    data_path, meta_path = _paths(ckpt_dir, step)
-    meta = {"format_version": FORMAT_VERSION, "step": step,
-            "scale_factor": float(scale_factor),
-            "hps": json.loads(hps.to_json())}
-    tmp = meta_path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(meta, f, indent=2)
-    os.replace(tmp, meta_path)
-    tmp = data_path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(host_state))
-    os.replace(tmp, data_path)
-    _prune(ckpt_dir, keep)
-    return data_path
+
+    def _commit() -> str:
+        fault_point("ckpt.commit")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        step = int(host_state.step)
+        data_path, meta_path = _paths(ckpt_dir, step)
+        meta = {"format_version": FORMAT_VERSION, "step": step,
+                "scale_factor": float(scale_factor),
+                "hps": json.loads(hps.to_json())}
+        tmp = meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=2)
+        os.replace(tmp, meta_path)
+        fault_point("ckpt.torn")
+        tmp = data_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(serialization.to_bytes(host_state))
+        os.replace(tmp, data_path)
+        _prune(ckpt_dir, keep)
+        return data_path
+
+    if retries <= 0:
+        return _commit()
+    return retry_call(_commit, retries, retry_backoff_s,
+                      describe=f"checkpoint commit to {ckpt_dir}",
+                      counter="ckpt_commit_retries")
 
 
 def _complete_steps(ckpt_dir: str) -> list:

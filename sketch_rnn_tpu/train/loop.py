@@ -89,6 +89,7 @@ from sketch_rnn_tpu.train.watchdog import (
     WatchdogMonitor,
 )
 from sketch_rnn_tpu.utils.debug import check_finite, param_count
+from sketch_rnn_tpu.utils.faults import fault_point
 from sketch_rnn_tpu.utils.profiling import GoodputLedger, Throughput
 from sketch_rnn_tpu.utils import telemetry as tele
 
@@ -370,6 +371,21 @@ def train(hps: HParams,
     if workdir and resume and latest_checkpoint(workdir) is not None:
         state, scale_factor, meta = restore_checkpoint(workdir, state)
         print(f"[train] resumed from step {meta['step']}", flush=True)
+        # crash-equivalent resume (ISSUE 10): align the feed so step S
+        # of the resumed run consumes the batch the uninterrupted run
+        # drew at step S — with the per-step fold_in(key, step) RNG the
+        # resumed run then reproduces the uninterrupted final state
+        # leaf-bitwise (scripts/resilience_bench.py is the proof
+        # harness). Works for the random feed AND the bucketed plan
+        # (fast_forward replays the real next_batch stream, epoch
+        # refills included).
+        r = int(state.step)
+        if (r and hps.resume_align
+                and hasattr(train_loader, "fast_forward")):
+            train_loader.fast_forward(r)
+            print(f"[train] resume_align: training feed fast-forwarded "
+                  f"{r} batches (crash-equivalent replay; "
+                  f"--hparams resume_align=false to skip)", flush=True)
 
     # steps_per_call > 1: K optimizer steps per jitted call (one dispatch,
     # one stacked transfer) — host-loop amortization for remote runtimes;
@@ -474,6 +490,14 @@ def train(hps: HParams,
         mem_sampler.phase = "train"
     try:
         while step < num_steps:
+            # fault site (ISSUE 10): one invocation per loop iteration
+            # (== per global step at K=1), so a chaos plan can kill or
+            # crash train() at an exact step — the crash-equivalence
+            # harness (scripts/resilience_bench.py) resumes from latest
+            # and proves the final state bitwise equal to the
+            # uninterrupted run. No-op (one global read) when no fault
+            # plan is armed.
+            fault_point("train.step")
             if profile_span and not trace_active and step >= profile_span[0]:
                 tele.get_telemetry().instant(
                     tele.DEVICE_TRACE_START, cat=tele.PROFILER_CAT,
@@ -584,8 +608,13 @@ def train(hps: HParams,
                     if ckpt is not None:
                         ckpt.save(state, scale_factor, hps)
                     else:
-                        save_checkpoint(write_dir, state, scale_factor,
-                                        hps)
+                        # transient I/O failures retry with bounded
+                        # backoff (ISSUE 10); permanent ones still stop
+                        # training here, loudly
+                        save_checkpoint(
+                            write_dir, state, scale_factor, hps,
+                            retries=hps.ckpt_retries,
+                            retry_backoff_s=hps.ckpt_retry_backoff_s)
                 last_saved_step = step
         # tail of the deferral queue: the final window's row (and its
         # finiteness guard — divergence still stops the run before the
@@ -662,7 +691,9 @@ def train(hps: HParams,
         # same-step checkpoint left by a previous --no_resume run must
         # be overwritten, so directory contents cannot be trusted
         if last_saved_step != step:
-            save_checkpoint(write_dir, state, scale_factor, hps)
+            save_checkpoint(write_dir, state, scale_factor, hps,
+                            retries=hps.ckpt_retries,
+                            retry_backoff_s=hps.ckpt_retry_backoff_s)
     if is_primary():
         totals = ledger.summary()
         print("[goodput] " + " ".join(

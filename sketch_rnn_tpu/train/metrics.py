@@ -25,6 +25,8 @@ import sys
 import time
 from typing import Any, Callable, Dict, Optional, Sequence
 
+from sketch_rnn_tpu.utils.faults import corrupt_value, fault_point
+
 
 class MetricsWriter:
     """Append-only scalar logger; one row per logged step."""
@@ -42,6 +44,9 @@ class MetricsWriter:
             self._jsonl_path = os.path.join(workdir, f"{name}_metrics.jsonl")
 
     def write(self, step: int, scalars: Dict[str, float]) -> None:
+        # fault site (ISSUE 10): a metrics-file I/O failure — the
+        # chaos plan's stand-in for a full disk / yanked volume
+        fault_point("metrics.write")
         # strings pass through (serve rows carry admission-class names,
         # ISSUE 9); everything else must coerce to float — the train
         # path stays strictly numeric (what the watchdog consumes)
@@ -151,6 +156,13 @@ class MetricsDrain:
         scalars = scalars_from_device(device_metrics)
         if extras:
             scalars.update(extras)
+        if "loss" in scalars:
+            # value-corruption fault site (ISSUE 10, kind=nan only): a
+            # drained row's loss goes NaN — the injected divergence the
+            # watchdog must catch AND attribute (its incident.json
+            # embeds the injector's fired log as evidence)
+            scalars["loss"] = corrupt_value("metrics.row",
+                                            scalars["loss"])
         self.drained_rows += 1
         self.writer.write(step, scalars)
         self.writer.log_console(step, scalars)

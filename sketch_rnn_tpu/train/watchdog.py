@@ -60,6 +60,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
+from sketch_rnn_tpu.utils import faults as _faults
 from sketch_rnn_tpu.utils.telemetry import get_telemetry, json_safe
 
 INCIDENT_FILE = "incident.json"
@@ -339,6 +340,13 @@ class WatchdogMonitor:
             "recent_anomalies": [a.to_json() for a in self.incidents],
             "last_rows": self.detector.last_rows(),
             "telemetry": snap,
+            # fault-injection evidence (ISSUE 10 satellite): when a
+            # chaos plan is armed, the post-mortem names the exact
+            # fired sites/invocations — an injected NaN row's incident
+            # is attributable to its trigger, closing the loop between
+            # injection and detection. None on un-injected runs.
+            "faults": (_faults.get_injector().summary()
+                       if _faults.get_injector() is not None else None),
         }
         os.makedirs(self.workdir, exist_ok=True)
         path = os.path.join(self.workdir, INCIDENT_FILE)

@@ -1,0 +1,352 @@
+"""Deterministic, plan-driven fault injection (the chaos layer).
+
+ISSUE 10 tentpole piece 1. The ROADMAP's north star is a production
+system, and production JAX stacks treat component failure as routine
+(the pjit/TPUv4 scaling paper trains through preemptions via
+checkpoint/resume; the TensorFlow system paper makes fault tolerance a
+first-class runtime design axis) — but a recovery path that is never
+exercised is a recovery path that does not work. This module makes
+failure *injectable, reproducible and accounted*:
+
+- **Named sites.** Every guarded operation calls
+  :func:`fault_point("<site>")` (raising sites) or
+  :func:`corrupt_value("<site>", v)` (value-corruption sites) at the
+  exact place a real fault would land: the checkpoint commit
+  (``ckpt.commit``), the torn instant between the sidecar and msgpack
+  renames (``ckpt.torn``), the async writer thread (``ckpt.writer``),
+  a fleet replica's burst dispatch (``fleet.worker.rNN``), the data
+  loader's batch assembly (``data.batch``), the metrics writer
+  (``metrics.write``), a drained metrics row's loss value
+  (``metrics.row``), and the training loop's step dispatch
+  (``train.step``). Sites cost one module-global read when no plan is
+  armed — the process default — so the chaos layer is invisible in
+  production runs (the telemetry off-by-default discipline).
+
+- **Pure firing decision.** Whether invocation ``n`` of a site fires
+  is a pure function of ``(seed, site, n)`` and the plan — ``at=N``
+  fires exactly at the Nth call, ``every=K`` on every Kth,
+  ``p=0.25`` via a seeded hash — so every chaos run is exactly
+  reproducible: re-running the same plan against the same workload
+  kills the same burst / tears the same save. No RNG state is shared
+  with anything (the decision hashes, it does not draw), so an armed
+  plan that never fires is bitwise invisible to training and serving.
+
+- **Accounted.** Every fire lands a telemetry counter
+  (``faults_injected`` + the per-site series, cat ``faults``) and an
+  entry in the injector's ``fired`` log; ``summary()`` is the evidence
+  block incident post-mortems and RESILIENCE.json embed, closing the
+  loop between injection and detection.
+
+Plan grammar (one spec per site, comma-separated)::
+
+    site[@N][:every=K][:p=F][:kind=raise|exit|nan][:times=M]
+
+- ``site@N`` — fire at invocation N (0-based), once (``times=1``).
+- ``site:every=K`` — fire every Kth invocation (0, K, 2K, ...).
+- ``site:p=F`` — fire with probability F, decided by a seeded hash of
+  ``(seed, site, n)`` (deterministic; independent across sites).
+- ``kind=raise`` (default) raises :class:`InjectedFault`;
+  ``kind=exit`` calls ``os._exit(EXIT_CODE)`` — a true crash, no
+  ``finally`` blocks, the kill -9 of the crash-equivalence harness;
+  ``kind=nan`` only fires at value sites (:func:`corrupt_value`),
+  replacing the value with NaN.
+- ``times=M`` caps total fires (default 1 for ``at``, unbounded for
+  ``every``/``p``); ``times=0`` means unbounded explicitly.
+
+``retry_call`` is the shared bounded-retry-with-backoff helper the
+recovery paths use (checkpoint commits, fleet requeues): attempts and
+backoff schedule are deterministic in the attempt index, and each
+retry ticks a telemetry counter so recovery work is observable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+EXIT_CODE = 70  # os.EX_SOFTWARE: the injected hard-crash exit status
+
+KINDS = ("raise", "exit", "nan")
+
+
+class InjectedFault(RuntimeError):
+    """Raised at an armed fault site; carries the site + invocation so
+    handlers (and tests) can tell injected failures from real ones."""
+
+    def __init__(self, site: str, invocation: int):
+        self.site = site
+        self.invocation = invocation
+        super().__init__(
+            f"injected fault at site {site!r} (invocation {invocation})")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One site's firing rule (see the module docstring's grammar)."""
+
+    site: str
+    at: Optional[int] = None
+    every: Optional[int] = None
+    p: Optional[float] = None
+    kind: str = "raise"
+    times: Optional[int] = None   # None = grammar default
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"{self.site}: kind must be one of {KINDS}, "
+                             f"got {self.kind!r}")
+        rules = [r for r in (self.at, self.every, self.p) if r is not None]
+        if len(rules) != 1:
+            raise ValueError(
+                f"{self.site}: exactly one of @N / every=K / p=F must be "
+                f"given, got {len(rules)}")
+        if self.every is not None and self.every < 1:
+            raise ValueError(f"{self.site}: every must be >= 1")
+        if self.p is not None and not 0.0 < self.p <= 1.0:
+            raise ValueError(f"{self.site}: p must be in (0, 1]")
+
+    @property
+    def max_fires(self) -> Optional[int]:
+        """Fire cap: explicit ``times`` wins (0 = unbounded); ``at``
+        defaults to one fire, ``every``/``p`` to unbounded."""
+        if self.times is not None:
+            return None if self.times == 0 else self.times
+        return 1 if self.at is not None else None
+
+    def due(self, seed: int, n: int) -> bool:
+        """Pure firing decision for invocation ``n`` (ignores the fire
+        cap — the injector enforces that statefully)."""
+        if self.at is not None:
+            return n == self.at
+        if self.every is not None:
+            return n % self.every == 0
+        return _unit_hash(seed, self.site, n) < self.p
+
+
+def _unit_hash(seed: int, site: str, n: int) -> float:
+    """Deterministic uniform in [0, 1) from ``(seed, site, n)`` — a
+    hash, not an RNG draw, so probabilistic sites share no stream with
+    the workload (or each other)."""
+    h = hashlib.blake2b(f"{seed}:{site}:{n}".encode(),
+                        digest_size=8).digest()
+    return int.from_bytes(h, "big") / 2.0 ** 64
+
+
+def parse_plan(spec: str) -> Dict[str, FaultSpec]:
+    """Parse a ``--fault_plan`` string into ``{site: FaultSpec}``.
+
+    Example: ``"ckpt.commit@1,fleet.worker.r0@0,metrics.row@3:kind=nan"``.
+    """
+    out: Dict[str, FaultSpec] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        head = fields[0]
+        kw: Dict[str, object] = {}
+        if "@" in head:
+            site, at = head.split("@", 1)
+            try:
+                kw["at"] = int(at)
+            except ValueError:
+                raise ValueError(f"bad fault spec {part!r}: @N needs an "
+                                 f"integer invocation, got {at!r}")
+        else:
+            site = head
+        if not site:
+            raise ValueError(f"bad fault spec {part!r}: empty site name")
+        for f in fields[1:]:
+            if "=" not in f:
+                raise ValueError(f"bad fault spec {part!r}: field {f!r} "
+                                 f"is not key=value")
+            k, v = f.split("=", 1)
+            if k == "kind":
+                kw["kind"] = v
+            elif k == "every":
+                kw["every"] = int(v)
+            elif k == "p":
+                kw["p"] = float(v)
+            elif k == "times":
+                kw["times"] = int(v)
+            else:
+                raise ValueError(f"bad fault spec {part!r}: unknown key "
+                                 f"{k!r} (kind/every/p/times)")
+        if site in out:
+            raise ValueError(f"duplicate fault site {site!r} in plan")
+        out[site] = FaultSpec(site=site, **kw)
+    return out
+
+
+class FaultInjector:
+    """Stateful executor of a parsed plan: per-site invocation counters
+    (thread-safe — fleet workers hit sites concurrently), the fire cap,
+    the fired log, and the telemetry counters. Construct via
+    :func:`configure`; the module global is what the sites consult."""
+
+    def __init__(self, plan: Dict[str, FaultSpec], seed: int = 0):
+        self.plan = dict(plan)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._fires: Dict[str, int] = {}
+        self.fired: List[Dict] = []
+
+    def _step(self, site: str) -> Optional[Dict]:
+        """Count one invocation of ``site``; return the booked fire
+        record (never re-read from ``fired`` — concurrent sites would
+        race for [-1]) or None. The telemetry tick happens outside the
+        lock."""
+        spec = self.plan.get(site)
+        with self._lock:
+            n = self._counts.get(site, 0)
+            self._counts[site] = n + 1
+            if spec is None or not spec.due(self.seed, n):
+                return None
+            cap = spec.max_fires
+            if cap is not None and self._fires.get(site, 0) >= cap:
+                return None
+            self._fires[site] = self._fires.get(site, 0) + 1
+            rec = {"site": site, "invocation": n, "kind": spec.kind}
+            self.fired.append(rec)
+        from sketch_rnn_tpu.utils.telemetry import get_telemetry, \
+            site_series
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter("faults_injected", 1.0, cat="faults")
+            tel.counter(site_series("faults_injected", site), 1.0,
+                        cat="faults")
+        return rec
+
+    def hit(self, site: str) -> None:
+        """One invocation of a raising site: no-op, raise, or hard-exit
+        per the due spec. ``kind=nan`` specs never fire here — a value
+        site and a raising site with the same name would double-count
+        otherwise."""
+        spec = self.plan.get(site)
+        if spec is not None and spec.kind == "nan":
+            return
+        rec = self._step(site)
+        if rec is None:
+            return
+        if rec["kind"] == "exit":
+            # the genuine crash: no finally blocks, no exception
+            # handlers, no atexit — what kill -9 / a preemption does
+            os._exit(EXIT_CODE)
+        raise InjectedFault(site, rec["invocation"])
+
+    def corrupt(self, site: str, value: float) -> float:
+        """One invocation of a value site: returns ``value`` or NaN."""
+        spec = self.plan.get(site)
+        if spec is None or spec.kind != "nan":
+            return value
+        return float("nan") if self._step(site) is not None else value
+
+    def count(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def summary(self) -> Dict:
+        """The evidence block: seed, plan, per-site invocation counts
+        and the exact fired log (incident.json / RESILIENCE.json)."""
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "plan": {s: {k: v for k, v in dataclasses.asdict(
+                    spec).items() if v is not None and k != "site"}
+                    for s, spec in sorted(self.plan.items())},
+                "counts": dict(sorted(self._counts.items())),
+                "fired": list(self.fired),
+            }
+
+    def __repr__(self) -> str:
+        return (f"FaultInjector(seed={self.seed}, "
+                f"sites={sorted(self.plan)}, fired={len(self.fired)})")
+
+
+# the process-wide injector; None = chaos layer off (the default)
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def configure(plan, seed: int = 0) -> FaultInjector:
+    """Arm the process-wide injector with ``plan`` (a spec string or a
+    parsed ``{site: FaultSpec}``); replaces any previous one."""
+    global _INJECTOR
+    if isinstance(plan, str):
+        plan = parse_plan(plan)
+    _INJECTOR = FaultInjector(plan, seed=seed)
+    return _INJECTOR
+
+
+def disable() -> None:
+    """Disarm (the process default; the conftest guard restores it)."""
+    global _INJECTOR
+    _INJECTOR = None
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _INJECTOR
+
+
+def fault_point(site: str) -> None:
+    """THE raising fault site. One global read when disarmed."""
+    inj = _INJECTOR
+    if inj is not None:
+        inj.hit(site)
+
+
+def corrupt_value(site: str, value: float) -> float:
+    """THE value-corruption site. One global read when disarmed."""
+    inj = _INJECTOR
+    if inj is None:
+        return value
+    return inj.corrupt(site, value)
+
+
+def backoff_s(base_s: float, attempt: int, cap_s: float = 2.0) -> float:
+    """Deterministic exponential backoff: ``min(cap, base * 2**attempt)``
+    — a pure function of the attempt index, so recovery cost is a
+    schedule, not a wall-clock accident."""
+    if base_s <= 0:
+        return 0.0
+    return min(cap_s, base_s * (2.0 ** attempt))
+
+
+def retry_call(fn: Callable, retries: int, backoff_base_s: float = 0.0,
+               describe: str = "operation",
+               counter: Optional[str] = None):
+    """Call ``fn()`` with up to ``retries`` bounded retries.
+
+    Transient = any ``Exception`` (and :class:`InjectedFault`, which
+    subclasses RuntimeError — injected transients exercise exactly the
+    real path); ``BaseException`` (KeyboardInterrupt, SystemExit)
+    passes through. The final failure re-raises the LAST error, so a
+    permanent fault still stops the caller loudly. Each retry sleeps
+    the deterministic :func:`backoff_s` schedule and ticks the
+    ``counter`` telemetry series (cat ``faults``) when given.
+    """
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    last: Optional[Exception] = None
+    for attempt in range(retries + 1):
+        if attempt:
+            time.sleep(backoff_s(backoff_base_s, attempt - 1))
+            from sketch_rnn_tpu.utils.telemetry import get_telemetry
+            tel = get_telemetry()
+            if tel.enabled and counter:
+                tel.counter(counter, 1.0, cat="faults")
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — transient by contract
+            last = e
+            if attempt >= retries:
+                raise
+            print(f"[faults] WARNING: {describe} failed "
+                  f"(attempt {attempt + 1}/{retries + 1}): {e!r}; "
+                  f"retrying in {backoff_s(backoff_base_s, attempt):.2f}s",
+                  flush=True)
+    raise last  # unreachable; keeps type checkers honest

@@ -120,6 +120,17 @@ def class_series(name: str, cls: Optional[str] = None) -> str:
     return f"{name}_{_SERIES_SAFE.sub('_', str(cls))}"
 
 
+def site_series(name: str, site: Optional[str] = None) -> str:
+    """Per-fault-site series name (ISSUE 10): ``faults_injected`` ->
+    ``faults_injected_ckpt_commit`` (site dots and other non-Prometheus
+    chars sanitized). Same contract as :func:`class_series` — the
+    emitter (utils/faults.py) and every /metrics consumer key the
+    per-site counters identically."""
+    if not site:
+        return name
+    return f"{name}_{_SERIES_SAFE.sub('_', str(site))}"
+
+
 def shard_suffix(process_index: int, host_count: int) -> str:
     """Filename suffix isolating one host's export shard.
 

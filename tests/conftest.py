@@ -81,8 +81,18 @@ def _no_leaked_fleet_threads():
     (serve/fleet.py and serve/loadgen.py registries) — a leaked worker
     keeps dispatching into whatever device/telemetry state later tests
     set up, exactly like a leaked metrics server. Leaks are drained AND
-    failed loudly, naming the leaker."""
+    failed loudly, naming the leaker.
+
+    ISSUE 10 extends the guard below the registries: after the
+    registry drain, NO fleet/loadgen/ckpt-writer THREAD may survive
+    the test — a faulted test (injected replica death, crashed async
+    save) must not leave a runtime thread behind even when its owning
+    object already unregistered. A short grace window covers threads
+    that are mid-exit (a ckpt writer finishing its last commit)."""
     yield
+    import threading
+    import time as _time
+
     from sketch_rnn_tpu.serve import fleet, loadgen
 
     leaked_gens = loadgen.stop_all()
@@ -91,6 +101,32 @@ def _no_leaked_fleet_threads():
         f"test leaked live load generators: {leaked_gens}")
     assert not leaked_fleets, (
         f"test leaked live serve fleets: {leaked_fleets}")
+
+    def _runtime_threads():
+        return sorted(t.name for t in threading.enumerate()
+                      if t.is_alive() and t.name.startswith(
+                          ("fleet-replica-", "loadgen", "ckpt-writer")))
+
+    deadline = _time.monotonic() + 5.0
+    survivors = _runtime_threads()
+    while survivors and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+        survivors = _runtime_threads()
+    assert not survivors, (
+        f"test left runtime thread(s) alive after drain: {survivors}")
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_fault_injector():
+    """ISSUE 10 guard: the fault injector is process-global and OFF by
+    default, like the telemetry core — a chaos test that arms a plan
+    must not leak it into later tests (an armed plan fires on exact
+    invocation counts, so a leak would corrupt arbitrary later
+    tests)."""
+    yield
+    from sketch_rnn_tpu.utils import faults
+
+    faults.disable()
 
 
 @pytest.fixture(autouse=True)

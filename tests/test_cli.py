@@ -345,3 +345,27 @@ def test_cli_train_no_resume(tmp_path, capsys):
                  "--no_resume", f"--hparams={HP}"]) == 0
     out = capsys.readouterr().out
     assert "resumed" not in out
+
+
+def test_cli_bad_fault_plan_is_usage_error(tmp_path, capsys):
+    """ISSUE 10: a malformed --fault_plan fails fast (rc 2, one stderr
+    line) BEFORE any data load / restore / compile, for both chaos
+    entry points."""
+    rc = main(["train", "--synthetic", f"--workdir={tmp_path}",
+               "--fault_plan=train.step@@oops"])
+    assert rc == 2
+    assert "bad --fault_plan" in capsys.readouterr().err
+    rc = main(["serve-bench", "--random_init", "-n", "2",
+               "--fault_plan=:kind=raise"])
+    assert rc == 2
+    assert "bad --fault_plan" in capsys.readouterr().err
+    # and a well-formed plan never leaks out of the cli (armed plans
+    # are process-global; the finally disarms even on the rc-2 path)
+    from sketch_rnn_tpu.utils import faults
+    assert faults.get_injector() is None
+    # ...including when setup fails AFTER arming (bad data_dir raises
+    # inside _load_data with the plan already armed)
+    with pytest.raises(FileNotFoundError):
+        main(["train", f"--workdir={tmp_path}", "--data_dir=/nonexist",
+              "--fault_plan=train.step@5", f"--hparams={HP}"])
+    assert faults.get_injector() is None

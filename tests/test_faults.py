@@ -1,0 +1,441 @@
+"""Fault-injection layer tests (ISSUE 10): the plan grammar, the pure
+firing decision, the retry helper, and every wired site — including the
+crash-mid-save pin (torn commit between sidecar and msgpack) and the
+watchdog's injection->detection evidence loop. The end-to-end matrix
+(crash+resume bitwise equivalence, fleet failover parity) lives in
+tests/test_resilience_bench.py and tests/test_fleet.py."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.utils import faults
+from sketch_rnn_tpu.utils.faults import (
+    FaultSpec,
+    InjectedFault,
+    backoff_s,
+    parse_plan,
+    retry_call,
+)
+
+
+# -- plan grammar ------------------------------------------------------------
+
+
+def test_parse_plan_grammar():
+    plan = parse_plan("a@3,b:every=2,c:p=0.5,d@0:kind=exit,"
+                      "e@1:times=3,f:p=1.0:kind=nan")
+    assert plan["a"].at == 3 and plan["a"].max_fires == 1
+    assert plan["b"].every == 2 and plan["b"].max_fires is None
+    assert plan["c"].p == 0.5
+    assert plan["d"].kind == "exit"
+    assert plan["e"].times == 3 and plan["e"].max_fires == 3
+    assert plan["f"].kind == "nan"
+    assert parse_plan("") == {} and parse_plan(None) == {}
+
+
+@pytest.mark.parametrize("bad", [
+    "a@x", "a", "a@1:every=2", "a:kind=boom", "a@1:wat=2", "@1",
+    "a:every=0", "a:p=0", "a:p=1.5", "a@1,a@2", "a:nokey",
+])
+def test_parse_plan_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        parse_plan(bad)
+
+
+def test_firing_decision_pure_and_deterministic():
+    spec = FaultSpec(site="s", p=0.3)
+    draws = [spec.due(7, n) for n in range(200)]
+    assert draws == [spec.due(7, n) for n in range(200)]  # pure
+    frac = sum(draws) / len(draws)
+    assert 0.15 < frac < 0.45          # roughly p, never exact
+    # a different seed fires a different (but equally deterministic) set
+    assert draws != [spec.due(8, n) for n in range(200)]
+    at = FaultSpec(site="s", at=5)
+    assert [at.due(0, n) for n in range(8)] == [False] * 5 + [True,
+                                                              False,
+                                                              False]
+    ev = FaultSpec(site="s", every=3)
+    assert [ev.due(0, n) for n in range(7)] == [True, False, False,
+                                               True, False, False,
+                                               True]
+
+
+def test_injector_counts_caps_and_summary():
+    inj = faults.configure("a:every=1:times=2", seed=3)
+    fired = 0
+    for _ in range(5):
+        try:
+            inj.hit("a")
+        except InjectedFault:
+            fired += 1
+    assert fired == 2                   # times cap
+    assert inj.count("a") == 5
+    s = inj.summary()
+    assert [f["invocation"] for f in s["fired"]] == [0, 1]
+    assert s["plan"]["a"]["every"] == 1
+    faults.disable()
+    assert faults.get_injector() is None
+
+
+def test_disabled_sites_are_noops():
+    faults.disable()
+    faults.fault_point("anything")       # no raise
+    assert faults.corrupt_value("anything", 2.5) == 2.5
+
+
+def test_nan_kind_only_fires_at_value_sites():
+    faults.configure("v@0:kind=nan")
+    try:
+        faults.fault_point("v")          # raising site ignores nan spec
+        assert np.isnan(faults.corrupt_value("v", 1.0))
+        assert faults.corrupt_value("v", 1.0) == 1.0  # at=0 spent
+    finally:
+        faults.disable()
+
+
+def test_injected_faults_tick_telemetry_counters():
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    tel = tele.configure(trace_dir=None)
+    faults.configure("ckpt.commit@0")
+    try:
+        with pytest.raises(InjectedFault):
+            faults.fault_point("ckpt.commit")
+        counters = tel.counters()
+        assert counters[("faults", "faults_injected")] == 1
+        assert counters[("faults", "faults_injected_ckpt_commit")] == 1
+    finally:
+        faults.disable()
+        tele.disable()
+
+
+# -- retry helper ------------------------------------------------------------
+
+
+def test_backoff_schedule_is_deterministic():
+    assert backoff_s(0.0, 5) == 0.0
+    assert backoff_s(0.1, 0) == pytest.approx(0.1)
+    assert backoff_s(0.1, 3) == pytest.approx(0.8)
+    assert backoff_s(0.1, 30) == 2.0    # capped
+
+
+def test_retry_call_bounded():
+    calls = []
+
+    def flaky(fail_times):
+        def fn():
+            calls.append(1)
+            if len(calls) <= fail_times:
+                raise OSError("disk hiccup")
+            return "ok"
+        return fn
+
+    assert retry_call(flaky(2), retries=2) == "ok"
+    assert len(calls) == 3
+    calls.clear()
+    with pytest.raises(OSError, match="hiccup"):
+        retry_call(flaky(99), retries=2)
+    assert len(calls) == 3              # bounded: 1 + 2 retries
+    with pytest.raises(ValueError, match="retries"):
+        retry_call(lambda: None, retries=-1)
+
+
+# -- wired sites -------------------------------------------------------------
+
+
+def _tiny_state():
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train.state import make_train_state
+
+    hps = HParams(batch_size=4, max_seq_len=8, enc_rnn_size=8,
+                  dec_rnn_size=8, z_size=4, num_mixture=2,
+                  ckpt_retry_backoff_s=0.0)
+    model = SketchRNN(hps)
+    return hps, make_train_state(model, hps, jax.random.key(0))
+
+
+def test_ckpt_commit_transient_retried_bitwise(tmp_path):
+    """A transient commit failure is retried and the retried file is
+    byte-identical to an unfaulted save's."""
+    from sketch_rnn_tpu.train.checkpoint import save_checkpoint
+
+    hps, state = _tiny_state()
+    clean = save_checkpoint(str(tmp_path / "clean"), state, 1.0, hps)
+    faults.configure("ckpt.commit@0")
+    try:
+        path = save_checkpoint(str(tmp_path / "faulted"), state, 1.0,
+                               hps, retries=2, retry_backoff_s=0.0)
+    finally:
+        faults.disable()
+    assert open(path, "rb").read() == open(clean, "rb").read()
+    # without a retry budget the same fault stops the save loudly
+    faults.configure("ckpt.commit@0")
+    try:
+        with pytest.raises(InjectedFault):
+            save_checkpoint(str(tmp_path / "nofretry"), state, 1.0, hps)
+    finally:
+        faults.disable()
+
+
+def test_crash_mid_save_torn_commit_pins_previous_checkpoint(tmp_path):
+    """ISSUE 10 satellite: kill the commit BETWEEN the sidecar and
+    msgpack writes (the documented torn-write window, now exercised
+    under injection) — latest_checkpoint, _prune and resume must all
+    agree on the previous COMPLETE checkpoint."""
+    from sketch_rnn_tpu.train.checkpoint import (
+        _prune,
+        latest_checkpoint,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    hps, state = _tiny_state()
+    d = str(tmp_path)
+    save_checkpoint(d, state._replace(step=jnp.asarray(3, jnp.int32)),
+                    1.5, hps)
+    faults.configure("ckpt.torn@0")
+    try:
+        with pytest.raises(InjectedFault):
+            save_checkpoint(
+                d, state._replace(step=jnp.asarray(6, jnp.int32)), 1.5,
+                hps)
+    finally:
+        faults.disable()
+    # the torn save left only the step-6 sidecar: an orphan, not a
+    # checkpoint
+    names = sorted(os.listdir(d))
+    assert "ckpt_00000006.json" in names
+    assert "ckpt_00000006.msgpack" not in names
+    assert latest_checkpoint(d) == 3
+    restored, scale, _ = restore_checkpoint(d, state)
+    assert int(restored.step) == 3 and scale == 1.5
+    # cleanup agrees with resume: the orphan is pruned, step 3 kept
+    _prune(d, keep=3)
+    names = sorted(os.listdir(d))
+    assert "ckpt_00000006.json" not in names
+    assert latest_checkpoint(d) == 3
+    # and a retried torn commit self-heals: the commit is idempotent
+    faults.configure("ckpt.torn@0")
+    try:
+        save_checkpoint(
+            d, state._replace(step=jnp.asarray(9, jnp.int32)), 1.5, hps,
+            retries=1, retry_backoff_s=0.0)
+    finally:
+        faults.disable()
+    assert latest_checkpoint(d) == 9
+
+
+def test_data_batch_fault_site_fires_in_assembly():
+    from sketch_rnn_tpu.data.loader import DataLoader, \
+        make_synthetic_strokes
+
+    hps = HParams(batch_size=4, max_seq_len=16)
+    seqs, labels = make_synthetic_strokes(8, max_len=12, seed=0)
+    loader = DataLoader(seqs, hps, labels=labels, seed=0)
+    faults.configure("data.batch@1")
+    try:
+        loader.random_batch()            # invocation 0 passes
+        with pytest.raises(InjectedFault, match="data.batch"):
+            loader.random_batch()
+        loader.random_batch()            # one-shot: the stream survives
+    finally:
+        faults.disable()
+
+
+def test_metrics_sites_write_and_nan_row(tmp_path):
+    from sketch_rnn_tpu.train.metrics import MetricsDrain, MetricsWriter
+
+    w = MetricsWriter(str(tmp_path), "train")
+    faults.configure("metrics.write@0")
+    try:
+        with pytest.raises(InjectedFault, match="metrics.write"):
+            w.write(1, {"loss": 1.0})
+    finally:
+        faults.disable()
+    # the value-corruption site NaNs a drained row's loss (and ONLY
+    # the planned invocation)
+    faults.configure("metrics.row@1:kind=nan")
+    try:
+        drain = MetricsDrain(w, defer=False)
+        drain.push(1, {"loss": 1.0})
+        drain.push(2, {"loss": 2.0})
+        drain.push(3, {"loss": 3.0})
+    finally:
+        faults.disable()
+    rows = [json.loads(line) for line in
+            open(tmp_path / "train_metrics.jsonl")]
+    assert [r["loss"] for r in rows][0] == 1.0
+    assert np.isnan(rows[1]["loss"]) and rows[2]["loss"] == 3.0
+
+
+def test_async_writer_fault_raises_one_save_late(tmp_path):
+    from sketch_rnn_tpu.train.async_ckpt import AsyncCheckpointer
+
+    hps, state = _tiny_state()
+    ckpt = AsyncCheckpointer(str(tmp_path))
+    faults.configure("ckpt.writer@0")
+    try:
+        ckpt.save(state, 1.0, hps)       # writer dies in background
+        ckpt.join()
+        assert isinstance(ckpt.failure, InjectedFault)
+        with pytest.raises(RuntimeError, match="async checkpoint"):
+            ckpt.save(state, 1.0, hps)   # surfaces one save late
+    finally:
+        faults.disable()
+        ckpt.join()
+
+
+def test_async_commit_transient_retried_in_background(tmp_path):
+    """The writer thread's commit rides the same bounded retry: a
+    transient failure never surfaces to the loop at all."""
+    from sketch_rnn_tpu.train.async_ckpt import AsyncCheckpointer
+    from sketch_rnn_tpu.train.checkpoint import latest_checkpoint
+
+    hps, state = _tiny_state()          # ckpt_retries=2 default
+    ckpt = AsyncCheckpointer(str(tmp_path))
+    faults.configure("ckpt.commit@0")
+    try:
+        ckpt.save(state, 1.0, hps)
+        ckpt.wait()                      # no raise: the retry absorbed it
+    finally:
+        faults.disable()
+    assert latest_checkpoint(str(tmp_path)) == 0
+
+
+def test_watchdog_incident_records_fault_evidence(tmp_path):
+    """ISSUE 10 satellite: an incident written while a chaos plan is
+    armed embeds the injector's fired log — the triggering fault site
+    is in the post-mortem's evidence."""
+    from sketch_rnn_tpu.train.watchdog import WatchdogMonitor
+
+    inj = faults.configure("metrics.row@0:kind=nan")
+    try:
+        bad = inj.corrupt("metrics.row", 1.0)
+        assert np.isnan(bad)
+        mon = WatchdogMonitor(str(tmp_path)).arm()
+        try:
+            mon({"loss": bad}, step=4)
+        finally:
+            mon.disarm()
+    finally:
+        faults.disable()
+    inc = json.load(open(tmp_path / "incident.json"))
+    assert inc["anomalies"][0]["kind"] == "nonfinite"
+    assert [f["site"] for f in inc["faults"]["fired"]] == ["metrics.row"]
+    assert inc["faults"]["plan"]["metrics.row"]["kind"] == "nan"
+
+
+# -- loader / ndjson hardening (ISSUE 10 satellite) --------------------------
+
+
+def test_corrupt_npz_record_fails_with_one_line_error(tmp_path):
+    from sketch_rnn_tpu.data.loader import load_dataset, \
+        make_synthetic_strokes
+
+    seqs, _ = make_synthetic_strokes(30, max_len=12, seed=0)
+    sets = {}
+    for split, lo, hi in (("train", 0, 20), ("valid", 20, 25),
+                          ("test", 25, 30)):
+        arr = np.empty(hi - lo, dtype=object)
+        arr[:] = seqs[lo:hi]
+        sets[split] = arr
+    sets["train"][3] = np.zeros((4, 7), np.float32)   # wrong columns
+    path = tmp_path / "cat.npz"
+    np.savez_compressed(path, **sets)
+    hps = HParams(batch_size=2, max_seq_len=16, data_set=("cat.npz",),
+                  data_dir=str(tmp_path))
+    with pytest.raises(ValueError) as ei:
+        load_dataset(hps)
+    msg = str(ei.value)
+    assert "cat.npz[train] record 3" in msg and "\n" not in msg
+
+    # under the explicit flag the record is skipped and counted
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    tel = tele.configure(trace_dir=None)
+    try:
+        train_l, _, _, _ = load_dataset(hps, skip_bad_records=True)
+        assert len(train_l) == 19
+        assert tel.counters()[("data", "records_skipped")] == 1
+    finally:
+        tele.disable()
+
+
+def test_unreadable_npz_fails_with_file_name(tmp_path):
+    from sketch_rnn_tpu.data.loader import load_dataset
+
+    path = tmp_path / "cat.npz"
+    path.write_bytes(b"PK\x03\x04 truncated garbage")
+    hps = HParams(batch_size=2, max_seq_len=16, data_set=("cat.npz",),
+                  data_dir=str(tmp_path))
+    with pytest.raises(RuntimeError, match="cat.npz"):
+        load_dataset(hps)
+
+
+def test_corrupt_ndjson_line_named_or_skipped():
+    from sketch_rnn_tpu.data.quickdraw import iter_ndjson
+
+    good = json.dumps({"word": "cat", "recognized": True,
+                       "drawing": [[[0, 1, 2], [0, 1, 0]]]})
+    lines = [good, '{"torn": tru', good, '{"word": "x"}']
+    with pytest.raises(ValueError) as ei:
+        list(iter_ndjson(lines, source="cat.ndjson"))
+    assert "cat.ndjson line 2" in str(ei.value)
+    assert "\n" not in str(ei.value)
+    out = list(iter_ndjson(lines, source="cat.ndjson", skip_bad=True))
+    assert len(out) == 2                # both bad lines skipped
+
+
+# -- off-by-default invisibility ---------------------------------------------
+
+
+def test_armed_never_firing_plan_is_bitwise_invisible(tmp_path):
+    """An armed plan whose sites never fire must not change training at
+    all: metrics files and final state bitwise equal a faults-off run
+    (the decision hashes — it never draws from any RNG stream)."""
+    from sketch_rnn_tpu.data.loader import synthetic_loader
+    from sketch_rnn_tpu.train.loop import train
+
+    hps = HParams(batch_size=4, max_seq_len=16, enc_rnn_size=8,
+                  dec_rnn_size=8, z_size=4, num_mixture=2,
+                  num_steps=4, save_every=10 ** 9, log_every=2,
+                  eval_every=10 ** 9, prefetch_depth=0)
+
+    def run(sub, plan):
+        loader, scale = synthetic_loader(hps, 16, seed=1, augment=True)
+        if plan:
+            faults.configure(plan)
+        try:
+            state = train(hps, loader, scale_factor=scale,
+                          workdir=str(tmp_path / sub), seed=0,
+                          use_mesh=False, resume=False)
+        finally:
+            faults.disable()
+        return state
+
+    s_off = run("off", None)
+    s_armed = run("armed", "train.step@999999,ckpt.commit@999999")
+    for a, b in zip(jax.tree_util.tree_leaves(s_off),
+                    jax.tree_util.tree_leaves(s_armed)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    csv_off = (tmp_path / "off" / "train_metrics.csv").read_text()
+    csv_armed = (tmp_path / "armed" / "train_metrics.csv").read_text()
+
+    def strip_wall(text):
+        import csv as _csv
+        import io
+        rows = list(_csv.DictReader(io.StringIO(text)))
+        for r in rows:
+            r.pop("wall_time", None)
+            for k in list(r):
+                if k.startswith("t_") or "per_sec" in k:
+                    r.pop(k)
+        return rows
+
+    assert strip_wall(csv_off) == strip_wall(csv_armed)

@@ -310,3 +310,152 @@ def test_drain_raises_when_closed_underneath(tiny_fleet_setup):
     fleet.close()
     with pytest.raises(RuntimeError, match="closed while draining"):
         fleet.drain(timeout=5)
+
+
+# -- failover (ISSUE 10) -----------------------------------------------------
+
+
+def test_admission_mark_dead_shrinks_capacity():
+    c = _controller()
+    c.place("batch"), c.place("batch"), c.place("batch")
+    assert c.backlog == [2, 1]
+    dropped = c.mark_dead(0)
+    assert dropped == 2 and c.dead == [0] and c.live_replicas == [1]
+    assert c.backlog == [0, 1]          # dead backlog dropped
+    assert c.mark_dead(0) == 0          # idempotent
+    # placement only ever chooses survivors now
+    assert all(c.place("batch").replica == 1 for _ in range(4))
+    s = c.summary()
+    assert s["dead_replicas"] == [0] and s["live_replicas"] == 1
+    c.mark_dead(1)
+    with pytest.raises(RuntimeError, match="no live replicas"):
+        c.place("batch")
+
+
+def test_fleet_failover_completes_with_chaos_parity(tiny_fleet_setup):
+    """THE acceptance pin: a replica killed mid-burst -> its requests
+    fail over, drain() completes, /healthz degrades, and every
+    completed request's strokes are BITWISE identical to the no-fault
+    run (the placement-invariance guarantee extended to failure)."""
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.serve.metrics_http import health_payload
+    from sketch_rnn_tpu.utils import faults
+    from sketch_rnn_tpu.utils.telemetry import get_telemetry
+
+    hps, model, params = tiny_fleet_setup
+    n = 6
+
+    def run(plan):
+        if plan:
+            faults.configure(plan)
+        try:
+            fleet = ServeFleet(model, hps, params, replicas=2,
+                               retry_backoff_s=0.0)
+            for i in range(n):
+                fleet.submit(_req(i, hps.z_size))
+            with fleet:
+                assert fleet.drain(timeout=120)
+                return (fleet.results, fleet.summary(), fleet.health())
+        finally:
+            faults.disable()
+
+    res0, sum0, health0 = run(None)
+    res1, sum1, health1 = run("fleet.worker.r0@0")
+    # the no-fault run is healthy; the faulted one is degraded but DONE
+    assert health0["healthy"] and not health1["healthy"]
+    assert sum1["completed"] == n and sum1["failed"] == 0
+    assert sum1["replicas_dead"] == 1 and sum1["requeues"] > 0
+    assert [r["dead"] for r in sum1["per_replica"]] == [True, False]
+    # every requeued request landed on the survivor
+    assert all(rec["replica"] == 1 for rec in res1.values())
+    # requeues never re-count admission: admitted == what arrived
+    assert sum1["admission"]["admitted"] == n
+    assert sum1["admission"]["dead_replicas"] == [0]
+    # chaos parity: strokes bitwise identical to the no-fault run
+    assert sorted(res0) == sorted(res1) == list(range(n))
+    for uid in res0:
+        assert np.array_equal(res0[uid]["result"].strokes5,
+                              res1[uid]["result"].strokes5)
+    # /healthz flips to degraded on the fleet's verdict
+    payload = health_payload(get_telemetry(), None, lambda: health1)
+    assert payload["status"] == "degraded"
+    assert payload["fleet"]["replicas_dead"][0]["replica"] == 0
+    assert health_payload(get_telemetry(), None,
+                          lambda: health0)["status"] == "ok"
+
+
+def test_fleet_failover_last_replica_death_is_fatal(tiny_fleet_setup):
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.utils import faults
+
+    hps, model, params = tiny_fleet_setup
+    faults.configure("fleet.worker.r0@0")
+    try:
+        fleet = ServeFleet(model, hps, params, replicas=1)
+        fleet.submit(_req(0, hps.z_size))
+        with fleet:
+            with pytest.raises(RuntimeError, match="fleet worker failed"):
+                fleet.drain(timeout=60)
+        assert not fleet.health()["healthy"]
+    finally:
+        faults.disable()
+
+
+def test_fleet_failover_budget_exhausted_fails_requests(tiny_fleet_setup):
+    """retry_budget=0: a dead replica's requests are recorded as failed
+    (never silently dropped) and drain() still completes — the fleet
+    reports the damage instead of hanging or lying."""
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.utils import faults
+
+    hps, model, params = tiny_fleet_setup
+    faults.configure("fleet.worker.r0@0")
+    try:
+        fleet = ServeFleet(model, hps, params, replicas=2,
+                           retry_budget=0, retry_backoff_s=0.0)
+        for i in range(6):
+            fleet.submit(_req(i, hps.z_size))
+        with fleet:
+            assert fleet.drain(timeout=120)
+            s = fleet.summary()
+            failed = fleet.failed
+            results = fleet.results
+    finally:
+        faults.disable()
+    # replica 0's pre-start share died with it; the rest completed
+    assert s["failed"] == len(failed) > 0
+    assert s["completed"] == 6 - s["failed"]
+    assert set(failed) | set(results) == set(range(6))
+    for rec in failed.values():
+        assert "retry budget" in rec["reason"]
+        assert rec["retries"] == 0
+    # reset refuses a degraded fleet (its worker thread is gone)
+    with pytest.raises(RuntimeError, match="degraded"):
+        fleet.reset()
+
+
+def test_fleet_failover_counters_and_close_reports(tiny_fleet_setup):
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.utils import faults
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    hps, model, params = tiny_fleet_setup
+    tel = tele.configure(trace_dir=None)
+    faults.configure("fleet.worker.r1@0")
+    try:
+        fleet = ServeFleet(model, hps, params, replicas=2,
+                           retry_backoff_s=0.0)
+        for i in range(4):
+            fleet.submit(_req(i, hps.z_size))
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        assert fleet.close() == []       # clean join, no stragglers
+        counters = tel.counters()
+        assert counters[("serve", "replica_deaths")] == 1
+        assert counters[("serve", "requests_requeued")] > 0
+        assert counters[("faults", "faults_injected")] == 1
+        assert counters[("faults",
+                         "faults_injected_fleet_worker_r1")] == 1
+    finally:
+        faults.disable()
+        tele.disable()

@@ -285,3 +285,16 @@ def test_integer_grid_int16_feed_bitwise_equals_f32(hps):
     np.testing.assert_array_equal(dq, bf["strokes"][..., :2])
     np.testing.assert_array_equal(
         bq["strokes"][..., 2:].astype(np.float32), bf["strokes"][..., 2:])
+
+
+def test_purify_drops_empty_records_without_flagging_corrupt():
+    """ISSUE 10 review fix: an empty record is DROPPED (the
+    pre-hardening filter contract), never reported as corrupt — only
+    malformed non-empty records fail."""
+    from sketch_rnn_tpu.data.loader import _purify
+
+    good = np.ones((4, 3), np.float32)
+    out = _purify([good, np.zeros((0,)), [], good], 10)
+    assert len(out) == 2
+    with pytest.raises(ValueError, match="record 1"):
+        _purify([good, np.ones((4, 7), np.float32)], 10, source="x")

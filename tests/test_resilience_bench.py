@@ -1,0 +1,114 @@
+"""Resilience harness tests (ISSUE 10 satellite: CI wiring).
+
+``test_resilience_smoke`` runs the REAL fault matrix on the CPU smoke
+config and asserts every cell's expected outcome — the tier-1 proof
+that crash+resume is bitwise-equivalent, torn saves fall back, a
+permanent writer failure halts loudly one save late, the watchdog
+attributes injected NaNs, and fleet failover drains with chaos parity.
+The regression-gate tests are pure: they pin that a future ``ok:
+false`` resilience row actually gates (bench_regress) and that
+bench_summary keys the rows per (site, mode).
+"""
+
+import json
+
+import pytest
+
+import scripts.bench_regress as bench_regress
+import scripts.resilience_bench as resilience_bench
+from scripts.bench_summary import key_of, metric_of
+
+
+def test_resilience_smoke(tmp_path):
+    out = tmp_path / "RESILIENCE.json"
+    rc = resilience_bench.main(["--smoke", f"--out={out}",
+                                f"--workdir={tmp_path / 'work'}"])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    assert rec["all_ok"] is True
+    by_site = {c["site"]: c for c in rec["cells"]}
+    assert by_site["train.step"]["outcome"] == "recovered"
+    assert by_site["train.step"]["final_state_bitwise_equal"] is True
+    assert by_site["train.step"]["recovery_cost_steps"] == \
+        by_site["train.step"]["crash_step"] - \
+        by_site["train.step"]["resumed_from_step"]
+    assert by_site["ckpt.commit"]["outcome"] == "recovered"
+    assert by_site["ckpt.commit"]["retries_used"] == 1
+    assert by_site["ckpt.torn"]["outcome"] == "recovered"
+    assert by_site["ckpt.torn"]["resumed_from_step"] == \
+        rec["config"]["save_every"]
+    assert by_site["ckpt.writer"]["outcome"] == "clean-halt"
+    assert by_site["ckpt.writer"]["no_checkpoint_left"] is True
+    assert by_site["metrics.row"]["outcome"] == "clean-halt"
+    assert by_site["metrics.row"]["fault_site_in_evidence"] is True
+    assert by_site["fleet.worker"]["outcome"] == "degraded"
+    assert by_site["fleet.worker"]["strokes_bitwise_equal"] is True
+    # recovery costs are deterministic step counts, never wall-clock
+    assert all("wall" not in k
+               for c in rec["cells"] for k in c
+               if k.startswith("recovery_cost"))
+
+
+def _row(ok, site="train.step", mode="raise"):
+    return {"kind": "resilience", "site": site, "mode": mode,
+            "device_kind": "cpu", "smoke": True, "ok": ok,
+            "expected": "recovered",
+            "outcome": "recovered" if ok else "FAILED"}
+
+
+def test_bench_summary_keys_resilience_per_site_and_mode():
+    a, b = _row(True), _row(True, mode="subprocess-exit")
+    assert key_of(a) != key_of(b)          # modes never pool
+    assert key_of(a) == key_of(_row(False))
+    assert metric_of(_row(True)) == 1.0
+    assert metric_of(_row(False)) == 0.0
+
+
+def test_bench_regress_gates_broken_resilience_cell(tmp_path, capsys):
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text("".join(json.dumps(_row(True)) + "\n"
+                            for _ in range(4)))
+    ok_fresh = tmp_path / "ok.jsonl"
+    ok_fresh.write_text(json.dumps(_row(True)) + "\n")
+    bad_fresh = tmp_path / "bad.jsonl"
+    bad_fresh.write_text(json.dumps(_row(False)) + "\n")
+    assert bench_regress.main([f"--fresh={ok_fresh}",
+                               f"--history={hist}"]) == 0
+    capsys.readouterr()
+    assert bench_regress.main([f"--fresh={bad_fresh}",
+                               f"--history={hist}"]) == 1
+    assert "REGRESS" in capsys.readouterr().out
+    # a RECORDED failure must not poison the baseline: with an ok=false
+    # row already in history, a fresh failure still gates (the failed
+    # row is evidence, not a baseline — without the filter the cell's
+    # band blows to 1.0 and the gate is disabled forever)
+    poisoned = tmp_path / "poisoned.jsonl"
+    poisoned.write_text(hist.read_text() + json.dumps(_row(False))
+                        + "\n")
+    assert bench_regress.main([f"--fresh={bad_fresh}",
+                               f"--history={poisoned}"]) == 1
+    capsys.readouterr()
+    # and the --smoke self-check fails on a history ENDING in a failure
+    assert bench_regress.main(["--smoke",
+                               f"--history={poisoned}"]) == 1
+
+
+def test_committed_smoke_history_self_check():
+    """The committed smoke history's resilience rows must themselves
+    end in-band — the same self-check tier-1 already runs for the perf
+    rows (bench_regress --smoke), now covering recovery outcomes."""
+    rc = bench_regress.main(["--smoke", "--json"])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_resilience_full_matches_committed(tmp_path):
+    """The full matrix (subprocess hard-kill included) — slow tier."""
+    out = tmp_path / "RESILIENCE.json"
+    rc = resilience_bench.main([f"--out={out}",
+                                f"--workdir={tmp_path / 'work'}"])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    subs = [c for c in rec["cells"] if c["mode"] == "subprocess-exit"]
+    assert subs and subs[0]["hard_killed"] is True
+    assert subs[0]["final_ckpt_bytes_equal"] is True

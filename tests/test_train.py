@@ -1120,3 +1120,55 @@ def test_train_loop_profile_trace_closed_on_error(tmp_path, monkeypatch):
     # starts (and stops) cleanly instead of raising "already started"
     jax.profiler.start_trace(os.path.join(str(tmp_path), "t2"))
     jax.profiler.stop_trace()
+
+
+# -- crash-equivalent resume (ISSUE 10) -------------------------------------
+
+
+def test_resume_align_reproduces_uninterrupted_state_bitwise(tmp_path):
+    """THE crash-equivalence pin, independent of the bench harness:
+    stop at the save cadence, resume with a FRESH loader, and the final
+    state must equal the uninterrupted run's leaf-bitwise —
+    ``resume_align`` fast-forwards the feed and the per-step
+    fold_in(key, step) RNG does the rest. The negative control proves
+    the pin bites: with ``resume_align=false`` (the legacy fresh-stream
+    resume) the states diverge."""
+    hps = tiny_hps(num_steps=8, save_every=4, log_every=4,
+                   eval_every=10 ** 9, prefetch_depth=2)
+
+    def leaves(state):
+        return [np.asarray(x) for x in jax.tree_util.tree_leaves(state)]
+
+    base = train(hps, make_loader(hps, augment=True), workdir=None,
+                 use_mesh=False, seed=0)
+
+    def interrupted(sub, align):
+        h = hps.replace(resume_align=align)
+        d = str(tmp_path / sub)
+        train(h.replace(num_steps=4), make_loader(h, augment=True),
+              workdir=d, use_mesh=False, seed=0, resume=False)
+        # a fresh identically-seeded loader, exactly like a new process
+        return train(h, make_loader(h, augment=True), workdir=d,
+                     use_mesh=False, seed=0, resume=True)
+
+    aligned = interrupted("aligned", True)
+    assert all(np.array_equal(a, b)
+               for a, b in zip(leaves(base), leaves(aligned)))
+    legacy = interrupted("legacy", False)
+    assert not all(np.array_equal(a, b)
+                   for a, b in zip(leaves(base), leaves(legacy)))
+
+
+def test_loader_fast_forward_aligns_stream():
+    hps = tiny_hps()
+    a = make_loader(hps, augment=True)
+    b = make_loader(hps, augment=True)
+    skipped = [a.random_batch() for _ in range(3)]
+    del skipped
+    b.fast_forward(3)
+    for _ in range(2):
+        x, y = a.random_batch(), b.random_batch()
+        assert np.array_equal(x["strokes"], y["strokes"])
+        assert np.array_equal(x["seq_len"], y["seq_len"])
+    with pytest.raises(ValueError, match="n_batches"):
+        b.fast_forward(-1)
