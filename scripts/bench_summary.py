@@ -65,6 +65,13 @@ def key_of(r: dict):
         # are different measurements (ISSUE 10)
         return ("resilience", r.get("site"),
                 f"mode={r.get('mode')} dev={dev}")
+    if r.get("kind") == "serve_cost":
+        # deterministic per-class cost-attribution cells (ISSUE 11):
+        # one per replica count of the fleet capacity arm; the binary
+        # exactness signal gates like the resilience cells
+        return ("servecost", r.get("dec_model"),
+                f"R={r.get('replicas')} B={r.get('slots')} "
+                f"K={r.get('chunk')} n={r.get('n_requests')} dev={dev}")
     # steps_per_call / transfer_dtype change what is being measured (feed
     # amortization), so K=5 rows must not pool with K=1 rows; old rows
     # predate the knobs and default to 1 / float32. `steps` keys too
@@ -90,11 +97,12 @@ def metric_of(r: dict):
         # the fleet's headline: realized sketches/sec at this cell's
         # (replicas, offered rate)
         return r.get("sketches_per_sec")
-    if r.get("kind") == "resilience":
+    if r.get("kind") in ("resilience", "serve_cost"):
         # binary outcome metric: 1.0 = the cell hit its expected
-        # recovery outcome, 0.0 = it missed. Deterministic, so the
-        # regression gate's band math (best=1.0, floored band) flags
-        # ANY future miss as a REGRESS while repeat passes stay "ok".
+        # outcome (recovery, or exact cost attribution), 0.0 = it
+        # missed. Deterministic, so the regression gate's band math
+        # (best=1.0, floored band) flags ANY future miss as a REGRESS
+        # while repeat passes stay "ok".
         ok = r.get("ok")
         return None if ok is None else (1.0 if ok else 0.0)
     return r.get("strokes_per_sec_per_chip") or r.get("sketches_per_sec")
@@ -117,7 +125,9 @@ def _fleet_cols(r: dict) -> str:
     throughput, the shed fraction under overload, and — on capacity
     rows — the ``scaling=`` efficiency (sketches/sec at R replicas /
     (R x the single-replica record)) plus the deterministic
-    step-parallel speedup."""
+    step-parallel speedup. ISSUE 11 adds the tail-attribution verdict
+    (``p99_dom=queue|decode`` + the dominant segment's share of tail
+    time, from the trace_query/engine shared decomposition)."""
     cols = []
     by_class = r.get("by_class") or {}
     if by_class:
@@ -125,6 +135,7 @@ def _fleet_cols(r: dict) -> str:
             f"{c}={1e3 * v['p99_s']:.0f}"
             for c, v in sorted(by_class.items())
             if v.get("p99_s") is not None))
+    cols.append(_tail_col(r))
     sf = r.get("shed_frac")
     if sf:
         cols.append(f" shed={sf:.1%}")
@@ -133,6 +144,18 @@ def _fleet_cols(r: dict) -> str:
     if r.get("step_parallel") is not None:
         cols.append(f" steps||={r['step_parallel']}x")
     return "".join(cols)
+
+
+def _tail_col(r: dict) -> str:
+    """The ISSUE 11 tail-attribution column: which critical-path
+    segment dominates the latency tail. Rows predating the
+    decomposition print nothing."""
+    dom = r.get("p99_dom")
+    if not dom:
+        return ""
+    frac = r.get("p99_dom_frac")
+    return (f" p99_dom={dom}" if frac is None
+            else f" p99_dom={dom}@{frac:.0%}")
 
 
 def _stacked_cols(r: dict) -> str:
@@ -192,7 +215,7 @@ def main(argv=None) -> int:
             # with None knobs
             if r.get("kind") not in ("train", "sampler", "bucket_bench",
                                      "serve_bench", "serve_fleet",
-                                     "resilience"):
+                                     "resilience", "serve_cost"):
                 continue
             v = metric_of(r)
             if v is None:
@@ -223,7 +246,7 @@ def main(argv=None) -> int:
             sp_col = f" {sp}x vs sampler" if sp is not None else ""
             print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
                   f"best={metric_of(b):>11.2f} sk/s ({when}"
-                  f"{_serve_lat_cols(b)}{sp_col})  "
+                  f"{_serve_lat_cols(b)}{_tail_col(b)}{sp_col})  "
                   f"latest={metric_of(l):>11.2f}")
             continue
         if k[0] == "fleet":
@@ -244,6 +267,17 @@ def main(argv=None) -> int:
             print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
                   f"latest={l.get('outcome'):>11s} "
                   f"(expected {l.get('expected')}{cost_col})")
+            continue
+        if k[0] == "servecost":
+            # cost-attribution cell (ISSUE 11): exactness is the
+            # signal (attributed + idle == dispatched, integers);
+            # the per-class split prints beside it
+            by = l.get("steps_by_class") or {}
+            by_col = " ".join(f"{c}={s}" for c, s in sorted(by.items()))
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"latest={'exact' if l.get('ok') else 'INEXACT':>11s} "
+                  f"(steps {by_col} idle={l.get('steps_idle')}"
+                  f"{_tail_col(l)})")
             continue
         extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""
         # records the bench itself flagged as never reaching 70% of the

@@ -1,5 +1,19 @@
 """Step-time breakdown of the flagship training step on the real chip.
 
+LEGACY SCOPE (ISSUE 11): this ladder decomposes the TRAINING step by
+differential measurement (run reduced programs, subtract), which is
+wall-clock-based and train-side only. The repo's latency-decomposition
+source of truth for SERVING is the shared critical-path segment schema
+in ``utils/telemetry.py`` (``CRITICAL_PATH_SEGMENTS`` /
+``critical_path_segments``: per-request segments whose in-order float
+sum is bitwise the Result's ``latency_s``), consumed by
+``scripts/trace_query.py`` (span trees, p99 queue-vs-decode
+attribution, per-class device-step cost), the engine/fleet summaries
+and the bench rows. Do not grow per-request latency attribution here —
+this script remains useful only for its train-side fed/cached/feed
+rungs (and see ``scripts/glue_ladder.py`` for the sharper train-side
+attribution).
+
 VERDICT r2 #2: MFU ~0.27 means ~73% of the chip's peak is unused and
 nothing committed says where the time goes. This script measures a
 LADDER of progressively reduced programs on the real TPU and distills

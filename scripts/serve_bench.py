@@ -404,6 +404,16 @@ def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
 
     trials = 2
     curves = []
+    # serve_cost history rows (ISSUE 11) stream out per capacity arm
+    # BEFORE any exactness/determinism raise — the bench_regress gate
+    # must see the 0.0 cell even when the bench aborts loudly (the
+    # resilience precedent: record the damage, then fail)
+    cost_base = {
+        "kind": "serve_cost", "smoke": bool(args.smoke),
+        "device_kind": jax.devices()[0].device_kind,
+        "dec_model": hps.dec_model, "slots": slots, "chunk": chunk,
+        "n_requests": n, "len_dist": dist,
+    }
     ref_strokes = None          # uid -> strokes5 from the first burst
     cap1 = None                 # R=1 capacity (sketches/sec)
     cp1 = None                  # R=1 critical-path device steps
@@ -456,14 +466,34 @@ def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
             check_parity(res0, f"placement at {R} replicas")
             parity["replicas_checked"].append(R)
         cap_walls = [s0["wall_s"]]
+        cost_drift = None
         for _ in range(trials - 1):
+            # every trial replays the SAME deterministic pre-start
+            # schedule (stop workers -> reset reopens -> re-queue the
+            # whole burst -> start): submitting into live workers
+            # would race the burst chop against the submit loop,
+            # measuring thread timing instead of the scheduler
+            if fleet.close():
+                raise RuntimeError(
+                    f"fleet close timed out between trials at R={R}")
             fleet.reset()
             submit_all(fleet)
+            fleet.start()
             if not fleet.drain(timeout=600):
                 raise RuntimeError("fleet drain timed out (trial)")
-            cap_walls.append(fleet.summary()["wall_s"])
+            s_trial = fleet.summary()
+            cap_walls.append(s_trial["wall_s"])
+            # cost-attribution determinism (ISSUE 11): with identical
+            # pre-start schedules, placement + burst chop + chunk
+            # count are pure functions of the request stream, so the
+            # whole cost block — per-class split, attributed, idle,
+            # dispatched — must be IDENTICAL across trials; any drift
+            # means wall clock leaked into the attribution
+            if s_trial["cost"] != s0["cost"] and cost_drift is None:
+                cost_drift = s_trial["cost"]
         cap = round(n / min(cap_walls), 3)
         cp = s0["critical_path_device_steps"]
+        tail0 = s0.get("tail") or {}
         row = {
             "replicas": R, "offered_rate": 0.0,
             "sketches_per_sec": cap,
@@ -475,9 +505,33 @@ def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
             "by_class": {c: {"p99_s": v["p99_s"],
                              "completed": v["completed"], "shed": 0}
                          for c, v in s0["latency_by_class"].items()},
+            "p99_dom": tail0.get("dom"),
+            "p99_dom_frac": tail0.get("dom_frac"),
+            "cost": s0["cost"],
             "critical_path_device_steps": cp,
             "total_device_steps": s0["total_device_steps"],
         }
+        # the binary attribution cell: ok only when the identity held
+        # AND the trials reproduced it bitwise — recorded FIRST, so a
+        # future break lands as a 0.0 row the gate flags even though
+        # the bench then aborts
+        hist_append({
+            **cost_base, "replicas": R,
+            "ok": s0["cost"]["exact"] and cost_drift is None,
+            "steps_by_class": s0["cost"]["steps_by_class"],
+            "steps_attributed": s0["cost"]["steps_attributed"],
+            "steps_idle": s0["cost"]["steps_idle"],
+            "steps_dispatched": s0["cost"]["steps_dispatched"],
+            "p99_dom": tail0.get("dom"),
+            "p99_dom_frac": tail0.get("dom_frac"),
+        })
+        if cost_drift is not None:
+            raise RuntimeError(
+                f"COST ATTRIBUTION NONDETERMINISM at R={R}: "
+                f"trial cost {cost_drift} != first {s0['cost']}")
+        if not s0["cost"]["exact"]:
+            raise RuntimeError(
+                f"COST ATTRIBUTION INEXACT at R={R}: {s0['cost']}")
         # scaling/step_parallel are defined AGAINST THE R=1 ARM only —
         # a sweep without R=1 reports capacity per cell but no
         # efficiency ratios (dividing by the first swept count would
@@ -526,6 +580,7 @@ def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
                 raise RuntimeError("fleet drain timed out (load arm)")
             s = fleet.summary()
             shed_by_class = s["shed_by_class"]
+            tail = s.get("tail") or {}
             curves.append({
                 "replicas": R, "offered_rate": rate,
                 "sketches_per_sec": s["sketches_per_sec"],
@@ -540,6 +595,12 @@ def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
                                  "shed": shed_by_class.get(c, 0)}
                              for c, v in
                              s["latency_by_class"].items()},
+                # tail attribution (ISSUE 11): is THIS load point's
+                # p99 queue- or decode-dominated? The signal the
+                # ROADMAP's autoscaler will scale on
+                "p99_dom": tail.get("dom"),
+                "p99_dom_frac": tail.get("dom_frac"),
+                "cost": s["cost"],
                 "loadgen_max_lag_s": round(gen.max_lag_s, 6),
             })
             print(f"# R={R} rate={rate}: "
@@ -589,6 +650,9 @@ def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
              "chunk", "n_requests", "len_dist")}
     for row in curves:
         hist_append({**base, **row})
+    # (the serve_cost rows — the binary attribution-exactness signal
+    # bench_regress gates like the resilience cells — streamed out per
+    # capacity arm above, before any exactness raise)
     print(json.dumps(fleet_rec, indent=2))
     if args.out:
         # SERVE_BENCH.json GAINS the curves: the engine-vs-sampler

@@ -45,6 +45,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from sketch_rnn_tpu.utils.telemetry import (  # noqa: E402
     Histogram,
     TELEMETRY_JSONL,
+    chrome_flow_events,
+    stamp_trace_flow,
 )
 
 MERGED_JSONL = "telemetry.merged.jsonl"
@@ -223,10 +225,15 @@ def write_merged_jsonl(merged: Dict, path: str) -> None:
 
 def write_merged_chrome(merged: Dict, path: str) -> None:
     """One Chrome trace, one track group per host: pid = host index
-    (named ``host N``), tids unique per (host, recording thread)."""
+    (named ``host N``), tids unique per (host, recording thread).
+    Trace-stamped events (ISSUE 11) chain into flow arrows across
+    host tracks — the same protocol as the single-host exporter
+    (``telemetry.chrome_flow_events``), so Perfetto draws a request's
+    causal path even when its hops span processes."""
     out: List[dict] = []
     tids: Dict = {}
     named_hosts = set()
+    flows: List = []
 
     def tid_of(host: int, thread: str) -> int:
         key = (host, thread)
@@ -252,6 +259,7 @@ def write_merged_chrome(merged: Dict, path: str) -> None:
                    "ts": ts_us, "dur": ev["dur"] * 1e6}
             if "args" in ev:
                 rec["args"] = ev["args"]
+            stamp_trace_flow(rec, ev, flows, host)
             out.append(rec)
         elif ev["type"] == "instant":
             rec = {"ph": "i", "name": ev["name"], "cat": ev["cat"],
@@ -259,11 +267,13 @@ def write_merged_chrome(merged: Dict, path: str) -> None:
                    "ts": ts_us, "s": "t"}
             if "args" in ev:
                 rec["args"] = ev["args"]
+            stamp_trace_flow(rec, ev, flows, host)
             out.append(rec)
         elif ev["type"] == "counter":
             out.append({"ph": "C", "name": ev["name"], "cat": ev["cat"],
                         "pid": host, "tid": 0, "ts": ts_us,
                         "args": {ev["name"]: ev["value"]}})
+    out.extend(chrome_flow_events(flows))
     with open(path, "w") as f:
         json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
 

@@ -1,0 +1,516 @@
+"""Causal request-tracing query tool (ISSUE 11).
+
+The telemetry runtime stamps every hop of a request's life with a
+``(trace_id, span_id, parent_id)`` coordinate (the naming contract in
+``utils/telemetry.py``): loadgen dispatch -> fleet admission decision
+(chosen replica, backlog, est_wait, shed verdict) -> per-class queue
+residency -> micro-burst membership -> decode -> failover retry ->
+completion carrying the exact ``Result`` floats. This script is the
+analysis engine on top — it answers *why was this request slow, and
+what did it cost*:
+
+- **Span trees** — one tree per request uid, reconstructed from a
+  telemetry JSONL (a shard or a ``trace_merge`` merged stream).
+  Trace ids are pure functions of the uid, so an analyzed stream must
+  come from ONE uid namespace — the shards of one run, whose single
+  loadgen/fleet allocated every uid. Merging shards of two unrelated
+  serve runs, or tracing repeated auto-uid ``engine.run()`` calls
+  (uids restart at 0 per run) in one telemetry session, collides
+  their ``req-<uid>`` trees — pass explicit unique uids for traced
+  multi-run sessions. A
+  failover-retried request is still ONE tree: its retry spans hang
+  under the request root and the re-served hops hang under the retry
+  span. Trees are VERIFIED: a span whose parent is missing is an
+  orphan, and any orphan fails the run (exit 1) — unless the bounded
+  event ring dropped events, where the orphan and event-level cost
+  checks turn advisory (a WARNING, like trace_report's) because an
+  evicted parent is indistinguishable from a broken tree.
+- **Critical-path decomposition** — every complete event carries the
+  shared segment schema (``queue_wait_s`` + ``decode_s``,
+  ``utils/telemetry.critical_path_segments``) whose in-order float sum
+  is BITWISE the Result's ``latency_s``; the tool re-sums and fails on
+  any violation. The latency percentile table is the same
+  ``np.percentile`` math over the same event floats as
+  ``ServeEngine.run()``'s summary (via ``trace_report.latency_table``),
+  so the two reconcile exactly.
+- **p99 decomposition** — per class / per replica / overall: is the
+  latency tail queue-dominated (wants capacity — the ROADMAP's
+  autoscaling signal) or decode-dominated (wants a faster engine)?
+  Shared math with the bench rows (``utils/telemetry.tail_attribution``).
+- **Cost accounting** — per-class device-step cost from the
+  deterministic integer attribution (each chunk's steps split over its
+  live slots), reconciled EXACTLY against the run's dispatched and
+  idle step counters: attributed + idle == dispatched, in integers.
+
+Usage:
+    python scripts/trace_query.py <telemetry.jsonl | trace_dir>
+        [--request UID] [--json]
+    python scripts/trace_query.py --smoke   # tier-1 self-check over
+                                            # the committed fixture
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts.trace_report import (  # noqa: E402
+    _drop_counts,
+    _resolve_path,
+    latency_table,
+    load,
+)
+from sketch_rnn_tpu.serve.admission import DEFAULT_CLASS  # noqa: E402
+from sketch_rnn_tpu.utils.telemetry import (  # noqa: E402
+    REQUEST_TRACE_PREFIX,
+    request_span_id,
+    segments_sum,
+    tail_attribution,
+)
+
+SMOKE_FIXTURE = os.path.join("tests", "data", "trace_query_fixture",
+                             "telemetry.jsonl")
+
+
+def build_traces(data: Dict) -> Dict[str, Dict[str, dict]]:
+    """Group trace-stamped events by trace id: ``{trace_id: {span_id:
+    event}}``. Duplicate span ids collapse to their LAST occurrence:
+    a re-emitted backdated enqueue instant is identical either way,
+    but a request that completed inside a burst that then crashed
+    mid-flight is re-served by the failover (the dying ``engine.run``
+    books nothing), and its second ``complete`` emission — the one
+    whose floats match the booked Result — shares the attempt-less
+    ``complete-<uid>`` span id with the first. Last wins, so trees
+    carry the authoritative completion."""
+    traces: Dict[str, Dict[str, dict]] = {}
+    for ev in data["events"]:
+        tr = ev.get("trace")
+        if not tr:
+            continue
+        traces.setdefault(tr["id"], {})[tr["span"]] = ev
+    return traces
+
+
+def request_trees(traces: Dict[str, Dict[str, dict]]) -> Dict[int, Dict]:
+    """One verified tree per request uid.
+
+    Per tree: ``complete`` (the completion args — Result floats,
+    segments, cost, burst membership), ``shed`` (refused at the door —
+    a self-rooted single-span trace, not an orphan), ``failed``
+    (retry budget exhausted — the fleet emits the root span and a
+    terminal ``failed`` instant, so a deliberately-abandoned request
+    is distinguishable from a torn export), ``retries`` (the linked
+    retry span ids), ``orphans`` (spans whose parent is missing from
+    the tree — ONLY judged once the tree is terminal: a torn
+    mid-flight export legitimately lacks its root, and is reported as
+    ``incomplete`` instead), and ``exact_sum`` (the critical-path
+    segments re-summed in order == ``latency_s`` bitwise)."""
+    out: Dict[int, Dict] = {}
+    for tid, spans in sorted(traces.items()):
+        if not tid.startswith(REQUEST_TRACE_PREFIX):
+            continue
+        try:
+            uid = int(tid[len(REQUEST_TRACE_PREFIX):])
+        except ValueError:
+            continue
+        complete_ev = spans.get(request_span_id("complete", uid))
+        shed_ev = spans.get(request_span_id("shed", uid))
+        failed_ev = spans.get(request_span_id("failed", uid))
+        root_id = request_span_id("request", uid)
+        terminal = (complete_ev is not None or shed_ev is not None
+                    or failed_ev is not None)
+        orphans = []
+        if terminal:
+            orphans = sorted(
+                s for s, ev in spans.items()
+                if ev["trace"].get("parent") is not None
+                and ev["trace"]["parent"] not in spans)
+        retries = sorted(s for s, ev in spans.items()
+                         if ev["name"] == "retry")
+        tree = {
+            "uid": uid,
+            "spans": spans,
+            "n_spans": len(spans),
+            "root": root_id if root_id in spans else None,
+            "complete": complete_ev["args"] if complete_ev else None,
+            "shed": shed_ev["args"] if shed_ev else None,
+            "failed": failed_ev["args"] if failed_ev else None,
+            "incomplete": not terminal,
+            "retries": retries,
+            "orphans": orphans,
+            "exact_sum": None,
+        }
+        if complete_ev is not None:
+            args = complete_ev["args"]
+            segs = args.get("segments")
+            if segs is not None:
+                tree["exact_sum"] = (segments_sum(segs)
+                                     == args["latency_s"])
+        out[uid] = tree
+    return out
+
+
+def p99_decomposition(trees: Dict[int, Dict]) -> Dict:
+    """Tail attribution overall and per class / replica, from the
+    completion events' shared segment schema."""
+    def rows_of(pred):
+        return [(t["complete"]["latency_s"], t["complete"]["segments"])
+                for t in trees.values()
+                if t["complete"] is not None
+                and t["complete"].get("segments") is not None
+                and pred(t["complete"])]
+
+    groups: Dict[str, Dict] = {}
+    classes = sorted({t["complete"].get("class")
+                      for t in trees.values() if t["complete"]}
+                     - {None})
+    replicas = sorted({t["complete"].get("replica")
+                       for t in trees.values() if t["complete"]}
+                      - {None})
+    out = {"all": tail_attribution(rows_of(lambda a: True))}
+    for c in classes:
+        groups[c] = tail_attribution(
+            rows_of(lambda a, c=c: a.get("class") == c))
+    out["by_class"] = groups
+    out["by_replica"] = {
+        str(r): tail_attribution(
+            rows_of(lambda a, r=r: a.get("replica") == r))
+        for r in replicas}
+    return out
+
+
+def cost_accounting(data: Dict) -> Optional[Dict]:
+    """Per-class device-step cost, reconciled exactly against the
+    run's counters: sum(per-completion attributed) == the attributed
+    counter, and attributed + idle == dispatched — all integers, all
+    deterministic in (seed, placement). None when the stream predates
+    the cost counters.
+
+    Sums run over every complete EMISSION in the stream, not the
+    deduplicated trees: a completion inside a burst that then crashed
+    was real device work (its ``attributed`` counter ticked), and the
+    failover re-serves it — two emissions, two counter ticks. The
+    dying run's abort ledger closes its own dispatched/idle counters,
+    so emission totals and counters stay in lockstep even across a
+    crash + failover, while the trees keep one booked completion per
+    request."""
+    counters = data["counters"]
+    dispatched = counters.get(("serve", "device_steps_dispatched"))
+    if dispatched is None:
+        return None
+    idle = int(counters.get(("serve", "device_steps_idle"), 0))
+    counter_attr = int(counters.get(("serve", "device_steps_attributed"),
+                                    0))
+    by_class: Dict[str, int] = {}
+    event_attr = 0
+    for ev in data["events"]:
+        if ev["type"] != "instant" or ev["name"] != "complete" \
+                or ev["cat"] != "serve":
+            continue
+        args = ev.get("args", {})
+        steps = int(args.get("attributed_steps", 0))
+        event_attr += steps
+        c = args.get("class") or DEFAULT_CLASS
+        by_class[c] = by_class.get(c, 0) + steps
+    dispatched = int(dispatched)
+    return {
+        "steps_by_class": dict(sorted(by_class.items())),
+        "steps_attributed": event_attr,
+        "counter_attributed": counter_attr,
+        "steps_idle": idle,
+        "steps_dispatched": dispatched,
+        # the counter-level identity holds regardless of ring
+        # eviction (counters are exact and ring-independent); the
+        # event-level one only while every complete event survived
+        "exact_counters": counter_attr + idle == dispatched,
+        "exact": (event_attr == counter_attr
+                  and event_attr + idle == dispatched),
+    }
+
+
+def report(data: Dict) -> Dict:
+    traces = build_traces(data)
+    trees = request_trees(traces)
+    bursts = sorted(t for t in traces if t.startswith("burst-"))
+    complete = [t for t in trees.values() if t["complete"] is not None]
+    return {
+        "meta": data["meta"],
+        "ring_dropped": _drop_counts(data["meta"]),
+        "requests": len(trees),
+        "complete": len(complete),
+        "shed": sum(1 for t in trees.values() if t["shed"] is not None),
+        "failed": sum(1 for t in trees.values()
+                      if t["failed"] is not None),
+        "incomplete": sum(1 for t in trees.values() if t["incomplete"]),
+        "retried": sum(1 for t in trees.values() if t["retries"]),
+        "bursts": len(bursts),
+        "orphan_spans": sum(len(t["orphans"]) for t in trees.values()),
+        "exact_sum_violations": sum(
+            1 for t in trees.values() if t["exact_sum"] is False),
+        "latency": latency_table(data),
+        "p99_decomposition": p99_decomposition(trees),
+        "cost": cost_accounting(data),
+    }
+
+
+def verdict(rep: Dict) -> List[str]:
+    """The verification failures (empty == every claim held).
+
+    Ring eviction is NOT a broken invariant: on a run long enough to
+    overflow the bounded event ring the orphan check (an evicted
+    parent span) and the event-level cost sum (evicted complete
+    events) become advisory — surfaced by :func:`drop_warnings` — while
+    the per-event exact sums and the counter-level cost identity
+    (counters are exact and ring-independent) still gate."""
+    problems = []
+    dropped = int((rep.get("ring_dropped") or {}).get("total", 0))
+    if rep["orphan_spans"] and not dropped:
+        problems.append(f"{rep['orphan_spans']} orphan span(s): a "
+                        f"terminal request tree has a parentless hop")
+    if rep["exact_sum_violations"]:
+        problems.append(f"{rep['exact_sum_violations']} request(s) "
+                        f"whose critical-path segments do not sum "
+                        f"bitwise to latency_s")
+    cost = rep.get("cost")
+    if cost is not None:
+        if not cost.get("exact_counters", cost["exact"]):
+            problems.append(
+                f"cost attribution inexact: attributed "
+                f"{cost.get('counter_attributed', cost['steps_attributed'])} "
+                f"+ idle {cost['steps_idle']} "
+                f"!= dispatched {cost['steps_dispatched']}")
+        elif not cost["exact"] and not dropped:
+            problems.append(
+                f"cost attribution inexact: event-stream attributed "
+                f"{cost['steps_attributed']} != counter "
+                f"{cost.get('counter_attributed')}")
+    return problems
+
+
+def drop_warnings(rep: Dict) -> List[str]:
+    """Advisory notes for checks :func:`verdict` waived because the
+    bounded event ring dropped events (mirrors trace_report's drop
+    warning — an eviction undercounts the event stream, it does not
+    break the run's invariants)."""
+    dropped = int((rep.get("ring_dropped") or {}).get("total", 0))
+    if not dropped:
+        return []
+    out = [f"event ring dropped {dropped} event(s) — orphan and "
+           f"event-level cost checks are advisory on this stream "
+           f"(agg/counter totals stay exact)"]
+    if rep["orphan_spans"]:
+        out.append(f"{rep['orphan_spans']} parentless span(s) — "
+                   f"consistent with evicted parents, not verified "
+                   f"as tree violations")
+    cost = rep.get("cost")
+    if cost is not None and cost.get("exact_counters") \
+            and not cost["exact"]:
+        out.append(f"event-stream attributed steps "
+                   f"{cost['steps_attributed']} undercount the exact "
+                   f"counter {cost.get('counter_attributed')} "
+                   f"(evicted complete events)")
+    return out
+
+
+# -- the per-request tree printer --------------------------------------------
+
+
+def print_tree(trees: Dict[int, Dict], uid: int) -> int:
+    tree = trees.get(uid)
+    if tree is None:
+        print(f"trace_query: no trace for request uid {uid} — uids "
+              f"present: {sorted(trees)[:20]}{'...' if len(trees) > 20 else ''}",
+              file=sys.stderr)
+        return 2
+    spans = tree["spans"]
+    children: Dict[Optional[str], List[str]] = {}
+    for sid, ev in sorted(spans.items(),
+                          key=lambda kv: kv[1].get("ts", 0.0)):
+        children.setdefault(ev["trace"].get("parent"), []).append(sid)
+
+    def render(sid: str, depth: int, note: str = "") -> None:
+        ev = spans[sid]
+        dur = f" dur={ev['dur'] * 1e3:.3f}ms" if "dur" in ev else ""
+        args = ev.get("args", {})
+        keep = {k: v for k, v in args.items()
+                if k not in ("uid", "segments", "uids")}
+        extra = f" {keep}" if keep else ""
+        print(f"{'  ' * depth}{ev['name']:12s} [{sid}] "
+              f"ts={ev['ts']:.6f}{dur}{extra}{note}")
+        for c in children.get(sid, []):
+            render(c, depth + 1)
+
+    print(f"request uid={uid}: {tree['n_spans']} spans, "
+          f"{len(tree['retries'])} retries"
+          + (", SHED" if tree['shed'] else "")
+          + (", FAILED" if tree['failed'] else "")
+          + (", INCOMPLETE" if tree['incomplete'] else ""))
+    for root in children.get(None, []):
+        render(root, 1)
+    # spans whose parent never made it into the stream (torn
+    # mid-flight export, evicted parent) still render — as extra
+    # roots flagged with the missing parent — instead of vanishing
+    # while the header counts them
+    for parent in sorted(p for p in children if p is not None
+                         and p not in spans):
+        for sid in children[parent]:
+            render(sid, 1, note=f" (parent {parent} missing)")
+    comp = tree["complete"]
+    if comp is not None:
+        segs = comp.get("segments") or []
+        seg_s = ", ".join(f"{k}={v:.6f}" for k, v in segs)
+        print(f"  critical path: {seg_s} -> latency_s="
+              f"{comp['latency_s']:.6f} "
+              f"(sum exact: {tree['exact_sum']})")
+        print(f"  cost: attributed_steps="
+              f"{comp.get('attributed_steps')} "
+              f"burst={comp.get('burst')} class={comp.get('class')} "
+              f"replica={comp.get('replica')}")
+    return 0
+
+
+def print_report(rep: Dict) -> None:
+    print("== request trees ==")
+    print(f"requests {rep['requests']}  complete {rep['complete']}  "
+          f"shed {rep['shed']}  failed {rep['failed']}  "
+          f"incomplete {rep['incomplete']}  "
+          f"retried {rep['retried']}  bursts {rep['bursts']}")
+    print(f"orphan spans {rep['orphan_spans']}  exact-sum violations "
+          f"{rep['exact_sum_violations']}")
+    print()
+    lat = rep["latency"]
+    if lat:
+        print("== latency percentiles (exact, reconcile with "
+              "engine summary) ==")
+        for r in lat:
+            print(f"{r['metric']:14s} n={r['count']:5d} "
+                  f"p50={1e3 * r['p50_s']:8.3f}ms "
+                  f"p95={1e3 * r['p95_s']:8.3f}ms "
+                  f"p99={1e3 * r['p99_s']:8.3f}ms")
+        print()
+    dec = rep["p99_decomposition"]
+
+    def dec_line(label, d):
+        if not d:
+            return
+        print(f"{label:16s} p99={1e3 * d['p99_s']:8.3f}ms "
+              f"tail_n={d['tail_n']:3d} dom={d['dom']} "
+              f"({d['dom_frac']:.1%} of tail time)")
+
+    print("== p99 decomposition (queue- vs decode-dominated) ==")
+    dec_line("all", dec["all"])
+    for c, d in sorted(dec["by_class"].items()):
+        dec_line(f"class {c}", d)
+    for r, d in sorted(dec["by_replica"].items()):
+        dec_line(f"replica {r}", d)
+    print()
+    cost = rep["cost"]
+    if cost is not None:
+        print("== device-step cost (deterministic attribution) ==")
+        for c, s in cost["steps_by_class"].items():
+            print(f"class {c:12s} {s:8d} steps")
+        print(f"attributed {cost['steps_attributed']} + idle "
+              f"{cost['steps_idle']} == dispatched "
+              f"{cost['steps_dispatched']} (exact: {cost['exact']})")
+
+
+# -- smoke (tier-1 wiring) ----------------------------------------------------
+
+
+def smoke() -> int:
+    """Self-check over the committed fixture (a traced seeded
+    ``fleet.worker`` chaos run): every request reconstructs as one
+    orphan-free tree, retry spans are linked, every critical path sums
+    bitwise, and the cost attribution reconciles exactly."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, SMOKE_FIXTURE)
+    if not os.path.exists(path):
+        print(f"trace_query --smoke: committed fixture missing at "
+              f"{path}", file=sys.stderr)
+        return 1
+    data = load(path)
+    rep = report(data)
+    problems = verdict(rep)
+    if rep["requests"] < 2:
+        problems.append(f"fixture holds {rep['requests']} request "
+                        f"trees; expected a real burst")
+    if rep["complete"] != rep["requests"]:
+        problems.append(f"fixture has incomplete trees "
+                        f"({rep['complete']}/{rep['requests']})")
+    if not rep["retried"]:
+        problems.append("fixture is a chaos run but no tree carries a "
+                        "retry span")
+    if rep["cost"] is None:
+        problems.append("fixture carries no cost counters")
+    if problems:
+        print("trace_query --smoke FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print(f"trace_query --smoke OK: {rep['requests']} trees "
+          f"({rep['retried']} retried) orphan-free, all critical "
+          f"paths sum bitwise, cost exact "
+          f"({rep['cost']['steps_dispatched']} steps)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="span trees / critical-path decomposition / "
+                    "per-class cost over a telemetry JSONL")
+    ap.add_argument("path", nargs="?",
+                    help="telemetry.jsonl (a shard or a trace_merge "
+                         "merged stream) or the trace_dir holding it")
+    ap.add_argument("--request", type=int, default=None,
+                    help="print one request's span tree by uid")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report instead of tables")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-check over the committed fixture "
+                         "(tier-1 wiring); ignores other arguments")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        return smoke()
+    if not args.path:
+        ap.error("need a telemetry.jsonl or trace_dir (or --smoke)")
+    resolved = _resolve_path(args.path)
+    if not os.path.exists(resolved):
+        print(f"trace_query: no telemetry stream at {resolved} — "
+              f"produce one with `cli serve-bench --trace_dir=...`, "
+              f"then point this at the trace dir or the "
+              f"telemetry.jsonl inside it", file=sys.stderr)
+        return 2
+    data = load(resolved)
+    traces = build_traces(data)
+    if not traces:
+        print(f"trace_query: {resolved} holds no trace-stamped events "
+              f"— was it exported by a pre-tracing runtime, or a "
+              f"train-only run? (request tracing rides serve traffic)",
+              file=sys.stderr)
+        return 2
+    if args.request is not None:
+        return print_tree(request_trees(traces), args.request)
+    rep = report(data)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print_report(rep)
+    for w in drop_warnings(rep):
+        print(f"trace_query: WARNING: {w}", file=sys.stderr)
+    problems = verdict(rep)
+    for p in problems:
+        print(f"trace_query: VERIFICATION FAILURE: {p}",
+              file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # e.g. piped into head
+        sys.exit(0)

@@ -40,6 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from sketch_rnn_tpu.utils.telemetry import (  # noqa: E402
     TELEMETRY_JSONL,
     replica_of_series,
+    tail_attribution,
 )
 
 SPARK = " ▁▂▃▄▅▆▇█"
@@ -185,13 +186,27 @@ def latency_table(data: Dict) -> List[Dict]:
     ``latency_s``'s p50/p95/p99 match it exactly. The live streaming-
     histogram approximations ride along for comparison.
     """
-    vals: Dict[str, List[float]] = {}
-    for ev in data["events"]:
+    # one completion per request: a burst that crashes mid-flight is
+    # re-served whole by the failover, so a request that completed in
+    # the dying run emits `complete` twice under the same trace span id
+    # — only the LAST (the one booked into the fleet's results, hence
+    # the summary this table must reconcile with) may count. Untraced
+    # streams keep every event (no identity to dedup on).
+    completes: Dict[object, dict] = {}
+    for i, ev in enumerate(data["events"]):
         if ev["type"] == "instant" and ev["name"] == "complete" \
                 and ev["cat"] == "serve":
-            for m in ("queue_wait_s", "decode_s", "latency_s"):
-                if m in ev.get("args", {}):
-                    vals.setdefault(m, []).append(ev["args"][m])
+            tr = ev.get("trace")
+            completes[tr["span"] if tr else i] = ev
+    vals: Dict[str, List[float]] = {}
+    seg_rows = []
+    for ev in completes.values():
+        args = ev.get("args", {})
+        for m in ("queue_wait_s", "decode_s", "latency_s"):
+            if m in args:
+                vals.setdefault(m, []).append(args[m])
+        if args.get("segments") is not None:
+            seg_rows.append((args["latency_s"], args["segments"]))
     rows = []
     for m, xs in sorted(vals.items()):
         a = np.array(xs)
@@ -204,6 +219,19 @@ def latency_table(data: Dict) -> List[Dict]:
             row["hist_p50_s"] = h["p50"]
             row["hist_p95_s"] = h["p95"]
             row["hist_p99_s"] = h["p99"]
+        if m == "latency_s" and seg_rows and len(seg_rows) == len(xs):
+            # tail attribution (ISSUE 11): the same shared segment
+            # schema scripts/trace_query.py decomposes fully — the
+            # report shows the one-line verdict, the query tool the
+            # per-class/replica breakdown and the span trees. Only
+            # attached when EVERY complete event carries segments:
+            # on a mixed stream (a pre-tracing shard merged with a
+            # traced one) the verdict would describe a different
+            # tail than the percentile printed beside it.
+            tail = tail_attribution(seg_rows)
+            if tail is not None:
+                row["p99_dom"] = tail["dom"]
+                row["p99_dom_frac"] = tail["dom_frac"]
         rows.append(row)
     return rows
 
@@ -282,9 +310,12 @@ def print_report(rep: Dict) -> None:
         print(f"{'metric':14s} {'count':>6s} {'mean_ms':>9s} "
               f"{'p50_ms':>9s} {'p95_ms':>9s} {'p99_ms':>9s}")
         for r in lat:
+            dom = (f"  p99_dom={r['p99_dom']}@{r['p99_dom_frac']:.0%}"
+                   if r.get("p99_dom") else "")
             print(f"{r['metric']:14s} {r['count']:6d} "
                   f"{1e3 * r['mean_s']:9.3f} {1e3 * r['p50_s']:9.3f} "
-                  f"{1e3 * r['p95_s']:9.3f} {1e3 * r['p99_s']:9.3f}")
+                  f"{1e3 * r['p95_s']:9.3f} {1e3 * r['p99_s']:9.3f}"
+                  f"{dom}")
         print()
 
 

@@ -679,7 +679,9 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
             exported = paths = tel.export()
             print(f"[telemetry] wrote {paths['jsonl']} and "
                   f"{paths['chrome']} (read with scripts/trace_report.py "
-                  f"or Perfetto)", file=sys.stderr)
+                  f"or Perfetto; per-request span trees / critical-path "
+                  f"attribution with scripts/trace_query.py "
+                  f"[--request UID])", file=sys.stderr)
         tele.disable()  # restore the process default
         # run manifest (ISSUE 8): the artifact index that joins this
         # bench's trace, prom scrape and report on one run_id. Only
@@ -869,7 +871,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace_dir", default="",
                    help="enable per-request serving telemetry and write "
                         "telemetry.jsonl + trace.json (Chrome trace) "
-                        "here; read with scripts/trace_report.py")
+                        "here; read with scripts/trace_report.py, or "
+                        "answer 'why was this request slow' with "
+                        "scripts/trace_query.py [--request UID]")
     p.add_argument("--metrics_port", type=int, default=None,
                    help="serve a live Prometheus /metrics + /healthz "
                         "endpoint on 127.0.0.1:PORT for the run's "

@@ -58,12 +58,20 @@ from jax import lax
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.ops import mdn
 from sketch_rnn_tpu.sample.sampler import END_TOKEN, START_TOKEN
+from sketch_rnn_tpu.utils.faults import fault_point
 from sketch_rnn_tpu.utils.profiling import SpanTimer
 from sketch_rnn_tpu.utils.telemetry import (
     JitCompileProbe,
+    attribute_chunk_steps,
     class_series,
+    critical_path_segments,
     get_telemetry,
     replica_series,
+    request_parent_id,
+    request_span_id,
+    request_trace_id,
+    span_link,
+    tail_attribution,
 )
 
 
@@ -94,6 +102,12 @@ class Request:
     cls: Optional[str] = None
     queue_pos: Optional[int] = None
     enqueue_ts: Optional[float] = None
+    # failover retry attempt (ISSUE 11): 0 on arrival, incremented by
+    # the fleet each requeue. Keys the per-attempt span ids so a
+    # retried request's trace stays ONE tree (the retry span parents
+    # the re-served hops); like the other admission metadata it can
+    # never affect the request's strokes.
+    attempt: int = 0
 
 
 @dataclasses.dataclass
@@ -107,6 +121,13 @@ class Result:
     queue_wait_s: float           # enqueue -> slot admission
     decode_s: float               # admission -> completion
     latency_s: float              # enqueue -> completion
+    # deterministic device-step COST of this request (ISSUE 11): each
+    # chunk's K device steps split in integers over the slots live in
+    # that chunk (utils/telemetry.attribute_chunk_steps), accumulated
+    # over the request's chunks — pure scheduling math, so per-request
+    # and per-class cost are provable bitwise; run() reports the idle
+    # remainder so attributed + idle == dispatched EXACTLY.
+    attributed_steps: int = 0
 
     @property
     def ended(self) -> bool:
@@ -379,8 +400,8 @@ class ServeEngine:
     # -- the serving loop --------------------------------------------------
 
     def run(self, requests: List[Request], recycle: bool = True,
-            metrics_writer=None, slo=None, pool_pad: int = 0
-            ) -> Dict[str, Any]:
+            metrics_writer=None, slo=None, pool_pad: int = 0,
+            burst: Optional[str] = None) -> Dict[str, Any]:
         """Drive ``requests`` to completion; continuous batching when
         ``recycle`` (default), static freeze-until-batch-done otherwise.
 
@@ -394,6 +415,9 @@ class ServeEngine:
         ``pool_pad``: pad the request pool to this fixed row count so
         variable-size bursts share one compiled program (fleet mode;
         see ``_prepare_pool``).
+        ``burst``: the fleet's micro-burst id (ISSUE 11) — stamped on
+        this run's traced events so every member request's tree links
+        back to the burst span the fleet emits around this call.
         """
         t_start = time.perf_counter()
         self.spans = SpanTimer(category="serve")  # per-run (no warmup leak)
@@ -404,6 +428,11 @@ class ServeEngine:
         # while the run is in flight, not only in the returned summary.
         # One attribute check when telemetry is off (the default).
         tel = get_telemetry()
+        # auto-uids restart at 0 EVERY run (pre-fleet callers key
+        # results on them): trace ids are pure in the uid, so a traced
+        # session spanning several run() calls must pass explicit
+        # unique uids (the fleet/loadgen allocators do) or its trace
+        # analysis will collide the runs' request trees
         for i, req in enumerate(requests):
             if req.uid is None:
                 req.uid = i
@@ -421,8 +450,16 @@ class ServeEngine:
             # with run()'s end-of-run `completed`
             tel.counter("requests_enqueued", len(requests), cat="serve")
             for req in requests:
+                # causal coordinate (ISSUE 11): per-attempt span ids
+                # keep a failover-retried request ONE tree (attempt > 0
+                # hops hang under the fleet's retry span)
                 tel.instant("enqueue", cat="serve", ts=enq[req.uid],
-                            args={"uid": req.uid})
+                            args={"uid": req.uid},
+                            trace=span_link(
+                                request_trace_id(req.uid),
+                                request_span_id("enqueue", req.uid,
+                                                req.attempt),
+                                request_parent_id(req.uid, req.attempt)))
         admit_t: Dict[int, float] = {}
         slot_req: List[Optional[Request]] = [None] * self.slots
         results: List[Result] = []
@@ -471,7 +508,14 @@ class ServeEngine:
                         if tel.enabled:
                             tel.instant("admit", cat="serve", ts=now,
                                         args={"uid": req.uid,
-                                              "slot": int(b)})
+                                              "slot": int(b)},
+                                        trace=span_link(
+                                            request_trace_id(req.uid),
+                                            request_span_id(
+                                                "admit", req.uid,
+                                                req.attempt),
+                                            request_parent_id(
+                                                req.uid, req.attempt)))
 
         def dispatch():
             """Enqueue one chunk; returns its output futures and its
@@ -511,6 +555,22 @@ class ServeEngine:
         horizon = -(-self.max_len // self.chunk) + 2
         occupied = np.zeros((nslots,), bool)
         n_live = 0
+        # deterministic device-step cost attribution (ISSUE 11): each
+        # fetched chunk's K steps split in integers over the slots live
+        # in it (ascending slot order); chunks with no live slot — the
+        # pipeline's admission bubble and the final drain chunk — land
+        # in `idle`, so attributed + idle == dispatched EXACTLY. Pure
+        # scheduling math: for a fixed request list the split is
+        # bitwise-reproducible, wall clock never enters it.
+        attr_steps: Dict[int, int] = {}
+        idle_steps = 0
+        # fault site (ISSUE 10 grammar): kill THIS burst mid-loop —
+        # per-replica names so a fleet plan targets one engine
+        # deterministically ("serve.chunk.r0@3" = replica 0's 4th
+        # fetched chunk), after earlier chunks' completions already
+        # emitted their telemetry (the abort-ledger path below)
+        chunk_site = ("serve.chunk" if self.replica_id is None
+                      else f"serve.chunk.r{self.replica_id}")
 
         def gather(b: int, cidx: int) -> np.ndarray:
             """Reassemble slot ``b``'s strokes from the ring at its
@@ -529,106 +589,204 @@ class ServeEngine:
         occupied[:] = [r is not None for r in slot_req]
         n_live = int(occupied.sum())
         nxt = dispatch() if requests else None
-        while n_live:
-            fut, cidx = nxt
-            nxt = dispatch()   # admissions decided from chunk i-1
-            t_prev = t_host    # chunk cidx-1's t: the row-delta base
-            with self.spans.span("fetch"):
-                t_host, done, strokes = jax.device_get(fut)
-            n_chunks += 1
-            t = t_host
-            now = time.perf_counter()
-            with self.spans.span("collect"):
-                ring[cidx] = (t, strokes)
-                ring.pop(cidx - horizon, None)
-                eligible = occupied & (first_chunk <= cidx)
-                base = np.where(first_chunk == cidx, 0, t_prev)
-                live_slot_steps += int(
-                    (t - base)[eligible].sum())
-                if tel.enabled:
-                    # per-chunk occupancy sample: how many slots held a
-                    # request during this chunk — trace_report.py's
-                    # slot-occupancy timeline, a Chrome counter track.
-                    # Fleet replicas record their own series
-                    # (slots_live_rNN) so the timeline is per-replica.
-                    tel.gauge(self._slots_gauge, int(eligible.sum()),
-                              cat="serve", ts=now)
-                for b in np.nonzero(eligible & done)[0]:
-                    req = slot_req[b]
-                    s5 = gather(int(b), cidx)
-                    steps = int(t[b])
-                    length = steps - int(s5[-1, 4] > 0.5)
-                    res = Result(
-                        uid=req.uid, strokes5=s5, length=length,
-                        steps=steps,
-                        queue_wait_s=admit_t[req.uid] - enq[req.uid],
-                        decode_s=now - admit_t[req.uid],
-                        latency_s=now - enq[req.uid])
-                    results.append(res)
-                    if slo is not None:
-                        # the SLO tracker sees the EXACT Result floats,
-                        # so /metrics burn rates and run()'s summary can
-                        # never tell different stories
-                        slo.observe("generate", {
-                            "queue_wait_s": res.queue_wait_s,
-                            "decode_s": res.decode_s,
-                            "latency_s": res.latency_s})
+        try:
+            while n_live:
+                fut, cidx = nxt
+                nxt = dispatch()   # admissions decided from chunk i-1
+                t_prev = t_host    # chunk cidx-1's t: the row-delta base
+                fault_point(chunk_site)
+                with self.spans.span("fetch"):
+                    t_host, done, strokes = jax.device_get(fut)
+                n_chunks += 1
+                t = t_host
+                now = time.perf_counter()
+                with self.spans.span("collect"):
+                    ring[cidx] = (t, strokes)
+                    ring.pop(cidx - horizon, None)
+                    eligible = occupied & (first_chunk <= cidx)
+                    base = np.where(first_chunk == cidx, 0, t_prev)
+                    live_slot_steps += int(
+                        (t - base)[eligible].sum())
+                    live_idx = np.nonzero(eligible)[0]
+                    if len(live_idx):
+                        shares = attribute_chunk_steps(self.chunk,
+                                                       len(live_idx))
+                        for share, b in zip(shares, live_idx):
+                            uid = slot_req[b].uid
+                            attr_steps[uid] = attr_steps.get(uid, 0) + share
+                    else:
+                        idle_steps += self.chunk
                     if tel.enabled:
-                        tel.counter("requests_completed", 1.0,
-                                    cat="serve")
-                        # the complete event carries the EXACT Result
-                        # latencies, so event-derived percentiles in
-                        # trace_report.py match run()'s summary; the
-                        # histograms stream the same values live.
-                        # Admission metadata (class / fleet queue
-                        # position / replica id) rides along when the
-                        # fleet stamped it, so a trace explains WHY a
-                        # request waited — never what it computed.
-                        ev_args = {"uid": res.uid,
-                                   "steps": res.steps,
-                                   "length": res.length,
-                                   "queue_wait_s": res.queue_wait_s,
-                                   "decode_s": res.decode_s,
-                                   "latency_s": res.latency_s}
-                        if req.cls is not None:
-                            ev_args["class"] = req.cls
-                        if req.queue_pos is not None:
-                            ev_args["queue_pos"] = req.queue_pos
-                        if self.replica_id is not None:
-                            ev_args["replica"] = self.replica_id
-                        tel.instant("complete", cat="serve", ts=now,
-                                    args=ev_args)
-                        tel.observe("queue_wait_s", res.queue_wait_s,
-                                    cat="serve")
-                        tel.observe("decode_s", res.decode_s, cat="serve")
-                        tel.observe("latency_s", res.latency_s,
-                                    cat="serve")
-                        if req.cls is not None:
-                            # per-class latency histogram: the SLA
-                            # surface an admission class is judged by
-                            tel.observe(
-                                class_series("latency_s", req.cls),
-                                res.latency_s, cat="serve")
-                    slot_req[b] = None
-                    occupied[b] = False
-                    n_live -= 1
-                    if metrics_writer is not None:
-                        metrics_writer.write(len(results), {
-                            "uid": res.uid, "steps": res.steps,
-                            "length": res.length,
-                            "queue_wait_s": res.queue_wait_s,
-                            "decode_s": res.decode_s,
-                            "latency_s": res.latency_s})
-            if queue and (recycle or n_live == 0):
-                admit_free_slots()
-                occupied[:] = [r is not None for r in slot_req]
-                n_live = int(occupied.sum())
-        if nxt is not None:
-            # drain the last in-flight (all-frozen) chunk
-            jax.device_get(nxt[0][1])
-            n_chunks += 1
+                        # per-chunk occupancy sample: how many slots held a
+                        # request during this chunk — trace_report.py's
+                        # slot-occupancy timeline, a Chrome counter track.
+                        # Fleet replicas record their own series
+                        # (slots_live_rNN) so the timeline is per-replica.
+                        tel.gauge(self._slots_gauge, int(eligible.sum()),
+                                  cat="serve", ts=now)
+                    for b in np.nonzero(eligible & done)[0]:
+                        req = slot_req[b]
+                        s5 = gather(int(b), cidx)
+                        steps = int(t[b])
+                        length = steps - int(s5[-1, 4] > 0.5)
+                        res = Result(
+                            uid=req.uid, strokes5=s5, length=length,
+                            steps=steps,
+                            queue_wait_s=admit_t[req.uid] - enq[req.uid],
+                            decode_s=now - admit_t[req.uid],
+                            latency_s=now - enq[req.uid],
+                            attributed_steps=attr_steps.get(req.uid, 0))
+                        results.append(res)
+                        if slo is not None:
+                            # the SLO tracker sees the EXACT Result floats,
+                            # so /metrics burn rates and run()'s summary can
+                            # never tell different stories
+                            slo.observe("generate", {
+                                "queue_wait_s": res.queue_wait_s,
+                                "decode_s": res.decode_s,
+                                "latency_s": res.latency_s})
+                        if tel.enabled:
+                            tel.counter("requests_completed", 1.0,
+                                        cat="serve")
+                            # the causal tree (ISSUE 11): a ROOT span over
+                            # the whole request clock plus queue/decode
+                            # child spans, all deterministic span ids —
+                            # scripts/trace_query.py reconstructs one
+                            # orphan-free tree per uid from these.
+                            trace_id = request_trace_id(res.uid)
+                            root_id = request_span_id("request", res.uid)
+                            parent = request_parent_id(res.uid, req.attempt)
+                            tel.emit_span(
+                                "request", "serve", enq[res.uid], now,
+                                args={"uid": res.uid},
+                                trace=span_link(trace_id, root_id))
+                            tel.emit_span(
+                                "queue_wait", "serve", enq[res.uid],
+                                admit_t[res.uid], args={"uid": res.uid},
+                                trace=span_link(
+                                    trace_id,
+                                    request_span_id("queue", res.uid,
+                                                    req.attempt), parent))
+                            tel.emit_span(
+                                "decode", "serve", admit_t[res.uid], now,
+                                args={"uid": res.uid},
+                                trace=span_link(
+                                    trace_id,
+                                    request_span_id("decode", res.uid,
+                                                    req.attempt), parent))
+                            # the complete event carries the EXACT Result
+                            # latencies, so event-derived percentiles in
+                            # trace_report.py match run()'s summary; the
+                            # histograms stream the same values live.
+                            # Admission metadata (class / fleet queue
+                            # position / replica id) rides along when the
+                            # fleet stamped it, so a trace explains WHY a
+                            # request waited — never what it computed.
+                            # `segments` is the critical-path decomposition
+                            # whose in-order sum is BITWISE latency_s;
+                            # `attributed_steps` the request's exact
+                            # device-step cost (ISSUE 11).
+                            ev_args = {"uid": res.uid,
+                                       "steps": res.steps,
+                                       "length": res.length,
+                                       "queue_wait_s": res.queue_wait_s,
+                                       "decode_s": res.decode_s,
+                                       "latency_s": res.latency_s,
+                                       "segments": [
+                                           [k, v] for k, v in
+                                           critical_path_segments(
+                                               res.queue_wait_s,
+                                               res.latency_s)],
+                                       "attributed_steps":
+                                           res.attributed_steps,
+                                       "attempt": req.attempt}
+                            if burst is not None:
+                                ev_args["burst"] = burst
+                            if req.cls is not None:
+                                ev_args["class"] = req.cls
+                            if req.queue_pos is not None:
+                                ev_args["queue_pos"] = req.queue_pos
+                            if self.replica_id is not None:
+                                ev_args["replica"] = self.replica_id
+                            tel.instant("complete", cat="serve", ts=now,
+                                        args=ev_args,
+                                        trace=span_link(
+                                            trace_id,
+                                            request_span_id("complete",
+                                                            res.uid),
+                                            root_id))
+                            tel.counter("device_steps_attributed",
+                                        res.attributed_steps, cat="serve")
+                            if req.cls is not None:
+                                tel.counter(
+                                    class_series("device_steps_attributed",
+                                                 req.cls),
+                                    res.attributed_steps, cat="serve")
+                            tel.observe("queue_wait_s", res.queue_wait_s,
+                                        cat="serve")
+                            tel.observe("decode_s", res.decode_s, cat="serve")
+                            tel.observe("latency_s", res.latency_s,
+                                        cat="serve")
+                            if req.cls is not None:
+                                # per-class latency histogram: the SLA
+                                # surface an admission class is judged by
+                                tel.observe(
+                                    class_series("latency_s", req.cls),
+                                    res.latency_s, cat="serve")
+                        slot_req[b] = None
+                        occupied[b] = False
+                        n_live -= 1
+                        if metrics_writer is not None:
+                            metrics_writer.write(len(results), {
+                                "uid": res.uid, "steps": res.steps,
+                                "length": res.length,
+                                "queue_wait_s": res.queue_wait_s,
+                                "decode_s": res.decode_s,
+                                "latency_s": res.latency_s,
+                                "attributed_steps": res.attributed_steps})
+                if queue and (recycle or n_live == 0):
+                    admit_free_slots()
+                    occupied[:] = [r is not None for r in slot_req]
+                    n_live = int(occupied.sum())
+            if nxt is not None:
+                # drain the last in-flight (all-frozen) chunk — its steps
+                # served no request, so they land in the idle bucket and
+                # the attributed + idle == dispatched identity stays exact
+                jax.device_get(nxt[0][1])
+                n_chunks += 1
+                idle_steps += self.chunk
+        except BaseException:
+            # abort ledger: a mid-burst crash has already emitted
+            # per-completion `attributed` counters and complete
+            # events, but the run-level dispatched/idle counters
+            # below never fire — and the fleet's
+            # failover re-serves the WHOLE burst (a raise books
+            # nothing), re-emitting those completions. Close the
+            # dying run's counter identity on the way out: its
+            # fetched chunks are `dispatched`, and every fetched
+            # step not already emitted as a completion's
+            # `attributed` lands in `idle` (partial shares of
+            # never-completed requests included — the retry re-
+            # attributes those from scratch on the survivor). The
+            # exported stream then satisfies attributed + idle ==
+            # dispatched EXACTLY even across a crash + failover.
+            if tel.enabled and n_chunks:
+                emitted = sum(r.attributed_steps for r in results)
+                tel.counter("device_steps_dispatched",
+                            n_chunks * self.chunk, cat="serve")
+                tel.counter("device_steps_idle",
+                            n_chunks * self.chunk - emitted,
+                            cat="serve")
+            raise
 
         wall = time.perf_counter() - t_start
+        if tel.enabled and n_chunks:
+            # run-level cost counters for /metrics: attributed ticks
+            # per completion above; dispatched/idle close the exact
+            # identity attributed + idle == dispatched on the scrape
+            tel.counter("device_steps_dispatched",
+                        n_chunks * self.chunk, cat="serve")
+            tel.counter("device_steps_idle", idle_steps, cat="serve")
         lat = np.array([r.latency_s for r in results]) if results else \
             np.zeros((1,))
         metrics = {
@@ -639,6 +797,11 @@ class ServeEngine:
             "decode_steps": int(sum(r.steps for r in results)),
             "device_steps": n_chunks * self.chunk,
             "chunks": n_chunks,
+            # cost attribution (ISSUE 11): steps_attributed +
+            # steps_idle == device_steps EXACTLY (integers) — the
+            # invariant trace_query and the fleet summary reconcile
+            "steps_attributed": int(sum(attr_steps.values())),
+            "steps_idle": int(idle_steps),
             "slot_utilization": round(
                 live_slot_steps / max(n_chunks * self.chunk * self.slots,
                                       1), 4),
@@ -648,6 +811,13 @@ class ServeEngine:
             "latency_p50_s": round(float(np.percentile(lat, 50)), 6),
             "latency_p95_s": round(float(np.percentile(lat, 95)), 6),
             "latency_p99_s": round(float(np.percentile(lat, 99)), 6),
+            # tail attribution (ISSUE 11): is this run's p99 queue- or
+            # decode-dominated? Same shared segment schema + percentile
+            # rank as trace_query, so the two can never disagree.
+            "tail": tail_attribution(
+                [(r.latency_s,
+                  critical_path_segments(r.queue_wait_s, r.latency_s))
+                 for r in results]),
             "spans": self.spans.summary(),
         }
         if slo is not None:

@@ -78,7 +78,16 @@ from sketch_rnn_tpu.serve.admission import (
 )
 from sketch_rnn_tpu.serve.engine import Request, ServeEngine
 from sketch_rnn_tpu.utils.faults import backoff_s, fault_point
-from sketch_rnn_tpu.utils.telemetry import class_series, get_telemetry
+from sketch_rnn_tpu.utils.telemetry import (
+    class_series,
+    critical_path_segments,
+    get_telemetry,
+    request_span_id,
+    request_trace_id,
+    span_link,
+    suppressed as telemetry_suppressed,
+    tail_attribution,
+)
 
 # every live fleet, for the conftest no-stray-threads guard
 _LIVE: set = set()
@@ -109,6 +118,11 @@ class _Replica:
         self.chunks = 0
         self.device_steps = 0
         self.live_slot_steps = 0.0
+        # cost attribution (ISSUE 11): attributed + idle ==
+        # device_steps EXACTLY per booked burst (engine invariant)
+        self.attributed_steps = 0
+        self.idle_steps = 0
+        self.burst_seq = 0  # keys the per-burst trace span ids
 
     def pending(self) -> int:
         return sum(len(q) for q in self.queues.values())
@@ -218,15 +232,20 @@ class ServeFleet:
         ``pool_cap`` — the exact (B, K, N) geometry every later
         micro-burst dispatches, so a measured run can never compile.
         ``template`` supplies valid request fields (z for conditional
-        models); its strokes are discarded."""
+        models); its strokes are discarded. Runs under a suppressed
+        telemetry core (ISSUE 11): the clone's auto-assigned uid 0
+        would otherwise emit a ``req-0`` span tree colliding with the
+        real request 0's trace when the caller configured telemetry
+        before warming."""
         import jax
 
-        for rep in self._replicas:
-            clone = dataclasses.replace(
-                template, uid=None, max_len=1, cls=None, queue_pos=None,
-                enqueue_ts=None)
-            with jax.default_device(rep.device):
-                rep.engine.run([clone], pool_pad=self.pool_cap)
+        with telemetry_suppressed():
+            for rep in self._replicas:
+                clone = dataclasses.replace(
+                    template, uid=None, max_len=1, cls=None,
+                    queue_pos=None, enqueue_ts=None, attempt=0)
+                with jax.default_device(rep.device):
+                    rep.engine.run([clone], pool_pad=self.pool_cap)
 
     def start(self) -> "ServeFleet":
         if self._started:
@@ -244,12 +263,31 @@ class ServeFleet:
     def reset(self) -> None:
         """Clear results/shed/admission state between measurement arms
         (the compiled replica engines are the expensive part and are
-        kept). Only legal while idle — no queued or in-flight work."""
+        kept). Only legal while idle — no queued or in-flight work.
+
+        A cleanly close()d fleet resets back to the pristine PRE-START
+        state (ISSUE 11: serve_bench's capacity trials close ->
+        reset -> re-queue the whole burst -> start(), so every trial
+        replays the deterministic pre-start schedule — submitting into
+        live workers would race the burst chop against the submit
+        loop). close() alone stays terminal for submit()/drain(); only
+        this explicit reset reopens, and only when every worker thread
+        actually joined (a straggler forbids restart: two workers on
+        one replica would corrupt the queues)."""
         with self._lock:
             if any(rep.pending() for rep in self._replicas):
                 raise RuntimeError("reset with queued work")
             if self._done_locked() < self._submitted:
                 raise RuntimeError("reset with requests in flight")
+            if self._stop:
+                lingering = [rep.thread.name for rep in self._replicas
+                             if rep.thread is not None
+                             and rep.thread.is_alive()]
+                if lingering:
+                    raise RuntimeError(
+                        f"reset on a closed fleet with live worker "
+                        f"thread(s) {lingering} — close() timed out; "
+                        f"build a fresh fleet instead")
             if any(rep.dead for rep in self._replicas):
                 # a dead replica's worker thread has exited and cannot
                 # be restarted by reset — the measurement arms that use
@@ -258,6 +296,13 @@ class ServeFleet:
                     f"reset on a degraded fleet (dead replicas: "
                     f"{[r.idx for r in self._replicas if r.dead]}); "
                     f"build a fresh fleet instead")
+            if self._stop:
+                # every validation passed — only now reopen to the
+                # pristine pre-start state (a raise above must leave a
+                # closed fleet fully closed; a RUNNING fleet's flags
+                # stay untouched so start() stays a no-op on it)
+                self._stop = False
+                self._started = False
             self._admission = AdmissionController(
                 self.classes, n_replicas=self.n_replicas,
                 slots=self.slots, queue_cap=self._admission.queue_cap,
@@ -276,6 +321,7 @@ class ServeFleet:
                 rep.completed = rep.bursts = rep.chunks = 0
                 rep.device_steps = 0
                 rep.live_slot_steps = 0.0
+                rep.attributed_steps = rep.idle_steps = 0
 
     def close(self, timeout: float = 30.0) -> List[str]:
         """Stop the workers (any queued-but-unstarted work is
@@ -357,6 +403,14 @@ class ServeFleet:
             if self._t_first_submit is None:
                 self._t_first_submit = req.enqueue_ts
             self._submitted += 1
+            # admission evidence (ISSUE 11): the backlog the decision
+            # saw, captured BEFORE place() mutates it — the arrival
+            # instant carries the whole verdict (chosen replica,
+            # per-replica backlog, est_wait, shed reason), so a trace
+            # explains the placement without replaying the controller.
+            # Only materialized when tracing is on: the copy is pure
+            # trace evidence, and this is the hot admission path.
+            backlog = self._admission.backlog if tel.enabled else None
             decision = self._admission.place(cls_name, force=force)
             if decision.shed:
                 self._shed.append({"uid": req.uid, "class": cls_name,
@@ -368,6 +422,19 @@ class ServeFleet:
                     tel.counter("requests_shed", 1.0, cat="serve")
                     tel.counter(class_series("requests_shed", cls_name),
                                 1.0, cat="serve")
+                    # a shed request never completes, so its submit
+                    # instant IS its whole trace — a self-rooted
+                    # single-span tree, never an orphan
+                    tel.instant(
+                        "submit", cat="serve", ts=req.enqueue_ts,
+                        args={"uid": req.uid, "class": cls_name,
+                              "shed": True,
+                              "reason": decision.shed_reason,
+                              "est_wait_s": decision.est_wait_s,
+                              "backlog": backlog},
+                        trace=span_link(request_trace_id(req.uid),
+                                        request_span_id("shed",
+                                                        req.uid)))
                 self._done_cv.notify_all()
                 return False
             req.queue_pos = decision.queue_pos
@@ -375,6 +442,17 @@ class ServeFleet:
             rep.queues[cls_name].append(req)
             if tel.enabled:
                 tel.counter("requests_admitted", 1.0, cat="serve")
+                tel.instant(
+                    "submit", cat="serve", ts=req.enqueue_ts,
+                    args={"uid": req.uid, "class": cls_name,
+                          "shed": False, "replica": decision.replica,
+                          "queue_pos": decision.queue_pos,
+                          "est_wait_s": decision.est_wait_s,
+                          "backlog": backlog},
+                    trace=span_link(request_trace_id(req.uid),
+                                    request_span_id("submit", req.uid),
+                                    request_span_id("request",
+                                                    req.uid)))
             rep.cond.notify()
             return True
 
@@ -399,16 +477,35 @@ class ServeFleet:
                 if self._stop:
                     return
                 batch = rep.pop_batch(self.pool_cap)
+                bid = f"r{rep.idx}.b{rep.burst_seq}"
+                rep.burst_seq += 1
+            tel = get_telemetry()
+            t_burst = time.perf_counter()
             try:
                 # fault site: kill THIS replica's burst (plans target a
                 # specific replica: "fleet.worker.r0@0")
                 fault_point(f"fleet.worker.r{rep.idx}")
                 with jax.default_device(rep.device):
-                    out = rep.engine.run(batch, pool_pad=self.pool_cap)
+                    out = rep.engine.run(batch, pool_pad=self.pool_cap,
+                                         burst=bid)
             except BaseException as e:  # noqa: BLE001
                 self._on_replica_death(rep, batch, e)
                 return
             now = time.perf_counter()
+            if tel.enabled:
+                # the micro-burst span (ISSUE 11): its own rooted
+                # trace naming every member uid; each member's
+                # complete event carries `burst` back, so the linkage
+                # is bidirectional without forcing a many-parent tree
+                tel.emit_span(
+                    "burst", "serve", t_burst, now,
+                    args={"replica": rep.idx, "burst": bid,
+                          "n_requests": len(batch),
+                          "slots_live": min(len(batch),
+                                            self.slots),
+                          "pool_pad": self.pool_cap,
+                          "uids": [r.uid for r in batch]},
+                    trace=span_link(f"burst-{bid}", f"burst-{bid}"))
             m = out["metrics"]
             with self._lock:
                 for res in out["results"]:
@@ -432,6 +529,8 @@ class ServeFleet:
                 rep.bursts += 1
                 rep.chunks += m["chunks"]
                 rep.device_steps += m["device_steps"]
+                rep.attributed_steps += m["steps_attributed"]
+                rep.idle_steps += m["steps_idle"]
                 rep.live_slot_steps += (m["slot_utilization"]
                                         * m["chunks"] * self.chunk
                                         * self.slots)
@@ -452,6 +551,7 @@ class ServeFleet:
         the death of the LAST replica is fleet-fatal and surfaces as
         the pre-failover "fleet worker failed" raise."""
         tel = get_telemetry()
+        t_death = time.perf_counter()
         with self._lock:
             rep.dead = True
             rep.death = repr(exc)
@@ -493,12 +593,38 @@ class ServeFleet:
                         "error": repr(exc)}
                     if tel.enabled:
                         tel.counter("requests_failed", 1.0, cat="serve")
+                        # a failed request never reaches the engine's
+                        # completion emitter, so IT won't get a root
+                        # span or a terminal instant there — emit both
+                        # here, or its tree reads as a torn mid-flight
+                        # export ("incomplete") instead of a request
+                        # the fleet deliberately gave up on. The root
+                        # still covers the full clock from the
+                        # ORIGINAL arrival, and the terminal `failed`
+                        # instant puts the tree under the orphan check.
+                        trace_id = request_trace_id(r.uid)
+                        root_id = request_span_id("request", r.uid)
+                        tel.emit_span(
+                            "request", "serve", r.enqueue_ts, t_death,
+                            args={"uid": r.uid},
+                            trace=span_link(trace_id, root_id))
+                        tel.instant(
+                            "failed", cat="serve", ts=t_death,
+                            args={"uid": r.uid, "class": r.cls,
+                                  "replica": rep.idx, "retries": n - 1,
+                                  "reason": self._failed[r.uid]["reason"],
+                                  "error": repr(exc)},
+                            trace=span_link(
+                                trace_id,
+                                request_span_id("failed", r.uid),
+                                root_id))
         # deterministic backoff OUTSIDE the lock (the dying worker is
         # the only thread that sleeps; submits/completions proceed):
         # the schedule is a pure function of the worst attempt index
         if requeue and self.retry_backoff_s > 0:
             time.sleep(backoff_s(self.retry_backoff_s, max_attempt - 1))
         with self._lock:
+            now = time.perf_counter()
             for r in requeue:
                 # already-admitted requests never re-shed OR re-count:
                 # failover is the fleet's fault, not the client's
@@ -506,11 +632,29 @@ class ServeFleet:
                 # survivors, no shed checks, no second admitted tick)
                 decision = self._admission.place(r.cls, requeue=True)
                 r.queue_pos = decision.queue_pos
+                # stamp the attempt (ISSUE 11): the retried hops' span
+                # ids hang under this retry span, so the request stays
+                # ONE tree — and its enqueue_ts is untouched, so the
+                # latency clock still starts at the ORIGINAL arrival
+                # (the backdating-survives-requeue pin)
+                r.attempt = self._retries[r.uid]
                 target = self._replicas[decision.replica]
                 target.queues[r.cls].append(r)
                 self._requeues += 1
                 if tel.enabled:
                     tel.counter("requests_requeued", 1.0, cat="serve")
+                    # the retry span covers death -> requeue (backoff
+                    # included), parented to the request ROOT
+                    tel.emit_span(
+                        "retry", "serve", t_death, now,
+                        args={"uid": r.uid, "attempt": r.attempt,
+                              "from_replica": rep.idx,
+                              "to_replica": decision.replica,
+                              "error": repr(exc)},
+                        trace=span_link(
+                            request_trace_id(r.uid),
+                            request_span_id("retry", r.uid, r.attempt),
+                            request_span_id("request", r.uid)))
                 target.cond.notify()
             # failed requests count toward done — wake any drainer
             self._done_cv.notify_all()
@@ -603,7 +747,8 @@ class ServeFleet:
             requeues = self._requeues
             submitted = self._submitted
             reps = [(r.idx, r.completed, r.bursts, r.chunks,
-                     r.device_steps, r.live_slot_steps, r.dead)
+                     r.device_steps, r.live_slot_steps, r.dead,
+                     r.attributed_steps, r.idle_steps)
                     for r in self._replicas]
             t0, t1 = self._t_first_submit, self._t_last_done
         wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
@@ -635,7 +780,30 @@ class ServeFleet:
             "slot_utilization": round(
                 live / max(chunks * self.chunk * self.slots, 1), 4),
             "dead": dead,
-        } for idx, comp, bursts, chunks, steps, live, dead in reps]
+            "steps_attributed": attr, "steps_idle": idle,
+        } for idx, comp, bursts, chunks, steps, live, dead, attr, idle
+          in reps]
+        # per-class device-step cost (ISSUE 11): integer sums of the
+        # engine's deterministic per-request attribution; `exact` pins
+        # the identity attributed + idle == dispatched over every
+        # BOOKED burst (a replica that died mid-burst booked nothing,
+        # so the identity holds on degraded runs too)
+        steps_by_class: Dict[str, int] = {}
+        for rec in recs:
+            c = rec.get("class") or DEFAULT_CLASS
+            steps_by_class[c] = (steps_by_class.get(c, 0)
+                                 + rec["result"].attributed_steps)
+        total_attr = sum(r["steps_attributed"] for r in per_replica)
+        total_idle = sum(r["steps_idle"] for r in per_replica)
+        total_steps = sum(r["device_steps"] for r in per_replica)
+        cost = {
+            "steps_by_class": dict(sorted(steps_by_class.items())),
+            "steps_attributed": total_attr,
+            "steps_idle": total_idle,
+            "steps_dispatched": total_steps,
+            "exact": total_attr + total_idle == total_steps
+            and sum(steps_by_class.values()) == total_attr,
+        }
         return {
             "replicas": self.n_replicas,
             "replicas_dead": sum(1 for r in per_replica if r["dead"]),
@@ -659,6 +827,15 @@ class ServeFleet:
             "latency": pct(lat_all),
             "latency_by_class": {c: {**pct(v), "completed": len(v)}
                                  for c, v in sorted(by_class.items())},
+            # critical-path tail attribution (ISSUE 11): the shared
+            # segment schema over every completed Result — is the p99
+            # queue- or decode-dominated? (None with no completions)
+            "tail": tail_attribution(
+                [(rec["result"].latency_s,
+                  critical_path_segments(rec["result"].queue_wait_s,
+                                         rec["result"].latency_s))
+                 for rec in recs]),
+            "cost": cost,
             "per_replica": per_replica,
             # the fleet's critical path in DEVICE STEPS: max over
             # replicas — deterministic for a closed burst, and the

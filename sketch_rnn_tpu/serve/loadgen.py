@@ -32,6 +32,13 @@ from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
+from sketch_rnn_tpu.utils.telemetry import (
+    get_telemetry,
+    request_span_id,
+    request_trace_id,
+    span_link,
+)
+
 # every live generator, for the conftest no-stray-threads guard
 _LIVE: set = set()
 _LIVE_LOCK = threading.Lock()
@@ -62,15 +69,23 @@ class OpenLoopLoadGen:
     scheduled instant and never waits on completions; if the host
     stalls past an arrival the request fires immediately and the
     shortfall is recorded in ``max_lag_s`` (honesty over smoothing).
+
+    ``uid_of`` maps the arrival index to the request uid the submit
+    callback will assign, keying the arrival's causal trace stamp
+    (ISSUE 11). Defaults to identity — every in-repo caller (cli,
+    serve_bench) numbers requests by arrival index; pass your own
+    mapping if yours does not.
     """
 
     def __init__(self, arrivals: Sequence[float],
                  submit: Callable[[int], object],
-                 name: str = "loadgen"):
+                 name: str = "loadgen",
+                 uid_of: Callable[[int], int] = lambda i: i):
         self.arrivals = np.asarray(arrivals, np.float64)
         if len(self.arrivals) and np.any(np.diff(self.arrivals) < 0):
             raise ValueError("arrivals must be non-decreasing")
         self._submit = submit
+        self._uid_of = uid_of
         self.name = name
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -91,6 +106,24 @@ class OpenLoopLoadGen:
                 if self._stop.is_set():
                     return
                 self.max_lag_s = max(self.max_lag_s, lag)
+                tel = get_telemetry()
+                if tel.enabled:
+                    # the loadgen hop of the causal chain (ISSUE 11):
+                    # scheduled vs realized arrival, BEFORE the submit
+                    # — so a trace can tell replay lag (this thread
+                    # fell behind the schedule) apart from queueing
+                    # (the fleet made the request wait). SELF-ROOTED
+                    # in the request's trace: the eventual terminal
+                    # span may be `request` OR `shed`, so parenting
+                    # under either would orphan the other outcome.
+                    uid = self._uid_of(i)
+                    tel.instant("loadgen_dispatch", cat="serve",
+                                args={"index": int(i),
+                                      "sched_s": float(at),
+                                      "lag_s": round(float(lag), 6)},
+                                trace=span_link(
+                                    request_trace_id(uid),
+                                    request_span_id("arrival", uid)))
                 self._submit(i)
                 self.submitted += 1
         finally:

@@ -14,7 +14,10 @@ failure *injectable, reproducible and accounted*:
   exact place a real fault would land: the checkpoint commit
   (``ckpt.commit``), the torn instant between the sidecar and msgpack
   renames (``ckpt.torn``), the async writer thread (``ckpt.writer``),
-  a fleet replica's burst dispatch (``fleet.worker.rNN``), the data
+  a fleet replica's burst dispatch (``fleet.worker.rNN``), a serving
+  engine's chunk loop (``serve.chunk[.rNN]`` — fires mid-burst, after
+  earlier chunks' completions already emitted telemetry, exercising
+  the abort-ledger / duplicate-emission path), the data
   loader's batch assembly (``data.batch``), the metrics writer
   (``metrics.write``), a drained metrics row's loss value
   (``metrics.row``), and the training loop's step dispatch

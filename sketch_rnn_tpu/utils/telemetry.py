@@ -159,6 +159,212 @@ DEVICE_TRACE_STOP = "device_trace_stop"
 PROFILER_CAT = "profiler"
 
 
+# -- causal trace context (ISSUE 11) -----------------------------------------
+#
+# Every hop of a request's life can stamp its event with a causal
+# coordinate — (trace_id, span_id, parent_id) — so a query tool can
+# reconstruct one span TREE per request across threads, replicas and
+# hosts, and the Chrome exporter can draw flow arrows between the hops.
+# The naming contract lives HERE (one copy), shared by the emitters
+# (serve/engine.py, serve/fleet.py) and the reader
+# (scripts/trace_query.py): span ids are PURE functions of
+# (uid, hop, attempt), so a retried request's tree is reconstructible
+# without any shared mutable id allocator — the same no-RNG-stream
+# discipline as utils/faults.py.
+
+
+def span_link(trace_id: str, span_id: str,
+              parent_id: Optional[str] = None) -> Dict[str, str]:
+    """The propagation helper: the ``trace`` dict an event carries.
+
+    ``parent_id=None`` marks a tree ROOT. Pass the result as the
+    ``trace=`` argument of :meth:`Telemetry.emit_span` /
+    :meth:`Telemetry.instant`."""
+    link = {"id": str(trace_id), "span": str(span_id)}
+    if parent_id is not None:
+        link["parent"] = str(parent_id)
+    return link
+
+
+REQUEST_TRACE_PREFIX = "req-"
+
+
+def request_trace_id(uid) -> str:
+    """One trace per request uid: the join key of its span tree."""
+    return f"{REQUEST_TRACE_PREFIX}{int(uid)}"
+
+
+def request_span_id(hop: str, uid, attempt: int = 0) -> str:
+    """Span id of one hop of request ``uid``'s life. ``attempt``
+    distinguishes failover retries (attempt 0 spans keep the bare name,
+    so pre-failover traces and healthy runs read identically)."""
+    base = f"{hop}-{int(uid)}"
+    return base if not attempt else f"{base}-a{int(attempt)}"
+
+
+def request_parent_id(uid, attempt: int = 0) -> str:
+    """The parent a per-attempt hop hangs under: the request ROOT span
+    for the first attempt, the attempt's ``retry`` span afterwards —
+    which is itself rooted, so a retried request stays ONE tree."""
+    if not attempt:
+        return request_span_id("request", uid)
+    return request_span_id("retry", uid, attempt)
+
+
+# -- critical-path latency decomposition (ISSUE 11) --------------------------
+#
+# One segment schema for "why was this request slow", shared by the
+# emitter (the serve engine stamps `segments` into every complete
+# event), the fleet/engine summaries, scripts/trace_query.py and the
+# bench rows — the single latency-decomposition source of truth
+# (scripts/profile_breakdown.py's train-step ladder is marked legacy
+# and points here for the serving side).
+
+CRITICAL_PATH_SEGMENTS = ("queue_wait_s", "decode_s")
+
+# display labels for the dominant-segment verdicts (p99_dom=queue|decode)
+SEGMENT_LABELS = {"queue_wait_s": "queue", "decode_s": "decode"}
+
+
+def critical_path_segments(queue_wait_s: float, latency_s: float
+                           ) -> List[Tuple[str, float]]:
+    """Per-request critical-path decomposition whose LEFT-TO-RIGHT
+    float sum is BITWISE ``latency_s``.
+
+    ``queue_wait_s`` is the Result's exact queue segment (original
+    arrival -> slot admission — failover requeues keep the original
+    ``enqueue_ts`` clock base); the decode segment is the REMAINDER of
+    the request's latency clock, compensated so ``q + d == latency_s``
+    exactly (plain ``latency - queue`` can be an ulp off under IEEE
+    rounding, and the reconciliation contract is bitwise, not approx).
+    It therefore reconciles with the Result's own ``decode_s`` within
+    one ulp rather than matching it bitwise — the sum invariant is the
+    one the tree query verifies. The unreachable non-convergent case
+    degrades to attributing the whole clock to decode, which still
+    sums exactly (``0.0 + x == x`` for ``x >= 0``).
+    """
+    q, lat = float(queue_wait_s), float(latency_s)
+    d = lat - q
+    for _ in range(8):
+        s = q + d
+        if s == lat:
+            return [("queue_wait_s", q), ("decode_s", d)]
+        d += lat - s
+    return [("queue_wait_s", 0.0), ("decode_s", lat)]
+
+
+def segments_sum(segments) -> float:
+    """The decomposition's canonical (left-to-right) float sum — the
+    exact-reconciliation side of :func:`critical_path_segments`."""
+    total = 0.0
+    for _, v in segments:
+        total += float(v)
+    return total
+
+
+def tail_attribution(latency_segments, q: float = 0.99) -> Optional[Dict]:
+    """Dominant critical-path segment of the latency tail.
+
+    ``latency_segments``: ``[(latency_s, [(segment, seconds), ...])]``
+    per completed request. The tail set is every request at or above
+    the ``q``-quantile latency (``np.percentile`` linear — the same
+    rank math as ``ServeEngine.run()``'s summary, so the threshold IS
+    the reported p99); their segments are summed and the largest share
+    names the verdict: a queue-dominated tail wants capacity, a
+    decode-dominated tail wants a faster engine (the ROADMAP's
+    autoscaling signal). Deterministic: ties break in segment order.
+    Returns ``{p99_s, tail_n, dom, dom_frac, segments}`` or None when
+    there is nothing to attribute.
+    """
+    rows = [(float(lat), segs) for lat, segs in latency_segments]
+    if not rows:
+        return None
+    import numpy as np  # lazy: telemetry stays import-light
+
+    lats = np.array([lat for lat, _ in rows])
+    thresh = float(np.percentile(lats, 100.0 * q))
+    totals: Dict[str, float] = {}
+    order: List[str] = []
+    tail_n = 0
+    for lat, segs in rows:
+        if lat < thresh:
+            continue
+        tail_n += 1
+        for name, v in segs:
+            if name not in totals:
+                totals[name] = 0.0
+                order.append(name)
+            totals[name] += float(v)
+    accounted = sum(totals.values())
+    dom = max(order, key=lambda nm: totals[nm]) if order else None
+    return {
+        "p99_s": thresh,
+        "tail_n": tail_n,
+        "dom": SEGMENT_LABELS.get(dom, dom),
+        "dom_frac": (round(totals[dom] / accounted, 4)
+                     if dom is not None and accounted > 0 else None),
+        "segments": {SEGMENT_LABELS.get(nm, nm): round(v, 6)
+                     for nm, v in totals.items()},
+    }
+
+
+def attribute_chunk_steps(chunk_steps: int, n_live: int
+                          ) -> List[int]:
+    """Deterministic integer split of one chunk's device steps over its
+    live slots: every live slot gets ``chunk // n``, the first
+    ``chunk % n`` slots (ascending slot order — deterministic in the
+    admission schedule) one extra, so the shares sum to ``chunk_steps``
+    EXACTLY in integers. Per-class cost built on this is provable
+    bitwise on any box — no float division, no wall clock (the
+    ROADMAP's scheduling-math constraint)."""
+    if n_live < 1:
+        raise ValueError(f"n_live must be >= 1, got {n_live}")
+    base, extra = divmod(int(chunk_steps), n_live)
+    return [base + 1 if i < extra else base for i in range(n_live)]
+
+
+def chrome_flow_events(items) -> List[dict]:
+    """Chrome-trace flow events (``ph`` s/t/f) chaining each trace's
+    events in time order, so Perfetto draws arrows across thread (and,
+    in a merged fleet trace, host) tracks.
+
+    ``items``: ``[(trace_id, ts_us, pid, tid), ...]`` — one entry per
+    traced event, any order. Traces with fewer than two events draw no
+    arrow. Shared by the single-host exporter and trace_merge's merged
+    writer (one copy of the flow protocol)."""
+    by_trace: Dict[str, List[Tuple[float, int, int]]] = {}
+    for trace_id, ts_us, pid, tid in items:
+        by_trace.setdefault(str(trace_id), []).append(
+            (float(ts_us), pid, tid))
+    out: List[dict] = []
+    for fid, (trace_id, pts) in enumerate(sorted(by_trace.items())):
+        if len(pts) < 2:
+            continue
+        pts.sort()
+        for i, (ts_us, pid, tid) in enumerate(pts):
+            ph = "s" if i == 0 else ("f" if i == len(pts) - 1 else "t")
+            rec = {"ph": ph, "id": fid, "cat": "request",
+                   "name": trace_id, "pid": pid, "tid": tid,
+                   "ts": ts_us}
+            if ph == "f":
+                rec["bp"] = "e"  # bind to the enclosing slice
+            out.append(rec)
+    return out
+
+
+def stamp_trace_flow(rec: dict, ev: dict, flows: List, pid: int) -> None:
+    """Collection side of the flow protocol: surface a traced event's
+    causal coordinate in its Chrome record's ``args.trace`` and
+    register one flow point for :func:`chrome_flow_events`. Untraced
+    events are left alone. Shared by both branches of both Chrome
+    writers (the single-host exporter and trace_merge's merged one),
+    so a change to how the coordinate is surfaced lands everywhere."""
+    if "trace" not in ev:
+        return
+    rec["args"] = {**rec.get("args", {}), "trace": ev["trace"]}
+    flows.append((ev["trace"]["id"], rec["ts"], pid, rec["tid"]))
+
+
 def json_safe(obj):
     """Strict-JSON-safe copy: non-finite floats become repr strings.
 
@@ -344,14 +550,15 @@ class _SpanCtx:
     block with ``perf_counter`` and records on exit (exceptions
     included — the span still closes, Chrome traces stay well-formed)."""
 
-    __slots__ = ("_tel", "_name", "_cat", "_args", "_t0")
+    __slots__ = ("_tel", "_name", "_cat", "_args", "_trace", "_t0")
 
     def __init__(self, tel: "Telemetry", name: str, cat: str,
-                 args: Optional[dict]):
+                 args: Optional[dict], trace: Optional[dict] = None):
         self._tel = tel
         self._name = name
         self._cat = cat
         self._args = args
+        self._trace = trace
 
     def __enter__(self) -> "_SpanCtx":
         self._t0 = time.perf_counter()
@@ -359,7 +566,8 @@ class _SpanCtx:
 
     def __exit__(self, *exc_info) -> None:
         self._tel.emit_span(self._name, self._cat, self._t0,
-                            time.perf_counter(), self._args)
+                            time.perf_counter(), self._args,
+                            trace=self._trace)
 
 
 class _NullCtx:
@@ -424,18 +632,23 @@ class Telemetry:
     # -- recording ---------------------------------------------------------
 
     def span(self, name: str, cat: str = "host",
-             args: Optional[dict] = None):
+             args: Optional[dict] = None,
+             trace: Optional[dict] = None):
         """Context manager timing a block as one span (no-op when
-        disabled)."""
+        disabled). ``trace`` (a :func:`span_link` dict) stamps the
+        span's causal coordinate."""
         if not self.enabled:
             return _NULL_CTX
-        return _SpanCtx(self, name, cat, args)
+        return _SpanCtx(self, name, cat, args, trace)
 
     def emit_span(self, name: str, cat: str, t0: float, t1: float,
-                  args: Optional[dict] = None) -> None:
+                  args: Optional[dict] = None,
+                  trace: Optional[dict] = None) -> None:
         """Record an already-timed span (``t0``/``t1`` from
         ``perf_counter``) — the path the ledger views use, so THEIR
-        accumulation and the core's see the identical ``t1 - t0``."""
+        accumulation and the core's see the identical ``t1 - t0``.
+        ``trace`` (a :func:`span_link` dict) rides the event verbatim
+        into both exporters (ISSUE 11)."""
         if not self.enabled:
             return
         dur = t1 - t0
@@ -444,6 +657,8 @@ class Telemetry:
               "tid": threading.current_thread().name}
         if args:
             ev["args"] = args
+        if trace:
+            ev["trace"] = trace
         with self._lock:
             rec = self._agg.setdefault((cat, name), [0, 0.0])
             rec[0] += 1
@@ -452,7 +667,8 @@ class Telemetry:
 
     def instant(self, name: str, cat: str = "host",
                 args: Optional[dict] = None,
-                ts: Optional[float] = None) -> None:
+                ts: Optional[float] = None,
+                trace: Optional[dict] = None) -> None:
         """Record a zero-duration marker event (e.g. request enqueue)."""
         if not self.enabled:
             return
@@ -461,6 +677,8 @@ class Telemetry:
               "tid": threading.current_thread().name}
         if args:
             ev["args"] = args
+        if trace:
+            ev["trace"] = trace
         with self._lock:
             self._append(ev)
 
@@ -602,11 +820,16 @@ class Telemetry:
     def export_chrome_trace(self, path: str) -> None:
         """Write a Chrome-trace ``traceEvents`` JSON (chrome://tracing /
         Perfetto). Spans -> ``X`` complete events, instants -> ``i``,
-        counters/gauges -> ``C`` tracks; threads get name metadata."""
+        counters/gauges -> ``C`` tracks; threads get name metadata.
+        Trace-stamped events (ISSUE 11) additionally carry their causal
+        coordinate in ``args.trace`` and chain into flow arrows
+        (:func:`chrome_flow_events`), so Perfetto draws a request's
+        hops across thread tracks."""
         events = self.events()
         pid = os.getpid()
         tids: Dict[str, int] = {}
         out: List[dict] = []
+        flows: List[Tuple[str, float, int, int]] = []
 
         def tid_of(name: str) -> int:
             if name not in tids:
@@ -624,6 +847,7 @@ class Telemetry:
                        "ts": ts_us, "dur": ev["dur"] * 1e6}
                 if "args" in ev:
                     rec["args"] = ev["args"]
+                stamp_trace_flow(rec, ev, flows, pid)
                 out.append(rec)
             elif ev["type"] == "instant":
                 rec = {"ph": "i", "name": ev["name"], "cat": ev["cat"],
@@ -631,12 +855,14 @@ class Telemetry:
                        "ts": ts_us, "s": "t"}
                 if "args" in ev:
                     rec["args"] = ev["args"]
+                stamp_trace_flow(rec, ev, flows, pid)
                 out.append(rec)
             elif ev["type"] == "counter":
                 out.append({"ph": "C", "name": ev["name"],
                             "cat": ev["cat"], "pid": pid, "tid": 0,
                             "ts": ts_us,
                             "args": {ev["name"]: ev["value"]}})
+        out.extend(chrome_flow_events(flows))
         with open(path, "w") as f:
             json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
 
@@ -728,6 +954,25 @@ def disable() -> None:
     """Restore the disabled default (tests; end of a traced run)."""
     global _global
     _global = Telemetry(enabled=False)
+
+
+@contextlib.contextmanager
+def suppressed():
+    """Temporarily swap in a disabled core (ISSUE 11: fleet warmup —
+    the warm clone's 1-step burst must not emit request spans, or its
+    auto-assigned uid 0 would collide with the real request 0's trace
+    tree; the CLI orders warmup before configure, but the library API
+    allows either order). Probe sites resolve the global at call time,
+    so everything inside the block records nothing and the prior core
+    comes back intact. NOT thread-safe — setup phases only, before any
+    worker threads run."""
+    global _global
+    prev = _global
+    _global = Telemetry(enabled=False)
+    try:
+        yield
+    finally:
+        _global = prev
 
 
 # -- compile accounting ------------------------------------------------------

@@ -200,3 +200,49 @@ def test_fleet_rows_gate_per_replica_and_rate_cell(tmp_path, capsys):
     by_r = {r["key"][2].split()[0]: r for r in rep["rows"]}
     assert by_r["R=2"]["verdict"] == "REGRESS"
     assert by_r["R=1"]["verdict"] == "ok"
+
+
+def _cost_row(ok, replicas=2, **kw):
+    return {"kind": "serve_cost", "dec_model": "lstm", "slots": 32,
+            "chunk": 8, "n_requests": 512, "len_dist": "bimodal",
+            "device_kind": "cpu", "smoke": True, "replicas": replicas,
+            "ok": ok, "steps_by_class": {"batch": 900,
+                                         "interactive": 700},
+            "steps_attributed": 1600, "steps_idle": 32,
+            "steps_dispatched": 1632 if ok else 1700,
+            "p99_dom": "queue", "p99_dom_frac": 0.8, **kw}
+
+
+def test_serve_cost_rows_gate_binary_exactness(tmp_path, capsys):
+    """ISSUE 11 satellite: the per-class cost-attribution cells gate
+    like the resilience cells — binary ok metric keyed per replica
+    count, any fresh exactness miss is a REGRESS, and a RECORDED miss
+    never poisons the baseline (the band would blow to 1.0 and disable
+    the gate forever)."""
+    from scripts.bench_summary import key_of, metric_of
+
+    assert metric_of(_cost_row(True)) == 1.0
+    assert metric_of(_cost_row(False)) == 0.0
+    assert key_of(_cost_row(True)) == key_of(_cost_row(False))
+    assert key_of(_cost_row(True)) != key_of(_cost_row(True,
+                                                       replicas=1))
+    # serve_cost cells never pool with the fleet throughput cells
+    assert key_of(_cost_row(True))[0] == "servecost"
+
+    hist = _write(tmp_path / "h.jsonl",
+                  [_cost_row(True) for _ in range(4)])
+    ok_fresh = _write(tmp_path / "ok.jsonl", [_cost_row(True)])
+    bad_fresh = _write(tmp_path / "bad.jsonl", [_cost_row(False)])
+    assert bench_regress.main(
+        ["--fresh", ok_fresh, "--history", hist]) == 0
+    capsys.readouterr()
+    assert bench_regress.main(
+        ["--fresh", bad_fresh, "--history", hist]) == 1
+    assert "REGRESS" in capsys.readouterr().out
+    # a recorded failure is evidence, not a baseline
+    poisoned = _write(tmp_path / "p.jsonl",
+                      [_cost_row(True) for _ in range(4)]
+                      + [_cost_row(False)])
+    assert bench_regress.main(
+        ["--fresh", bad_fresh, "--history", poisoned]) == 1
+    capsys.readouterr()

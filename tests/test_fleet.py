@@ -459,3 +459,161 @@ def test_fleet_failover_counters_and_close_reports(tiny_fleet_setup):
     finally:
         faults.disable()
         tele.disable()
+
+
+def test_failover_queue_wait_clock_base_survives_requeue(tiny_fleet_setup):
+    """ISSUE 11 satellite pin: a fleet-retried request's queue_wait_s
+    is still measured from the ORIGINAL arrival. Both runs submit with
+    an enqueue_ts backdated 50s; if the failover requeue rebased the
+    clock, the retried requests' queue_wait_s would collapse to
+    sub-second while the no-fault run keeps the 50s base."""
+    import jax
+
+    from sketch_rnn_tpu.serve import Request, ServeFleet
+    from sketch_rnn_tpu.utils import faults
+
+    hps, model, params = tiny_fleet_setup
+    BACKDATE = 50.0
+    n = 6
+
+    def run(plan):
+        if plan:
+            faults.configure(plan)
+        try:
+            fleet = ServeFleet(model, hps, params, replicas=2,
+                               retry_backoff_s=0.0)
+            base = time.perf_counter() - BACKDATE
+            for i in range(n):
+                rng = np.random.default_rng(i)
+                fleet.submit(Request(
+                    key=jax.random.key(1000 + i),
+                    z=rng.standard_normal(hps.z_size).astype(np.float32),
+                    temperature=0.8, max_len=4, uid=i,
+                    enqueue_ts=base))
+            with fleet:
+                assert fleet.drain(timeout=120)
+                s = fleet.summary()
+                return ({uid: rec["result"]
+                         for uid, rec in fleet.results.items()}, s)
+        finally:
+            faults.disable()
+
+    res0, _ = run(None)
+    res1, sum1 = run("fleet.worker.r0@0")
+    assert sum1["requeues"] > 0 and sum1["completed"] == n
+    for uid in range(n):
+        # clock base held in BOTH runs: the backdated 50s dominates
+        # the sub-second serving time, retried or not
+        assert res1[uid].queue_wait_s > BACKDATE - 1.0, uid
+        assert res0[uid].queue_wait_s > BACKDATE - 1.0, uid
+        # and the two runs' clock bases agree to serving-time noise —
+        # a rebased requeue clock would differ by ~50s
+        assert abs(res1[uid].queue_wait_s
+                   - res0[uid].queue_wait_s) < 5.0, uid
+        assert res1[uid].latency_s >= res1[uid].queue_wait_s
+
+
+def test_closed_fleet_restarts_and_replays_identical_cost(
+        tiny_fleet_setup):
+    """ISSUE 11: a cleanly-closed fleet can start() again, and a
+    replayed deterministic pre-start schedule — all requests queued
+    before the workers run — reproduces the ENTIRE cost block
+    (per-class split, attributed, idle, dispatched) and the per-request
+    attributed steps bitwise: attribution is scheduling math, not
+    timing. (Submitting into live workers races the burst chop, which
+    is why serve_bench's trials replay pre-start.)"""
+    import jax
+
+    from sketch_rnn_tpu.serve import Request, ServeFleet
+    from sketch_rnn_tpu.serve.admission import parse_admission_classes
+
+    hps, model, params = tiny_fleet_setup
+    classes = parse_admission_classes(
+        ["interactive:p95<=5", "batch:p99<=30"])
+    fleet = ServeFleet(model, hps, params, replicas=2, classes=classes)
+    fleet.warm(Request(key=jax.random.key(0),
+                       z=np.zeros(hps.z_size, np.float32),
+                       temperature=0.8, max_len=2))
+
+    def run_once():
+        for i in range(8):
+            rng = np.random.default_rng(i)
+            fleet.submit(Request(
+                key=jax.random.key(1000 + i),
+                z=rng.standard_normal(hps.z_size).astype(np.float32),
+                temperature=0.8, max_len=2 + i % 5, uid=i),
+                cls=("interactive", "batch")[i % 2])
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        s = fleet.summary()
+        per_req = {uid: rec["result"].attributed_steps
+                   for uid, rec in fleet.results.items()}
+        assert fleet.close() == []
+        fleet.reset()
+        return s, per_req
+
+    s1, per1 = run_once()
+    s2, per2 = run_once()   # the restart: same pre-start schedule
+    assert s1["completed"] == s2["completed"] == 8
+    assert s1["cost"]["exact"] and s2["cost"]["exact"]
+    assert s1["cost"] == s2["cost"]
+    assert per1 == per2
+    assert sum(per1.values()) == s1["cost"]["steps_attributed"]
+
+
+def test_loadgen_arrival_stamps_request_trace():
+    """ISSUE 11: under an enabled core the loadgen stamps each arrival
+    as a SELF-ROOTED span of the request's trace (the terminal span
+    may be `request` or `shed`, so it parents under neither), keyed by
+    uid_of (default: uid == arrival index)."""
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    tel = tele.configure(trace_dir=None)
+    try:
+        gen = OpenLoopLoadGen([0.0, 0.0], lambda i: None,
+                              uid_of=lambda i: 100 + i).start()
+        assert gen.join(timeout=10)
+        evs = [e for e in tel.events()
+               if e.get("name") == "loadgen_dispatch"]
+    finally:
+        tele.disable()
+    assert [e["trace"] for e in evs] == [
+        {"id": "req-100", "span": "arrival-100"},
+        {"id": "req-101", "span": "arrival-101"}]
+    assert all("parent" not in e["trace"] for e in evs)
+    assert [e["args"]["index"] for e in evs] == [0, 1]
+
+
+def test_warm_under_enabled_telemetry_emits_no_request_spans(
+        tiny_fleet_setup):
+    """ISSUE 11 fix: warm()'s 1-step clone (auto-assigned uid 0) must
+    not emit a req-0 span tree when telemetry was configured BEFORE
+    the fleet was built — it would collide with the real request 0's
+    trace and break trace_query's event/counter reconciliation."""
+    import jax
+
+    from sketch_rnn_tpu.serve import Request, ServeFleet
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    hps, model, params = tiny_fleet_setup
+    tel = tele.configure(trace_dir=None)
+    try:
+        fleet = ServeFleet(model, hps, params, replicas=1)
+        fleet.warm(Request(key=jax.random.key(0),
+                           z=np.zeros(hps.z_size, np.float32),
+                           temperature=0.8, max_len=2))
+        assert [e for e in tel.events() if e.get("cat") == "serve"] == []
+        fleet.submit(Request(key=jax.random.key(1000),
+                             z=np.zeros(hps.z_size, np.float32),
+                             temperature=0.8, max_len=2, uid=0))
+        with fleet:
+            assert fleet.drain(timeout=60)
+        # exactly ONE complete event for uid 0 — the real request's
+        completes = [e for e in tel.events()
+                     if e.get("name") == "complete"]
+        assert len(completes) == 1
+        assert completes[0]["args"]["uid"] == 0
+        assert completes[0]["args"]["steps"] == \
+            fleet.results[0]["result"].steps
+    finally:
+        tele.disable()

@@ -375,3 +375,29 @@ def test_scrape_mid_and_post_serve_reconciles_with_summary(tiny_engine):
         got = _hist_quantile(final, "sketch_rnn_serve_latency_s", q)
         assert got == pytest.approx(m[key], rel=0.15), key
     tele.disable()
+
+
+def test_render_prometheus_cost_counters_close_identity():
+    """ISSUE 11 acceptance: per-class device-step cost lands on
+    /metrics as counters, and the scrape itself closes the identity
+    attributed + idle == dispatched (plus per-class series summing to
+    the aggregate) — straight from the telemetry core, no new
+    bookkeeping in the exposition layer."""
+    tel = Telemetry()
+    tel.counter("device_steps_dispatched", 40, cat="serve")
+    tel.counter("device_steps_idle", 4, cat="serve")
+    tel.counter("device_steps_attributed", 36, cat="serve")
+    from sketch_rnn_tpu.utils.telemetry import class_series
+    tel.counter(class_series("device_steps_attributed", "interactive"),
+                20, cat="serve")
+    tel.counter(class_series("device_steps_attributed", "batch"),
+                16, cat="serve")
+    s = _series(render_prometheus(tel))
+    attr = s["sketch_rnn_serve_device_steps_attributed_total"]
+    idle = s["sketch_rnn_serve_device_steps_idle_total"]
+    disp = s["sketch_rnn_serve_device_steps_dispatched_total"]
+    assert attr + idle == disp == 40
+    per_class = (
+        s["sketch_rnn_serve_device_steps_attributed_interactive_total"],
+        s["sketch_rnn_serve_device_steps_attributed_batch_total"])
+    assert sum(per_class) == attr == 36

@@ -67,11 +67,30 @@ def test_serve_bench_fleet_end_to_end_small(tmp_path, capsys):
     # critical path in device steps must drop ~2x at 2 replicas
     # (least-loaded placement splits the skewed mix)
     assert f["scaling"]["2"]["step_parallel"] >= 1.7
-    # per-class SLA surface present on every curve point
+    # per-class SLA surface present on every curve point, plus the
+    # ISSUE 11 tail-attribution verdict and the exact cost identity
     for c in f["curves"]:
         assert {"interactive", "batch"} == set(c["by_class"])
         assert c["latency_p50_s"] <= c["latency_p99_s"]
+        assert c["p99_dom"] in ("queue", "decode")
+        cost = c["cost"]
+        assert cost["exact"] is True
+        assert (cost["steps_attributed"] + cost["steps_idle"]
+                == cost["steps_dispatched"])
+        assert set(cost["steps_by_class"]) == {"interactive", "batch"}
     assert f["host_parallel_ceiling"] > 0
+    # one binary serve_cost history row per capacity arm (ISSUE 11):
+    # the exactness signal bench_regress gates; routed to the hermetic
+    # smoke history (same tmp_path as the conftest redirect)
+    hist = tmp_path / "BENCH_SMOKE_HISTORY.jsonl"
+    cost_rows = [r for r in map(json.loads, open(hist))
+                 if r.get("kind") == "serve_cost"]
+    assert {r["replicas"] for r in cost_rows} == {1, 2}
+    for r in cost_rows:
+        assert r["ok"] is True
+        assert sum(r["steps_by_class"].values()) == r["steps_attributed"]
+        assert (r["steps_attributed"] + r["steps_idle"]
+                == r["steps_dispatched"])
 
 
 @pytest.mark.parametrize("dist", ["power", "bimodal"])

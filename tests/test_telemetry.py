@@ -630,3 +630,123 @@ def test_traced_train_writes_run_manifest_and_memory_gauges(tmp_path):
     assert meta["process_index"] == 0 and meta["host_count"] == 1
     csvs = [p for p in man["artifacts"]["metrics"] if p.endswith(".csv")]
     assert any(os.path.exists(p) for p in csvs)
+
+
+# -- causal trace context + critical-path math (ISSUE 11) ---------------------
+
+
+def test_span_link_and_request_id_contract():
+    """The propagation helper and the deterministic span-id naming:
+    pure functions of (uid, hop, attempt), attempt 0 keeps bare names
+    so healthy traces read identically to pre-failover ones."""
+    link = tele.span_link("req-3", "queue-3", "request-3")
+    assert link == {"id": "req-3", "span": "queue-3",
+                    "parent": "request-3"}
+    assert "parent" not in tele.span_link("req-3", "request-3")
+
+    assert tele.request_trace_id(7) == "req-7"
+    assert tele.request_span_id("queue", 7) == "queue-7"
+    assert tele.request_span_id("queue", 7, attempt=2) == "queue-7-a2"
+    # attempt 0 hops hang under the root; attempt N under the retry
+    assert tele.request_parent_id(7) == "request-7"
+    assert tele.request_parent_id(7, 2) == "retry-7-a2"
+
+
+def test_critical_path_segments_sum_bitwise():
+    """The decomposition's in-order float sum equals latency_s
+    BITWISE — including adversarial float pairs where the naive
+    latency - queue remainder is an ulp off."""
+    rng = np.random.default_rng(11)
+    for _ in range(2000):
+        q = float(rng.uniform(0, 1e3) * 10.0 ** rng.integers(-9, 3))
+        lat = q + float(rng.uniform(0, 1e3)
+                        * 10.0 ** rng.integers(-9, 3))
+        segs = tele.critical_path_segments(q, lat)
+        assert [s[0] for s in segs] == ["queue_wait_s", "decode_s"]
+        assert tele.segments_sum(segs) == lat
+    # degenerate clocks still sum exactly
+    assert tele.segments_sum(tele.critical_path_segments(0.0, 0.0)) == 0.0
+    for segs in (tele.critical_path_segments(0.5, 0.5),
+                 tele.critical_path_segments(1e-300, 1.0)):
+        assert tele.segments_sum(segs) == segs[0][1] + segs[1][1]
+
+
+def test_attribute_chunk_steps_exact_integer_split():
+    """Each chunk's steps split deterministically over its live slots:
+    shares sum EXACTLY, remainder goes to the lowest slot indices."""
+    assert tele.attribute_chunk_steps(8, 4) == [2, 2, 2, 2]
+    assert tele.attribute_chunk_steps(7, 3) == [3, 2, 2]
+    assert tele.attribute_chunk_steps(2, 5) == [1, 1, 0, 0, 0]
+    for chunk in (1, 2, 7, 64):
+        for n in range(1, 9):
+            shares = tele.attribute_chunk_steps(chunk, n)
+            assert sum(shares) == chunk
+            assert max(shares) - min(shares) <= 1
+    with pytest.raises(ValueError, match="n_live"):
+        tele.attribute_chunk_steps(4, 0)
+
+
+def test_tail_attribution_verdicts():
+    """Queue- vs decode-dominated tails, deterministic ties, empty
+    input -> None."""
+    assert tele.tail_attribution([]) is None
+    qrows = [(lat, [("queue_wait_s", lat * 0.9),
+                    ("decode_s", lat * 0.1)])
+             for lat in (0.1, 0.2, 0.3, 1.0)]
+    t = tele.tail_attribution(qrows)
+    assert t["dom"] == "queue" and t["dom_frac"] == pytest.approx(0.9)
+    assert t["tail_n"] >= 1
+    drows = [(lat, [("queue_wait_s", lat * 0.2),
+                    ("decode_s", lat * 0.8)])
+             for lat in (0.1, 0.2, 0.3, 1.0)]
+    assert tele.tail_attribution(drows)["dom"] == "decode"
+    # exact tie breaks in segment order (queue first) — deterministic
+    tie = [(1.0, [("queue_wait_s", 0.5), ("decode_s", 0.5)])]
+    assert tele.tail_attribution(tie)["dom"] == "queue"
+
+
+def test_chrome_flow_events_chain_per_trace():
+    """Flow events chain each trace's hops in time order (s -> t ->
+    f); single-event traces draw no arrow."""
+    flows = tele.chrome_flow_events([
+        ("req-1", 30.0, 0, 2),   # out of order on purpose
+        ("req-1", 10.0, 0, 1),
+        ("req-1", 20.0, 0, 2),
+        ("req-2", 5.0, 0, 1),    # lone event: no arrow
+    ])
+    assert [f["ph"] for f in flows] == ["s", "t", "f"]
+    assert [f["ts"] for f in flows] == [10.0, 20.0, 30.0]
+    assert all(f["name"] == "req-1" for f in flows)
+    assert flows[-1]["bp"] == "e"
+    ids = {f["id"] for f in flows}
+    assert len(ids) == 1
+
+
+def test_trace_stamped_events_ride_exporters(tmp_path):
+    """A trace= stamp rides the event into both exporters: the JSONL
+    event carries `trace` verbatim; the Chrome trace puts it in args
+    and chains flow arrows across the stamped events."""
+    tel = tele.configure(trace_dir=str(tmp_path))
+    link_a = tele.span_link("req-1", "enqueue-1", "request-1")
+    link_b = tele.span_link("req-1", "complete-1", "request-1")
+    tel.instant("enqueue", cat="serve", args={"uid": 1}, trace=link_a)
+    t0 = tel.origin_perf
+    tel.emit_span("decode", "serve", t0, t0 + 0.01, args={"uid": 1})
+    tel.instant("complete", cat="serve", args={"uid": 1}, trace=link_b)
+    paths = tel.export()
+    tele.disable()
+
+    evs = [json.loads(l) for l in open(paths["jsonl"])]
+    stamped = [e for e in evs if e.get("trace")]
+    assert [e["trace"] for e in stamped] == [link_a, link_b]
+    # unstamped events stay clean — no trace key at all
+    decode = next(e for e in evs if e.get("name") == "decode")
+    assert "trace" not in decode
+
+    chrome = json.load(open(paths["chrome"]))["traceEvents"]
+    args_traces = [e["args"]["trace"] for e in chrome
+                   if e.get("args", {}).get("trace")]
+    assert args_traces == [link_a, link_b]
+    flows = [e for e in chrome if e["ph"] in ("s", "t", "f")]
+    assert [f["ph"] for f in flows] == ["s", "f"]
+    assert all(f["name"] == "req-1" for f in flows)

@@ -1,0 +1,396 @@
+"""trace_query.py tests (ISSUE 11): causal span trees, critical-path
+attribution and per-class device-step cost over a real traced chaos
+fleet run.
+
+The acceptance pins live here:
+
+- a seeded ``fleet.worker`` fault run yields ONE orphan-free tree per
+  request — retry spans linked under the request root, re-served hops
+  under the retry span;
+- every request's critical-path segments sum BITWISE to the Result's
+  ``latency_s``, and the percentile table reconciles with the fleet
+  summary (same ``np.percentile`` over the same floats);
+- per-class attributed device steps reconcile EXACTLY with the fleet's
+  dispatched counters (attributed + idle == dispatched, in integers)
+  and agree with the fleet summary's own cost block;
+- ``--smoke`` (the tier-1 wiring) holds over the committed fixture.
+
+The chaos run is expensive (two fleets, jax), so it is built ONCE per
+module and shared.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts import trace_query
+from scripts.trace_report import load
+from sketch_rnn_tpu.utils import faults
+from sketch_rnn_tpu.utils import telemetry as tele
+
+
+@pytest.fixture(scope="module")
+def serve_setup():
+    """Model + params shared by every traced fleet run in this module
+    (the runs are the expensive part; the model is tiny)."""
+    import jax
+
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+
+    hps = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+                  dec_rnn_size=16, z_size=6, num_mixture=3,
+                  serve_slots=2, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    return hps, model, params
+
+
+def _traced_run(serve_setup, plan, out_dir, **fleet_kw):
+    """One traced fleet run (2 replicas, 8 requests over 2 admission
+    classes) under fault plan ``plan`` -> (jsonl, summary, results)."""
+    import jax
+
+    from sketch_rnn_tpu.serve import Request, ServeFleet
+    from sketch_rnn_tpu.serve.admission import parse_admission_classes
+
+    hps, model, params = serve_setup
+
+    def req(i, cap=5):
+        rng = np.random.default_rng(i)
+        return Request(key=jax.random.key(1000 + i),
+                       z=rng.standard_normal(hps.z_size).astype(
+                           np.float32),
+                       temperature=0.8, max_len=cap, uid=i)
+
+    classes = parse_admission_classes(
+        ["interactive:p95<=5", "batch:p99<=30"])
+    fleet = ServeFleet(model, hps, params, replicas=2,
+                       classes=classes, retry_backoff_s=0.0,
+                       **fleet_kw)
+    fleet.warm(req(0))
+    tel = tele.configure(trace_dir=str(out_dir))
+    if plan:
+        faults.configure(plan)
+    try:
+        for i in range(8):
+            fleet.submit(req(i),
+                         cls=("interactive", "batch")[i % 2])
+        with fleet:
+            assert fleet.drain(timeout=120)
+            summary = fleet.summary()
+            results = {uid: rec["result"]
+                       for uid, rec in fleet.results.items()}
+        paths = tel.export()
+    finally:
+        faults.disable()
+        tele.disable()
+    return paths["jsonl"], summary, results
+
+
+@pytest.fixture(scope="module")
+def chaos_run(serve_setup, tmp_path_factory):
+    """One traced seeded ``fleet.worker.r0@0`` chaos run (2 replicas,
+    8 requests over 2 admission classes, replica 0 killed on its first
+    burst) plus the matching no-fault run — exported shards, fleet
+    summaries and per-request Results for both."""
+    base = tmp_path_factory.mktemp("trace_query_chaos")
+    fault = _traced_run(serve_setup, "fleet.worker.r0@0", base / "fault")
+    clean = _traced_run(serve_setup, None, base / "clean")
+    assert fault[1]["requeues"] > 0 and fault[1]["completed"] == 8
+    return {"fault": fault, "clean": clean}
+
+
+@pytest.fixture(scope="module")
+def midburst_run(serve_setup, tmp_path_factory):
+    """A crash AFTER completions: replica 0 dies on its 4th loop
+    iteration (``serve.chunk.r0@3``) — one past the 3 chunks a 5-step
+    request needs — so requests that already completed inside the
+    dying burst (complete event + attributed counters emitted) are
+    re-served whole by the failover. The duplicate-emission path."""
+    base = tmp_path_factory.mktemp("trace_query_midburst")
+    out = _traced_run(serve_setup, "serve.chunk.r0@3", base / "fault")
+    assert out[1]["requeues"] > 0 and out[1]["completed"] == 8
+    return out
+
+
+def test_chaos_trees_complete_orphan_free_and_retry_linked(chaos_run):
+    """THE orphan-free acceptance pin: every request of the chaos run
+    reconstructs as one complete tree; the killed replica's requests
+    carry linked retry spans; no span is parentless."""
+    jsonl, summary, _ = chaos_run["fault"]
+    rep = trace_query.report(load(jsonl))
+    assert rep["requests"] == 8
+    assert rep["complete"] == 8 and rep["incomplete"] == 0
+    assert rep["shed"] == 0
+    assert rep["retried"] >= 1          # replica 0's burst failed over
+    assert rep["orphan_spans"] == 0
+    assert rep["exact_sum_violations"] == 0
+    # the killed replica books no burst span (it died mid-burst), so
+    # only the survivor's bursts appear
+    assert rep["bursts"] >= 1
+    assert trace_query.verdict(rep) == []
+
+    # the retried trees carry the whole causal story: retry span
+    # parented under the request root, attempt-1 hops under the retry
+    trees = trace_query.request_trees(
+        trace_query.build_traces(load(jsonl)))
+    retried = [t for t in trees.values() if t["retries"]]
+    assert len(retried) >= 1
+    for t in retried:
+        assert t["complete"]["attempt"] >= 1
+        for rid in t["retries"]:
+            ev = t["spans"][rid]
+            assert ev["trace"]["parent"] == f"request-{t['uid']}"
+            assert ev["args"]["from_replica"] == 0
+            assert ev["args"]["to_replica"] == 1
+
+
+def test_chaos_percentiles_reconcile_with_fleet_summary(chaos_run):
+    """The latency table is the same np.percentile math over the same
+    exact Result floats as the fleet summary — rounded to the
+    summary's own 6 digits they must agree exactly."""
+    jsonl, summary, results = chaos_run["fault"]
+    rep = trace_query.report(load(jsonl))
+    by_metric = {r["metric"]: r for r in rep["latency"]}
+    row = by_metric["latency_s"]
+    assert row["count"] == summary["completed"] == 8
+    for p in ("p50", "p95", "p99"):
+        assert round(row[f"{p}_s"], 6) == summary["latency"][f"{p}_s"]
+    # and the event floats ARE the Result floats, bitwise
+    trees = trace_query.request_trees(
+        trace_query.build_traces(load(jsonl)))
+    for uid, res in results.items():
+        comp = trees[uid]["complete"]
+        assert comp["latency_s"] == res.latency_s
+        assert comp["queue_wait_s"] == res.queue_wait_s
+        assert comp["attributed_steps"] == res.attributed_steps
+
+
+def test_chaos_segments_sum_bitwise_to_latency(chaos_run):
+    """Per-request critical-path segments sum EXACTLY (left-to-right
+    float add) to latency_s — the acceptance identity, re-verified
+    here directly rather than through report()'s counter."""
+    jsonl, _, _ = chaos_run["fault"]
+    trees = trace_query.request_trees(
+        trace_query.build_traces(load(jsonl)))
+    assert len(trees) == 8
+    for t in trees.values():
+        segs = t["complete"]["segments"]
+        assert [s[0] for s in segs] == ["queue_wait_s", "decode_s"]
+        total = 0.0
+        for _, v in segs:
+            total += v
+        assert total == t["complete"]["latency_s"]
+        assert t["exact_sum"] is True
+
+
+def test_chaos_cost_reconciles_exactly_with_summary(chaos_run):
+    """Per-class device-step attribution: event-derived per-class sums
+    equal the fleet summary's cost block, and attributed + idle ==
+    dispatched in integers — on the DEGRADED run too (the dead
+    replica's unbooked burst never enters the identity)."""
+    jsonl, summary, results = chaos_run["fault"]
+    rep = trace_query.report(load(jsonl))
+    cost = rep["cost"]
+    assert cost is not None and cost["exact"]
+    assert cost["steps_by_class"] == summary["cost"]["steps_by_class"]
+    assert cost["steps_attributed"] == summary["cost"]["steps_attributed"]
+    assert (cost["steps_attributed"] + cost["steps_idle"]
+            == cost["steps_dispatched"])
+    assert cost["steps_dispatched"] == summary["cost"]["steps_dispatched"]
+    assert sum(cost["steps_by_class"].values()) == sum(
+        r.attributed_steps for r in results.values())
+    assert set(cost["steps_by_class"]) == {"interactive", "batch"}
+
+
+def test_cost_attribution_deterministic_across_fault_and_clean(chaos_run):
+    """Attribution is pure scheduling math in (seed, placement): the
+    no-fault run — same requests, same admission order — reproduces
+    its own exact identity, and both runs attribute every step they
+    dispatched."""
+    for key in ("fault", "clean"):
+        _, summary, _ = chaos_run[key]
+        cost = summary["cost"]
+        assert cost["exact"], (key, cost)
+        assert (cost["steps_attributed"] + cost["steps_idle"]
+                == cost["steps_dispatched"] ==
+                summary["total_device_steps"])
+
+
+def test_midburst_crash_dedups_trees_and_keeps_cost_exact(midburst_run):
+    """A replica that dies AFTER emitting completions re-serves the
+    whole burst (the dying ``engine.run`` books nothing), so the
+    stream holds TWO complete emissions for every pre-crash finisher.
+    Trees and the percentile table must keep the booked (last) one;
+    cost accounting counts both — both were real device work — and
+    the dying run's abort ledger keeps attributed + idle ==
+    dispatched exact across the crash."""
+    from collections import Counter
+
+    jsonl, summary, results = midburst_run
+    data = load(jsonl)
+    rep = trace_query.report(data)
+    assert trace_query.verdict(rep) == []
+    assert rep["requests"] == 8 and rep["complete"] == 8
+    assert rep["retried"] >= 1 and rep["orphan_spans"] == 0
+    assert rep["exact_sum_violations"] == 0
+
+    # the duplicate path actually ran: at least one request completed
+    # in the dying burst and again on the survivor
+    dupes = [uid for uid, n in Counter(
+        ev["args"]["uid"] for ev in data["events"]
+        if ev["type"] == "instant" and ev["name"] == "complete"
+        and ev["cat"] == "serve").items() if n > 1]
+    assert dupes, "fault fired before any completion — move the @N"
+
+    # trees keep the BOOKED completion (bitwise the fleet Result),
+    # not the dead run's first emission
+    trees = trace_query.request_trees(trace_query.build_traces(data))
+    for uid in dupes:
+        assert trees[uid]["complete"]["attempt"] >= 1
+    for uid, res in results.items():
+        comp = trees[uid]["complete"]
+        assert comp["latency_s"] == res.latency_s
+        assert comp["queue_wait_s"] == res.queue_wait_s
+        assert comp["attributed_steps"] == res.attributed_steps
+
+    # cost counts EMISSIONS, in lockstep with the counters: the dead
+    # run's completions and its abort-ledger dispatched/idle are in,
+    # so the identity survives the crash while the booked summary —
+    # which never sees the dying burst — stays strictly below
+    cost = rep["cost"]
+    booked = sum(r.attributed_steps for r in results.values())
+    assert cost["steps_attributed"] > booked
+    assert cost["steps_attributed"] == cost["counter_attributed"]
+    assert cost["exact"] and cost["exact_counters"]
+    assert (cost["steps_attributed"] + cost["steps_idle"]
+            == cost["steps_dispatched"])
+    assert cost["steps_dispatched"] > summary["cost"]["steps_dispatched"]
+
+    # the percentile table dedups to one completion per request and
+    # reconciles with the fleet summary despite the duplicates
+    row = {r["metric"]: r for r in rep["latency"]}["latency_s"]
+    assert row["count"] == 8
+    for p in ("p50", "p95", "p99"):
+        assert round(row[f"{p}_s"], 6) == summary["latency"][f"{p}_s"]
+
+
+def test_retry_budget_exhaustion_is_a_named_terminal_state(
+        serve_setup, tmp_path_factory):
+    """A request the fleet deliberately gave up on (retry budget
+    exhausted) must read as FAILED, not as a torn export: the fleet
+    emits the root span plus a terminal `failed` instant, so the tree
+    is terminal, orphan-free, and carries the give-up evidence."""
+    base = tmp_path_factory.mktemp("trace_query_failed")
+    jsonl, summary, results = _traced_run(
+        serve_setup, "fleet.worker.r0@0", base / "fault",
+        retry_budget=0)
+    assert summary["failed"] > 0
+    assert summary["completed"] == 8 - summary["failed"]
+
+    rep = trace_query.report(load(jsonl))
+    assert trace_query.verdict(rep) == []
+    assert rep["requests"] == 8
+    assert rep["failed"] == summary["failed"]
+    assert rep["incomplete"] == 0          # failed != torn export
+    assert rep["orphan_spans"] == 0
+
+    trees = trace_query.request_trees(
+        trace_query.build_traces(load(jsonl)))
+    failed = [t for t in trees.values() if t["failed"] is not None]
+    assert len(failed) == summary["failed"]
+    for t in failed:
+        assert t["complete"] is None and not t["incomplete"]
+        assert t["root"] is not None       # full-clock root emitted
+        assert "retry budget" in t["failed"]["reason"]
+        assert t["uid"] not in results
+
+
+def test_chaos_p99_decomposition_groups_and_tail(chaos_run):
+    """The p99 decomposition reports a verdict overall and per
+    class/replica, from the shared segment schema — and it agrees with
+    the fleet summary's own tail block (same tail_attribution math)."""
+    jsonl, summary, _ = chaos_run["fault"]
+    rep = trace_query.report(load(jsonl))
+    dec = rep["p99_decomposition"]
+    assert dec["all"] is not None
+    assert dec["all"]["dom"] in ("queue", "decode")
+    assert set(dec["by_class"]) == {"interactive", "batch"}
+    # chaos run: every request completed on the survivor (replica 1)
+    assert set(dec["by_replica"]) == {"1"}
+    tail = summary["tail"]
+    assert tail["dom"] == dec["all"]["dom"]
+    assert tail["p99_s"] == pytest.approx(dec["all"]["p99_s"])
+    assert tail["tail_n"] == dec["all"]["tail_n"]
+
+
+def test_cli_json_report_and_tree_printer(chaos_run, capsys):
+    """main() end to end: table mode exits 0 on a verified stream,
+    --json round-trips, --request prints one retried request's tree
+    (retry + re-served hops), unknown uid is a one-line rc 2."""
+    jsonl, _, _ = chaos_run["fault"]
+    assert trace_query.main([jsonl]) == 0
+    out = capsys.readouterr().out
+    assert "request trees" in out and "p99 decomposition" in out
+    assert "device-step cost" in out
+
+    assert trace_query.main([jsonl, "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["orphan_spans"] == 0 and rep["cost"]["exact"]
+
+    trees = trace_query.request_trees(
+        trace_query.build_traces(load(jsonl)))
+    uid = next(t["uid"] for t in trees.values() if t["retries"])
+    assert trace_query.main([jsonl, "--request", str(uid)]) == 0
+    out = capsys.readouterr().out
+    assert f"request uid={uid}" in out
+    assert "retry" in out and "critical path" in out
+    assert "sum exact: True" in out
+
+    assert trace_query.main([jsonl, "--request", "9999"]) == 2
+    assert "no trace for request uid 9999" in capsys.readouterr().err
+
+
+def test_usage_errors_are_one_liners(tmp_path, capsys):
+    """Missing stream and trace-free stream are actionable rc-2
+    one-liners, not tracebacks."""
+    assert trace_query.main([str(tmp_path / "nope")]) == 2
+    assert "no telemetry stream" in capsys.readouterr().err
+
+    # a train-only export carries no trace-stamped events
+    tel = tele.configure(trace_dir=str(tmp_path))
+    with tel.span("dispatch", cat="train"):
+        time.sleep(0.001)
+    paths = tel.export()
+    tele.disable()
+    assert trace_query.main([paths["jsonl"]]) == 2
+    assert "no trace-stamped events" in capsys.readouterr().err
+
+
+def test_smoke_self_check_over_committed_fixture(capsys):
+    """The tier-1 wiring: --smoke verifies the committed chaos fixture
+    (orphan-free retried trees, bitwise sums, exact cost)."""
+    assert trace_query.main(["--smoke"]) == 0
+    out = capsys.readouterr().out
+    assert "trace_query --smoke OK" in out
+    assert "retried" in out and "cost exact" in out
+
+
+def test_verdict_flags_violations():
+    """verdict() fails loudly on a doctored report: orphans, inexact
+    sums, broken cost identity."""
+    rep = {"orphan_spans": 2, "exact_sum_violations": 1,
+           "cost": {"exact": False, "steps_attributed": 5,
+                    "steps_idle": 1, "steps_dispatched": 7}}
+    problems = trace_query.verdict(rep)
+    assert len(problems) == 3
+    assert any("orphan" in p for p in problems)
+    assert any("bitwise" in p for p in problems)
+    assert any("inexact" in p for p in problems)
