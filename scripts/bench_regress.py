@@ -52,20 +52,27 @@ from typing import Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from scripts.bench_summary import iter_rows, key_of, metric_of  # noqa: E402
+from scripts.bench_summary import (  # noqa: E402
+    BINARY_KINDS,
+    iter_rows,
+    key_of,
+    metric_of,
+)
 
 # serve_fleet rows (ISSUE 9) key on replica count + offered rate via
 # bench_summary.key_of, so a 2-replica capacity record can only ever
-# gate a fresh 2-replica capacity row. resilience rows (ISSUE 10) and
+# gate a fresh 2-replica capacity row. resilience rows (ISSUE 10),
 # serve_cost rows (ISSUE 11: per-class device-step attribution
-# exactness on the deterministic capacity arm) carry a binary ok
-# metric (1.0 = the cell hit its expected outcome): with an all-1.0
-# history the cell's floor sits at
-# best * (1 - min_band) * (1 - slack) ≈ 0.855, so any future 0.0 —
-# a recovery path or the attribution identity silently broken —
-# gates as REGRESS
+# exactness on the deterministic capacity arm) and the ISSUE 12
+# traffic-grid rows (serve_cache: bitwise hit parity + strictly-fewer
+# device steps; serve_autoscale: reproducible scale plan + autoscaled
+# shed strictly below fixed) carry a binary ok metric (1.0 = the cell
+# hit its expected outcome): with an all-1.0 history the cell's floor
+# sits at best * (1 - min_band) * (1 - slack) ≈ 0.855, so any future
+# 0.0 — a recovery path, the attribution identity, or a traffic-grid
+# invariant silently broken — gates as REGRESS
 GATED_KINDS = ("train", "sampler", "bucket_bench", "serve_bench",
-               "serve_fleet", "resilience", "serve_cost")
+               "serve_fleet", *BINARY_KINDS)
 
 
 def _usable(r: dict) -> bool:
@@ -80,13 +87,12 @@ def _usable(r: dict) -> bool:
 
 def _baseline_ok(r: dict) -> bool:
     """Rows usable as a cell's BASELINE (the history side). A FAILED
-    binary-outcome row (resilience or serve_cost: ok=false, metric
-    0.0) is evidence of damage, not a baseline: pooling it would blow
-    the cell's band to 1.0 (floor 0) and permanently disable the gate
-    for that cell — the one failure mode an exactness gate must not
-    have. Such rows still gate as FRESH measurements."""
-    return not (r.get("kind") in ("resilience", "serve_cost")
-                and not r.get("ok"))
+    binary-outcome row (ok=false, metric 0.0) is evidence of damage,
+    not a baseline: pooling it would blow the cell's band to 1.0
+    (floor 0) and permanently disable the gate for that cell — the
+    one failure mode an exactness gate must not have. Such rows still
+    gate as FRESH measurements."""
+    return not (r.get("kind") in BINARY_KINDS and not r.get("ok"))
 
 
 def collect(paths: List[str],

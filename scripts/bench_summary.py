@@ -25,6 +25,13 @@ import os
 import sys
 import time
 
+# row kinds whose headline metric is a BINARY ok outcome (1.0 = the
+# cell hit its expected deterministic result). ONE list, shared with
+# bench_regress (which imports it): a new binary kind added here is
+# automatically keyed, summarized and gated consistently.
+BINARY_KINDS = ("resilience", "serve_cost", "serve_cache",
+                "serve_autoscale")
+
 
 def key_of(r: dict):
     # device_kind keys BOTH kinds: with the smoke history aggregated
@@ -72,6 +79,23 @@ def key_of(r: dict):
         return ("servecost", r.get("dec_model"),
                 f"R={r.get('replicas')} B={r.get('slots')} "
                 f"K={r.get('chunk')} n={r.get('n_requests')} dev={dev}")
+    if r.get("kind") == "serve_cache":
+        # traffic-grid cache cells (ISSUE 12): one per (trace,
+        # autoscale) arm pair — hit parity + strictly-fewer device
+        # steps is the binary signal; a fixed-fleet cell and an
+        # autoscaled cell are different measurements
+        return ("servecache", r.get("dec_model"),
+                f"trace={r.get('trace')} auto={r.get('autoscale')} "
+                f"n={r.get('n_requests')} u={r.get('unique')} "
+                f"dev={dev}")
+    if r.get("kind") == "serve_autoscale":
+        # traffic-grid autoscale cells (ISSUE 12): one per (trace,
+        # cache) arm pair — reproducible scale plan + autoscaled shed
+        # strictly below the fixed fleet's is the binary signal
+        return ("autoscale", r.get("dec_model"),
+                f"trace={r.get('trace')} cache={r.get('cache')} "
+                f"n={r.get('n_requests')} u={r.get('unique')} "
+                f"dev={dev}")
     # steps_per_call / transfer_dtype change what is being measured (feed
     # amortization), so K=5 rows must not pool with K=1 rows; old rows
     # predate the knobs and default to 1 / float32. `steps` keys too
@@ -97,10 +121,12 @@ def metric_of(r: dict):
         # the fleet's headline: realized sketches/sec at this cell's
         # (replicas, offered rate)
         return r.get("sketches_per_sec")
-    if r.get("kind") in ("resilience", "serve_cost"):
+    if r.get("kind") in BINARY_KINDS:
         # binary outcome metric: 1.0 = the cell hit its expected
-        # outcome (recovery, or exact cost attribution), 0.0 = it
-        # missed. Deterministic, so the regression gate's band math
+        # outcome (recovery, exact cost attribution, bitwise cache
+        # parity with steps saved, or a reproducible scale plan with
+        # the shed comparison holding), 0.0 = it missed.
+        # Deterministic, so the regression gate's band math
         # (best=1.0, floored band) flags ANY future miss as a REGRESS
         # while repeat passes stay "ok".
         ok = r.get("ok")
@@ -215,7 +241,7 @@ def main(argv=None) -> int:
             # with None knobs
             if r.get("kind") not in ("train", "sampler", "bucket_bench",
                                      "serve_bench", "serve_fleet",
-                                     "resilience", "serve_cost"):
+                                     *BINARY_KINDS):
                 continue
             v = metric_of(r)
             if v is None:
@@ -278,6 +304,26 @@ def main(argv=None) -> int:
                   f"latest={'exact' if l.get('ok') else 'INEXACT':>11s} "
                   f"(steps {by_col} idle={l.get('steps_idle')}"
                   f"{_tail_col(l)})")
+            continue
+        if k[0] == "servecache":
+            # traffic cache cell (ISSUE 12): parity + savings is the
+            # binary signal; the satellite columns print beside it —
+            # hit rate and device steps saved vs the uncached arm
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"latest={'ok' if l.get('ok') else 'BROKEN':>11s} "
+                  f"(hit_rate={l.get('hit_rate')} "
+                  f"steps_saved={l.get('steps_saved')}/"
+                  f"{l.get('steps_uncached')})")
+            continue
+        if k[0] == "autoscale":
+            # traffic autoscale cell (ISSUE 12): the shed comparison
+            # (fixed -> autoscaled) and the realized fleet trajectory
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"latest={'ok' if l.get('ok') else 'BROKEN':>11s} "
+                  f"(shed {l.get('shed_frac_fixed'):.1%}->"
+                  f"{l.get('shed_frac_autoscaled'):.1%} "
+                  f"fleet max={l.get('fleet_size_max')} "
+                  f"final={l.get('fleet_size_final')})")
             continue
         extra = f" mfu={b['mfu']}" if b.get("mfu") is not None else ""
         # records the bench itself flagged as never reaching 70% of the

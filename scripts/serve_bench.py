@@ -223,6 +223,34 @@ def main(argv=None) -> int:
                          "latency-vs-offered-load curves (+ an in-run "
                          "placement/arrival bitwise parity block) into "
                          "--out under the 'fleet' key")
+    ap.add_argument("--traffic", action="store_true",
+                    help="traffic mode (ISSUE 12): cached-vs-uncached x "
+                         "fixed-vs-autoscaled grid over a seeded traffic "
+                         "trace (serve/loadgen.py trace replay + "
+                         "serve/cache.py + serve/autoscale.py) — "
+                         "deterministic latency-vs-offered-load curves, "
+                         "cache hit rates, scale-decision timelines and "
+                         "shed fractions into --out under 'traffic'")
+    ap.add_argument("--trace", default="flash",
+                    choices=("poisson", "diurnal", "flash", "pareto"),
+                    help="traffic mode: trace shape (default flash — "
+                         "the overload scenario the autoscaler is "
+                         "judged on)")
+    ap.add_argument("--unique", type=int, default=0,
+                    help="traffic mode: distinct-request space the Zipf "
+                         "repetition model draws from (0 = mode "
+                         "default; the cache's hit structure)")
+    ap.add_argument("--trace_rate", type=float, default=0.0,
+                    help="traffic mode: base offered rate in requests/"
+                         "sec (0 = mode default); the curve sweeps "
+                         "multiples of it")
+    ap.add_argument("--rate_mults", default="0.5,1,2",
+                    help="traffic mode: offered-load curve points as "
+                         "multiples of --trace_rate")
+    ap.add_argument("--manifest_dir", default="",
+                    help="traffic mode: also record the scale-decision "
+                         "timeline + artifacts in <dir>/RUN.json "
+                         "(utils/runinfo.py)")
     ap.add_argument("--replicas", default="",
                     help="fleet mode: comma-separated replica counts to "
                          "sweep (default 1,2,4)")
@@ -256,11 +284,26 @@ def main(argv=None) -> int:
                     help="result JSON path ('' = stdout only)")
     args = ap.parse_args(argv)
 
+    if args.traffic and "jax" not in sys.modules:
+        # the traffic grid's elastic arms need >= 2 devices; on a CPU
+        # box, virtualize them BEFORE jax imports (the resilience_bench
+        # precedent — under pytest jax is already imported and 8-way)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if ("--xla_force_host_platform_device_count" not in flags
+                and os.environ["JAX_PLATFORMS"] == "cpu"):
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
     import jax
 
     from scripts._measure import hist_append
     from sketch_rnn_tpu.config import get_default_hparams
     from sketch_rnn_tpu.models.vae import SketchRNN
+
+    if args.traffic:
+        return _run_traffic(args, hist_append)
 
     if args.smoke:
         # sized so per-step decode compute dominates per-chunk host
@@ -670,6 +713,466 @@ def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
         doc["fleet"] = fleet_rec
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2)
+    return 0
+
+
+def _run_traffic(args, hist_append):
+    """Traffic mode (ISSUE 12): the cached-vs-uncached x fixed-vs-
+    autoscaled grid over one seeded traffic trace.
+
+    Two layers, split by what this box can prove (the ROADMAP's
+    no-CPU-parallelism constraint — wall-clock is noise here):
+
+    1. **Modeled curves** — :func:`sketch_rnn_tpu.serve.autoscale.
+       simulate_traffic` fluid-replays the trace at each offered-load
+       multiple for all four arms: latency percentiles, shed
+       fractions, admitted device steps and the scale-decision
+       timeline are pure functions of (trace seed, policy), so the
+       curve block is bit-reproducible and the flash-crowd
+       shed-comparison acceptance (autoscaled strictly below fixed)
+       is scheduling math, not timing.
+    2. **Measured arms** — the base trace is REALLY served through an
+       elastic :class:`ServeFleet` four times (cache off/on x fixed/
+       autoscaled). Submission is forced (no shedding: every arm
+       completes the identical request set), the fixed arms submit
+       pre-start so their device-step accounting is deterministic
+       (asserted across two trials), the autoscaled arms apply the
+       PLANNED decision schedule at exact arrival indices and must
+       realize exactly the planned spawn/retire sequence, and the
+       in-run parity block proves (a) every cache hit bitwise equal
+       to the uncached arm's recomputation and (b) strokes bitwise
+       independent of mid-run fleet resizes.
+
+    One ``serve_cache`` row per (trace, autoscale) cell and one
+    ``serve_autoscale`` row per (trace, cache) cell stream into the
+    smoke history BEFORE any exactness raise (the serve_cost/
+    resilience precedent), and the whole record lands in --out under
+    ``traffic`` (existing engine/fleet records preserved).
+    """
+    import dataclasses
+
+    import jax
+
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import (
+        AutoscalePolicy,
+        Request,
+        ResultCache,
+        ServeFleet,
+        TraceSpec,
+        make_trace,
+        plan_decisions,
+        simulate_traffic,
+    )
+    from sketch_rnn_tpu.utils import runinfo
+
+    if args.smoke:
+        hps = get_default_hparams().replace(
+            batch_size=8, max_seq_len=48, enc_rnn_size=16,
+            dec_rnn_size=32, z_size=8, num_mixture=3, dec_model="lstm")
+        slots = args.slots or 4
+        chunk = args.chunk or 2
+        n = args.requests or 192
+        unique = args.unique or 48
+        lmin = args.min_len or 3
+        lmax = args.max_len or 16
+        rate = args.trace_rate or 120.0
+    else:
+        hps = get_default_hparams().replace(
+            dec_model=os.environ.get("BENCH_DEC", "layer_norm"))
+        slots = args.slots or 32
+        chunk = args.chunk or 8
+        n = args.requests or 1024
+        unique = args.unique or 256
+        lmin = args.min_len or 16
+        lmax = args.max_len or hps.max_seq_len
+        rate = args.trace_rate or 200.0
+    hps = hps.replace(max_seq_len=max(hps.max_seq_len, lmax))
+    ndev = len(jax.devices())
+    if ndev < 2:
+        print(f"serve_bench: --traffic needs >= 2 devices for the "
+              f"elastic arms, have {ndev}", file=sys.stderr)
+        return 2
+    min_r, max_r = 1, min(4, ndev)
+
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(args.seed))
+    # pen suppression (the sampler_latency.py trick): request lengths
+    # are exactly the drawn caps, so device work is deterministic
+    params["out_b"] = params["out_b"].at[2].set(-1e9)
+
+    # -- the trace: `unique` distinct contents, Zipf-repeated ----------
+    lengths = skewed_lengths(unique, lmin, lmax, args.seed)
+    kz, kreq = jax.random.split(jax.random.key(args.seed))
+    z = (np.asarray(jax.random.normal(kz, (unique, hps.z_size)),
+                    np.float32) if hps.conditional else None)
+    contents = [
+        Request(key=jax.random.fold_in(kreq, c),
+                z=None if z is None else z[c],
+                temperature=args.temperature, max_len=int(lengths[c]))
+        for c in range(unique)
+    ]
+    base_dur = n / rate
+    spec = TraceSpec(
+        kind=args.trace, n=n, rate_hz=rate, seed=args.seed,
+        diurnal_period_s=0.6 * base_dur,
+        flash_at_s=0.15 * base_dur, flash_dur_s=0.22 * base_dur,
+        flash_mult=6.0, pareto_cap_s=4.0 / rate,
+        unique=unique, zipf_s=1.1)
+    trace = make_trace(spec)
+    distinct = trace.distinct()
+    work = lengths.astype(np.float64)
+
+    # provisioning model: one replica retires 1.2x the base offered
+    # step rate — stable at the base rate, overwhelmed by the flash
+    offered_steps = rate * float(work[trace.request_ids].mean())
+    rate_hint = 1.2 * offered_steps
+    policy = AutoscalePolicy(
+        min_replicas=min_r, max_replicas=max_r,
+        up_wait_s=18.0 / rate, down_wait_s=6.0 / rate,
+        down_epochs=4, cooldown_epochs=1, step=1,
+        epoch_s=6.0 / rate, rate_hint_steps_per_s=rate_hint)
+    shed_wait_s = 36.0 / rate
+    print(f"# traffic: {args.trace} trace n={n} unique={unique} "
+          f"(distinct {distinct}) rate={rate}/s dur={trace.duration_s:.2f}s"
+          f", B={slots} K={chunk}, fleet {min_r}..{max_r}",
+          file=sys.stderr)
+
+    # -- reproducibility pin: the plan is a function of the seed ------
+    def sim(cache, autoscale, tr=trace, shed=shed_wait_s):
+        return simulate_traffic(tr.arrivals, tr.request_ids, work,
+                                policy, cache=cache,
+                                autoscale=autoscale, shed_wait_s=shed)
+
+    trace2 = make_trace(spec)
+    plan_reproducible = (
+        np.array_equal(trace.arrivals, trace2.arrivals)
+        and np.array_equal(trace.request_ids, trace2.request_ids)
+        and sim(False, True)["decisions"]
+        == sim(False, True, tr=trace2)["decisions"])
+
+    # -- modeled latency-vs-offered-load curves (pure) -----------------
+    mults = [float(x) for x in args.rate_mults.split(",") if x]
+    if 1.0 not in mults:
+        mults = sorted(mults + [1.0])
+    curves = []
+    for mult in mults:
+        # time-shape fields scale with 1/mult so the trace SHAPE is
+        # invariant and only the offered intensity changes
+        spec_m = dataclasses.replace(
+            spec, rate_hz=rate * mult,
+            diurnal_period_s=spec.diurnal_period_s / mult,
+            flash_at_s=spec.flash_at_s / mult,
+            flash_dur_s=spec.flash_dur_s / mult,
+            pareto_cap_s=spec.pareto_cap_s / mult)
+        tr_m = make_trace(spec_m)
+        for cache_on in (False, True):
+            for auto_on in (False, True):
+                s = sim(cache_on, auto_on, tr=tr_m)
+                curves.append({
+                    "rate_mult": mult,
+                    "offered_rate": rate * mult,
+                    "cache": cache_on,
+                    "autoscale": auto_on,
+                    "completed": s["completed"],
+                    "shed_frac": s["shed_frac"],
+                    "hit_frac": s["hit_frac"],
+                    "device_steps": s["device_steps"],
+                    "latency_p50_s": s["latency_p50_s"],
+                    "latency_p95_s": s["latency_p95_s"],
+                    "latency_p99_s": s["latency_p99_s"],
+                    "fleet_size_final": s["fleet_size_by_epoch"][-1],
+                    "fleet_size_max": max(s["fleet_size_by_epoch"]),
+                    "n_scale_actions": sum(
+                        1 for d in s["decisions"]
+                        if d.action != "hold"),
+                })
+
+    # -- measured arms: the real elastic fleet on the base trace ------
+    cfg_hash = runinfo.config_hash(hps) or ""
+    ckpt_id = f"init-seed{args.seed}"
+
+    def arrival_req(i):
+        return dataclasses.replace(
+            contents[int(trace.request_ids[i])], uid=i, cls=None,
+            queue_pos=None, enqueue_ts=None, attempt=0)
+
+    fleet = ServeFleet(model, hps, params, replicas=min_r,
+                       max_replicas=max_r, slots=slots, chunk=chunk)
+    fleet.warm(contents[0])
+    failures = []
+    ref_strokes = None      # uid -> strokes5 from the uncached-fixed arm
+
+    def plan_apply_map(plan):
+        """Non-hold decisions -> {arrival index: [targets]}; epochs
+        past the last arrival land on index n (applied post-drain)."""
+        apply_at = {}
+        for d in plan:
+            if d.action == "hold":
+                continue
+            t_edge = (d.epoch + 1) * policy.epoch_s
+            idx = int(np.searchsorted(trace.arrivals, t_edge))
+            apply_at.setdefault(min(idx, n), []).append(d.target)
+        return apply_at
+
+    def run_arm(cache_on, auto_on):
+        cache = (ResultCache(config_hash=cfg_hash, ckpt_id=ckpt_id)
+                 if cache_on else None)
+        fleet.cache = cache
+        plan = (plan_decisions(
+            trace.arrivals,
+            np.where(_first_occurrence(trace.request_ids), work[
+                trace.request_ids], 0.0) if cache_on
+            else work[trace.request_ids],
+            policy) if auto_on else [])
+        apply_at = plan_apply_map(plan)
+        if auto_on:
+            fleet.start()
+            for i in range(n):
+                for tgt in apply_at.get(i, ()):
+                    fleet.set_target_replicas(tgt)
+                fleet.submit(arrival_req(i), force=True)
+        else:
+            # pre-start burst: placement, burst chop and therefore the
+            # device-step accounting are pure functions of the stream
+            for i in range(n):
+                fleet.submit(arrival_req(i), force=True)
+            fleet.start()
+        if not fleet.drain(timeout=600):
+            raise RuntimeError(
+                f"fleet drain timed out (cache={cache_on} "
+                f"auto={auto_on})")
+        for tgt in apply_at.get(n, ()):   # the trailing quiet retires
+            fleet.set_target_replicas(tgt)
+        s = fleet.summary()
+        res = fleet.results
+        stats = cache.stats() if cache is not None else None
+        out = {"summary": s, "results": res, "cache_stats": stats,
+               "plan": plan}
+        if fleet.close():
+            raise RuntimeError("fleet close timed out")
+        fleet.reset()
+        return out
+
+    def _first_occurrence(ids):
+        out = np.zeros(len(ids), bool)
+        out[np.unique(ids, return_index=True)[1]] = True
+        return out
+
+    measured = []
+    arms = {}
+    for cache_on in (False, True):
+        for auto_on in (False, True):
+            arm = run_arm(cache_on, auto_on)
+            arms[(cache_on, auto_on)] = arm
+            s = arm["summary"]
+            if s["completed"] != n:
+                failures.append(
+                    f"arm cache={cache_on} auto={auto_on} completed "
+                    f"{s['completed']}/{n} (forced submission must "
+                    f"never shed)")
+            if ref_strokes is None:
+                ref_strokes = {uid: rec["result"].strokes5
+                               for uid, rec in arm["results"].items()}
+            else:
+                for uid, ref in ref_strokes.items():
+                    rec = arm["results"].get(uid)
+                    if rec is None or not np.array_equal(
+                            rec["result"].strokes5, ref):
+                        failures.append(
+                            f"PARITY: uid {uid} strokes differ under "
+                            f"cache={cache_on} auto={auto_on} — "
+                            f"{'cache hit != recomputation' if cache_on else 'fleet resize leaked into outputs'}")
+                        break
+            stats = arm["cache_stats"]
+            if stats is not None:
+                served_free = stats["hits"] + stats["coalesced"]
+                if served_free != n - distinct:
+                    failures.append(
+                        f"cache accounting: served-without-device "
+                        f"{served_free} != n - distinct "
+                        f"{n - distinct} (cache={cache_on} "
+                        f"auto={auto_on})")
+            realized = [(e["action"], e["n_live"])
+                        for e in s["scale_log"]]
+            planned = []
+            live = min_r
+            for d in arm["plan"]:
+                if d.action == "hold":
+                    continue
+                step = 1 if d.target > live else -1
+                while live != d.target:
+                    live += step
+                    planned.append(
+                        ("spawn" if step > 0 else "retire", live))
+            if auto_on and realized != planned:
+                failures.append(
+                    f"scale-decision mismatch (cache={cache_on}): "
+                    f"realized {realized} != planned {planned}")
+            print(f"# measured cache={cache_on} auto={auto_on}: "
+                  f"{s['completed']} done, {s['total_device_steps']} "
+                  f"device steps, {len(s['scale_log'])} scale actions, "
+                  f"wall {s['wall_s']}s", file=sys.stderr)
+            measured.append({
+                "cache": cache_on,
+                "autoscale": auto_on,
+                "completed": s["completed"],
+                "completed_cached": s["completed_cached"],
+                "device_steps": s["total_device_steps"],
+                "hit_rate": (None if stats is None
+                             else stats["hit_rate"]),
+                "cache_stats": stats,
+                "wall_s": s["wall_s"],
+                "sketches_per_sec": s["sketches_per_sec"],
+                "latency_p50_s": s["latency"]["p50_s"],
+                "latency_p95_s": s["latency"]["p95_s"],
+                "latency_p99_s": s["latency"]["p99_s"],
+                "scale_log": s["scale_log"],
+                "fleet_size_final": s["replicas_live"],
+                "planned_actions": [
+                    dataclasses.asdict(d) for d in arm["plan"]
+                    if d.action != "hold"],
+            })
+
+    # -- fixed-arm determinism: replay both fixed arms once more ------
+    det_ok = True
+    for cache_on in (False, True):
+        first = arms[(cache_on, False)]["summary"]
+        again = run_arm(cache_on, False)["summary"]
+        for k in ("total_device_steps", "completed", "cost"):
+            if first[k] != again[k]:
+                det_ok = False
+                failures.append(
+                    f"fixed-arm nondeterminism (cache={cache_on}): "
+                    f"{k} {first[k]} != {again[k]} across identical "
+                    f"pre-start replays")
+    fleet.close()
+
+    # -- grid rows: stream BEFORE any exactness raise ------------------
+    steps = {k: arms[k]["summary"]["total_device_steps"] for k in arms}
+    parity_ok = not any(f.startswith("PARITY") for f in failures)
+    # every arm must have completed the identical request set — a
+    # damaged run (failover exhausting a retry budget) must not stream
+    # ok rows even when parity/savings/plan checks still hold
+    completed_ok = all(a["summary"]["completed"] == n
+                       for a in arms.values())
+    base = {
+        "smoke": bool(args.smoke),
+        "device_kind": jax.devices()[0].device_kind,
+        "dec_model": hps.dec_model, "slots": slots, "chunk": chunk,
+        "trace": args.trace, "n_requests": n, "unique": unique,
+        "distinct": distinct,
+    }
+    cache_rows, autoscale_rows = [], []
+    for auto_on in (False, True):
+        saved = steps[(False, auto_on)] - steps[(True, auto_on)]
+        st = arms[(True, auto_on)]["cache_stats"]
+        row = {
+            "kind": "serve_cache", **base, "autoscale": auto_on,
+            "hit_rate": st["hit_rate"],
+            "steps_saved": saved,
+            "steps_uncached": steps[(False, auto_on)],
+            "steps_cached": steps[(True, auto_on)],
+            "completed": arms[(True, auto_on)]["summary"]["completed"],
+            "deterministic": (det_ok if not auto_on else None),
+            "ok": bool(parity_ok and completed_ok and saved > 0
+                       and st["hits"] + st["coalesced"] == n - distinct
+                       and (auto_on or det_ok)),
+        }
+        cache_rows.append(row)
+        hist_append(row)
+    base_cell = {c["autoscale"]: c for c in curves
+                 if c["rate_mult"] == 1.0 and not c["cache"]}
+    base_cell_cached = {c["autoscale"]: c for c in curves
+                       if c["rate_mult"] == 1.0 and c["cache"]}
+    for cache_on, cells in ((False, base_cell),
+                            (True, base_cell_cached)):
+        shed_fixed = cells[False]["shed_frac"]
+        shed_auto = cells[True]["shed_frac"]
+        realized_ok = not any("scale-decision mismatch" in f
+                              for f in failures)
+        row = {
+            "kind": "serve_autoscale", **base, "cache": cache_on,
+            "shed_frac_fixed": shed_fixed,
+            "shed_frac_autoscaled": shed_auto,
+            "fleet_size_final": cells[True]["fleet_size_final"],
+            "fleet_size_max": cells[True]["fleet_size_max"],
+            "n_scale_actions": cells[True]["n_scale_actions"],
+            "plan_reproducible": plan_reproducible,
+            "ok": bool(plan_reproducible and realized_ok
+                       and completed_ok
+                       and (shed_auto < shed_fixed if shed_fixed > 0
+                            else shed_auto == shed_fixed)),
+        }
+        autoscale_rows.append(row)
+        hist_append(row)
+
+    traffic_rec = {
+        "kind": "serve_traffic",
+        **base,
+        "rate_hz": rate,
+        "trace_seed": args.seed,
+        "trace_duration_s": round(trace.duration_s, 4),
+        "policy": dataclasses.asdict(policy),
+        "shed_wait_s": round(shed_wait_s, 6),
+        "rate_mults": mults,
+        "plan_reproducible": plan_reproducible,
+        "curves": curves,
+        "measured": measured,
+        "parity": {
+            "cache_bitwise": parity_ok,
+            "resize_invariant": parity_ok,
+            "fixed_arm_deterministic": det_ok,
+            "steps_saved_fixed": steps[(False, False)]
+            - steps[(True, False)],
+            "steps_saved_autoscaled": steps[(False, True)]
+            - steps[(True, True)],
+            "failures": failures,
+        },
+        "host_parallel_ceiling": measure_host_parallel_ceiling(),
+        "caveats": [
+            "wall_s / sketches_per_sec / measured latency percentiles "
+            "are host-bound on this box (see host_parallel_ceiling); "
+            "the acceptance signals are the deterministic ones: "
+            "modeled curves, shed fractions, device-step savings, "
+            "bitwise parity and the reproducible decision sequence"],
+    }
+    print(json.dumps(traffic_rec, indent=2))
+    if args.out:
+        doc = {}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    doc = loaded
+            except ValueError:
+                pass
+        doc["traffic"] = traffic_rec
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+    if args.manifest_dir:
+        # the ISSUE 12 RUN.json contract: scale decisions + per-epoch
+        # fleet size recorded in the run manifest
+        from sketch_rnn_tpu.serve.autoscale import decisions_summary
+
+        auto_arm = next(m for m in measured
+                        if m["autoscale"] and not m["cache"])
+        runinfo.write_manifest(
+            args.manifest_dir, kind="serve_traffic", hps=hps,
+            artifacts={"serve_bench": args.out} if args.out else None,
+            extra={"traffic": {
+                "trace": args.trace, "trace_seed": args.seed,
+                **decisions_summary(sim(False, True,
+                                        shed=None)["decisions"]),
+                "scale_log_realized": auto_arm["scale_log"],
+                "plan_reproducible": plan_reproducible,
+            }})
+    if failures:
+        raise RuntimeError(
+            "TRAFFIC GRID FAILURES (rows already streamed):\n  "
+            + "\n  ".join(failures))
     return 0
 
 

@@ -700,6 +700,11 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
         if fleet_report is not None:
             extra["replicas"] = fleet_report["replicas"]
             extra["offered_rate"] = fleet_report["offered_rate"]
+            if fleet_report.get("scale_log"):
+                # the ISSUE 12 contract: elastic scale decisions and
+                # the realized fleet trajectory land in RUN.json
+                extra["scale_log"] = fleet_report["scale_log"]
+                extra["replicas_live"] = fleet_report["replicas_live"]
         runinfo.write_manifest(
             man_dir, kind="serve_bench", hps=hps, run_id=run_id,
             artifacts=artifacts, extra=extra)

@@ -6,6 +6,16 @@ from sketch_rnn_tpu.serve.admission import (
     AdmissionController,
     parse_admission_classes,
 )
+from sketch_rnn_tpu.serve.autoscale import (
+    AutoscalePolicy,
+    AutoscaleSignals,
+    Autoscaler,
+    Decision,
+    fleet_signals,
+    plan_decisions,
+    simulate_traffic,
+)
+from sketch_rnn_tpu.serve.cache import ResultCache, request_fingerprint
 from sketch_rnn_tpu.serve.engine import (
     Request,
     Result,
@@ -14,22 +24,40 @@ from sketch_rnn_tpu.serve.engine import (
     make_chunk_step,
 )
 from sketch_rnn_tpu.serve.fleet import ServeFleet
-from sketch_rnn_tpu.serve.loadgen import OpenLoopLoadGen, poisson_arrivals
+from sketch_rnn_tpu.serve.loadgen import (
+    OpenLoopLoadGen,
+    Trace,
+    TraceSpec,
+    make_trace,
+    poisson_arrivals,
+)
 from sketch_rnn_tpu.serve.metrics_http import MetricsServer
 from sketch_rnn_tpu.serve.slo import SLO, SLOTracker, parse_slo
 
 __all__ = [
     "AdmissionClass",
     "AdmissionController",
+    "Autoscaler",
+    "AutoscalePolicy",
+    "AutoscaleSignals",
+    "Decision",
     "OpenLoopLoadGen",
     "Request",
     "Result",
+    "ResultCache",
     "ServeEngine",
     "ServeFleet",
+    "Trace",
+    "TraceSpec",
+    "fleet_signals",
     "generate_many",
     "make_chunk_step",
+    "make_trace",
     "parse_admission_classes",
+    "plan_decisions",
     "poisson_arrivals",
+    "simulate_traffic",
+    "request_fingerprint",
     "MetricsServer",
     "SLO",
     "SLOTracker",

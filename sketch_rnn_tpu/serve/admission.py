@@ -142,6 +142,11 @@ class AdmissionController:
         self._ewma = float(ewma)
         self._backlog: List[int] = [0] * n_replicas
         self._dead: set = set()
+        # elastically RETIRED replicas (ISSUE 12): out of the placement
+        # set like the dead, but gracefully — their queued work drains
+        # (backlog kept, note_done still decrements) and rejoin()
+        # brings them back; mark_dead stays the crash path
+        self._retired: set = set()
         self.service_s: Optional[float] = None   # EWMA decode_s
         self.admitted = 0
         self.shed: Dict[str, int] = {c: 0 for c in self.classes}
@@ -155,8 +160,33 @@ class AdmissionController:
         return sorted(self._dead)
 
     @property
+    def retired(self) -> List[int]:
+        return sorted(self._retired)
+
+    @property
     def live_replicas(self) -> List[int]:
-        return [r for r in range(self.n_replicas) if r not in self._dead]
+        return [r for r in range(self.n_replicas)
+                if r not in self._dead and r not in self._retired]
+
+    def retire(self, replica: int) -> None:
+        """Gracefully remove ``replica`` from the placement set (ISSUE
+        12 elastic scale-down): unlike :meth:`mark_dead` its backlog is
+        KEPT — the replica drains what it already owns, completions
+        still free backlog through note_done — but no new arrival is
+        ever placed on it. Idempotent."""
+        if not 0 <= replica < self.n_replicas:
+            raise ValueError(f"replica {replica} out of range "
+                             f"0..{self.n_replicas - 1}")
+        self._retired.add(replica)
+
+    def rejoin(self, replica: int) -> None:
+        """Return a retired replica to the placement set (the elastic
+        spawn path — a rejoined replica starts at its current tracked
+        backlog, usually 0 after its drain)."""
+        if replica in self._dead:
+            raise ValueError(f"replica {replica} is dead, not retired "
+                             f"— the crash path cannot rejoin")
+        self._retired.discard(replica)
 
     def mark_dead(self, replica: int) -> int:
         """Shrink capacity: ``replica`` leaves the placement set (ISSUE
@@ -170,6 +200,7 @@ class AdmissionController:
         if replica in self._dead:
             return 0
         self._dead.add(replica)
+        self._retired.discard(replica)  # dead outranks retired
         dropped, self._backlog[replica] = self._backlog[replica], 0
         return dropped
 
@@ -250,6 +281,7 @@ class AdmissionController:
             "shed_by_class": dict(self.shed),
             "backlog": self.backlog,
             "dead_replicas": self.dead,
+            "retired_replicas": self.retired,
             "live_replicas": len(self.live_replicas),
             "service_est_s": (None if self.service_s is None
                               else round(self.service_s, 6)),

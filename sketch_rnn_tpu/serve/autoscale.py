@@ -1,0 +1,357 @@
+"""SLO-driven elastic autoscaling: pure decisions, deterministic plans.
+
+ISSUE 12 tentpole piece 2. The fleet (serve/fleet.py) can now grow and
+shrink — ``add_replica`` / ``retire_replica`` are the PR 10 failover
+primitives turned elastic (retire = drain + leave the placement set;
+spawn = the rejoin path) — and this module decides WHEN. Two layers,
+deliberately separated:
+
+- :class:`Autoscaler` is a PURE controller: each decision epoch it is
+  fed one :class:`AutoscaleSignals` snapshot — an estimated queueing
+  wait (admission's backlog x EWMA service estimate) and an SLO
+  error-budget burn rate (the SLOTracker's breach_frac over its
+  budget) — and emits a :class:`Decision`. No clocks, no threads, no
+  jax: the decision sequence is a deterministic function of the signal
+  sequence, which is what makes it testable and replayable. The rule
+  is the standard error-budget ladder: scale UP when the estimated
+  wait exceeds ``up_wait_s`` (or the burn rate exceeds ``up_burn``),
+  scale DOWN only after ``down_epochs`` consecutive quiet epochs, and
+  hold through a ``cooldown_epochs`` refractory window after any
+  action so the controller cannot flap.
+- :func:`plan_decisions` is the DETERMINISTIC feeder for benchmarks:
+  on this box wall-clock latencies are noise (the measured
+  no-CPU-parallelism ceiling, see ROADMAP), so live SLO signals would
+  make scale decisions unreproducible. Instead the traffic bench runs
+  the same pure :meth:`Autoscaler.decide` over a fluid-queue model of
+  the TRACE itself: per epoch, offered work (sum of arriving requests'
+  decode steps, cache hits excluded) accumulates into a backlog that
+  drains at ``policy.rate_hint_steps_per_s`` per live replica, and the
+  modeled wait feeds the controller. Every input is a pure function of
+  (trace seed, policy), so the emitted spawn/retire schedule is
+  REPRODUCIBLE FROM THE TRACE SEED ALONE — the ISSUE 12 acceptance —
+  and the fleet applies it at exact arrival indices during replay.
+
+Live integration: :func:`fleet_signals` extracts the same signal shape
+from a live SLOTracker + AdmissionController pair, so a production
+loop can drive the identical controller from real measurements (the
+decisions are then deterministic given the measurements, which is all
+a wall-clock world can promise).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """The scale-decision rule's knobs (all pure numbers — the policy
+    is part of the experiment config, so decisions stay reproducible).
+
+    ``rate_hint_steps_per_s`` is the provisioning model: slot-steps of
+    decode work one replica is assumed to retire per second. The
+    deterministic planner uses it to convert backlog steps into an
+    estimated wait; a live loop ignores it (admission's EWMA measures
+    the real thing).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    up_wait_s: float = 1.0          # est wait above this -> scale up
+    up_burn: float = 1.0            # burn rate above this -> scale up
+    down_wait_s: float = 0.25       # est wait below this is "quiet"
+    down_epochs: int = 3            # consecutive quiet epochs to retire
+    cooldown_epochs: int = 2        # refractory window after any action
+    step: int = 1                   # replicas per decision
+    epoch_s: float = 0.25           # decision epoch (virtual seconds)
+    rate_hint_steps_per_s: float = 0.0
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}/{self.max_replicas}")
+        if self.step < 1 or self.down_epochs < 1 or self.epoch_s <= 0:
+            raise ValueError("step/down_epochs must be >= 1 and "
+                             "epoch_s > 0")
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleSignals:
+    """One decision epoch's inputs. ``est_wait_s`` may be None (cold
+    admission has no service estimate yet — never scale on nothing);
+    ``burn_rate`` is the worst tracked SLO's window burn (0 when no
+    SLO is tracked)."""
+
+    est_wait_s: Optional[float] = None
+    burn_rate: float = 0.0
+    backlog: int = 0
+    n_live: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One epoch's verdict. ``action`` is ``up`` / ``down`` / ``hold``;
+    ``target`` is the replica count AFTER applying it."""
+
+    epoch: int
+    action: str
+    target: int
+    reason: str
+    est_wait_s: Optional[float] = None
+    burn_rate: float = 0.0
+
+
+class Autoscaler:
+    """Pure scale controller; state = (cooldown, quiet-epoch streak).
+
+    Feed :meth:`decide` once per decision epoch. The caller applies
+    ``Decision.target`` (the fleet's ``set_target_replicas``); the
+    controller assumes it was applied — it tracks its own intended
+    replica count so the decision sequence is a function of the signal
+    sequence alone, not of how fast the fleet resized.
+    """
+
+    def __init__(self, policy: AutoscalePolicy,
+                 replicas: Optional[int] = None):
+        self.policy = policy
+        self.replicas = int(replicas if replicas is not None
+                            else policy.min_replicas)
+        if not (policy.min_replicas <= self.replicas
+                <= policy.max_replicas):
+            raise ValueError(
+                f"start replicas {self.replicas} outside "
+                f"[{policy.min_replicas}, {policy.max_replicas}]")
+        self._cooldown = 0
+        self._quiet = 0
+        self._epoch = 0
+
+    def decide(self, signals: AutoscaleSignals) -> Decision:
+        p = self.policy
+        epoch = self._epoch
+        self._epoch += 1
+        wait = signals.est_wait_s
+        burn = float(signals.burn_rate)
+        action, reason = "hold", "steady"
+        target = self.replicas
+        hot = ((wait is not None and wait > p.up_wait_s)
+               or burn > p.up_burn)
+        # a None wait (cold admission, no service estimate yet) is
+        # ABSENCE of signal, not quiet: it must neither trigger a
+        # scale-up nor count toward the retire streak — never scale
+        # on nothing, in either direction
+        quiet = (wait is not None and wait < p.down_wait_s
+                 and burn <= p.up_burn)
+        self._quiet = self._quiet + 1 if quiet else 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            reason = "cooldown"
+        elif hot and self.replicas < p.max_replicas:
+            target = min(p.max_replicas, self.replicas + p.step)
+            action = "up"
+            reason = (f"est_wait {wait:.3f}s > {p.up_wait_s}s"
+                      if wait is not None and wait > p.up_wait_s
+                      else f"burn {burn:.2f} > {p.up_burn}")
+            self._cooldown = p.cooldown_epochs
+            self._quiet = 0
+        elif (self._quiet >= p.down_epochs
+              and self.replicas > p.min_replicas):
+            target = max(p.min_replicas, self.replicas - p.step)
+            action = "down"
+            reason = f"quiet for {self._quiet} epochs"
+            self._cooldown = p.cooldown_epochs
+            self._quiet = 0
+        self.replicas = target
+        return Decision(epoch=epoch, action=action, target=target,
+                        reason=reason,
+                        est_wait_s=(None if wait is None
+                                    else round(float(wait), 6)),
+                        burn_rate=round(burn, 4))
+
+
+def fleet_signals(slo_tracker, admission, n_live: int
+                  ) -> AutoscaleSignals:
+    """Live signal extraction: the WORST tracked SLO's window burn rate
+    plus admission's least-loaded estimated wait — the same shape the
+    deterministic planner feeds, from real measurements."""
+    burn = 0.0
+    if slo_tracker is not None:
+        for rec in slo_tracker.summary().values():
+            b = rec.get("burn_rate", 0.0)
+            if not math.isfinite(b):
+                b = 1e9  # a p100 breach burns "infinitely": cap, act
+            burn = max(burn, float(b))
+    waits = [admission.est_wait_s(r) for r in admission.live_replicas]
+    waits = [w for w in waits if w is not None]
+    return AutoscaleSignals(
+        est_wait_s=min(waits) if waits else None,
+        burn_rate=burn,
+        backlog=sum(admission.backlog),
+        n_live=int(n_live))
+
+
+def simulate_traffic(arrivals: Sequence[float],
+                     content_ids: Sequence[int],
+                     content_work: Sequence[float],
+                     policy: AutoscalePolicy, *,
+                     cache: bool = False,
+                     autoscale: bool = True,
+                     shed_wait_s: Optional[float] = None,
+                     replicas: Optional[int] = None) -> Dict:
+    """Deterministic fluid-queue replay of one traffic arm — THE
+    scheduling-math engine behind every ISSUE 12 acceptance signal.
+
+    On this box wall-clock latencies are noise (the measured
+    no-CPU-parallelism ceiling, see ROADMAP), so the traffic bench's
+    latency-vs-offered-load curves, shed fractions and scale decisions
+    all come from this pure virtual-time model instead: arrival ``i``
+    carries content ``content_ids[i]`` costing ``content_work[c]``
+    decode steps. Per ``policy.epoch_s`` epoch, arrivals are processed
+    in order against the current backlog — a ``cache`` arm serves a
+    repeat of an already-admitted content at the door for zero work
+    and zero modeled wait (the result cache's contract; a shed primary
+    caches nothing, so the NEXT occurrence pays full work exactly like
+    the real fleet) and a ``shed_wait_s`` arm sheds any arrival whose
+    modeled wait ``backlog / (live x rate_hint)`` exceeds the deadline
+    — then the backlog drains at ``live x rate_hint_steps_per_s`` and
+    the epoch's closing estimated wait feeds the pure controller
+    (``autoscale=False`` holds the fleet at its starting size, the
+    fixed-provisioning baseline).
+
+    Everything is a function of (arrivals, contents, policy, flags):
+    two calls over the same realized trace return identical decisions,
+    shed masks and modeled waits — the ISSUE 12 "reproducible from the
+    trace seed alone" acceptance.
+
+    Returns ``{decisions, admitted, cached, wait_s, shed_frac,
+    device_steps, fleet_size_by_epoch, ...}`` where ``wait_s[i]`` is
+    arrival ``i``'s modeled latency (queue wait + its own service;
+    0 for a cache hit) and ``device_steps`` the admitted device work.
+    """
+    arrivals = np.asarray(arrivals, np.float64)
+    content_ids = np.asarray(content_ids, np.int64)
+    work = np.asarray(content_work, np.float64)
+    if arrivals.shape != content_ids.shape:
+        raise ValueError(f"arrivals {arrivals.shape} and content_ids "
+                         f"{content_ids.shape} must align")
+    rate = policy.rate_hint_steps_per_s
+    if rate <= 0:
+        raise ValueError("simulate_traffic needs policy."
+                         "rate_hint_steps_per_s > 0 (the provisioning "
+                         "model the modeled wait is derived from)")
+    scaler = Autoscaler(policy, replicas=replicas)
+    n = len(arrivals)
+    horizon = float(arrivals[-1]) if n else 0.0
+    # trailing quiet epochs sized so a fully scaled-up fleet can walk
+    # all the way back down (one cooldown + quiet streak per retire
+    # step), not just one epoch
+    n_epochs = (int(horizon // policy.epoch_s) + 2
+                + (policy.cooldown_epochs + policy.down_epochs + 1)
+                * (policy.max_replicas - policy.min_replicas))
+    backlog = 0.0
+    stored: set = set()            # contents an admitted primary fills
+    admitted = np.zeros(n, bool)
+    hit = np.zeros(n, bool)
+    wait_s = np.zeros(n, np.float64)
+    decisions: List[Decision] = []
+    i = 0
+    for k in range(n_epochs):
+        t1 = (k + 1) * policy.epoch_s
+        live = scaler.replicas
+        while i < n and arrivals[i] < t1:
+            c = int(content_ids[i])
+            if cache and c in stored:
+                # served at the door: zero work, zero modeled wait
+                admitted[i] = hit[i] = True
+                i += 1
+                continue
+            est = backlog / (live * rate)
+            if shed_wait_s is not None and est > shed_wait_s:
+                i += 1              # shed: stores nothing
+                continue
+            w = float(work[c])
+            backlog += w
+            wait_s[i] = est + w / rate
+            admitted[i] = True
+            if cache:
+                stored.add(c)
+            i += 1
+        backlog = max(0.0, backlog - live * rate * policy.epoch_s)
+        est_wait = backlog / (live * rate)
+        sig = AutoscaleSignals(est_wait_s=est_wait, burn_rate=0.0,
+                               backlog=int(round(backlog)), n_live=live)
+        if autoscale:
+            decisions.append(scaler.decide(sig))
+        else:
+            decisions.append(Decision(
+                epoch=k, action="hold", target=live, reason="fixed",
+                est_wait_s=round(est_wait, 6)))
+    n_adm = int(admitted.sum())
+    lat = np.sort(wait_s[admitted]) if n_adm else np.zeros(1)
+    pct = lambda p: round(  # noqa: E731
+        float(lat[min(len(lat) - 1, int(p * len(lat)))]), 6)
+    return {
+        "decisions": decisions,
+        "admitted": admitted,
+        "cached": hit,
+        "wait_s": wait_s,
+        "n": n,
+        "completed": n_adm,
+        "shed": n - n_adm,
+        "shed_frac": round((n - n_adm) / max(n, 1), 4),
+        "hit_frac": round(float(hit.sum()) / max(n, 1), 4),
+        "device_steps": int(work[content_ids[admitted & ~hit]].sum()),
+        "latency_p50_s": pct(0.50),
+        "latency_p95_s": pct(0.95),
+        "latency_p99_s": pct(0.99),
+        "fleet_size_by_epoch": [d.target for d in decisions],
+    }
+
+
+def plan_decisions(arrivals: Sequence[float],
+                   work_steps: Sequence[float],
+                   policy: AutoscalePolicy,
+                   replicas: Optional[int] = None) -> List[Decision]:
+    """The deterministic scale plan for a trace: the no-shed fluid
+    replay of :func:`simulate_traffic` reduced to its decision list.
+
+    ``arrivals`` are the trace's cumulative virtual-time offsets and
+    ``work_steps[i]`` the decode steps arrival ``i`` will cost (0 for
+    a predicted cache hit — repeats never touch a device, so they must
+    not inflate the modeled backlog). Everything is a function of
+    (trace, policy), so two calls with the same trace seed return the
+    IDENTICAL decision list — the ISSUE 12 reproducibility acceptance
+    — and the traffic bench applies it at exact arrival indices.
+
+    Returns one :class:`Decision` per epoch covering the whole trace
+    (plus one trailing epoch so a final quiet window can retire).
+    """
+    arrivals = np.asarray(arrivals, np.float64)
+    work = np.asarray(work_steps, np.float64)
+    if arrivals.shape != work.shape:
+        raise ValueError(f"arrivals {arrivals.shape} and work_steps "
+                         f"{work.shape} must align")
+    return simulate_traffic(
+        arrivals, np.arange(len(arrivals)), work, policy,
+        cache=False, autoscale=True, shed_wait_s=None,
+        replicas=replicas)["decisions"]
+
+
+def decisions_summary(decisions: Sequence[Decision]) -> Dict:
+    """Compact record for bench rows / RUN.json: the action timeline
+    (hold epochs elided) plus the per-epoch fleet size."""
+    actions = [dataclasses.asdict(d) for d in decisions
+               if d.action != "hold"]
+    return {
+        "epochs": len(decisions),
+        "actions": actions,
+        "n_actions": len(actions),
+        "fleet_size_by_epoch": [d.target for d in decisions],
+        "final_replicas": (decisions[-1].target if decisions else None),
+        "max_replicas_reached": max(
+            (d.target for d in decisions), default=None),
+    }

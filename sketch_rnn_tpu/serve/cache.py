@@ -1,0 +1,243 @@
+"""Deterministic content-addressed result cache (+ in-flight coalescing).
+
+ISSUE 12 tentpole piece 1. Generation in this repo is a PURE function
+of (config_hash, params checkpoint, key, z, label, temperature,
+max_len) — the determinism contract every invariance suite pins — so
+two requests with identical content MUST produce bitwise-identical
+strokes, and the second one need not touch a device at all. This
+module is that observation turned into a serving layer in front of
+admission (serve/fleet.py consults it before placing a request):
+
+- **Content addressing.** :func:`request_fingerprint` hashes the
+  request's *content* fields — raw PRNG key data, z bytes, label,
+  temperature, max_len — plus the cache's ``(config_hash, ckpt_id)``
+  namespace with blake2b. Scheduling metadata (uid, class, queue
+  position, enqueue time, retry attempt) is deliberately EXCLUDED:
+  it changes WHEN a sketch is computed, never WHAT (the engine's
+  documented contract), so it must not fragment the keyspace. Two
+  different checkpoints (or configs) can never collide: their bytes
+  are inside the hash.
+- **Bounded LRU.** ``max_entries`` / ``max_bytes`` bound the store;
+  eviction order is pure LRU over the get/put sequence, so for a
+  deterministic request stream the hit/miss/evict sequence is itself
+  deterministic (tier-1-tested). The cache keeps EXACT internal
+  counters (hits / misses / evictions / bytes / coalesced) independent
+  of telemetry — the telemetry core, when enabled, mirrors them as
+  ``cache_hit`` / ``cache_miss`` / ``cache_evict`` counters and the
+  ``cache_bytes`` gauge (cat ``serve``), which the ``/metrics``
+  endpoint renders as ``sketch_rnn_serve_cache_*`` series for free.
+- **Hits are the stored Result, bitwise.** A hit returns the stored
+  strokes (marked ``cached=True`` on the Result the fleet builds) and
+  remembers the ORIGINAL computation's uid, so the hit's fresh trace
+  span links back to the origin request's trace_id — a cached
+  request's tree explains where its bytes came from. The traffic
+  bench proves hits bitwise equal to recomputation in-run.
+- **In-flight coalescing.** A repeat arriving while its content is
+  still being computed must not compute twice: the fleet registers it
+  as a WAITER on the pending fingerprint and fans the result out at
+  completion. This is what makes the cache's device-step savings a
+  deterministic function of the trace (misses == distinct contents),
+  not a race between completion and repetition.
+
+The cache itself is pure host-side state with one lock (the fleet
+calls it under its scheduler lock already, but a bare engine or a test
+may not) and never imports jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sketch_rnn_tpu.utils.telemetry import get_telemetry
+
+
+def request_fingerprint(req, config_hash: str = "",
+                        ckpt_id: str = "") -> bytes:
+    """blake2b digest of the request CONTENT + the model namespace.
+
+    Content = everything the strokes may depend on (the engine's
+    determinism contract): raw PRNG key data, z, label, temperature,
+    max_len. ``config_hash`` (the RUN.json HParams hash) and
+    ``ckpt_id`` (which params checkpoint is serving) namespace the
+    keyspace so different models can never collide. uid/class/queue
+    metadata never enter the hash — scheduling cannot fragment it.
+    """
+    import jax  # lazy: the serve-module discipline
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(config_hash.encode())
+    h.update(b"\x00")
+    h.update(ckpt_id.encode())
+    h.update(b"\x00")
+    key_data = np.asarray(jax.random.key_data(req.key))
+    h.update(str(key_data.dtype).encode() + b"|")
+    h.update(key_data.tobytes())
+    if req.z is None:
+        h.update(b"z:none")
+    else:
+        z = np.asarray(req.z, np.float32)
+        h.update(z.tobytes())
+    h.update(f"|{int(req.label)}|{float(req.temperature)!r}|"
+             f"{req.max_len}".encode())
+    return h.digest()
+
+
+class CacheEntry:
+    """One stored completion: the strokes plus origin metadata for the
+    hit path's trace link."""
+
+    __slots__ = ("strokes5", "length", "steps", "origin_uid", "nbytes")
+
+    def __init__(self, strokes5: np.ndarray, length: int, steps: int,
+                 origin_uid: int):
+        self.strokes5 = strokes5
+        self.length = int(length)
+        self.steps = int(steps)
+        self.origin_uid = int(origin_uid)
+        self.nbytes = int(strokes5.nbytes)
+
+
+class ResultCache:
+    """Bounded-LRU content-addressed store of completed Results.
+
+    ``max_entries`` and ``max_bytes`` both bound the store (0 =
+    unbounded on that axis); eviction pops the least-recently-used
+    entry until both bounds hold. ``get`` refreshes recency; ``put``
+    inserts most-recent. A ``put`` whose fingerprint is already stored
+    keeps the FIRST entry (determinism makes them bitwise-equal
+    anyway, and keep-first means a failover re-serve cannot churn the
+    LRU order).
+    """
+
+    def __init__(self, config_hash: str = "", ckpt_id: str = "",
+                 max_entries: int = 4096, max_bytes: int = 0):
+        if max_entries < 0 or max_bytes < 0:
+            raise ValueError(
+                f"bounds must be >= 0, got max_entries={max_entries} "
+                f"max_bytes={max_bytes}")
+        self.config_hash = str(config_hash or "")
+        self.ckpt_id = str(ckpt_id or "")
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._store: "OrderedDict[bytes, CacheEntry]" = OrderedDict()
+        self._bytes = 0
+        # exact counters, telemetry-independent (the ledger-as-view
+        # discipline: telemetry mirrors these when enabled)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.coalesced = 0
+
+    def fingerprint(self, req) -> bytes:
+        return request_fingerprint(req, self.config_hash, self.ckpt_id)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    @property
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def get(self, fp: bytes) -> Optional[CacheEntry]:
+        """Lookup + LRU refresh; ticks hit/miss exactly (and mirrors
+        into telemetry when enabled)."""
+        tel = get_telemetry()
+        with self._lock:
+            entry = self._store.get(fp)
+            if entry is None:
+                self.misses += 1
+            else:
+                self._store.move_to_end(fp)
+                self.hits += 1
+        if tel.enabled:
+            tel.counter("cache_hit" if entry is not None else
+                        "cache_miss", 1.0, cat="serve")
+        return entry
+
+    def note_coalesced(self) -> None:
+        """A repeat attached to an in-flight computation (the fleet's
+        waiter path): no device work, but not a store lookup hit."""
+        with self._lock:
+            self.coalesced += 1
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter("cache_coalesced", 1.0, cat="serve")
+
+    def put(self, fp: bytes, result) -> None:
+        """Insert one completed Result's strokes (keep-first on
+        duplicate fingerprints), then evict LRU until bounds hold."""
+        entry = CacheEntry(result.strokes5, result.length, result.steps,
+                           result.uid)
+        evicted = 0
+        tel = get_telemetry()
+        with self._lock:
+            if fp in self._store:
+                return
+            if self.max_entries == 0 and self.max_bytes == 0:
+                pass  # unbounded
+            self._store[fp] = entry
+            self._bytes += entry.nbytes
+            while ((self.max_entries and
+                    len(self._store) > self.max_entries)
+                   or (self.max_bytes and self._bytes > self.max_bytes
+                       and len(self._store) > 1)):
+                _, old = self._store.popitem(last=False)
+                self._bytes -= old.nbytes
+                self.evictions += 1
+                evicted += 1
+            total_bytes = self._bytes
+        if tel.enabled:
+            if evicted:
+                tel.counter("cache_evict", float(evicted), cat="serve")
+            tel.gauge("cache_bytes", float(total_bytes), cat="serve")
+
+    def stats(self) -> Dict[str, Any]:
+        """Exact counters for summaries / bench rows. Every arrival
+        does exactly one :meth:`get` (lookups = hits + misses); a
+        coalesced repeat ticked a miss there and then attached to the
+        in-flight computation, so ``hit_rate`` — the fraction of
+        arrivals served WITHOUT device work, the number the traffic
+        bench reports — is (hits + coalesced) / lookups."""
+        with self._lock:
+            lookups = self.hits + self.misses
+            served = self.hits + self.coalesced
+            return {
+                "entries": len(self._store),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "coalesced": self.coalesced,
+                "evictions": self.evictions,
+                "lookups": lookups,
+                "hit_rate": round(served / max(lookups, 1), 4),
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "config_hash": self.config_hash,
+                "ckpt_id": self.ckpt_id,
+            }
+
+    def keys(self) -> List[bytes]:
+        """LRU order, least-recent first (tests pin eviction order)."""
+        with self._lock:
+            return list(self._store)
+
+    def clear(self) -> None:
+        """Drop entries AND counters (bench arms reset between runs)."""
+        with self._lock:
+            self._store.clear()
+            self._bytes = 0
+            self.hits = self.misses = 0
+            self.evictions = self.coalesced = 0
+
+    def __repr__(self) -> str:
+        s = self.stats()
+        return (f"ResultCache({s['entries']} entries, {s['bytes']}B, "
+                f"hit_rate {s['hit_rate']}, ckpt={self.ckpt_id!r})")

@@ -128,6 +128,11 @@ class Result:
     # and per-class cost are provable bitwise; run() reports the idle
     # remainder so attributed + idle == dispatched EXACTLY.
     attributed_steps: int = 0
+    # served from the result cache (ISSUE 12): the strokes are the
+    # ORIGINAL computation's, bitwise (the determinism contract makes
+    # hit == recomputation provable); attributed_steps is 0 — a hit
+    # costs no device steps, which is the whole point
+    cached: bool = False
 
     @property
     def ended(self) -> bool:

@@ -54,6 +54,25 @@ all scrapeable live via ``serve/metrics_http.py``'s ``/metrics`` +
   replica (or an exhausted retry budget, recorded per request in
   ``failed``) surfaces as a failure.
 
+- **Traffic shaping (ISSUE 12).** The fleet is now ELASTIC and cached:
+  ``max_replicas`` pre-builds (and ``warm`` pre-compiles) spare
+  replicas that start RETIRED — out of the placement set, no worker
+  thread — and ``add_replica()`` / ``retire_replica()`` move the live
+  set at runtime on the failover primitives (retire = drain + leave
+  placement, exactly the graceful half of ``mark_dead``; spawn = the
+  rejoin path). ``serve/autoscale.py`` decides when; every action
+  lands in ``scale_log``, a ``replica_spawn``/``replica_retire`` span
+  and the ``fleet_replicas`` gauge. A :class:`~sketch_rnn_tpu.serve.
+  cache.ResultCache` attached as ``cache`` is consulted in ``submit``
+  BEFORE admission: a content hit is served at the door (bitwise the
+  original strokes, ``cached=True``, zero device steps) with a fresh
+  trace span linking the ORIGIN computation's trace_id, and a repeat
+  arriving while its content is still in flight coalesces onto the
+  pending computation instead of computing twice — so cache savings
+  are a deterministic function of the request stream, not a race.
+  ``/healthz`` reports ``scaling`` while a retire is still draining
+  (an intentional resize must not read as degradation).
+
 Every started fleet registers process-wide so the tier-1 conftest
 guard can prove no test leaks worker threads (:func:`stop_all`).
 """
@@ -76,7 +95,7 @@ from sketch_rnn_tpu.serve.admission import (
     DEFAULT_CLASS,
     parse_admission_classes,
 )
-from sketch_rnn_tpu.serve.engine import Request, ServeEngine
+from sketch_rnn_tpu.serve.engine import Request, Result, ServeEngine
 from sketch_rnn_tpu.utils.faults import backoff_s, fault_point
 from sketch_rnn_tpu.utils.telemetry import (
     class_series,
@@ -112,6 +131,11 @@ class _Replica:
         # admission controller no longer places on it
         self.dead = False
         self.death: Optional[str] = None
+        # elastic state (ISSUE 12): a RETIRED replica drains its queue
+        # then its worker exits; rejoin (add_replica) brings it back —
+        # the graceful sibling of `dead`
+        self.retired = False
+        self.retire_t0: Optional[float] = None
         # accumulated engine metrics across micro-bursts
         self.completed = 0
         self.bursts = 0
@@ -157,16 +181,22 @@ class ServeFleet:
                  pool_cap: int = 0, queue_cap: int = 0,
                  shed_margin: float = 1.0, slo=None,
                  retry_budget: int = 2,
-                 retry_backoff_s: float = 0.05):
+                 retry_backoff_s: float = 0.05,
+                 max_replicas: int = 0, cache=None):
         import jax  # lazy, the serve-module discipline
 
         devices = list(devices if devices is not None else jax.devices())
         n = int(replicas) if replicas else len(devices)
         if n < 1:
             raise ValueError(f"replicas must be >= 1, got {n}")
-        if n > len(devices):
+        # elastic headroom (ISSUE 12): build (and warm) engines up to
+        # max_replicas, but only `replicas` start in the placement set
+        # — the rest sit retired until add_replica() rejoins them, so
+        # an autoscale spawn never compiles inside the serving window
+        n_build = max(n, int(max_replicas) or n)
+        if n_build > len(devices):
             raise ValueError(
-                f"{n} replicas need {n} devices but only "
+                f"{n_build} replicas need {n_build} devices but only "
                 f"{len(devices)} are available")
         self.hps = hps
         self.slots = int(slots or hps.serve_slots)
@@ -186,13 +216,13 @@ class ServeFleet:
         self._default_class = class_order[0] if len(class_order) == 1 \
             else None
         self._admission = AdmissionController(
-            self.classes, n_replicas=n, slots=self.slots,
+            self.classes, n_replicas=n_build, slots=self.slots,
             queue_cap=queue_cap, shed_margin=shed_margin)
         self._slo = slo
         self._lock = threading.Lock()
         self._done_cv = threading.Condition(self._lock)
         self._replicas: List[_Replica] = []
-        for r in range(n):
+        for r in range(n_build):
             with jax.default_device(devices[r]):
                 eng = ServeEngine(model, hps, params, slots=self.slots,
                                   chunk=self.chunk, max_len=max_len,
@@ -200,7 +230,18 @@ class ServeFleet:
                                   replica_id=r)
             rep = _Replica(r, devices[r], eng, class_order)
             rep.cond = threading.Condition(self._lock)
+            if r >= n:
+                rep.retired = True
+                self._admission.retire(r)
             self._replicas.append(rep)
+        self._initial_active = n
+        # result cache (ISSUE 12): consulted in submit() before
+        # admission; assignable between bench arms (the compiled
+        # replicas are the expensive part, the cache is host state)
+        self.cache = cache
+        self._fp_of: Dict[int, bytes] = {}     # uid -> fingerprint
+        self._pending: Dict[bytes, List] = {}  # fp -> coalesced waiters
+        self._scale_log: List[Dict] = []
         if retry_budget < 0:
             raise ValueError(f"retry_budget must be >= 0, got "
                              f"{retry_budget}")
@@ -223,6 +264,14 @@ class ServeFleet:
     @property
     def n_replicas(self) -> int:
         return len(self._replicas)
+
+    @property
+    def n_live(self) -> int:
+        """Replicas currently in the placement set (not dead, not
+        retired) — the number the autoscaler moves and the
+        ``fleet_replicas`` gauge reports."""
+        return sum(1 for r in self._replicas
+                   if not r.dead and not r.retired)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -254,10 +303,15 @@ class ServeFleet:
         with _LIVE_LOCK:
             _LIVE.add(self)
         for rep in self._replicas:
+            if rep.retired or rep.dead:
+                continue  # elastic spares spawn via add_replica()
             rep.thread = threading.Thread(
                 target=self._worker, args=(rep,),
                 name=f"fleet-replica-{rep.idx}", daemon=True)
             rep.thread.start()
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.gauge("fleet_replicas", self.n_live, cat="serve")
         return self
 
     def reset(self) -> None:
@@ -307,6 +361,32 @@ class ServeFleet:
                 self.classes, n_replicas=self.n_replicas,
                 slots=self.slots, queue_cap=self._admission.queue_cap,
                 shed_margin=self._admission.shed_margin)
+            # restore the INITIAL topology (ISSUE 12): arms that
+            # autoscaled re-measure from the same starting fleet.
+            # Running fleets get workers spawned/retired to match;
+            # closed ones re-spawn at the next start().
+            for rep in self._replicas:
+                want_retired = rep.idx >= self._initial_active
+                if want_retired and not rep.retired:
+                    rep.retired = True
+                    rep.retire_t0 = time.perf_counter()
+                    rep.cond.notify_all()  # wake to exit (queue empty)
+                elif not want_retired and rep.retired:
+                    rep.retired = False
+                    rep.retire_t0 = None
+                    if (self._started and not self._stop
+                            and (rep.thread is None
+                                 or not rep.thread.is_alive())):
+                        rep.thread = threading.Thread(
+                            target=self._worker, args=(rep,),
+                            name=f"fleet-replica-{rep.idx}",
+                            daemon=True)
+                        rep.thread.start()
+                if want_retired:
+                    self._admission.retire(rep.idx)
+            self._fp_of = {}
+            self._pending = {}
+            self._scale_log = []
             self._next_uid = 0
             self._seen_uids = set()
             self._submitted = 0
@@ -322,6 +402,129 @@ class ServeFleet:
                 rep.device_steps = 0
                 rep.live_slot_steps = 0.0
                 rep.attributed_steps = rep.idle_steps = 0
+
+    # -- elastic scaling (ISSUE 12) ----------------------------------------
+
+    def _rejoin_locked(self, rep: "_Replica", reason: str,
+                       t0: float) -> int:
+        """The elastic SPAWN body (caller holds the scheduler lock):
+        clear the retired flags, rejoin the placement set, start a
+        worker if the fleet is live, and record the action in
+        ``scale_log`` + the ``replica_spawn`` span + the
+        ``fleet_replicas`` gauge. Shared by :meth:`add_replica` and
+        the failover self-heal so the rejoin invariants live once."""
+        tel = get_telemetry()
+        rep.retired = False
+        rep.retire_t0 = None
+        self._admission.rejoin(rep.idx)
+        if (self._started and not self._stop
+                and (rep.thread is None or not rep.thread.is_alive())):
+            rep.thread = threading.Thread(
+                target=self._worker, args=(rep,),
+                name=f"fleet-replica-{rep.idx}", daemon=True)
+            rep.thread.start()
+        n_live = self.n_live
+        self._scale_log.append({"action": "spawn", "replica": rep.idx,
+                                "n_live": n_live, "reason": reason})
+        if tel.enabled:
+            tel.counter("replica_spawns", 1.0, cat="serve")
+            tel.emit_span(
+                "replica_spawn", "serve", t0, time.perf_counter(),
+                args={"replica": rep.idx, "n_live": n_live,
+                      "reason": reason},
+                trace=span_link(f"replica-r{rep.idx}",
+                                f"spawn-r{rep.idx}.{rep.burst_seq}"))
+            tel.gauge("fleet_replicas", n_live, cat="serve")
+        return rep.idx
+
+    def add_replica(self, reason: str = "manual") -> int:
+        """Rejoin the lowest retired replica into the placement set
+        (the elastic SPAWN: PR 10's rejoin path — the engine is
+        already built, pinned and warm, so a spawn never compiles).
+        Returns the replica index. Recorded in ``scale_log``, the
+        ``replica_spawn`` span, the ``fleet_replicas`` gauge."""
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("fleet is closed")
+            cand = [r for r in self._replicas
+                    if r.retired and not r.dead]
+            if not cand:
+                raise RuntimeError(
+                    f"no retired replica to rejoin (live "
+                    f"{self.n_live}/{self.n_replicas}) — build the "
+                    f"fleet with max_replicas headroom")
+            return self._rejoin_locked(cand[0], reason, t0)
+
+    def retire_replica(self, replica: Optional[int] = None,
+                       reason: str = "manual") -> int:
+        """Gracefully remove one replica from the placement set (the
+        elastic RETIRE: drain + leave placement — the graceful half of
+        the failover path). Its queued work drains, then its worker
+        exits; ``/healthz`` reports ``scaling`` while the drain is in
+        flight. Defaults to the highest live index (deterministic);
+        refuses to retire the last live replica."""
+        tel = get_telemetry()
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("fleet is closed")
+            live = [r for r in self._replicas
+                    if not r.dead and not r.retired]
+            if len(live) <= 1:
+                raise RuntimeError(
+                    "cannot retire the last live replica")
+            if replica is None:
+                rep = live[-1]
+            else:
+                rep = self._replicas[replica]
+                if rep.dead or rep.retired:
+                    raise RuntimeError(
+                        f"replica {replica} is not live "
+                        f"(dead={rep.dead}, retired={rep.retired})")
+            rep.retired = True
+            rep.retire_t0 = time.perf_counter()
+            self._admission.retire(rep.idx)
+            rep.cond.notify_all()   # wake: drain the queue, then exit
+            n_live = self.n_live
+            self._scale_log.append({"action": "retire",
+                                    "replica": rep.idx,
+                                    "n_live": n_live,
+                                    "reason": reason})
+            if tel.enabled:
+                tel.counter("replica_retires", 1.0, cat="serve")
+                tel.gauge("fleet_replicas", n_live, cat="serve")
+            return rep.idx
+
+    def set_target_replicas(self, target: int,
+                            reason: str = "autoscale") -> List[Dict]:
+        """Apply an autoscale decision: spawn/retire replicas until
+        ``n_live == target`` (clamped to what was built AND is still
+        alive — a dead replica can never rejoin, so scaling up after a
+        crash tops out at the surviving count instead of raising out
+        of the control loop). Returns the scale_log entries it
+        appended — the bench records these as the realized decision
+        timeline."""
+        with self._lock:
+            usable = sum(1 for r in self._replicas if not r.dead)
+        target = max(1, min(int(target), usable))
+        actions: List[Dict] = []
+        while self.n_live < target:
+            try:
+                idx = self.add_replica(reason=reason)
+            except RuntimeError as e:
+                if "no retired replica" not in str(e):
+                    raise  # a closed fleet must still propagate
+                break  # a concurrent death consumed the headroom
+            actions.append({"action": "spawn", "replica": idx})
+        while self.n_live > target:
+            try:
+                idx = self.retire_replica(reason=reason)
+            except RuntimeError as e:
+                if "last live replica" not in str(e):
+                    raise
+                break  # a concurrent death got there first
+            actions.append({"action": "retire", "replica": idx})
+        return actions
 
     def close(self, timeout: float = 30.0) -> List[str]:
         """Stop the workers (any queued-but-unstarted work is
@@ -383,6 +586,10 @@ class ServeFleet:
                 f"request needs an admission class (configured: "
                 f"{sorted(self.classes)})")
         tel = get_telemetry()
+        # content fingerprint OUTSIDE the scheduler lock (blake2b over
+        # the request fields; the cache is consulted under it)
+        fp = (self.cache.fingerprint(req)
+              if self.cache is not None else None)
         with self._lock:
             if self._stop:
                 raise RuntimeError("fleet is closed")
@@ -403,6 +610,31 @@ class ServeFleet:
             if self._t_first_submit is None:
                 self._t_first_submit = req.enqueue_ts
             self._submitted += 1
+            # result cache (ISSUE 12): consulted BEFORE admission — a
+            # content hit is served at the door for zero device steps
+            # (bitwise the origin computation's strokes), and a repeat
+            # whose content is still IN FLIGHT coalesces onto the
+            # pending computation (fan-out at completion) instead of
+            # computing twice. Both paths bypass shed checks: they
+            # cost no queue slot and no device work.
+            if fp is not None:
+                entry = self.cache.get(fp)
+                if entry is not None:
+                    self._book_cache_hit(req, cls_name, entry.strokes5,
+                                         entry.length, entry.steps,
+                                         entry.origin_uid, tel)
+                    return True
+                if fp in self._pending:
+                    self._pending[fp].append(req)
+                    self.cache.note_coalesced()
+                    if tel.enabled:
+                        tel.instant(
+                            "coalesced", cat="serve", ts=req.enqueue_ts,
+                            args={"uid": req.uid, "class": cls_name},
+                            trace=span_link(
+                                request_trace_id(req.uid),
+                                request_span_id("coalesced", req.uid)))
+                    return True
             # admission evidence (ISSUE 11): the backlog the decision
             # saw, captured BEFORE place() mutates it — the arrival
             # instant carries the whole verdict (chosen replica,
@@ -440,6 +672,13 @@ class ServeFleet:
             req.queue_pos = decision.queue_pos
             rep = self._replicas[decision.replica]
             rep.queues[cls_name].append(req)
+            if fp is not None:
+                # this uid is now the PRIMARY computation for its
+                # content: later repeats coalesce onto it (registered
+                # only on admission — a shed request must never anchor
+                # waiters that could then wait forever)
+                self._pending[fp] = []
+                self._fp_of[req.uid] = fp
             if tel.enabled:
                 tel.counter("requests_admitted", 1.0, cat="serve")
                 tel.instant(
@@ -455,6 +694,69 @@ class ServeFleet:
                                                     req.uid)))
             rep.cond.notify()
             return True
+
+    def _book_cache_hit(self, req: Request, cls_name: Optional[str],
+                        strokes5, length: int, steps: int,
+                        origin_uid: int, tel,
+                        coalesced: bool = False) -> None:
+        """Serve one request from cached strokes (caller holds the
+        lock): book a ``cached=True`` Result with ZERO attributed
+        device steps, feed the SLO tracker the (tiny) real latency,
+        and emit the causal trace — a root span over the request's
+        clock plus a ``cache_hit`` instant carrying the ORIGIN
+        computation's trace id, so a cached tree explains where its
+        bytes came from (the ISSUE 12 trace-link contract)."""
+        now = time.perf_counter()
+        qw = now - req.enqueue_ts
+        res = Result(uid=req.uid, strokes5=strokes5, length=length,
+                     steps=steps, queue_wait_s=qw, decode_s=0.0,
+                     latency_s=qw, attributed_steps=0, cached=True)
+        self._results[req.uid] = {
+            "result": res, "replica": None, "class": cls_name,
+            "queue_pos": None, "cached": True,
+            "origin_uid": origin_uid}
+        if self._slo is not None:
+            self._slo.observe(cls_name or DEFAULT_CLASS, {
+                "queue_wait_s": res.queue_wait_s,
+                "decode_s": res.decode_s,
+                "latency_s": res.latency_s})
+        self._t_last_done = now
+        if tel.enabled:
+            trace_id = request_trace_id(req.uid)
+            root_id = request_span_id("request", req.uid)
+            tel.emit_span("request", "serve", req.enqueue_ts, now,
+                          args={"uid": req.uid, "cached": True},
+                          trace=span_link(trace_id, root_id))
+            tel.instant(
+                "cache_hit", cat="serve", ts=now,
+                args={"uid": req.uid, "class": cls_name,
+                      "coalesced": coalesced,
+                      "origin_uid": origin_uid,
+                      "origin_trace": request_trace_id(origin_uid)},
+                trace=span_link(trace_id,
+                                request_span_id("cache_hit", req.uid),
+                                root_id))
+            tel.instant(
+                "complete", cat="serve", ts=now,
+                args={"uid": req.uid, "steps": res.steps,
+                      "length": res.length,
+                      "queue_wait_s": res.queue_wait_s,
+                      "decode_s": res.decode_s,
+                      "latency_s": res.latency_s,
+                      "segments": [
+                          [k, v] for k, v in critical_path_segments(
+                              res.queue_wait_s, res.latency_s)],
+                      "attributed_steps": 0, "cached": True,
+                      **({"class": cls_name} if cls_name else {})},
+                trace=span_link(trace_id,
+                                request_span_id("complete", req.uid),
+                                root_id))
+            tel.counter("requests_completed", 1.0, cat="serve")
+            tel.observe("latency_s", res.latency_s, cat="serve")
+            if cls_name is not None:
+                tel.observe(class_series("latency_s", cls_name),
+                            res.latency_s, cat="serve")
+        self._done_cv.notify_all()
 
     def _worker(self, rep: _Replica) -> None:
         """One replica's drain loop: wait for queued work, pop a
@@ -472,9 +774,34 @@ class ServeFleet:
 
         while True:
             with self._lock:
-                while not rep.pending() and not self._stop:
+                while (not rep.pending() and not self._stop
+                       and not rep.retired):
                     rep.cond.wait()
                 if self._stop:
+                    return
+                if rep.retired and not rep.pending():
+                    # elastic retire (ISSUE 12): the queue is drained —
+                    # leave the fleet. The thread slot is cleared UNDER
+                    # the lock so a concurrent add_replica either sees
+                    # this worker gone (spawns a fresh one) or flipped
+                    # `retired` before we woke (we keep serving above).
+                    rep.thread = None
+                    t0 = (rep.retire_t0 if rep.retire_t0 is not None
+                          else time.perf_counter())
+                    rep.retire_t0 = None
+                    self._done_cv.notify_all()
+                    tel = get_telemetry()
+                    if tel.enabled:
+                        now = time.perf_counter()
+                        tel.emit_span(
+                            "replica_retire", "serve", t0, now,
+                            args={"replica": rep.idx,
+                                  "n_live": self.n_live},
+                            trace=span_link(
+                                f"replica-r{rep.idx}",
+                                f"retire-r{rep.idx}.{rep.burst_seq}"))
+                        tel.gauge("fleet_replicas", self.n_live,
+                                  cat="serve")
                     return
                 batch = rep.pop_batch(self.pool_cap)
                 bid = f"r{rep.idx}.b{rep.burst_seq}"
@@ -525,6 +852,19 @@ class ServeFleet:
                             "queue_wait_s": res.queue_wait_s,
                             "decode_s": res.decode_s,
                             "latency_s": res.latency_s})
+                    # result cache fill + coalesced fan-out (ISSUE
+                    # 12): the completed PRIMARY stores its strokes,
+                    # then every repeat that arrived while it was in
+                    # flight is served the identical bytes — so
+                    # repeats never compute, deterministically
+                    fp = self._fp_of.pop(res.uid, None)
+                    if fp is not None and self.cache is not None:
+                        self.cache.put(fp, res)
+                        for w in self._pending.pop(fp, []):
+                            self._book_cache_hit(
+                                w, w.cls, res.strokes5, res.length,
+                                res.steps, res.uid, tel,
+                                coalesced=True)
                 rep.completed += m["completed"]
                 rep.bursts += 1
                 rep.chunks += m["chunks"]
@@ -560,7 +900,24 @@ class ServeFleet:
                 stranded.extend(q)
                 q.clear()
             self._admission.mark_dead(rep.idx)
-            live = [r for r in self._replicas if not r.dead]
+            # survivors = the PLACEMENT set (a retired spare is not
+            # dead, but admission will never place on it — counting it
+            # here would requeue onto nobody and hang drain())
+            live = [r for r in self._replicas
+                    if not r.dead and not r.retired]
+            if not live:
+                # elastic self-heal (ISSUE 12 x PR 10): the last
+                # placed replica died but a pre-warmed retired spare
+                # exists — rejoin the lowest one (the spawn path,
+                # never a compile) instead of going fleet-fatal
+                spares = [r for r in self._replicas
+                          if r.retired and not r.dead]
+                if spares:
+                    spare = spares[0]
+                    self._rejoin_locked(
+                        spare, f"failover: replica {rep.idx} died",
+                        t_death)
+                    live = [spare]
             if tel.enabled:
                 tel.counter("replica_deaths", 1.0, cat="serve")
             # stderr: serve-bench's stdout is a JSON report stream
@@ -618,6 +975,21 @@ class ServeFleet:
                                 trace_id,
                                 request_span_id("failed", r.uid),
                                 root_id))
+                    # coalesced repeats waiting on this computation can
+                    # never be filled — fail them WITH their primary so
+                    # drain() completes and reports honestly (ISSUE 12)
+                    fpx = self._fp_of.pop(r.uid, None)
+                    if fpx is not None:
+                        for w in self._pending.pop(fpx, []):
+                            self._failed[w.uid] = {
+                                "uid": w.uid, "class": w.cls,
+                                "replica": rep.idx, "retries": 0,
+                                "reason": (f"coalesced onto failed "
+                                           f"request {r.uid}"),
+                                "error": repr(exc)}
+                            if tel.enabled:
+                                tel.counter("requests_failed", 1.0,
+                                            cat="serve")
         # deterministic backoff OUTSIDE the lock (the dying worker is
         # the only thread that sleeps; submits/completions proceed):
         # the schedule is a pure function of the worst attempt index
@@ -724,11 +1096,20 @@ class ServeFleet:
         with self._lock:
             dead = [{"replica": r.idx, "error": r.death}
                     for r in self._replicas if r.dead]
+            retired = [r.idx for r in self._replicas if r.retired]
+            # an in-flight resize (ISSUE 12): a retiring replica still
+            # draining its queue — intentional, not degradation, so
+            # /healthz reports `scaling` instead of flapping
+            scaling = any(r.retired and r.thread is not None
+                          and r.thread.is_alive()
+                          for r in self._replicas)
             return {
                 "healthy": not dead and self._error is None
                 and not self._failed,
+                "scaling": scaling,
                 "replicas": self.n_replicas,
-                "replicas_live": self.n_replicas - len(dead),
+                "replicas_live": self.n_live,
+                "replicas_retired": retired,
                 "replicas_dead": dead,
                 "requests_failed": len(self._failed),
                 "requests_requeued": self._requeues,
@@ -748,8 +1129,9 @@ class ServeFleet:
             submitted = self._submitted
             reps = [(r.idx, r.completed, r.bursts, r.chunks,
                      r.device_steps, r.live_slot_steps, r.dead,
-                     r.attributed_steps, r.idle_steps)
+                     r.attributed_steps, r.idle_steps, r.retired)
                     for r in self._replicas]
+            scale_log = list(self._scale_log)
             t0, t1 = self._t_first_submit, self._t_last_done
         wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
         by_class: Dict[str, List[float]] = {}
@@ -779,10 +1161,11 @@ class ServeFleet:
             "chunks": chunks, "device_steps": steps,
             "slot_utilization": round(
                 live / max(chunks * self.chunk * self.slots, 1), 4),
-            "dead": dead,
+            "dead": dead, "retired": retired,
             "steps_attributed": attr, "steps_idle": idle,
-        } for idx, comp, bursts, chunks, steps, live, dead, attr, idle
-          in reps]
+        } for idx, comp, bursts, chunks, steps, live, dead, attr, idle,
+          retired in reps]
+        n_cached = sum(1 for rec in recs if rec.get("cached"))
         # per-class device-step cost (ISSUE 11): integer sums of the
         # engine's deterministic per-request attribution; `exact` pins
         # the identity attributed + idle == dispatched over every
@@ -807,11 +1190,18 @@ class ServeFleet:
         return {
             "replicas": self.n_replicas,
             "replicas_dead": sum(1 for r in per_replica if r["dead"]),
+            "replicas_live": self.n_live,
+            "replicas_retired": sum(1 for r in per_replica
+                                    if r["retired"]),
+            "scale_log": scale_log,
             "slots": self.slots,
             "chunk": self.chunk,
             "pool_cap": self.pool_cap,
             "submitted": submitted,
             "completed": len(recs),
+            "completed_cached": n_cached,
+            "cache": (None if self.cache is None
+                      else self.cache.stats()),
             "shed": len(shed),
             "shed_frac": round(len(shed) / submitted, 4) if submitted
             else 0.0,

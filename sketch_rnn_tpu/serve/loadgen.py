@@ -1,4 +1,4 @@
-"""Deterministic open-loop Poisson load generator.
+"""Deterministic open-loop load generation: Poisson + trace replay.
 
 ISSUE 9 tentpole piece: a serving benchmark that feeds the next request
 only after the previous one completes (closed-loop) lets a slow server
@@ -19,6 +19,30 @@ wall-clock jitter of the replay thread, which the generator measures
 closed-burst schedule (every request at t=0) — the capacity-measurement
 arm.
 
+**Trace replay (ISSUE 12).** Real traffic is not stationary Poisson:
+it has diurnal rate curves, flash crowds, heavy-tailed quiet gaps, and
+— the property a result cache lives on — REPETITION. The trace layer
+grows the generator into seeded traffic shapes, all pure functions of
+a :class:`TraceSpec`:
+
+- ``poisson``  — the stationary baseline (unchanged math).
+- ``diurnal``  — sinusoidal rate modulation via thinning against the
+  peak rate (one seeded uniform stream; deterministic).
+- ``flash``    — piecewise-constant rate with a ``flash_mult`` x step
+  inside ``[flash_at_s, flash_at_s + flash_dur_s)`` — the overload
+  scenario the autoscaler is judged on.
+- ``pareto``   — bounded-Pareto inter-arrivals (``alpha``, capped at
+  ``pareto_cap_s``) rescaled to the requested mean rate: bursty
+  heavy-tail arrivals without an unbounded quiet tail.
+
+:func:`make_trace` additionally draws a **Zipf repetition model** over
+a ``unique``-sized request space (``request_ids``): arrival ``i``
+carries the content of request ``request_ids[i]``, so a few hot
+requests dominate — the realistic hit structure the result cache
+(serve/cache.py) is measured against. ``misses == distinct contents``
+is then a pure function of the trace seed, which is what makes the
+traffic bench's cache savings deterministic scheduling math.
+
 Every started generator registers process-wide so the tier-1 conftest
 guard can prove no test leaks a replay thread (:func:`stop_all`, the
 serve/metrics_http.py discipline).
@@ -26,6 +50,7 @@ serve/metrics_http.py discipline).
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from typing import Callable, Optional, Sequence, Tuple
@@ -57,6 +82,186 @@ def poisson_arrivals(n: int, rate_hz: float, seed: int) -> np.ndarray:
         return np.zeros((n,), np.float64)
     gaps = np.random.default_rng(seed).exponential(1.0 / rate_hz, size=n)
     return np.cumsum(gaps)
+
+
+# -- traffic traces (ISSUE 12) ------------------------------------------------
+
+TRACE_KINDS = ("poisson", "diurnal", "flash", "pareto")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """One seeded traffic shape + repetition model (pure config).
+
+    ``rate_hz`` is the BASE rate; the shape fields modulate it.
+    ``unique`` sizes the distinct-request space the Zipf repetition
+    model draws from (``unique >= n`` degenerates to all-distinct;
+    ``zipf_s`` is the exponent — larger = hotter head). Everything
+    downstream (:func:`make_trace`, the autoscale plan, the cache's
+    expected miss count) is a pure function of this dataclass.
+    """
+
+    kind: str = "poisson"
+    n: int = 256
+    rate_hz: float = 100.0
+    seed: int = 0
+    # diurnal
+    diurnal_period_s: float = 4.0
+    diurnal_amp: float = 0.8
+    # flash crowd
+    flash_at_s: float = 1.0
+    flash_dur_s: float = 0.5
+    flash_mult: float = 6.0
+    # heavy tail
+    pareto_alpha: float = 1.5
+    pareto_cap_s: float = 1.0
+    # repetition
+    unique: int = 0          # 0 = all requests distinct
+    zipf_s: float = 1.1
+
+    def __post_init__(self):
+        if self.kind not in TRACE_KINDS:
+            raise ValueError(f"unknown trace kind {self.kind!r}; want "
+                             f"one of {TRACE_KINDS}")
+        if self.n < 0 or self.rate_hz <= 0:
+            raise ValueError(f"need n >= 0 and rate_hz > 0, got "
+                             f"n={self.n} rate_hz={self.rate_hz}")
+        if self.kind == "diurnal" and not 0 <= self.diurnal_amp < 1:
+            raise ValueError(f"diurnal_amp must be in [0, 1), got "
+                             f"{self.diurnal_amp}")
+        if self.kind == "flash" and self.flash_mult < 1:
+            raise ValueError(f"flash_mult must be >= 1, got "
+                             f"{self.flash_mult}")
+        if self.kind == "pareto" and self.pareto_alpha <= 0:
+            raise ValueError(f"pareto_alpha must be > 0, got "
+                             f"{self.pareto_alpha}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Trace:
+    """A realized trace: arrival offsets + the repetition mapping.
+    ``request_ids[i]`` names the CONTENT arrival ``i`` carries."""
+
+    spec: TraceSpec
+    arrivals: np.ndarray      # [n] cumulative seconds, non-decreasing
+    request_ids: np.ndarray   # [n] int64 into the unique request space
+
+    @property
+    def n(self) -> int:
+        return len(self.arrivals)
+
+    @property
+    def duration_s(self) -> float:
+        return float(self.arrivals[-1]) if len(self.arrivals) else 0.0
+
+    def distinct(self) -> int:
+        """Distinct contents actually drawn — the deterministic miss
+        count a cold cache must see on this trace."""
+        return int(len(np.unique(self.request_ids)))
+
+
+def diurnal_arrivals(n: int, rate_hz: float, period_s: float,
+                     amp: float, seed: int) -> np.ndarray:
+    """Sinusoidally-modulated Poisson arrivals via thinning.
+
+    Instantaneous rate ``rate_hz * (1 + amp * sin(2 pi t / period))``;
+    candidates are drawn at the peak rate and accepted with probability
+    ``rate(t) / peak`` from the SAME seeded stream, so the result is a
+    pure function of ``(n, rate_hz, period_s, amp, seed)``.
+    """
+    if n == 0:
+        return np.zeros((0,), np.float64)
+    rng = np.random.default_rng(seed)
+    peak = rate_hz * (1.0 + amp)
+    out = np.empty((n,), np.float64)
+    t, k = 0.0, 0
+    while k < n:
+        t += rng.exponential(1.0 / peak)
+        rate = rate_hz * (1.0 + amp * np.sin(2.0 * np.pi * t / period_s))
+        if rng.random() * peak <= rate:
+            out[k] = t
+            k += 1
+    return out
+
+
+def flash_crowd_arrivals(n: int, rate_hz: float, at_s: float,
+                         dur_s: float, mult: float,
+                         seed: int) -> np.ndarray:
+    """Piecewise-constant-rate arrivals: base rate everywhere except a
+    ``mult`` x step inside ``[at_s, at_s + dur_s)`` — the flash crowd.
+    Sequential seeded draws (gap at the CURRENT instant's rate), so the
+    schedule is deterministic in the spec."""
+    if n == 0:
+        return np.zeros((0,), np.float64)
+    rng = np.random.default_rng(seed)
+    out = np.empty((n,), np.float64)
+    t = 0.0
+    for k in range(n):
+        rate = rate_hz * (mult if at_s <= t < at_s + dur_s else 1.0)
+        t += rng.exponential(1.0 / rate)
+        out[k] = t
+    return out
+
+
+def pareto_arrivals(n: int, rate_hz: float, alpha: float, cap_s: float,
+                    seed: int) -> np.ndarray:
+    """Bounded-Pareto inter-arrivals with mean ``~1/rate_hz``.
+
+    Heavy-tailed gaps (inverse-CDF of a Pareto with shape ``alpha``)
+    are first scaled so the sample mean rate is ``rate_hz`` — offered
+    load stays comparable across shapes — THEN truncated at ``cap_s``
+    in realized seconds, so one draw can never stall the trace by more
+    than the documented bound. Truncation only shortens gaps, so the
+    realized mean rate is >= ``rate_hz`` by the clipped tail mass.
+    Pure in the spec (the scale factor uses the sample mean, itself
+    seeded).
+    """
+    if n == 0:
+        return np.zeros((0,), np.float64)
+    rng = np.random.default_rng(seed)
+    u = rng.random(n)
+    gaps = 1.0 / np.power(1.0 - u, 1.0 / alpha)  # Pareto, xm = 1
+    gaps = gaps * ((1.0 / rate_hz) / gaps.mean())
+    gaps = np.minimum(gaps, max(cap_s, 1e-9))
+    return np.cumsum(gaps)
+
+
+def zipf_request_ids(n: int, unique: int, s: float,
+                     seed: int) -> np.ndarray:
+    """Zipf-distributed content ids over ``[0, unique)``: repetition
+    with a hot head, deterministic in the seed. ``unique <= 0`` means
+    all-distinct (identity — no repetition, a cache sees 0 hits)."""
+    if unique <= 0 or unique >= n:
+        return np.arange(n, dtype=np.int64)
+    ranks = np.arange(1, unique + 1, dtype=np.float64)
+    p = ranks ** (-float(s))
+    p /= p.sum()
+    return np.random.default_rng(seed + 1).choice(
+        unique, size=n, p=p).astype(np.int64)
+
+
+def trace_arrivals(spec: TraceSpec) -> np.ndarray:
+    """The spec's arrival schedule (dispatch on ``kind``)."""
+    if spec.kind == "poisson":
+        return poisson_arrivals(spec.n, spec.rate_hz, spec.seed)
+    if spec.kind == "diurnal":
+        return diurnal_arrivals(spec.n, spec.rate_hz,
+                                spec.diurnal_period_s,
+                                spec.diurnal_amp, spec.seed)
+    if spec.kind == "flash":
+        return flash_crowd_arrivals(spec.n, spec.rate_hz, spec.flash_at_s,
+                                    spec.flash_dur_s, spec.flash_mult,
+                                    spec.seed)
+    return pareto_arrivals(spec.n, spec.rate_hz, spec.pareto_alpha,
+                           spec.pareto_cap_s, spec.seed)
+
+
+def make_trace(spec: TraceSpec) -> Trace:
+    """Realize a spec: arrivals + Zipf repetition ids, pure in the
+    spec (two calls with equal specs return bitwise-equal arrays)."""
+    return Trace(spec=spec, arrivals=trace_arrivals(spec),
+                 request_ids=zipf_request_ids(spec.n, spec.unique,
+                                              spec.zipf_s, spec.seed))
 
 
 class OpenLoopLoadGen:

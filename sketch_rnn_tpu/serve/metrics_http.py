@@ -188,14 +188,20 @@ def health_payload(tel: Telemetry,
     ``healthy`` bool (``ServeFleet.health``): ``status`` reports
     ``degraded`` when EITHER a tracked SLO is out of compliance or the
     health source says so (dead replicas, failed requests), with the
-    source's block included as evidence."""
+    source's block included as evidence. A healthy fleet mid-resize
+    (``scaling`` in the health block — an elastic retire still
+    draining, ISSUE 12) reports ``scaling`` instead of flapping
+    ok/degraded: an intentional topology change is not an incident."""
     degraded = slo is not None and not slo.healthy()
     extra = None
+    scaling = False
     if health is not None:
         extra = health() if callable(health) else dict(health)
         degraded = degraded or not extra.get("healthy", True)
+        scaling = bool(extra.get("scaling"))
     return {
-        "status": "degraded" if degraded else "ok",
+        "status": ("degraded" if degraded
+                   else "scaling" if scaling else "ok"),
         "telemetry_enabled": bool(tel.enabled),
         "dropped_events": tel.dropped,
         "uptime_s": round(time.perf_counter() - tel.origin_perf, 3),

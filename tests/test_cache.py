@@ -1,0 +1,323 @@
+"""Result-cache tests (ISSUE 12): content addressing, keyspace
+isolation, LRU determinism, bitwise hit parity and the trace-link
+contract.
+
+The load-bearing invariant mirrors the serving suite's: the cache
+changes WHERE bytes come from (host store vs device), never WHAT —
+a hit is the origin computation's strokes bitwise, keyed by request
+CONTENT only (scheduling metadata must never fragment the keyspace,
+and different checkpoints/configs must never collide).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.serve import Request, ResultCache, request_fingerprint
+from sketch_rnn_tpu.serve.cache import CacheEntry
+
+
+def _req(i: int, z_dim: int = 6, cap: int = 4, **kw) -> Request:
+    rng = np.random.default_rng(i)
+    return Request(key=jax.random.key(1000 + i),
+                   z=rng.standard_normal(z_dim).astype(np.float32),
+                   temperature=0.8, max_len=cap, **kw)
+
+
+def _entry(nbytes: int = 40, uid: int = 0) -> CacheEntry:
+    return CacheEntry(np.zeros((nbytes // 20, 5), np.float32),
+                      length=1, steps=1, origin_uid=uid)
+
+
+# -- content addressing ------------------------------------------------------
+
+
+def test_fingerprint_is_content_only():
+    """Scheduling metadata (uid, class, queue_pos, enqueue_ts, attempt)
+    changes WHEN a sketch is computed, never WHAT — it must not enter
+    the fingerprint."""
+    a = _req(0, uid=1)
+    b = dataclasses.replace(_req(0), uid=99, cls="interactive",
+                            queue_pos=7, enqueue_ts=123.0, attempt=2)
+    assert request_fingerprint(a) == request_fingerprint(b)
+
+
+def test_fingerprint_covers_every_content_field():
+    base = _req(0)
+    fp = request_fingerprint(base)
+    variants = [
+        dataclasses.replace(base, key=jax.random.key(2)),
+        dataclasses.replace(base, z=base.z + 1.0),
+        dataclasses.replace(base, z=None),
+        dataclasses.replace(base, label=3),
+        dataclasses.replace(base, temperature=0.9),
+        dataclasses.replace(base, max_len=5),
+    ]
+    fps = [request_fingerprint(v) for v in variants]
+    assert all(f != fp for f in fps)
+    assert len(set(fps)) == len(fps)
+
+
+def test_keyspace_isolation_across_checkpoint_and_config():
+    """ISSUE 12 acceptance: a different checkpoint or config_hash can
+    NEVER collide — the namespace is inside the hash."""
+    r = _req(0)
+    fps = {request_fingerprint(r, config_hash=c, ckpt_id=k)
+           for c in ("", "cfgA", "cfgB") for k in ("", "ck1", "ck2")}
+    assert len(fps) == 9
+    # and the namespace split is unambiguous (no concat collision)
+    assert (request_fingerprint(r, config_hash="ab", ckpt_id="c")
+            != request_fingerprint(r, config_hash="a", ckpt_id="bc"))
+
+
+# -- bounded LRU -------------------------------------------------------------
+
+
+def test_lru_eviction_order_is_deterministic():
+    def run():
+        cache = ResultCache(max_entries=3)
+        fps = [bytes([i]) for i in range(5)]
+        for i in range(4):
+            cache.put(fps[i], type("R", (), {
+                "strokes5": np.zeros((2, 5), np.float32),
+                "length": 2, "steps": 2, "uid": i})())
+        # 0 evicted (oldest); touching 1 makes 2 the next victim
+        assert cache.get(fps[0]) is None
+        assert cache.get(fps[1]) is not None
+        cache.put(fps[4], type("R", (), {
+            "strokes5": np.zeros((2, 5), np.float32),
+            "length": 2, "steps": 2, "uid": 4})())
+        return list(cache.keys()), cache.evictions
+
+    keys1, ev1 = run()
+    keys2, ev2 = run()
+    assert keys1 == keys2 == [bytes([3]), bytes([1]), bytes([4])]
+    assert ev1 == ev2 == 2
+
+
+def test_byte_bound_evicts_and_counts():
+    cache = ResultCache(max_bytes=100)
+    for i in range(4):  # 40B entries: the 3rd insert evicts the 1st
+        cache.put(bytes([i]), type("R", (), {
+            "strokes5": np.zeros((2, 5), np.float32),
+            "length": 2, "steps": 2, "uid": i})())
+    assert len(cache) == 2 and cache.bytes == 80
+    assert cache.evictions == 2
+    assert cache.stats()["bytes"] == 80
+
+
+def test_put_keeps_first_on_duplicate_fingerprint():
+    cache = ResultCache()
+    first = type("R", (), {"strokes5": np.ones((2, 5), np.float32),
+                           "length": 2, "steps": 2, "uid": 7})()
+    second = type("R", (), {"strokes5": np.zeros((2, 5), np.float32),
+                            "length": 2, "steps": 2, "uid": 8})()
+    cache.put(b"fp", first)
+    cache.put(b"fp", second)
+    entry = cache.get(b"fp")
+    assert entry.origin_uid == 7
+    np.testing.assert_array_equal(entry.strokes5, first.strokes5)
+
+
+def test_stats_hit_rate_counts_coalesced_as_served():
+    cache = ResultCache()
+    cache.put(b"a", type("R", (), {
+        "strokes5": np.zeros((2, 5), np.float32),
+        "length": 2, "steps": 2, "uid": 0})())
+    assert cache.get(b"a") is not None      # hit
+    assert cache.get(b"b") is None          # miss
+    cache.note_coalesced()                  # a repeat that coalesced
+    s = cache.stats()
+    assert s["hits"] == 1 and s["misses"] == 1 and s["coalesced"] == 1
+    assert s["lookups"] == 2
+    assert s["hit_rate"] == round(2 / 2, 4)  # (hits+coalesced)/lookups
+
+
+def test_bounds_validation_and_clear():
+    with pytest.raises(ValueError, match="bounds"):
+        ResultCache(max_entries=-1)
+    cache = ResultCache()
+    cache.put(b"a", type("R", (), {
+        "strokes5": np.zeros((2, 5), np.float32),
+        "length": 2, "steps": 2, "uid": 0})())
+    cache.get(b"a")
+    cache.clear()
+    s = cache.stats()
+    assert s["entries"] == s["hits"] == s["misses"] == 0
+    assert cache.bytes == 0
+
+
+def test_cache_counters_mirror_into_telemetry():
+    """The ledger-as-view discipline: the exact internal counters are
+    authoritative; an enabled core mirrors them as cat=serve counters
+    (which /metrics renders as sketch_rnn_serve_cache_* for free)."""
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    tel = tele.configure(trace_dir=None)
+    try:
+        cache = ResultCache(max_entries=1)
+        mk = lambda u: type("R", (), {  # noqa: E731
+            "strokes5": np.zeros((2, 5), np.float32),
+            "length": 2, "steps": 2, "uid": u})()
+        cache.put(b"a", mk(0))
+        cache.get(b"a")
+        cache.get(b"b")
+        cache.note_coalesced()
+        cache.put(b"b", mk(1))          # evicts a
+        counters = tel.counters()
+        assert counters[("serve", "cache_hit")] == 1
+        assert counters[("serve", "cache_miss")] == 1
+        assert counters[("serve", "cache_coalesced")] == 1
+        assert counters[("serve", "cache_evict")] == 1
+        # the gauge holds its latest sample in the counters store
+        assert counters[("serve", "cache_bytes")] == 40.0
+    finally:
+        tele.disable()
+
+
+# -- the live hit path (one tiny jax model) ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+
+    hps = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+                  dec_rnn_size=16, z_size=6, num_mixture=3,
+                  serve_slots=2, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    return hps, model, params
+
+
+def test_hit_is_bitwise_recomputation_and_zero_steps(tiny_setup):
+    """THE cache acceptance pin: a store hit and a coalesced repeat
+    both return the origin computation's strokes bitwise, marked
+    cached=True with zero attributed device steps — and a cache-less
+    recomputation of the same content produces the identical bytes."""
+    from sketch_rnn_tpu.serve import ServeFleet
+
+    hps, model, params = tiny_setup
+    cache = ResultCache(config_hash="cfg", ckpt_id="ck")
+    fleet = ServeFleet(model, hps, params, replicas=1, cache=cache)
+    try:
+        fleet.submit(dataclasses.replace(_req(7), uid=0))
+        fleet.submit(dataclasses.replace(_req(7), uid=1))  # coalesces
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        fleet.submit(dataclasses.replace(_req(7), uid=2))  # store hit
+        assert fleet.drain(timeout=120)
+        res = fleet.results
+        st = cache.stats()
+        steps_cached = fleet.summary()["total_device_steps"]
+    finally:
+        fleet.close()
+    # the coalesced repeat ticked a store miss before attaching (the
+    # documented stats semantics), so misses = primary + coalesced
+    assert st["hits"] == 1 and st["coalesced"] == 1 and st["misses"] == 2
+    assert st["hit_rate"] == round(2 / 3, 4)
+    for uid in (1, 2):
+        r = res[uid]["result"]
+        assert r.cached and r.attributed_steps == 0
+        np.testing.assert_array_equal(r.strokes5,
+                                      res[0]["result"].strokes5)
+        assert res[uid]["origin_uid"] == 0
+    assert not res[0]["result"].cached
+    # recomputation without a cache: identical bytes, more device work
+    fleet2 = ServeFleet(model, hps, params, replicas=1)
+    try:
+        for uid in range(3):
+            fleet2.submit(dataclasses.replace(_req(7), uid=uid))
+        fleet2.start()
+        assert fleet2.drain(timeout=120)
+        for uid in range(3):
+            np.testing.assert_array_equal(
+                fleet2.results[uid]["result"].strokes5,
+                res[uid]["result"].strokes5)
+        assert fleet2.summary()["total_device_steps"] > steps_cached
+    finally:
+        fleet2.close()
+
+
+def test_cached_request_carries_trace_link_to_origin(tiny_setup):
+    """ISSUE 12 trace contract: a cached request's tree is fresh (its
+    own trace id, a root span over its own clock) and its cache_hit
+    instant names the ORIGIN computation's uid and trace id."""
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.utils import telemetry as tele
+    from sketch_rnn_tpu.utils.telemetry import request_trace_id
+
+    hps, model, params = tiny_setup
+    cache = ResultCache()
+    fleet = ServeFleet(model, hps, params, replicas=1, cache=cache)
+    tel = tele.configure(trace_dir=None)
+    try:
+        fleet.submit(dataclasses.replace(_req(3), uid=0))
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        fleet.submit(dataclasses.replace(_req(3), uid=1))  # store hit
+        assert fleet.drain(timeout=120)
+        evs = tel.events()
+    finally:
+        fleet.close()
+        tele.disable()
+    hits = [e for e in evs if e.get("name") == "cache_hit"
+            and e.get("type") == "instant"]  # not the mirrored counter
+    assert len(hits) == 1
+    hit = hits[0]
+    assert hit["args"]["uid"] == 1
+    assert hit["args"]["origin_uid"] == 0
+    assert hit["args"]["origin_trace"] == request_trace_id(0)
+    # the hit rides the CACHED request's own (fresh) trace tree
+    assert hit["trace"]["id"] == request_trace_id(1)
+    roots = [e for e in evs if e.get("name") == "request"
+             and e.get("trace", {}).get("id") == request_trace_id(1)]
+    assert len(roots) == 1 and roots[0]["args"]["cached"] is True
+    # and the cached complete event reports zero attributed steps
+    comp = [e for e in evs if e.get("name") == "complete"
+            and e["args"]["uid"] == 1]
+    assert comp[0]["args"]["cached"] is True
+    assert comp[0]["args"]["attributed_steps"] == 0
+
+
+def test_failed_primary_fails_coalesced_waiters(tiny_setup):
+    """A coalesced repeat whose primary exhausts its retry budget must
+    land in `failed` WITH it (drain completes and reports honestly),
+    never wait forever on a fill that cannot come."""
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.utils import faults
+
+    hps, model, params = tiny_setup
+    cache = ResultCache()
+    faults.configure("fleet.worker.r0@0")
+    try:
+        fleet = ServeFleet(model, hps, params, replicas=2,
+                           retry_budget=0, retry_backoff_s=0.0,
+                           cache=cache)
+        # two contents, one repeat each — one primary lands on the
+        # doomed replica 0, and its waiter must fail with it
+        for uid, content in ((0, 5), (1, 6), (2, 5), (3, 6)):
+            fleet.submit(dataclasses.replace(_req(content), uid=uid))
+        with fleet:
+            assert fleet.drain(timeout=120)
+            failed = fleet.failed
+            results = fleet.results
+    finally:
+        faults.disable()
+    assert set(failed) | set(results) == {0, 1, 2, 3}
+    assert failed  # replica 0's primary (and its waiter) died
+    waiter_reasons = [rec["reason"] for rec in failed.values()
+                     if "coalesced onto failed" in rec["reason"]]
+    primary_reasons = [rec["reason"] for rec in failed.values()
+                      if "retry budget" in rec["reason"]]
+    assert len(waiter_reasons) == len(primary_reasons)
+    # completed repeats (the surviving replica's pair) stayed bitwise
+    for uid, rec in results.items():
+        if rec.get("cached"):
+            origin = rec["origin_uid"]
+            np.testing.assert_array_equal(
+                rec["result"].strokes5,
+                results[origin]["result"].strokes5)

@@ -617,3 +617,87 @@ def test_warm_under_enabled_telemetry_emits_no_request_spans(
             fleet.results[0]["result"].steps
     finally:
         tele.disable()
+
+
+# -- elastic scaling telemetry (ISSUE 12) ------------------------------------
+
+
+def test_elastic_actions_emit_spans_counters_and_gauge(tiny_fleet_setup):
+    """The scale timeline is observable: spawn/retire tick counters,
+    ride lifecycle spans, and move the fleet_replicas gauge (what
+    /metrics renders as sketch_rnn_serve_fleet_replicas)."""
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    hps, model, params = tiny_fleet_setup
+    tel = tele.configure(trace_dir=None)
+    try:
+        fleet = ServeFleet(model, hps, params, replicas=1,
+                           max_replicas=2)
+        fleet.start()
+        fleet.add_replica(reason="load")
+        fleet.submit(_req(0, hps.z_size))
+        assert fleet.drain(timeout=120)
+        fleet.retire_replica(reason="quiet")
+        deadline = time.time() + 10      # retire drains asynchronously
+        while fleet.health()["scaling"] and time.time() < deadline:
+            time.sleep(0.01)
+        fleet.close()
+        counters = tel.counters()
+        events = tel.events()
+    finally:
+        tele.disable()
+    assert counters[("serve", "replica_spawns")] == 1
+    assert counters[("serve", "replica_retires")] == 1
+    assert counters[("serve", "fleet_replicas")] == 1  # gauge: latest
+    spawn = [e for e in events if e.get("name") == "replica_spawn"]
+    retire = [e for e in events if e.get("name") == "replica_retire"]
+    assert len(spawn) == 1 and spawn[0]["args"]["replica"] == 1
+    assert spawn[0]["args"]["reason"] == "load"
+    assert len(retire) == 1 and retire[0]["args"]["replica"] == 1
+
+
+def test_last_live_replica_death_rejoins_retired_spare(tiny_fleet_setup):
+    """ISSUE 12 x PR 10 composition pin: the ONLY placed replica dies
+    while a pre-warmed retired spare exists — the fleet self-heals by
+    rejoining the spare (the spawn path, recorded in scale_log) and
+    fails the stranded requests over to it: drain() completes, strokes
+    stay bitwise, and a later scale-up clamps to the SURVIVING build
+    (a dead replica can never rejoin) instead of raising."""
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.utils import faults
+
+    hps, model, params = tiny_fleet_setup
+    n = 4
+
+    def run(plan, **kw):
+        if plan:
+            faults.configure(plan)
+        try:
+            fleet = ServeFleet(model, hps, params, replicas=1,
+                               retry_backoff_s=0.0, **kw)
+            for i in range(n):
+                fleet.submit(_req(i, hps.z_size))
+            with fleet:
+                assert fleet.drain(timeout=120)
+                # scaling up post-crash tops out at the living build
+                acts = fleet.set_target_replicas(2)
+                return (fleet.results, fleet.summary(), acts)
+        finally:
+            faults.disable()
+
+    res0, _, _ = run(None)
+    res1, s1, acts = run("fleet.worker.r0@0", max_replicas=2)
+    assert s1["completed"] == n and s1["failed"] == 0
+    assert s1["replicas_dead"] == 1
+    # every request failed over to the rejoined spare
+    assert all(rec["replica"] == 1 for rec in res1.values())
+    heal = [e for e in s1["scale_log"] if e["action"] == "spawn"]
+    assert len(heal) == 1 and heal[0]["replica"] == 1
+    assert "failover" in heal[0]["reason"]
+    # bitwise: the self-healed run matches the no-fault run
+    for uid in range(n):
+        np.testing.assert_array_equal(res1[uid]["result"].strokes5,
+                                      res0[uid]["result"].strokes5)
+    # the clamp: target 2 > the 1 surviving replica -> no action
+    assert acts == [] and s1["replicas_live"] == 1

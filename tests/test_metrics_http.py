@@ -401,3 +401,51 @@ def test_render_prometheus_cost_counters_close_identity():
         s["sketch_rnn_serve_device_steps_attributed_interactive_total"],
         s["sketch_rnn_serve_device_steps_attributed_batch_total"])
     assert sum(per_class) == attr == 36
+
+
+def test_render_prometheus_cache_and_fleet_replica_series():
+    """ISSUE 12 satellite: the result cache's hit/miss/evict/byte
+    counters and the current replica count ride the existing
+    counter/gauge exposition for free — tick a ResultCache under an
+    enabled core and the sketch_rnn_serve_cache_* series (and the
+    fleet_replicas gauge) appear on /metrics."""
+    import numpy as np
+
+    from sketch_rnn_tpu.serve import ResultCache
+
+    tel = tele.configure(trace_dir=None)
+    try:
+        cache = ResultCache(max_entries=1)
+        mk = lambda u: type("R", (), {  # noqa: E731
+            "strokes5": np.zeros((2, 5), np.float32),
+            "length": 2, "steps": 2, "uid": u})()
+        cache.put(b"a", mk(0))
+        cache.get(b"a")          # hit
+        cache.get(b"b")          # miss
+        cache.put(b"b", mk(1))   # evicts a
+        tel.gauge("fleet_replicas", 3, cat="serve")
+        text = render_prometheus(tel)
+    finally:
+        tele.disable()
+    s = _series(text)
+    assert s["sketch_rnn_serve_cache_hit_total"] == 1
+    assert s["sketch_rnn_serve_cache_miss_total"] == 1
+    assert s["sketch_rnn_serve_cache_evict_total"] == 1
+    assert s["sketch_rnn_serve_cache_bytes"] == 40
+    assert "# TYPE sketch_rnn_serve_cache_bytes gauge" in text
+    assert s["sketch_rnn_serve_fleet_replicas"] == 3
+    assert "# TYPE sketch_rnn_serve_fleet_replicas gauge" in text
+
+
+def test_healthz_reports_scaling_during_resize_not_degraded():
+    """ISSUE 12 satellite: an in-flight elastic resize is intentional —
+    /healthz must report `scaling`, not flap ok/degraded; a genuinely
+    degraded fleet still wins over `scaling`."""
+    tel = tele.get_telemetry()
+    ok = {"healthy": True, "scaling": False}
+    mid = {"healthy": True, "scaling": True}
+    bad = {"healthy": False, "scaling": True}
+    assert health_payload(tel, None, lambda: ok)["status"] == "ok"
+    assert health_payload(tel, None, lambda: mid)["status"] == "scaling"
+    # degradation outranks an in-flight resize
+    assert health_payload(tel, None, lambda: bad)["status"] == "degraded"

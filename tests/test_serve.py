@@ -416,3 +416,259 @@ def test_complete_events_carry_exact_segments_and_cost(cond_setup):
                  if e.get("trace", {}).get("id") == f"req-{uid}"}
         assert {"enqueue", "admit", "request", "queue_wait",
                 "decode", "complete"} <= names
+
+
+# -- traffic shaping (ISSUE 12): trace replay, autoscaler, elasticity --------
+
+
+def test_trace_replay_deterministic_in_seed():
+    """ISSUE 12 acceptance: the same trace seed produces the IDENTICAL
+    arrival schedule and repetition mapping, for every trace kind."""
+    from sketch_rnn_tpu.serve import TraceSpec, make_trace
+
+    for kind in ("poisson", "diurnal", "flash", "pareto"):
+        spec = TraceSpec(kind=kind, n=128, rate_hz=200.0, seed=11,
+                         unique=32)
+        a, b = make_trace(spec), make_trace(spec)
+        np.testing.assert_array_equal(a.arrivals, b.arrivals)
+        np.testing.assert_array_equal(a.request_ids, b.request_ids)
+        assert np.all(np.diff(a.arrivals) >= 0), kind
+        assert a.request_ids.max() < 32 and a.request_ids.min() >= 0
+        assert a.distinct() == len(np.unique(a.request_ids))
+        other = make_trace(dataclasses.replace(spec, seed=12))
+        assert not np.array_equal(a.arrivals, other.arrivals), kind
+    # unique=0 (or >= n) means all-distinct: a cache sees zero repeats
+    t = make_trace(TraceSpec(kind="poisson", n=16, rate_hz=50.0,
+                             seed=0, unique=0))
+    np.testing.assert_array_equal(t.request_ids, np.arange(16))
+
+
+def test_trace_spec_validation():
+    from sketch_rnn_tpu.serve import TraceSpec
+
+    with pytest.raises(ValueError, match="unknown trace kind"):
+        TraceSpec(kind="nope")
+    with pytest.raises(ValueError, match="rate_hz"):
+        TraceSpec(rate_hz=0.0)
+    with pytest.raises(ValueError, match="diurnal_amp"):
+        TraceSpec(kind="diurnal", diurnal_amp=1.5)
+    with pytest.raises(ValueError, match="flash_mult"):
+        TraceSpec(kind="flash", flash_mult=0.5)
+
+
+def test_autoscaler_rule_up_cooldown_down():
+    """The error-budget ladder: hot -> up, refractory cooldown, a
+    quiet streak -> down, bounds always respected."""
+    from sketch_rnn_tpu.serve import (AutoscalePolicy, Autoscaler,
+                                      AutoscaleSignals)
+
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=3, up_wait_s=1.0,
+                          down_wait_s=0.2, down_epochs=2,
+                          cooldown_epochs=1)
+    sc = Autoscaler(pol)
+    hot = AutoscaleSignals(est_wait_s=5.0)
+    quiet = AutoscaleSignals(est_wait_s=0.01)
+    assert (sc.decide(hot).action, sc.replicas) == ("up", 2)
+    # cooldown holds even under heat
+    assert sc.decide(hot).action == "hold"
+    assert sc.decide(hot).action == "up" and sc.replicas == 3
+    # at max: hot can only hold
+    sc.decide(hot)  # cooldown
+    assert sc.decide(hot).action == "hold" and sc.replicas == 3
+    # two quiet epochs retire one step
+    assert sc.decide(quiet).action == "hold"
+    d = sc.decide(quiet)
+    assert d.action == "down" and d.target == 2
+    # burn rate alone also triggers scale-up
+    sc2 = Autoscaler(AutoscalePolicy(max_replicas=2, up_burn=1.0,
+                                     cooldown_epochs=0))
+    assert sc2.decide(AutoscaleSignals(est_wait_s=None,
+                                       burn_rate=2.0)).action == "up"
+    # a cold fleet (no signals at all) never scales — in EITHER
+    # direction: a scaled-up fleet with est_wait=None (no service
+    # estimate yet) must not count the signal gap as quiet and retire
+    # capacity on zero evidence
+    sc3 = Autoscaler(AutoscalePolicy(max_replicas=2))
+    assert sc3.decide(AutoscaleSignals()).action == "hold"
+    sc4 = Autoscaler(AutoscalePolicy(max_replicas=3, down_epochs=1,
+                                     cooldown_epochs=0), replicas=3)
+    for _ in range(5):
+        assert sc4.decide(AutoscaleSignals()).action == "hold"
+    assert sc4.replicas == 3
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+
+def test_scale_plan_reproducible_from_trace_seed():
+    """ISSUE 12 acceptance: the whole decision sequence is a pure
+    function of (trace seed, policy) — two independent realizations
+    agree decision-for-decision, and the fluid simulator's shed masks
+    and modeled waits are bitwise too."""
+    from sketch_rnn_tpu.serve import (AutoscalePolicy, TraceSpec,
+                                      make_trace, plan_decisions,
+                                      simulate_traffic)
+
+    spec = TraceSpec(kind="flash", n=96, rate_hz=150.0, seed=5,
+                     flash_at_s=0.1, flash_dur_s=0.2, flash_mult=6.0,
+                     unique=24)
+    pol = AutoscalePolicy(max_replicas=4, up_wait_s=0.1,
+                          down_wait_s=0.03, epoch_s=0.04,
+                          rate_hint_steps_per_s=900.0)
+    work = np.full(24, 6.0)
+    runs = []
+    for _ in range(2):
+        tr = make_trace(spec)
+        plan = plan_decisions(tr.arrivals, work[tr.request_ids], pol)
+        sim = simulate_traffic(tr.arrivals, tr.request_ids, work, pol,
+                               cache=False, autoscale=True,
+                               shed_wait_s=0.2)
+        runs.append((plan, sim))
+    (p1, s1), (p2, s2) = runs
+    assert p1 == p2
+    assert s1["decisions"] == s2["decisions"]
+    np.testing.assert_array_equal(s1["admitted"], s2["admitted"])
+    np.testing.assert_array_equal(s1["wait_s"], s2["wait_s"])
+    assert any(d.action == "up" for d in p1)  # the flash actually bit
+    # the autoscaled arm sheds strictly less than the fixed fleet
+    fixed = simulate_traffic(make_trace(spec).arrivals,
+                             make_trace(spec).request_ids, work, pol,
+                             cache=False, autoscale=False,
+                             shed_wait_s=0.2)
+    assert fixed["shed_frac"] > s1["shed_frac"]
+    # and a cache arm saves device steps deterministically
+    cached = simulate_traffic(make_trace(spec).arrivals,
+                              make_trace(spec).request_ids, work, pol,
+                              cache=True, autoscale=False,
+                              shed_wait_s=0.2)
+    assert cached["device_steps"] < fixed["device_steps"]
+    assert cached["hit_frac"] > 0
+
+
+def test_strokes_bitwise_independent_of_midrun_resizes():
+    """ISSUE 12 acceptance pin, extending the placement-invariance
+    suite: a fleet that spawns and retires replicas MID-RUN still
+    produces bitwise-identical strokes — elasticity changes WHERE a
+    request runs, never WHAT it returns. Also pins the scale_log
+    lifecycle record and the health surface's `scaling` phase."""
+    from sketch_rnn_tpu.serve import ServeFleet
+    from sketch_rnn_tpu.serve.metrics_http import health_payload
+    from sketch_rnn_tpu.utils.telemetry import get_telemetry
+
+    hps = tiny_hps(serve_slots=2, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    reqs = [_req(i, hps.z_size, cap=3 + (5 * i) % 9) for i in range(12)]
+    eng = ServeEngine(model, hps, params)
+    ref = _by_uid(eng.run([dataclasses.replace(r, uid=i)
+                           for i, r in enumerate(reqs)]))
+
+    fleet = ServeFleet(model, hps, params, replicas=1, max_replicas=3)
+    try:
+        assert fleet.n_live == 1 and fleet.n_replicas == 3
+        fleet.start()
+        for i in range(4):
+            fleet.submit(dataclasses.replace(reqs[i], uid=i))
+        fleet.add_replica(reason="test")
+        fleet.add_replica(reason="test")
+        assert fleet.n_live == 3
+        for i in range(4, 8):
+            fleet.submit(dataclasses.replace(reqs[i], uid=i))
+        assert fleet.drain(timeout=120)
+        fleet.retire_replica(reason="test")
+        assert fleet.n_live == 2
+        for i in range(8, 12):
+            fleet.submit(dataclasses.replace(reqs[i], uid=i))
+        assert fleet.drain(timeout=120)
+        s = fleet.summary()
+        got = fleet.results
+        health = fleet.health()
+    finally:
+        fleet.close()
+    assert s["completed"] == 12
+    for uid, r in ref.items():
+        np.testing.assert_array_equal(
+            got[uid]["result"].strokes5, r.strokes5,
+            err_msg=f"uid {uid} diverged under mid-run resizes")
+    # the lifecycle record: every action landed, n_live tracked
+    assert [(e["action"], e["n_live"]) for e in s["scale_log"]] == [
+        ("spawn", 2), ("spawn", 3), ("retire", 2)]
+    assert s["replicas_live"] == 2 and s["replicas_retired"] == 1
+    # a drained retire is done scaling: /healthz is ok, not degraded
+    assert health["healthy"] and not health["scaling"]
+    assert health_payload(get_telemetry(), None,
+                          lambda: health)["status"] == "ok"
+    # an in-flight resize reports `scaling` (not ok/degraded flapping)
+    mid = dict(health, scaling=True)
+    assert health_payload(get_telemetry(), None,
+                          lambda: mid)["status"] == "scaling"
+
+
+def test_elastic_lifecycle_guards():
+    """add/retire validation: no headroom -> actionable error; the
+    last live replica is irremovable; set_target clamps to what was
+    built."""
+    from sketch_rnn_tpu.serve import ServeFleet
+
+    hps = tiny_hps(serve_slots=2, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    fleet = ServeFleet(model, hps, params, replicas=1, max_replicas=2)
+    try:
+        with pytest.raises(RuntimeError, match="last live replica"):
+            fleet.retire_replica()
+        fleet.add_replica()
+        with pytest.raises(RuntimeError, match="no retired replica"):
+            fleet.add_replica()
+        # set_target walks and clamps; scale_log records each action
+        actions = fleet.set_target_replicas(99)
+        assert actions == [] and fleet.n_live == 2
+        actions = fleet.set_target_replicas(1)
+        assert [a["action"] for a in actions] == ["retire"]
+        assert fleet.n_live == 1
+    finally:
+        fleet.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        fleet.add_replica()
+
+
+def test_fleet_signals_extracts_live_measurements():
+    """The live integration path: fleet_signals pulls the WORST tracked
+    SLO's window burn (infinite burns capped so the controller still
+    acts) plus admission's least-loaded estimated wait into the same
+    signal shape the deterministic planner feeds."""
+    import math
+
+    from sketch_rnn_tpu.serve import fleet_signals
+    from sketch_rnn_tpu.serve.admission import (AdmissionController,
+                                                parse_admission_classes)
+    from sketch_rnn_tpu.serve.slo import SLOTracker, parse_slo
+
+    classes = parse_admission_classes(["interactive:p95<=0.5"])
+    adm = AdmissionController(classes, n_replicas=2, slots=2)
+    # cold: no completions -> est_wait is None, burn 0 on an empty SLO
+    trk = SLOTracker([parse_slo("interactive:latency_s:p50<=0.1")])
+    sig = fleet_signals(trk, adm, n_live=2)
+    assert sig.est_wait_s is None and sig.burn_rate == 0.0
+    assert sig.backlog == 0 and sig.n_live == 2
+    # load + a calibrated estimate: least-loaded wait, summed backlog
+    for _ in range(4):
+        adm.place("interactive")
+    adm.note_done(0, decode_s=0.2)     # replica 0: backlog 1, r1: 2
+    sig = fleet_signals(trk, adm, n_live=2)
+    assert sig.backlog == 3
+    assert sig.est_wait_s == pytest.approx(min(
+        adm.est_wait_s(0), adm.est_wait_s(1)))
+    # breaches: the worst SLO's window burn feeds through
+    for lat in (0.2, 0.3, 0.4, 0.5):
+        trk.observe("interactive", {"latency_s": lat})
+    worst = max(rec["burn_rate"] for rec in trk.summary().values())
+    assert math.isfinite(worst)
+    assert fleet_signals(trk, adm, n_live=2).burn_rate == worst
+    # an infinite burn (p100-style zero budget) is capped, not NaN'd
+    trk2 = SLOTracker([parse_slo("interactive:latency_s:p100<=0.1")])
+    trk2.observe("interactive", {"latency_s": 0.5})
+    assert fleet_signals(trk2, adm, n_live=1).burn_rate == 1e9
+    # retired replicas are excluded from the wait signal entirely
+    adm.retire(1)
+    assert fleet_signals(None, adm, n_live=1).est_wait_s == \
+        pytest.approx(adm.est_wait_s(0))

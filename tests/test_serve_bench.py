@@ -116,3 +116,89 @@ def test_serve_bench_end_to_end_small(tmp_path, capsys, dist):
     # shrunken scale (the full smoke config measures ~2.7)
     assert rec["device_step_ratio"] > 1.3
     assert 0 < rec["engine_slot_utilization"] <= 1
+
+
+def test_serve_bench_traffic_end_to_end_small(tmp_path):
+    """A shrunken traffic grid (ISSUE 12): all four cached-vs-uncached
+    x fixed-vs-autoscaled arms run in-process, the parity block holds
+    (cache hits bitwise == recomputation, strokes invariant under
+    mid-run resizes, fixed arms deterministic across replays), the
+    modeled curves land per (rate, cache, autoscale) cell, the grid's
+    serve_cache/serve_autoscale rows stream to the hermetic smoke
+    history, the scale-decision timeline is reproducible from the
+    trace seed and lands in RUN.json, and the existing records in
+    --out are preserved."""
+    out = tmp_path / "SB.json"
+    out.write_text(json.dumps(
+        {"kind": "serve_bench", "engine_sketches_per_sec": 123.0,
+         "fleet": {"kind": "serve_fleet"}}))
+    rc = serve_bench.main([
+        "--traffic", "--smoke", "--slots", "4", "--chunk", "2",
+        "--requests", "96", "--unique", "24", "--min_len", "2",
+        "--max_len", "10", "--rate_mults", "1,2",
+        "--out", str(out), "--manifest_dir", str(tmp_path)])
+    assert rc == 0
+    doc = json.load(open(out))
+    # pre-existing records survived the merge
+    assert doc["engine_sketches_per_sec"] == 123.0
+    assert doc["fleet"]["kind"] == "serve_fleet"
+    t = doc["traffic"]
+    assert t["kind"] == "serve_traffic" and t["smoke"] is True
+    assert t["trace"] == "flash" and t["distinct"] <= 24
+    # the parity block: every deterministic acceptance signal held
+    # (a failure would also have raised after streaming the rows)
+    p = t["parity"]
+    assert p["cache_bitwise"] and p["resize_invariant"]
+    assert p["fixed_arm_deterministic"] and not p["failures"]
+    assert p["steps_saved_fixed"] > 0
+    assert p["steps_saved_autoscaled"] > 0
+    assert t["plan_reproducible"] is True
+    # modeled curves: one row per (rate_mult, cache, autoscale) cell
+    cells = {(c["rate_mult"], c["cache"], c["autoscale"])
+             for c in t["curves"]}
+    assert cells == {(m, c, a) for m in (1.0, 2.0)
+                     for c in (False, True) for a in (False, True)}
+    # the flash-crowd acceptance: autoscaled shed strictly below the
+    # fixed fleet's on the uncached base-rate pair
+    base = {(c["cache"], c["autoscale"]): c for c in t["curves"]
+            if c["rate_mult"] == 1.0}
+    assert (base[(False, True)]["shed_frac"]
+            < base[(False, False)]["shed_frac"])
+    assert base[(False, True)]["fleet_size_max"] > 1
+    # cache-on arms: strictly fewer device steps at equal completion
+    n = t["n_requests"]
+    meas = {(m["cache"], m["autoscale"]): m for m in t["measured"]}
+    assert all(m["completed"] == n for m in t["measured"])
+    for auto in (False, True):
+        assert (meas[(True, auto)]["device_steps"]
+                < meas[(False, auto)]["device_steps"])
+        # hit rate is exact scheduling math: (n - distinct) / n
+        assert meas[(True, auto)]["hit_rate"] == round(
+            (n - t["distinct"]) / n, 4)
+    # the autoscaled arm really resized and realized its plan
+    auto_arm = meas[(False, True)]
+    assert auto_arm["scale_log"]
+    assert auto_arm["planned_actions"]
+    # history rows: one serve_cache per autoscale arm, one
+    # serve_autoscale per cache arm, all ok (the bench_regress gate's
+    # binary signal), routed to the hermetic smoke history
+    hist = tmp_path / "BENCH_SMOKE_HISTORY.jsonl"
+    rows = [r for r in map(json.loads, open(hist))]
+    cache_rows = [r for r in rows if r.get("kind") == "serve_cache"]
+    scale_rows = [r for r in rows if r.get("kind") == "serve_autoscale"]
+    assert {r["autoscale"] for r in cache_rows} == {False, True}
+    assert {r["cache"] for r in scale_rows} == {False, True}
+    for r in cache_rows:
+        assert r["ok"] is True and r["steps_saved"] > 0
+    for r in scale_rows:
+        assert r["ok"] is True and r["plan_reproducible"] is True
+    # RUN.json records the scale-decision timeline (ISSUE 12 contract)
+    man = json.load(open(tmp_path / "RUN.json"))
+    assert man["kind"] == "serve_traffic"
+    tm = man["traffic"]
+    assert tm["plan_reproducible"] is True
+    assert tm["actions"] and tm["fleet_size_by_epoch"]
+    assert max(tm["fleet_size_by_epoch"]) > 1
+    assert tm["max_replicas_reached"] > 1
+    assert [a["action"] for a in tm["actions"]].count("up") > 0
+    assert tm["n_actions"] == len(tm["actions"])
