@@ -135,8 +135,17 @@ def _run_stamp() -> dict:
 def _hist_append(record: dict) -> dict:
     """Stamp, route, append; returns the stamped record so streaming
     emitters print the SAME row the history holds (a captured stdout
-    log may be the only surviving record — it must carry wall_time)."""
-    record = {"wall_time": time.time(), **_run_stamp(), **record}
+    log may be the only surviving record — it must carry wall_time).
+
+    ``wall_time`` is the run-manifest clock (runinfo.run_wall_time):
+    ONE stamp per invocation, shared by every row the run emits and by
+    its RUN.json — committed history rows then diff cleanly across
+    re-runs instead of churning a fresh time.time() per row (ISSUE 14
+    satellite)."""
+    from sketch_rnn_tpu.utils import runinfo
+
+    record = {"wall_time": runinfo.run_wall_time(), **_run_stamp(),
+              **record}
     path = _smoke_hist_path() if _is_smoke_record(record) else _hist_path()
     with open(path, "a") as f:
         f.write(json.dumps(record) + "\n")

@@ -32,9 +32,11 @@ def drain(out) -> float:
     return float(jnp.ravel(leaf)[0])
 
 
-def hist_append(record: dict) -> None:
-    """Append ``record`` to the repo's bench history. Routing is
-    bench.py's: smoke/CPU rows (``smoke: true`` or ``device_kind ==
-    "cpu"``) land in BENCH_SMOKE_HISTORY.jsonl, accelerator rows in the
-    canonical BENCH_HISTORY.jsonl."""
-    bench._hist_append(record)
+def hist_append(record: dict) -> dict:
+    """Append ``record`` to the repo's bench history; returns the
+    stamped row (wall_time = the run-manifest clock, run_id, topology)
+    so streaming emitters print exactly what the history holds.
+    Routing is bench.py's: smoke/CPU rows (``smoke: true`` or
+    ``device_kind == "cpu"``) land in BENCH_SMOKE_HISTORY.jsonl,
+    accelerator rows in the canonical BENCH_HISTORY.jsonl."""
+    return bench._hist_append(record)

@@ -37,6 +37,23 @@ and proves, per cell, that recovery happened the way the code claims:
   train.step@S:kind=exit`` — ``os._exit``, no finally blocks, the
   honest kill -9 — resumed by a second cli invocation; final
   checkpoint bytes equal the uninterrupted subprocess run's.
+- ``host.kill`` elastic (ISSUE 14) ..... **recovered**: a 2-host
+  elastic BUCKETED fleet (two real ``cli train --elastic_hosts 2``
+  subprocesses, light mode — no accelerator tunnel) loses host 1 to
+  ``host.kill.h1@S:kind=exit`` (``os._exit`` at the step barrier: the
+  heartbeat stops, the honest host death). Host 0 detects the death,
+  commits a CONSISTENT checkpoint at the death step, rewrites
+  RUN.json with the surviving topology, relaunches at 1 host with the
+  re-striped coordinated loader, and its final checkpoint bytes must
+  equal an uninterrupted 1-host run's — with recovery cost ZERO
+  device steps (the survivors checkpoint their live state; only the
+  host-side fast-forward replay is paid).
+
+``wall_time`` on every history row and in RESILIENCE.json is the
+run-manifest clock (``runinfo.run_wall_time`` — one stamp per
+invocation, shared with RUN.json), never a per-row ``time.time()``:
+committed smoke rows then diff cleanly across re-runs (ISSUE 14
+satellite).
 
 Recovery costs are DETERMINISTIC signals — device steps replayed
 (``lost_steps = halt_step - resumed_from``), retries used, requests
@@ -49,8 +66,8 @@ Writes RESILIENCE.json (``--out``) and appends one ``kind:
 BENCH_SMOKE_HISTORY.jsonl), which ``scripts/bench_regress.py`` gates —
 a future PR that breaks a recovery path flips that cell's ``ok`` to
 false and the gate exits nonzero. ``--smoke`` (wired into tier-1) runs
-the in-process cells only; the default adds the subprocess hard-kill
-cell.
+the in-process cells plus the two-subprocess elastic host-kill cell;
+the default adds the ``train.step`` subprocess hard-kill cell.
 """
 
 from __future__ import annotations
@@ -61,7 +78,6 @@ import os
 import subprocess
 import sys
 import tempfile
-import time
 
 import numpy as np
 
@@ -341,6 +357,97 @@ def cell_fleet_failover(hps, tmp, n_requests=6):
     }
 
 
+def cell_host_kill(tmp, kill_at=10):
+    """THE elastic chaos cell (ISSUE 14): kill one host of a 2-host
+    bucketed elastic fleet mid-run via two REAL subprocesses; the
+    survivor must recover to a final state leaf-bitwise equal to an
+    uninterrupted run at the surviving topology. Light mode (no jax
+    cluster): each host runs the identical global program over the
+    coordinated loader, so state is replicated and the comparison is
+    exact — see train/elastic.py."""
+    # bucketed: the cell exercises the lifted data/loader.py guard —
+    # host-striped bucketed execution under the coordinated plan
+    hp = hps_cli_string() + ",bucket_edges=12"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+    def cli_cmd(workdir, rdv, host_id, hosts, *extra):
+        return [sys.executable, "-m", "sketch_rnn_tpu.cli", "train",
+                "--synthetic", f"--workdir={workdir}",
+                f"--hparams={hp}", f"--seed={SEED}", "--no_resume",
+                f"--elastic_hosts={hosts}",
+                f"--elastic_host_id={host_id}",
+                f"--rendezvous={rdv}",
+                "--heartbeat_interval=0.1", "--stale_after=1.5",
+                *extra]
+
+    from sketch_rnn_tpu.train.checkpoint import _paths, latest_checkpoint
+    from sketch_rnn_tpu.utils.faults import EXIT_CODE
+    from sketch_rnn_tpu.utils.runinfo import read_manifest
+
+    base_d = os.path.join(tmp, "ek_base")
+    crash_d = os.path.join(tmp, "ek_crash")
+    # uninterrupted arm at the SURVIVING topology (1 host), through the
+    # identical elastic entry point
+    p_base = subprocess.run(
+        cli_cmd(base_d, os.path.join(tmp, "ek_base_rdv"), 0, 1),
+        env=env, capture_output=True, text=True, timeout=600)
+    # chaos arm: 2 hosts, host 1 armed to hard-exit at step-barrier 10
+    procs = [subprocess.Popen(
+        cli_cmd(crash_d, os.path.join(tmp, "ek_crash_rdv"), h, 2,
+                *([f"--fault_plan=host.kill.h1@{kill_at}:kind=exit"]
+                  if h == 1 else [])),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for h in range(2)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    hard_killed = procs[1].returncode == EXIT_CODE
+    survived = procs[0].returncode == 0
+
+    man = read_manifest(crash_d) or {}
+    elastic = man.get("elastic") or {}
+    events = elastic.get("events") or []
+    detected_at = events[0].get("at_step") if events else None
+    resumed_from = events[0].get("resumed_from") if events else None
+    final = latest_checkpoint(base_d)
+    equal = False
+    if p_base.returncode == 0 and survived and final:
+        a = open(_paths(base_d, final)[0], "rb").read()
+        b_path = _paths(crash_d, final)[0]
+        equal = os.path.exists(b_path) and a == open(b_path, "rb").read()
+    topo_ok = (elastic.get("hosts") == [0]
+               and events and events[0].get("dead") == [1])
+    cost = (detected_at - resumed_from
+            if detected_at is not None and resumed_from is not None
+            else None)
+    ok = (p_base.returncode == 0 and hard_killed and survived
+          and equal and topo_ok and cost == 0)
+    return {
+        "site": "host.kill", "plan": f"host.kill.h1@{kill_at}:kind=exit",
+        "mode": "elastic", "expected": "recovered",
+        "outcome": "recovered" if ok else "FAILED",
+        "ok": ok, "hard_killed": hard_killed,
+        "survivor_completed": survived,
+        "exit_codes": [p.returncode for p in procs],
+        "killed_at_step": kill_at, "detected_at_step": detected_at,
+        "resumed_from_step": resumed_from,
+        # the elastic contract: survivors checkpoint their LIVE state
+        # at the death step, so zero device steps are re-executed; the
+        # only recovery work is the host-side fast-forward replay
+        "lost_steps": cost, "recovery_cost_steps": cost,
+        "fast_forward_batches": resumed_from,
+        "final_ckpt_bytes_equal": equal,
+        "run_manifest_topology": {"hosts": elastic.get("hosts"),
+                                  "generation":
+                                      elastic.get("generation"),
+                                  "events": events},
+        "stderr_tail": ("" if ok else
+                        "\n".join((p_base.stderr or "")
+                                  .splitlines()[-5:]
+                                  + (outs[0][1] or "").splitlines()[-5:]
+                                  + (outs[1][1] or "")
+                                  .splitlines()[-5:])),
+    }
+
+
 def cell_subprocess_kill(tmp, crash_at=15):
     """The crash cell with a REAL kill: ``cli train --fault_plan
     train.step@S:kind=exit`` hard-exits (os._exit — no finally blocks),
@@ -397,9 +504,11 @@ def main(argv=None) -> int:
         description="fault matrix + crash-equivalence harness; exits "
                     "nonzero when any cell misses its expected outcome")
     ap.add_argument("--smoke", action="store_true",
-                    help="in-process cells only (tier-1 wiring); the "
-                         "default additionally runs the subprocess "
-                         "hard-kill cell")
+                    help="the tier-1 cell set: the in-process cells "
+                         "plus the two-subprocess elastic host-kill "
+                         "cell (ISSUE 14 — the elastic smoke IS "
+                         "tier-1); the default additionally runs the "
+                         "train.step subprocess hard-kill cell")
     ap.add_argument("--out", default="RESILIENCE.json",
                     help="result JSON path ('' = stdout only)")
     ap.add_argument("--workdir", default="",
@@ -443,6 +552,12 @@ def main(argv=None) -> int:
                                                                tmp)),
             ("watchdog nan", lambda: cell_watchdog_nan(hps, tmp)),
             ("fleet failover", lambda: cell_fleet_failover(hps, tmp)),
+            # the elastic host-kill cell runs in SMOKE too (ISSUE 14
+            # satellite: the two-process elastic smoke is tier-1) —
+            # its subprocesses are the recovery path under test, not
+            # an optional heavyweight extra
+            ("elastic host-kill (2 subprocesses)",
+             lambda: cell_host_kill(tmp)),
     ):
         print(f"# cell: {name}", file=sys.stderr)
         cells.append(fn())
@@ -450,17 +565,22 @@ def main(argv=None) -> int:
         print("# cell: subprocess hard-kill (os._exit)", file=sys.stderr)
         cells.append(cell_subprocess_kill(tmp))
 
+    from sketch_rnn_tpu.utils import runinfo
+
     device_kind = jax.devices()[0].device_kind
-    stamp = time.time()
+    # the run-manifest clock: ONE stamp shared by every history row
+    # (hist_append stamps the same value) and the RESILIENCE.json
+    # record, so committed rows diff cleanly across re-runs
+    stamp = runinfo.run_wall_time()
     for c in cells:
         row = {"kind": "resilience", "smoke": bool(args.smoke),
-               "device_kind": device_kind, "wall_time": stamp,
+               "device_kind": device_kind,
                "num_steps": hps.num_steps, "save_every": hps.save_every,
                **{k: c.get(k) for k in
                   ("site", "mode", "expected", "outcome", "ok",
                    "recovery_cost_steps", "resumed_from_step",
                    "lost_steps")}}
-        hist_append(row)
+        row = hist_append(row)
         print(json.dumps(row))
 
     rec = {

@@ -66,9 +66,20 @@ def find_shards(path: str) -> List[str]:
 def load_shard(path: str) -> Dict:
     """Parse one shard into {meta, events, agg, counters, gauges,
     hists}; torn tail lines are skipped (same tolerance as
-    trace_report)."""
+    trace_report).
+
+    ``complete`` (ISSUE 14 satellite): the meta line announces whether
+    its exporter writes an ``end`` sentinel; such a stream is complete
+    ONLY when the sentinel is present (a tear anywhere — events or
+    mid-summary — is caught). Pre-sentinel legacy exports fall back to
+    "any summary line present", the best a reader can do for them.
+    Either way a shard truncated by a host death is detectable and the
+    merge annotates it instead of silently undercounting."""
     out: Dict = {"meta": {}, "events": [], "agg": {}, "counters": {},
-                 "gauges": {}, "hists": {}, "path": path}
+                 "gauges": {}, "hists": {}, "path": path,
+                 "complete": False}
+    saw_summary = False
+    saw_end = False
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -84,13 +95,20 @@ def load_shard(path: str) -> Dict:
             elif t in ("span", "instant", "counter"):
                 out["events"].append(rec)
             elif t == "agg":
+                saw_summary = True
                 out["agg"][(rec["cat"], rec["name"])] = (
                     int(rec["count"]), float(rec["total_s"]))
             elif t == "counter_total":
+                saw_summary = True
                 store = "gauges" if rec.get("gauge") else "counters"
                 out[store][(rec["cat"], rec["name"])] = rec["value"]
             elif t == "hist":
+                saw_summary = True
                 out["hists"][(rec["cat"], rec["name"])] = rec
+            elif t == "end":
+                saw_end = True
+    out["complete"] = (saw_end if out["meta"].get("end_sentinel")
+                       else saw_end or saw_summary)
     return out
 
 
@@ -134,6 +152,7 @@ def merge_shards(shards: List[Dict]) -> Dict:
                       "ts_offset": offset,
                       "dropped": int(meta.get("dropped", 0)),
                       "capacity": meta.get("capacity"),
+                      "truncated": not s.get("complete", True),
                       "path": os.path.basename(s["path"])})
         rid = meta.get("run_id")
         if rid is not None and rid not in run_ids:
@@ -175,10 +194,26 @@ def merge_shards(shards: List[Dict]) -> Dict:
     # shrink the recorded topology — warn that totals undercount
     declared = max([int(s["meta"].get("host_count", 1))
                     for s in shards] + [len(hosts)])
-    if len(hosts) < declared:
-        print(f"trace_merge: WARNING: merged {len(hosts)} shards but "
-              f"the shard metas declare a {declared}-host run — "
-              f"missing hosts' events and totals are NOT included",
+    # explicit host-death annotation (ISSUE 14 satellite), with the
+    # evidence kept honest: a TRUNCATED shard (export torn by the
+    # kill) is positive proof the host died mid-run -> host_died; a
+    # declared host with NO shard at all is ambiguous — killed before
+    # it ever exported, OR simply a shard the caller didn't pass to
+    # this merge (a healthy host must never be recorded as dead by a
+    # partial merge) -> missing_hosts, warning only. Host ids are
+    # 0..declared-1 by the shard-naming contract.
+    present = {h["process_index"] for h in hosts}
+    died = sorted(h["process_index"] for h in hosts
+                  if h.get("truncated"))
+    missing = sorted(set(range(declared)) - present)
+    if died:
+        print(f"trace_merge: WARNING: host(s) {died} died mid-run "
+              f"(truncated shard); their tails are not in the merged "
+              f"totals", file=sys.stderr)
+    if missing:
+        print(f"trace_merge: WARNING: host(s) {missing} have no shard "
+              f"in this merge — killed before export, or a partial "
+              f"shard list; their events and totals are NOT included",
               file=sys.stderr)
     return {
         "meta": {"type": "meta", "merged": True,
@@ -187,6 +222,8 @@ def merge_shards(shards: List[Dict]) -> Dict:
                  "run_id": run_ids[0] if run_ids else None,
                  "run_ids": run_ids,
                  "dropped": sum(h["dropped"] for h in hosts),
+                 "host_died": died,
+                 "missing_hosts": missing,
                  "hosts": hosts},
         "events": events,
         "agg": agg,

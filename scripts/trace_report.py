@@ -252,6 +252,11 @@ def _drop_counts(meta: Dict) -> Dict:
 def report(data: Dict) -> Dict:
     return {
         "meta": data["meta"],
+        # hosts trace_merge flagged dead (truncated shard) or absent
+        # from the merge (killed pre-export OR a partial shard list —
+        # ISSUE 14): their tails are missing from every total below
+        "host_died": data["meta"].get("host_died") or [],
+        "missing_hosts": data["meta"].get("missing_hosts") or [],
         "ring_dropped": _drop_counts(data["meta"]),
         "host_filter": data.get("host_filter"),
         "spans": span_breakdown(data),
@@ -267,6 +272,16 @@ def print_report(rep: Dict) -> None:
     if rep.get("host_filter") is not None:
         print(f"(host {rep['host_filter']} only — span totals are "
               f"per-event sums over that host's ring)\n")
+    died = rep.get("host_died") or []
+    if died:
+        print(f"WARNING: host(s) {died} died mid-run (truncated "
+              f"telemetry shard) — their tails are not in any total "
+              f"below\n")
+    absent = rep.get("missing_hosts") or []
+    if absent:
+        print(f"WARNING: host(s) {absent} have no shard in this merge "
+              f"(killed before export, or a partial shard list) — "
+              f"their events are not in any total below\n")
     drops = rep.get("ring_dropped") or {}
     dropped = drops.get("total", rep["meta"].get("dropped", 0))
     if dropped:

@@ -99,7 +99,12 @@ def _workdir_hps(workdir: str) -> Optional[HParams]:
 
 
 def _load_data(hps: HParams, args,
-               scale_factor: Optional[float] = None
+               scale_factor: Optional[float] = None,
+               host_id: Optional[int] = None,
+               num_hosts: Optional[int] = None,
+               local_hps: Optional[HParams] = None,
+               coordinated: Optional[bool] = None,
+               emit_global: bool = False,
                ) -> Tuple[object, object, object, float]:
     """Build loaders; ``scale_factor`` (from a checkpoint) overrides the
     recomputed train-split normalization — eval/sample must use the scale
@@ -107,17 +112,23 @@ def _load_data(hps: HParams, args,
 
     ``hps`` here carries the GLOBAL batch size; per-host striping and the
     local loader batch size are applied internally (each host assembles
-    ``1/process_count`` of every global batch)."""
+    ``1/process_count`` of every global batch). The elastic runtime
+    (ISSUE 14) passes its own fleet coordinate + local hparams —
+    ``host_id``/``num_hosts``/``local_hps`` default to the jax cluster's
+    — and ``coordinated``/``emit_global`` select the coordinated global
+    plan (see data/loader.py)."""
     from sketch_rnn_tpu.data.loader import load_dataset, synthetic_loader
     from sketch_rnn_tpu.parallel import multihost as mh
-    lhps = mh.local_batch_hps(hps)
-    host, nhosts = mh.process_index(), mh.process_count()
+    lhps = local_hps if local_hps is not None else mh.local_batch_hps(hps)
+    host = mh.process_index() if host_id is None else host_id
+    nhosts = mh.process_count() if num_hosts is None else num_hosts
     if args.synthetic:
         grid = (args.synthetic_grid if args.synthetic_grid > 0 else None)
         if scale_factor is None:
             train_l, scale = synthetic_loader(
                 lhps, 20 * hps.batch_size, seed=1, augment=True,
-                host_id=host, num_hosts=nhosts, integer_grid=grid)
+                host_id=host, num_hosts=nhosts, integer_grid=grid,
+                coordinated=coordinated, emit_global=emit_global)
         else:
             # eval/sample with a checkpointed scale never touch the train
             # corpus — skip generating it
@@ -128,16 +139,21 @@ def _load_data(hps: HParams, args,
         valid_l, _ = synthetic_loader(lhps, 2 * hps.batch_size, seed=2,
                                       scale_factor=scale,
                                       host_id=host, num_hosts=nhosts,
-                                      integer_grid=grid)
+                                      integer_grid=grid,
+                                      coordinated=coordinated,
+                                      emit_global=emit_global)
         test_l, _ = synthetic_loader(lhps, 2 * hps.batch_size, seed=3,
                                      scale_factor=scale,
                                      host_id=host, num_hosts=nhosts,
-                                     integer_grid=grid)
+                                     integer_grid=grid,
+                                     coordinated=coordinated,
+                                     emit_global=emit_global)
         return train_l, valid_l, test_l, scale
     return load_dataset(lhps, scale_factor=scale_factor,
                         host_id=host, num_hosts=nhosts,
                         skip_bad_records=getattr(args, "skip_bad_records",
-                                                 False))
+                                                 False),
+                        coordinated=coordinated, emit_global=emit_global)
 
 
 def _restore(hps: HParams, workdir: str):
@@ -173,6 +189,28 @@ def cmd_train(args) -> int:
     from sketch_rnn_tpu.utils import faults
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
+    # elastic fleet usage validation (ISSUE 14): fail before any
+    # expensive work, like the serve-bench flag checks
+    elastic_n = getattr(args, "elastic_hosts", 0)
+    if elastic_n:
+        if not args.rendezvous:
+            print("[cli] --elastic_hosts needs --rendezvous DIR (the "
+                  "shared heartbeat/barrier directory every host "
+                  "points at)", file=sys.stderr)
+            return 2
+        if not 0 <= args.elastic_host_id < elastic_n:
+            print(f"[cli] --elastic_host_id {args.elastic_host_id} out "
+                  f"of range for --elastic_hosts {elastic_n}",
+                  file=sys.stderr)
+            return 2
+        if hps.batch_size % elastic_n != 0:
+            print(f"[cli] global batch {hps.batch_size} not divisible "
+                  f"by {elastic_n} elastic hosts", file=sys.stderr)
+            return 2
+    elif args.rendezvous or getattr(args, "elastic_host_id", 0):
+        print("[cli] --rendezvous/--elastic_host_id configure the "
+              "elastic fleet; add --elastic_hosts N", file=sys.stderr)
+        return 2
     rc = _arm_faults(args)
     if rc:
         return rc
@@ -198,6 +236,37 @@ def cmd_train(args) -> int:
             # conversion) in one flag instead of two hparam overrides
             hps = hps.replace(async_checkpoint=False,
                               metrics_defer=False)
+        if elastic_n:
+            # elastic multi-host training (ISSUE 14): this process is
+            # ONE host of a fleet coordinated through --rendezvous.
+            # Light mode — no jax.distributed; each host runs the
+            # identical global program over emit_global coordinated
+            # loaders (replicated state), heartbeats, barriers every
+            # step, and on a detected peer death checkpoints + resumes
+            # at the surviving topology. Kill a host mid-run and watch
+            # the survivors recover (README "Chaos quickstart").
+            from sketch_rnn_tpu.train import elastic_train
+
+            hps_e, args_e = hps, args
+
+            def make_loaders(lhps, rank, n):
+                return _load_data(hps_e, args_e, host_id=rank,
+                                  num_hosts=n, local_hps=lhps,
+                                  coordinated=True, emit_global=True)
+
+            elastic_train(
+                hps, make_loaders, rendezvous_dir=args.rendezvous,
+                host_id=args.elastic_host_id, num_hosts=elastic_n,
+                workdir=args.workdir, seed=args.seed,
+                resume=not getattr(args, "no_resume", False),
+                trace_dir=getattr(args, "trace_dir", "") or None,
+                profile=getattr(args, "profile", False),
+                watchdog=getattr(args, "watchdog", False),
+                halt_on_anomaly=getattr(args, "halt_on_anomaly",
+                                        False),
+                stale_s=args.stale_after,
+                heartbeat_interval_s=args.heartbeat_interval)
+            return 0
         train_l, valid_l, test_l, scale = _load_data(hps, args)
         print(f"[cli] host {mh.process_index()}/{mh.process_count()}: "
               f"{len(train_l)} train / {len(valid_l)} valid sketches, "
@@ -768,6 +837,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="start fresh even when <workdir> holds "
                         "checkpoints (default: resume from latest — the "
                         "reference's resume-from-latest contract)")
+    p.add_argument("--elastic_hosts", type=int, default=0,
+                   help="run as ONE host of an elastic N-host fleet "
+                        "(ISSUE 14): launch N processes with "
+                        "--elastic_host_id 0..N-1 sharing --rendezvous "
+                        "and --workdir. Coordinated global data plan "
+                        "(bucketed execution included), per-step "
+                        "heartbeat/barrier death detection, and on a "
+                        "host death the survivors checkpoint + resume "
+                        "at the new topology — final state leaf-bitwise "
+                        "an uninterrupted run's. 0 (default) = plain "
+                        "single-process training")
+    p.add_argument("--elastic_host_id", type=int, default=0,
+                   help="this process's host id in the elastic fleet "
+                        "(0-based, < --elastic_hosts)")
+    p.add_argument("--rendezvous", default="",
+                   help="shared directory for the elastic fleet's "
+                        "heartbeats, step barriers and topology "
+                        "generations (every host must see the same "
+                        "path)")
+    p.add_argument("--heartbeat_interval", type=float, default=0.25,
+                   help="elastic liveness beat period in seconds")
+    p.add_argument("--stale_after", type=float, default=2.5,
+                   help="a barrier-missing host whose heartbeat file "
+                        "stops ADVANCING for this many seconds is "
+                        "declared DEAD; hosts still beating (or not "
+                        "yet launched — no file) are waited for")
     p.add_argument("--sync_io", action="store_true",
                    help="disable the overlapped goodput runtime "
                         "(async_checkpoint=false,metrics_defer=false): "
@@ -797,8 +892,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "'metrics.row@3:kind=nan' (NaN a logged loss). "
                         "Sites: train.step, ckpt.commit, ckpt.torn, "
                         "ckpt.writer, data.batch, metrics.write, "
-                        "metrics.row. Off by default: no injection, "
-                        "bitwise-identical runs")
+                        "metrics.row; elastic fleets add host.kill.hNN "
+                        "(step-barrier entry of host NN — kind=exit is "
+                        "an honest host death) and dcn.collective (the "
+                        "barrier exchange itself). Off by default: no "
+                        "injection, bitwise-identical runs")
     p.add_argument("--fault_seed", type=int, default=0,
                    help="seed of the fault plan's deterministic "
                         "p=... firing decisions")

@@ -114,6 +114,30 @@ class DataLoader:
     for-micro-batch identical to the :meth:`next_batch` stream (same
     plan, same assembly order, same RNG draws), so stacking can never
     change WHAT is trained on, only how it is dispatched.
+
+    Coordinated multi-host mode (ISSUE 14, ``coordinated=True``): the
+    loader holds the GLOBAL corpus (every host passes the identical
+    list in the identical order, with the shared seed) and derives the
+    identical *global* schedule — the random feed draws GLOBAL batches
+    of ``hps.batch_size * num_hosts`` rows, the bucketed epoch plan
+    bins and shuffles GLOBAL indices — then stripes each batch's row
+    dimension: host ``h`` emits rows ``[h*B_local, (h+1)*B_local)``.
+    Per-host geometry is therefore ``(B_local, Tb)`` with the SAME
+    ``Tb`` sequence on every host (the SPMD collectives can never see
+    mismatched programs — the guard this mode lifts), the
+    concatenation of the per-host slices is bitwise the single-host
+    global stream (each host assembles the full global batch, one
+    shared augmentation draw per batch, and slices; assembly cost is
+    ~69x cheaper than the step, so the H-fold host redundancy buys
+    exact topology invariance), and because the whole schedule is a
+    pure function of ``(seed, epoch, global corpus, B_global)`` —
+    never of ``num_hosts`` — a resume at a DIFFERENT host count
+    replays the same global example stream under the new striping
+    (topology-change-equivalent resume; ``fast_forward`` needs no
+    changes). ``emit_global=True`` returns the un-sliced global batch
+    — the light-mode elastic runtime's replicated-program feed
+    (train/elastic.py); the sliced mode is the real-mesh transfer
+    contract (``parallel.mesh.shard_batch``).
     """
 
     def __init__(self,
@@ -123,7 +147,10 @@ class DataLoader:
                  augment: bool = False,
                  seed: int = 0,
                  global_size: Optional[int] = None,
-                 num_hosts: int = 1):
+                 num_hosts: int = 1,
+                 host_id: int = 0,
+                 coordinated: bool = False,
+                 emit_global: bool = False):
         self.hps = hps
         self.scale_factor = 1.0  # set by normalize(); int16 transfer reads it
         self.strokes: List[np.ndarray] = [np.asarray(s, np.float32)
@@ -146,28 +173,50 @@ class DataLoader:
         #   would otherwise never evaluate it when the common length is an
         #   exact batch multiple).
         self.num_hosts = num_hosts
-        if global_size is not None and num_hosts > 1:
-            self._common_len = global_size // num_hosts
-            self._max_local_len = -(-global_size // num_hosts)
-        else:
+        self.host_id = host_id
+        self.coordinated = coordinated
+        self.emit_global = emit_global
+        if coordinated:
+            if not 0 <= host_id < num_hosts:
+                raise ValueError(f"host_id {host_id} out of range for "
+                                 f"num_hosts={num_hosts}")
+            # the corpus IS the global corpus; the schedule is planned
+            # over GLOBAL batches of B_local * num_hosts rows, so batch
+            # counts are trivially identical on every host (and on every
+            # TOPOLOGY with the same global batch — the resume contract)
+            self._gbatch = hps.batch_size * num_hosts
             self._common_len = self._max_local_len = len(self.strokes)
-        self.num_batches = self._common_len // hps.batch_size
+        else:
+            if host_id or emit_global:
+                raise ValueError("host_id / emit_global need "
+                                 "coordinated=True (the legacy striped "
+                                 "loader holds only its own stripe)")
+            self._gbatch = hps.batch_size
+            if global_size is not None and num_hosts > 1:
+                self._common_len = global_size // num_hosts
+                self._max_local_len = -(-global_size // num_hosts)
+            else:
+                self._common_len = self._max_local_len = len(self.strokes)
+        self.num_batches = self._common_len // self._gbatch
         # -- length-bucketed execution (ISSUE 4) ---------------------------
         # Effective edges always end at max_seq_len (the terminal bucket),
         # so every admitted sequence has a bucket. Empty = bucketing off,
         # the exact-parity default: next_batch then IS random_batch.
         self.seed = seed
         if hps.bucket_edges:
-            if num_hosts > 1:
+            if num_hosts > 1 and not coordinated:
                 # each host would plan its own bucket schedule, so the
                 # per-step GLOBAL batch would mix (B, Tb) geometries
                 # across hosts and the SPMD collectives would deadlock;
-                # multi-host bucketing needs a coordinated plan
+                # multi-host bucketing needs the coordinated global plan
                 raise RuntimeError(
                     f"bucket_edges on a host-striped loader (num_hosts="
                     f"{num_hosts}) would launch mismatched per-host "
-                    f"batch geometries; bucketed execution is "
-                    f"single-host only")
+                    f"batch geometries; build the loader with "
+                    f"coordinated=True (the ISSUE 14 coordinated global "
+                    f"plan: every host derives the identical schedule "
+                    f"from the global corpus and stripes each batch's "
+                    f"rows)")
             edges = tuple(hps.bucket_edges)
             if edges[-1] < hps.max_seq_len:
                 edges = edges + (hps.max_seq_len,)
@@ -310,7 +359,7 @@ class DataLoader:
         """
         if self._common_len == 0:
             return 0
-        b = self.hps.batch_size
+        b = self._gbatch
         return (self._max_local_len + b - 1) // b
 
     def filter_by_label(self, label: int) -> "DataLoader":
@@ -330,16 +379,73 @@ class DataLoader:
             raise RuntimeError(
                 f"filter_by_label on a host-striped loader "
                 f"(num_hosts={self.num_hosts}) would deadlock the SPMD "
-                f"eval sweep; use train.loop.evaluate_per_class instead")
+                f"eval sweep (the per-class GLOBAL count is not a batch "
+                f"multiple on every host, coordinated or not); use "
+                f"train.loop.evaluate_per_class instead")
         sel = np.flatnonzero(self.labels == label)
         return DataLoader([self.strokes[i] for i in sel], self.hps,
                           labels=self.labels[sel], augment=False)
 
+    def host_slice(self, batch: Dict[str, np.ndarray],
+                   host: Optional[int] = None) -> Dict[str, np.ndarray]:
+        """Host ``host``'s row-slice of a GLOBAL coordinated batch:
+        rows ``[host * B_local, (host + 1) * B_local)`` of every leaf
+        (strokes, seq_len, labels, weights, transfer_scale alike). The
+        striping contract: the per-host slices partition the global
+        batch exactly, in host order — tier-1-pinned, and what
+        ``parallel.mesh.shard_batch`` ships per process on a real
+        mesh."""
+        if not self.coordinated:
+            raise ValueError("host_slice needs a coordinated loader")
+        h = self.host_id if host is None else host
+        if not 0 <= h < self.num_hosts:
+            raise ValueError(f"host {h} out of range for "
+                             f"num_hosts={self.num_hosts}")
+        b = self.hps.batch_size
+        return {k: v[h * b:(h + 1) * b] for k, v in batch.items()}
+
+    def _emit(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Route an assembled GLOBAL batch to the configured view: this
+        host's row-slice (the real-mesh transfer contract), the whole
+        global batch (``emit_global`` — the light-mode replicated
+        runtime), or unchanged for legacy (uncoordinated) loaders."""
+        if not self.coordinated or self.emit_global:
+            return batch
+        return self.host_slice(batch)
+
+    def plan_fingerprint(self, epoch: Optional[int] = None) -> str:
+        """Digest of the coordinated schedule a peer host must agree
+        on: global batch size, bucket edges, the CORPUS CONTENT
+        (labels + every normalized stroke's bytes — a same-sized but
+        diverged corpus, e.g. a stale file on one host's disk, must
+        NOT pass), and — under bucketed execution — the exact ``(Tb,
+        idx, weighted?)`` epoch plan. Pure in ``(seed, epoch)``; the
+        elastic runtime exchanges it at fleet start so diverged plans
+        fail LOUDLY instead of silently training hosts on different
+        global streams (train/elastic.py). Cost: one pass over the
+        corpus bytes per fleet (re)start — O(corpus), not O(steps)."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        h.update(f"{self.seed}:{self._gbatch}:{self.bucket_edges}:"
+                 f"{len(self.strokes)}:{self.augment}".encode())
+        h.update(np.ascontiguousarray(self.labels).tobytes())
+        for s in self.strokes:
+            h.update(np.ascontiguousarray(s).tobytes())
+        if self.bucket_edges:
+            ep = self._bucket_epoch if epoch is None else int(epoch)
+            for tb, idx, w in self._plan_bucket_epoch(ep):
+                h.update(np.int64(tb).tobytes())
+                h.update(np.ascontiguousarray(idx, np.int64).tobytes())
+                h.update(b"-" if w is None
+                         else np.ascontiguousarray(w, np.float32).tobytes())
+        return h.hexdigest()
+
     def random_batch(self, int16_scale: Optional[float] = None
                      ) -> Dict[str, np.ndarray]:
-        idx = self.rng.choice(len(self.strokes), self.hps.batch_size,
-                              replace=len(self.strokes) < self.hps.batch_size)
-        return self._assemble(idx, int16_scale=int16_scale)
+        idx = self.rng.choice(len(self.strokes), self._gbatch,
+                              replace=len(self.strokes) < self._gbatch)
+        return self._emit(self._assemble(idx, int16_scale=int16_scale))
 
     def fast_forward(self, n_batches: int) -> None:
         """Advance the training feed by ``n_batches`` batches, discarding
@@ -399,8 +505,14 @@ class DataLoader:
         windowed shuffle (``bucket_shuffle_window``) so binning by
         length cannot introduce a length-curriculum bias; windows >= the
         epoch's batch count give a full shuffle.
+
+        Coordinated multi-host mode plans GLOBAL batches (``B_local *
+        num_hosts`` indices per batch) over the global corpus — the
+        plan is identical on every host AND at every topology sharing
+        the global batch size, which is what makes host-striped
+        bucketing and topology-change-equivalent resume possible.
         """
-        b = self.hps.batch_size
+        b = self._gbatch
         rng = np.random.default_rng([self.seed & 0x7FFFFFFF, 9176, epoch])
         perm = rng.permutation(len(self.strokes))
         bins: Dict[int, List[int]] = {e: [] for e in self.bucket_edges}
@@ -486,7 +598,7 @@ class DataLoader:
             # normalizes by sum(weights), so the epoch's weighted stream
             # treats every example exactly once (mdn.reconstruction_loss)
             batch["weights"] = w
-        return batch
+        return self._emit(batch)
 
     def seek_epoch(self, epoch: int) -> None:
         """Rewind the bucketed stream to the START of ``epoch``'s plan.
@@ -562,10 +674,11 @@ class DataLoader:
         if not 0 <= batch_index < self.num_eval_batches:
             raise IndexError(f"batch {batch_index} of "
                              f"{self.num_eval_batches}")
-        lo = batch_index * self.hps.batch_size
-        linear = np.arange(lo, lo + self.hps.batch_size)
+        lo = batch_index * self._gbatch
+        linear = np.arange(lo, lo + self._gbatch)
         # modulo is over the LOCAL length so hosts holding a striping
-        # remainder example still use it
+        # remainder example still use it (coordinated mode: the length
+        # IS the global corpus and the batch is global)
         return linear % len(self.strokes)
 
     def get_batch(self, batch_index: int) -> Dict[str, np.ndarray]:
@@ -581,14 +694,14 @@ class DataLoader:
         bitwise independent of the pad length (tested), so the sweep
         result is unchanged while the eval scan runs at bucket depth.
         """
-        lo = batch_index * self.hps.batch_size
-        linear = np.arange(lo, lo + self.hps.batch_size)
+        lo = batch_index * self._gbatch
+        linear = np.arange(lo, lo + self._gbatch)
         idx = self._eval_indices(batch_index)
         pad = (self.eval_pad_len(batch_index)
                if self.bucket_edges else None)
         batch = self._assemble(idx, pad_to=pad)
         batch["weights"] = (linear < len(self.strokes)).astype(np.float32)
-        return batch
+        return self._emit(batch)
 
 
 def _windowed_shuffle(items: List, window: int,
@@ -632,6 +745,8 @@ def load_dataset(hps: HParams,
                  num_hosts: int = 1,
                  scale_factor: Optional[float] = None,
                  skip_bad_records: bool = False,
+                 coordinated: Optional[bool] = None,
+                 emit_global: bool = False,
                  ) -> Tuple[DataLoader, DataLoader, DataLoader, float]:
     """Read category ``.npz`` files and build train/valid/test loaders.
 
@@ -639,6 +754,18 @@ def load_dataset(hps: HParams,
     attach the category index as the class label. ``host_id``/``num_hosts``
     stripe the training examples across hosts for multi-host data
     parallelism (each host feeds its own slice of the global batch).
+
+    ``coordinated`` (ISSUE 14): every host keeps the GLOBAL corpus and
+    the SHARED seed, derives the identical global schedule, and emits
+    its row-slice of every batch (see the DataLoader docstring) —
+    required for host-striped bucketed execution, and what makes a
+    resume at a different host count replay the same global stream.
+    Default ``None`` auto-selects it exactly when it is required
+    (``hps.bucket_edges`` and ``num_hosts > 1``); the legacy striped
+    corpus (decorrelated per-host feeds) remains the buckets-off
+    multi-host default, byte-for-byte. ``emit_global`` (coordinated
+    only) returns un-sliced global batches — the light-mode elastic
+    runtime's replicated feed.
 
     Returns ``(train, valid, test, scale_factor)``; every split is
     normalized by the train split's scale factor (SURVEY §3.5) — or by a
@@ -689,6 +816,9 @@ def load_dataset(hps: HParams,
 
     _SEEDS = {"train": 1, "valid": 2, "test": 3}  # fixed: runs must be reproducible
 
+    coord = (num_hosts > 1 and bool(hps.bucket_edges)
+             if coordinated is None else coordinated)
+
     def build(split: str, augment: bool) -> DataLoader:
         seqs, labels = splits[split]
         if not seqs:
@@ -696,6 +826,17 @@ def load_dataset(hps: HParams,
                 f"{split} split is empty after filtering to "
                 f"max_seq_len={hps.max_seq_len}; raise max_seq_len or check "
                 f"the data files {hps.data_set}")
+        if coord:
+            # coordinated global plan (ISSUE 14): every host keeps the
+            # WHOLE split and the SHARED seed — the schedule is then a
+            # pure function of (seed, epoch, global corpus) on every
+            # host and at every topology; each host emits only its
+            # row-slice of each globally-planned batch
+            return DataLoader(seqs, hps,
+                              labels=np.array(labels, np.int32),
+                              augment=augment, seed=_SEEDS[split],
+                              num_hosts=num_hosts, host_id=host_id,
+                              coordinated=True, emit_global=emit_global)
         # every split is host-striped: train for data parallelism, valid/
         # test so the eval sweep's global batches hold DISTINCT rows (each
         # host feeds 1/num_hosts of each global batch)
@@ -799,6 +940,8 @@ def synthetic_loader(hps: HParams, num: int, seed: int = 0,
                      scale_factor: Optional[float] = None,
                      host_id: int = 0, num_hosts: int = 1,
                      integer_grid: Optional[float] = None,
+                     coordinated: Optional[bool] = None,
+                     emit_global: bool = False,
                      ) -> Tuple[DataLoader, float]:
     """One synthetic-corpus DataLoader sized to ``hps`` (shared helper for
     the CLI, bench and driver entry; sequence lengths are clamped to fit
@@ -807,13 +950,24 @@ def synthetic_loader(hps: HParams, num: int, seed: int = 0,
     recomputing from this corpus. ``host_id``/``num_hosts`` stripe the
     corpus for multi-host DP; like ``load_dataset``, the scale factor is
     computed from the FULL pre-stripe corpus so every host normalizes
-    identically."""
+    identically. ``coordinated``/``emit_global`` select the ISSUE 14
+    coordinated global plan exactly like :func:`load_dataset` (default:
+    auto — coordinated when bucketed and multi-host)."""
     seqs, labels = make_synthetic_strokes(
         num, num_classes=max(hps.num_classes, 1),
         max_len=min(96, hps.max_seq_len - 2), seed=seed,
         integer_grid=integer_grid)
     if scale_factor is None:
         scale_factor = S.calculate_normalizing_scale_factor(seqs)
+    coord = (num_hosts > 1 and bool(hps.bucket_edges)
+             if coordinated is None else coordinated)
+    if coord:
+        loader = DataLoader(seqs, hps, labels=labels, augment=augment,
+                            seed=seed, num_hosts=num_hosts,
+                            host_id=host_id, coordinated=True,
+                            emit_global=emit_global)
+        loader.normalize(scale_factor)
+        return loader, scale_factor
     global_size = len(seqs)
     seqs, labels = _stripe(seqs, labels, host_id, num_hosts)
     loader = DataLoader(seqs, hps, labels=labels, augment=augment,

@@ -13,16 +13,37 @@ process feeds only its shard of the global batch
 (``jax.make_array_from_process_local_data`` assembles the global array),
 and the data loader stripes examples by ``host_id`` (see
 ``data.loader.load_dataset``).
+
+Host-failure detection (ISSUE 14): the elastic runtime's DCN-side
+primitives live here — a :class:`HostHeartbeat` daemon thread
+(``host-heartbeat-hNN``, registry-drained by the test guard) that
+keeps a liveness file fresh in a shared rendezvous directory, and a
+:class:`FleetRendezvous` step barrier around the dispatch loop. A host
+that stops arriving at the barrier while its heartbeat goes stale is
+declared DEAD: the barrier raises :class:`HostDeathDetected` carrying
+the dead/surviving sets, and the fleet-restart coordinator
+(train/elastic.py) turns that into a consistent checkpoint + relaunch
+at the surviving topology. A missing-but-fresh host is merely SLOW and
+is waited for (up to the hard barrier timeout), so transient stalls
+never trigger a restart. The barrier body is also the
+``dcn.collective`` fault site — a chaos plan can fail the collective
+itself (utils/faults.py), and the whole layer is filesystem-based so
+two real subprocesses exercise it with no accelerator tunnel (the
+``_multihost_worker.py`` light-mode discipline).
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional
+import threading
+import time
+from typing import Dict, List, Optional
 
 import jax
 
 from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.utils.faults import fault_point
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -70,12 +91,281 @@ def topology() -> dict:
     }
 
 
-def local_batch_hps(hps: HParams) -> HParams:
+def local_batch_hps(hps: HParams, num_hosts: Optional[int] = None
+                    ) -> HParams:
     """Per-host loader hparams: each host assembles ``1/num_hosts`` of the
     global batch (``hps.batch_size`` stays the GLOBAL batch everywhere
-    else — schedules, throughput accounting, the jitted step)."""
-    n = jax.process_count()
+    else — schedules, throughput accounting, the jitted step).
+    ``num_hosts`` defaults to the jax cluster size; the light-mode
+    elastic runtime (no ``jax.distributed``) passes its own fleet
+    size."""
+    n = jax.process_count() if num_hosts is None else int(num_hosts)
     if hps.batch_size % n != 0:
         raise ValueError(f"global batch {hps.batch_size} not divisible by "
                          f"{n} hosts")
     return hps.replace(batch_size=hps.batch_size // n)
+
+
+# -- host-failure detection (ISSUE 14) --------------------------------------
+
+# liveness thresholds: a host is SUSPECT once its heartbeat file is
+# stale_s old (several missed beats, not one scheduling hiccup), and the
+# barrier gives up entirely at timeout_s (a collective failure, loud)
+HEARTBEAT_INTERVAL_S = 0.25
+HEARTBEAT_STALE_S = 2.5
+BARRIER_TIMEOUT_S = 120.0
+
+_HB_LOCK = threading.Lock()
+_HEARTBEATS: List["HostHeartbeat"] = []
+
+
+class HostDeathDetected(RuntimeError):
+    """Raised by :meth:`FleetRendezvous.barrier` when one or more peers
+    stopped arriving AND let their heartbeats go stale. Carries the
+    evidence the restart coordinator needs: ``dead`` / ``survivors``
+    (original host ids), the barrier ``step``, and whether THIS host is
+    the surviving fleet's new primary (``new_primary`` — min survivor
+    id; the one that commits the consistent checkpoint)."""
+
+    def __init__(self, dead: List[int], survivors: List[int], step: int,
+                 host_id: int):
+        self.dead = sorted(dead)
+        self.survivors = sorted(survivors)
+        self.step = int(step)
+        self.host_id = int(host_id)
+        self.new_primary = bool(self.survivors
+                                and self.survivors[0] == host_id)
+        super().__init__(
+            f"host death detected at step {step}: dead={self.dead}, "
+            f"survivors={self.survivors}")
+
+
+def _atomic_json(path: str, doc: dict) -> None:
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # absent, or torn mid-replace on exotic filesystems
+
+
+def heartbeat_path(rendezvous_dir: str, host_id: int) -> str:
+    return os.path.join(rendezvous_dir, f"heartbeat_h{host_id:02d}.json")
+
+
+class HostHeartbeat:
+    """Daemon thread keeping ``heartbeat_hNN.json`` fresh: ``{host,
+    count, time}`` rewritten atomically every ``interval_s``. A hard
+    kill (``os._exit``, preemption) stops the rewrites instantly — the
+    staleness every peer's barrier then observes. Registered process-
+    wide so the conftest guard can prove no ``host-heartbeat-*`` thread
+    outlives a test (:func:`stop_all_heartbeats`)."""
+
+    def __init__(self, rendezvous_dir: str, host_id: int,
+                 interval_s: float = HEARTBEAT_INTERVAL_S):
+        os.makedirs(rendezvous_dir, exist_ok=True)
+        self.path = heartbeat_path(rendezvous_dir, host_id)
+        self.host_id = int(host_id)
+        self.interval_s = float(interval_s)
+        self._count = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"host-heartbeat-h{host_id:02d}",
+            daemon=True)
+
+    def start(self) -> "HostHeartbeat":
+        self._beat()  # liveness visible BEFORE the first barrier entry
+        with _HB_LOCK:
+            _HEARTBEATS.append(self)
+        self._thread.start()
+        return self
+
+    def _beat(self) -> None:
+        self._count += 1
+        _atomic_json(self.path, {"host": self.host_id,
+                                 "count": self._count,
+                                 "time": time.time()})
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._beat()
+            except OSError:
+                # a torn rendezvous dir must not kill the thread: the
+                # peers will see staleness and treat this host as dead,
+                # which is the honest outcome
+                pass
+
+    def stop(self, remove: bool = False) -> None:
+        """Stop beating; ``remove=True`` additionally deletes the
+        liveness file — ONLY for a host that finished its work
+        cleanly. A crashing host must leave its (frozen) file behind:
+        that frozen heartbeat is exactly what peers' barriers detect
+        as death, while an absent file reads as "not booted yet" and
+        is waited for. So: completed -> removed; crashed (raise or
+        kill) -> frozen file -> detected; a leftover frozen file in a
+        reused rendezvous dir is itself evidence of an unclean
+        death."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        with _HB_LOCK:
+            if self in _HEARTBEATS:
+                _HEARTBEATS.remove(self)
+        if remove:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+    def __repr__(self) -> str:
+        return (f"HostHeartbeat(h{self.host_id:02d}, "
+                f"alive={self._thread.is_alive()})")
+
+
+def stop_all_heartbeats() -> tuple:
+    """Stop every live heartbeat; returns their reprs (the conftest
+    guard asserts this is empty — a non-empty return names the
+    leaker)."""
+    with _HB_LOCK:
+        leaked = tuple(_HEARTBEATS)
+    names = tuple(repr(h) for h in leaked)
+    for h in leaked:
+        h.stop()
+    return names
+
+
+class FleetRendezvous:
+    """Filesystem step barrier over a fixed host set (one topology
+    generation). ``barrier(name)`` publishes this host's arrival file
+    (+ optional JSON payload), then polls for every peer's:
+
+    - all present -> returns ``{host_id: payload}``;
+    - a peer missing whose heartbeat file exists but has NOT ADVANCED
+      for ``stale_s`` of observed waiting -> declared dead,
+      :class:`HostDeathDetected` raises (the elastic recovery entry
+      point). Advance-based, never age-based: a leftover file from a
+      crashed previous incarnation is (correctly) frozen -> dead,
+      while clock skew or a busy-but-beating peer can never false-kill;
+    - a peer missing with NO heartbeat file -> not booted yet (clean
+      stops delete the file): waited for toward ``timeout_s``;
+    - a peer missing but heartbeat-advancing -> merely slow; waited;
+    - ``timeout_s`` exceeded -> RuntimeError naming the stragglers (a
+      collective failure / launch failure, not a detected death — loud
+      by design).
+
+    Arrival files are namespaced by generation so a relaunched fleet
+    can never match a previous topology's barriers, and each host
+    prunes its own previous arrival file once the next barrier
+    completes (a 100k-step run must not leave 100k files per host).
+    The publish body is the ``dcn.collective`` fault site."""
+
+    def __init__(self, rendezvous_dir: str, host_id: int,
+                 hosts: List[int], gen: int = 0,
+                 stale_s: float = HEARTBEAT_STALE_S,
+                 timeout_s: float = BARRIER_TIMEOUT_S,
+                 poll_s: float = 0.02):
+        self.dir = rendezvous_dir
+        self.host_id = int(host_id)
+        self.hosts = sorted(int(h) for h in hosts)
+        if self.host_id not in self.hosts:
+            raise ValueError(f"host {host_id} not in fleet {self.hosts}")
+        self.gen = int(gen)
+        self.stale_s = float(stale_s)
+        self.timeout_s = float(timeout_s)
+        self.poll_s = float(poll_s)
+        self._prev_arrival: Optional[str] = None
+        os.makedirs(rendezvous_dir, exist_ok=True)
+
+    def _arrival(self, name: str, host: int) -> str:
+        return os.path.join(self.dir,
+                            f"bar_g{self.gen:03d}_{name}_h{host:02d}.json")
+
+    def _hb_time(self, host: int) -> Optional[float]:
+        hb = _read_json(heartbeat_path(self.dir, host))
+        return None if hb is None else float(hb.get("time", 0.0))
+
+    def barrier(self, name: str, step: int = 0,
+                payload: Optional[dict] = None) -> Dict[int, dict]:
+        # the collective's failure site: a chaos plan can fail the
+        # exchange itself (kind=raise surfaces as a crashed host to the
+        # peers; kind=exit IS a host death)
+        fault_point("dcn.collective")
+        _atomic_json(self._arrival(name, self.host_id),
+                     {"host": self.host_id, "step": int(step),
+                      **(payload or {})})
+        t0 = time.monotonic()
+        # liveness = the heartbeat ADVANCING while we wait, never its
+        # absolute age: a stale file left by a crashed previous
+        # incarnation in a reused rendezvous dir, or clock skew, must
+        # not read as an instant death. A peer is dead only once its
+        # heartbeat file exists but has not moved for stale_s of
+        # OBSERVED waiting; a peer with NO heartbeat file has simply
+        # not booted yet (clean stops delete the file) and is waited
+        # for toward the hard timeout, which names it loudly.
+        peers = [h for h in self.hosts if h != self.host_id]
+        hb_seen = {h: self._hb_time(h) for h in peers}
+        last_adv = {h: t0 for h in peers}
+        while True:
+            missing = [h for h in self.hosts
+                       if not os.path.exists(self._arrival(name, h))]
+            if not missing:
+                out = {}
+                for h in self.hosts:
+                    doc = self._read_arrival(name, h)
+                    out[h] = doc
+                # prune MY OWN previous arrival file: every peer has
+                # entered THIS barrier, so all of them exited (and read
+                # the payloads of) the previous one — the file can
+                # never be needed again, and a long run must not
+                # accumulate one file per host per step
+                if self._prev_arrival is not None:
+                    try:
+                        os.remove(self._prev_arrival)
+                    except OSError:
+                        pass
+                self._prev_arrival = self._arrival(name, self.host_id)
+                return out
+            now = time.monotonic()
+            dead = []
+            for h in missing:
+                t = self._hb_time(h)
+                if t is not None and t != hb_seen[h]:
+                    hb_seen[h], last_adv[h] = t, now
+                elif (t is not None
+                        and now - last_adv[h] > self.stale_s):
+                    dead.append(h)
+            if dead:
+                survivors = [h for h in self.hosts if h not in dead]
+                raise HostDeathDetected(dead, survivors, step,
+                                        self.host_id)
+            if now - t0 > self.timeout_s:
+                unbooted = [h for h in missing
+                            if self._hb_time(h) is None]
+                raise RuntimeError(
+                    f"fleet barrier {name!r} (gen {self.gen}) timed out "
+                    f"after {self.timeout_s:.0f}s waiting for hosts "
+                    f"{missing}"
+                    + (f" (never heartbeated — never launched? "
+                       f"{unbooted})" if unbooted else
+                       " whose heartbeats are still fresh — a wedged "
+                       "(not dead) peer; raise the timeout or "
+                       "investigate the straggler"))
+            time.sleep(self.poll_s)
+
+    def _read_arrival(self, name: str, host: int) -> dict:
+        # atomic writes make a present file complete; retry a beat to
+        # ride out os.replace visibility on network filesystems
+        for _ in range(50):
+            doc = _read_json(self._arrival(name, host))
+            if doc is not None:
+                return doc
+            time.sleep(self.poll_s)
+        raise RuntimeError(f"barrier arrival file for host {host} "
+                           f"({name!r}) exists but never became "
+                           f"readable")

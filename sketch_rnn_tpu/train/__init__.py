@@ -18,6 +18,7 @@ from sketch_rnn_tpu.train.checkpoint import (
     save_checkpoint,
     write_checkpoint,
 )
+from sketch_rnn_tpu.train.elastic import ElasticCoordinator, elastic_train
 from sketch_rnn_tpu.train.loop import evaluate, evaluate_per_class, train
 from sketch_rnn_tpu.train.metrics import MetricsDrain, MetricsWriter
 from sketch_rnn_tpu.train.watchdog import (
@@ -44,6 +45,8 @@ __all__ = [
     "MetricsDrain",
     "MetricsWriter",
     "train",
+    "ElasticCoordinator",
+    "elastic_train",
     "evaluate",
     "evaluate_per_class",
     "AnomalyHalt",

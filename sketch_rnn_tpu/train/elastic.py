@@ -1,0 +1,474 @@
+"""Elastic multi-host training: survive host death, resume at the new
+topology (ISSUE 14 tentpole pieces 2-3).
+
+The pjit/TPUv4 scaling paper (PAPERS.md) treats preemption as routine
+and checkpoint-restart as THE recovery primitive; the TensorFlow
+system paper makes fault tolerance a first-class runtime axis. This
+module composes the repo's existing pieces into that shape:
+
+- **Coordinated data plan** (data/loader.py ``coordinated=True``):
+  every host derives the identical global schedule and feeds its row
+  slice, so the global example stream is a pure function of ``(seed,
+  epoch)`` — independent of the host count.
+- **Failure detection** (parallel/multihost.py): a heartbeat thread
+  per host plus a :class:`FleetRendezvous` barrier around every
+  dispatch-loop iteration; a peer that stops arriving with a stale
+  heartbeat raises :class:`HostDeathDetected` on every survivor at the
+  SAME step (the barrier is the synchronization point, so all
+  survivors hold the identical replicated state there).
+- **Restart protocol** (:func:`elastic_train`): on detected death the
+  surviving primary commits a CONSISTENT checkpoint of the live state
+  (through the same ``write_checkpoint`` commit path the async writer
+  uses — byte-identical files), the survivors agree on the new
+  topology via an atomically-published generation file, RUN.json is
+  rewritten with the new host set + a death event, and ``train()``
+  relaunches on the survivors with a re-striped coordinated loader.
+  ``resume_align`` then fast-forwards the fresh loader through the
+  SAME global stream under the NEW striping — which is why the
+  recovered run reproduces, leaf-bitwise, an uninterrupted run started
+  at the surviving topology (scripts/resilience_bench.py's
+  ``host.kill`` chaos cell is the end-to-end proof, via two real
+  subprocesses).
+
+Light mode vs real mesh: this box cannot form a ``jax.distributed``
+cluster (the slow-marked tests/test_multihost.py DP tests need the
+accelerator tunnel), so the elastic runtime runs each host as an
+independent process executing the IDENTICAL global program over the
+full global batch (``emit_global=True`` loaders) — the SPMD replicated
+-state model with the batch all-gather as the emulated collective.
+State is therefore bitwise topology-independent and every claim above
+is exact. On a real mesh the same coordinator wraps the same loop with
+sliced loaders and ``shard_batch``; the device all-reduce then
+reassociates across topologies, so the cross-topology claim relaxes to
+the documented scan tolerance while the in-topology recovery contract
+is unchanged.
+
+Fault sites (utils/faults.py): ``host.kill.hNN`` fires at host NN's
+step-barrier entry — ``kind=exit`` is an honest host death (no finally
+blocks, heartbeat stops beating); ``dcn.collective`` fires inside the
+barrier publish. Armed-but-never-firing plans are bitwise invisible,
+and the whole runtime with ``num_hosts=1`` is pinned bitwise-equal to
+a plain ``train()`` (tests/test_elastic.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.parallel.multihost import (
+    FleetRendezvous,
+    HostDeathDetected,
+    HostHeartbeat,
+    HEARTBEAT_STALE_S,
+    BARRIER_TIMEOUT_S,
+)
+from sketch_rnn_tpu.utils.faults import fault_point
+
+TOPOLOGY_PREFIX = "topology_g"
+
+_CO_LOCK = threading.Lock()
+_COORDINATORS: List["ElasticCoordinator"] = []
+
+
+def topology_path(rendezvous_dir: str, gen: int) -> str:
+    return os.path.join(rendezvous_dir, f"{TOPOLOGY_PREFIX}{gen:03d}.json")
+
+
+class ElasticCoordinator:
+    """One host's handle on one topology generation: heartbeat +
+    per-step barrier + the host-kill fault site. ``train()`` calls
+    :meth:`step_barrier` once per dispatch-loop iteration; everything
+    else is :func:`elastic_train`'s restart protocol."""
+
+    def __init__(self, rendezvous_dir: str, host_id: int,
+                 hosts: List[int], gen: int = 0,
+                 stale_s: float = HEARTBEAT_STALE_S,
+                 timeout_s: float = BARRIER_TIMEOUT_S,
+                 heartbeat_interval_s: Optional[float] = None,
+                 fleet_size: Optional[int] = None,
+                 heartbeat: Optional[HostHeartbeat] = None):
+        self.dir = rendezvous_dir
+        self.host_id = int(host_id)
+        self.hosts = sorted(int(h) for h in hosts)
+        self.gen = int(gen)
+        # the DECLARED gen-0 fleet size (stable across generations):
+        # telemetry shards are stamped with it so a dead host reads as
+        # a missing shard of an N-host run, never a shrunk topology
+        self.fleet_size = (max(self.hosts) + 1 if fleet_size is None
+                           else int(fleet_size))
+        self.rendezvous = FleetRendezvous(
+            rendezvous_dir, host_id, self.hosts, gen=gen,
+            stale_s=stale_s, timeout_s=timeout_s)
+        # an externally-owned heartbeat (elastic_train passes one that
+        # beats across EVERY generation — stopping it between
+        # generations would freeze this host's liveness file exactly
+        # while it rebuilds loaders for the relaunch, and a faster
+        # survivor would declare it dead); a coordinator built bare
+        # owns its own.
+        kw = ({} if heartbeat_interval_s is None
+              else {"interval_s": heartbeat_interval_s})
+        self._owns_heartbeat = heartbeat is None
+        self._heartbeat = (heartbeat if heartbeat is not None
+                           else HostHeartbeat(rendezvous_dir, host_id,
+                                              **kw))
+        self._started = False
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def rank(self) -> int:
+        """Striping rank within this generation (loader ``host_id``)."""
+        return self.hosts.index(self.host_id)
+
+    @property
+    def is_primary(self) -> bool:
+        """The generation's writer: lowest surviving original id."""
+        return self.host_id == self.hosts[0]
+
+    def start(self, plan_fingerprint: Optional[str] = None,
+              config_hash: Optional[str] = None) -> "ElasticCoordinator":
+        """Begin heartbeating and run the generation-start barrier,
+        exchanging the coordinated-plan fingerprint + config hash: a
+        host whose loader planned a DIFFERENT global schedule (wrong
+        seed, wrong corpus, wrong config) fails loudly here instead of
+        silently training on a diverged stream."""
+        if self._owns_heartbeat:
+            self._heartbeat.start()
+        with _CO_LOCK:
+            _COORDINATORS.append(self)
+        self._started = True
+        peers = self.rendezvous.barrier(
+            "start", step=-1,
+            payload={"plan": plan_fingerprint, "config": config_hash})
+        for h, doc in peers.items():
+            for field, mine in (("plan", plan_fingerprint),
+                                ("config", config_hash)):
+                theirs = doc.get(field)
+                if mine is not None and theirs is not None \
+                        and theirs != mine:
+                    raise RuntimeError(
+                        f"coordinated {field} divergence at gen "
+                        f"{self.gen}: host {h} announced {theirs!r}, "
+                        f"host {self.host_id} holds {mine!r} — the "
+                        f"hosts would train on different global "
+                        f"schedules; check seeds/corpus/config")
+        return self
+
+    def step_barrier(self, step: int) -> None:
+        """Synchronize one dispatch-loop iteration across the fleet.
+
+        Entry is the ``host.kill.hNN`` fault site — one invocation per
+        loop iteration, so ``host.kill.h1@10:kind=exit`` kills host 1
+        exactly at step 10 (the crash-equivalence discipline of the
+        ``train.step`` site). Raises :class:`HostDeathDetected` when a
+        peer is missing-and-stale; every survivor raises at the SAME
+        step, holding the identical replicated state."""
+        fault_point(f"host.kill.h{self.host_id}")
+        self.rendezvous.barrier(f"step{int(step):08d}", step=step)
+
+    def commit_topology(self, new_hosts: List[int], at_step: int,
+                        dead: List[int],
+                        resumed_from: Optional[int],
+                        retired: Optional[List[int]] = None) -> dict:
+        """Publish (primary) / await the next generation's topology
+        file, then return its contents. Atomic publish + poll-for-file
+        doubles as the survivors' regroup barrier: nobody proceeds to
+        the relaunch before the consistent checkpoint AND the agreed
+        host set are on disk. ``retired`` names survivors deliberately
+        left out (an indivisible survivor count — see
+        :func:`divisible_prefix`): a host finding itself there exits
+        cleanly, while one excluded WITHOUT a retirement record was
+        falsely declared dead and must refuse to proceed."""
+        import time
+
+        gen = self.gen + 1
+        path = topology_path(self.dir, gen)
+        doc = {"generation": gen, "hosts": sorted(new_hosts),
+               "dead": sorted(dead), "at_step": int(at_step),
+               "resumed_from": resumed_from,
+               "retired": sorted(retired or [])}
+        new_primary = min(new_hosts) == self.host_id
+        if new_primary:
+            from sketch_rnn_tpu.parallel.multihost import _atomic_json
+            _atomic_json(path, doc)
+            return doc
+        deadline = time.monotonic() + self.rendezvous.timeout_s
+        while time.monotonic() < deadline:
+            got = _read_topology(path)
+            if got is not None:
+                if (self.host_id not in got["hosts"]
+                        and self.host_id not in got.get("retired",
+                                                        [])):
+                    raise RuntimeError(
+                        f"host {self.host_id} excluded from gen {gen} "
+                        f"topology {got['hosts']} — the new primary "
+                        f"declared this host dead; refusing to rejoin "
+                        f"a fleet that re-striped without it")
+                return got
+            time.sleep(0.02)
+        raise RuntimeError(
+            f"gen {gen} topology file never appeared in {self.dir} — "
+            f"the new primary (host {min(new_hosts)}) died during the "
+            f"restart protocol")
+
+    def stop(self, remove_heartbeat: bool = False) -> None:
+        """Idempotent teardown; ``remove_heartbeat=True`` marks a CLEAN
+        completion (the liveness file is deleted so a reused rendezvous
+        dir can never mistake this host for a corpse) — crash paths
+        leave the frozen file behind as the death evidence. An
+        externally-owned heartbeat (elastic_train's cross-generation
+        one) is left running; its owner stops it."""
+        if self._owns_heartbeat or remove_heartbeat:
+            self._heartbeat.stop(remove=remove_heartbeat)
+        with _CO_LOCK:
+            if self in _COORDINATORS:
+                _COORDINATORS.remove(self)
+        self._started = False
+
+    def __repr__(self) -> str:
+        return (f"ElasticCoordinator(h{self.host_id:02d}, gen={self.gen}, "
+                f"hosts={self.hosts})")
+
+
+def stop_all() -> tuple:
+    """Stop every live coordinator (heartbeat threads included);
+    returns their reprs — the conftest guard asserts this is empty."""
+    with _CO_LOCK:
+        leaked = tuple(_COORDINATORS)
+    names = tuple(repr(c) for c in leaked)
+    for c in leaked:
+        c.stop()
+    return names
+
+
+def _read_topology(path: str) -> Optional[dict]:
+    from sketch_rnn_tpu.parallel.multihost import _read_json
+
+    return _read_json(path)
+
+
+def divisible_prefix(survivors: List[int], global_batch: int
+                     ) -> List[int]:
+    """The largest leading subset of ``survivors`` (sorted) whose size
+    divides the global batch — the host set the fleet can actually
+    re-stripe onto. 4 hosts at batch 8 losing one leaves 3 survivors,
+    which 8 does not divide: rather than crashing every healthy host
+    on the ``local_batch_hps`` ValueError mid-recovery, the fleet
+    keeps the largest workable prefix (here 2 hosts) and RETIRES the
+    rest cleanly. Always non-empty (1 divides everything), and always
+    contains the minimum survivor (the new primary)."""
+    s = sorted(survivors)
+    for k in range(len(s), 0, -1):
+        if global_batch % k == 0:
+            return s[:k]
+    raise AssertionError("unreachable: k=1 divides any batch")
+
+
+def latest_topology(rendezvous_dir: str) -> Optional[dict]:
+    """Highest-generation topology file in the rendezvous dir (None on
+    a fresh fleet)."""
+    try:
+        names = sorted(n for n in os.listdir(rendezvous_dir)
+                       if n.startswith(TOPOLOGY_PREFIX))
+    except OSError:
+        return None
+    for name in reversed(names):
+        doc = _read_topology(os.path.join(rendezvous_dir, name))
+        if doc is not None:
+            return doc
+    return None
+
+
+def elastic_train(hps: HParams,
+                  make_loaders: Callable,
+                  *,
+                  rendezvous_dir: str,
+                  host_id: int,
+                  num_hosts: int,
+                  workdir: str,
+                  seed: int = 0,
+                  num_steps: Optional[int] = None,
+                  use_mesh: bool = True,
+                  resume: bool = True,
+                  trace_dir: Optional[str] = None,
+                  profile: bool = False,
+                  watchdog: bool = False,
+                  halt_on_anomaly: bool = False,
+                  stale_s: float = HEARTBEAT_STALE_S,
+                  timeout_s: float = BARRIER_TIMEOUT_S,
+                  heartbeat_interval_s: Optional[float] = None,
+                  max_generations: int = 16):
+    """Run ``train()`` as host ``host_id`` of an elastic ``num_hosts``
+    fleet; returns the final TrainState on every surviving host (or
+    None on a host cleanly RETIRED because a post-death survivor count
+    did not divide the global batch — see :func:`divisible_prefix`).
+
+    ``make_loaders(local_hps, rank, n_hosts)`` must build fresh
+    COORDINATED loaders for one generation and return ``(train_loader,
+    valid_loader, test_loader, scale_factor)`` — it is called again
+    after every topology change with the new striping (the fresh-
+    loader-per-relaunch discipline resume_align depends on). ``hps``
+    carries the GLOBAL batch size, like the cli/train contract.
+
+    The restart protocol on a detected death (every survivor, same
+    step): the surviving primary has already committed the consistent
+    checkpoint inside ``train()``'s handler; survivors agree on the
+    new topology (generation file), the primary rewrites RUN.json with
+    the new host set + the death event, and the loop relaunches
+    ``train()`` with ``resume=True`` — restore + ``resume_align``
+    fast-forward through the same global stream at the new striping.
+    A host absent from the agreed topology (or the dead host itself)
+    never rejoins: generations only shrink.
+    """
+    if num_hosts < 1 or not 0 <= host_id < num_hosts:
+        raise ValueError(f"host_id {host_id} out of range for "
+                         f"num_hosts={num_hosts}")
+    if hps.batch_size % num_hosts != 0:
+        raise ValueError(f"global batch {hps.batch_size} not divisible "
+                         f"by {num_hosts} hosts")
+    topo = latest_topology(rendezvous_dir)
+    if topo is None:
+        gen, hosts = 0, list(range(num_hosts))
+    else:
+        # a relaunched/late host joins the CURRENT generation (the
+        # fleet may already have shrunk); the dead never rejoin
+        gen, hosts = int(topo["generation"]), list(topo["hosts"])
+        if host_id not in hosts:
+            raise RuntimeError(
+                f"host {host_id} is not part of the current topology "
+                f"{hosts} (gen {gen}); dead hosts do not rejoin an "
+                f"elastic fleet")
+    events: List[dict] = []
+    # ONE heartbeat for the whole run, beating across generations: the
+    # inter-generation regroup (loader rebuild, plan fingerprint) can
+    # take longer than stale_s on real data, and a survivor whose
+    # liveness file froze during it would be falsely declared dead by
+    # a faster peer. Stopped only on final return (clean: file
+    # removed) or in the outer finally (crash: frozen file = the
+    # evidence peers detect).
+    hb_kw = ({} if heartbeat_interval_s is None
+             else {"interval_s": heartbeat_interval_s})
+    heartbeat = HostHeartbeat(rendezvous_dir, host_id,
+                              **hb_kw).start()
+    try:
+        return _elastic_generations(
+            hps, make_loaders, rendezvous_dir=rendezvous_dir,
+            host_id=host_id, num_hosts=num_hosts, workdir=workdir,
+            seed=seed, num_steps=num_steps, use_mesh=use_mesh,
+            resume=resume, trace_dir=trace_dir, profile=profile,
+            watchdog=watchdog, halt_on_anomaly=halt_on_anomaly,
+            stale_s=stale_s, timeout_s=timeout_s,
+            max_generations=max_generations, gen=gen, hosts=hosts,
+            events=events, heartbeat=heartbeat)
+    finally:
+        heartbeat.stop()
+
+
+def _elastic_generations(hps, make_loaders, *, rendezvous_dir, host_id,
+                         num_hosts, workdir, seed, num_steps, use_mesh,
+                         resume, trace_dir, profile, watchdog,
+                         halt_on_anomaly, stale_s, timeout_s,
+                         max_generations, gen, hosts, events,
+                         heartbeat):
+    """The per-generation loop of :func:`elastic_train` (which owns the
+    cross-generation heartbeat wrapped around this)."""
+    from sketch_rnn_tpu.parallel.multihost import local_batch_hps
+    from sketch_rnn_tpu.train.loop import train
+    from sketch_rnn_tpu.utils import runinfo
+
+    while True:
+        n = len(hosts)
+        coord = ElasticCoordinator(
+            rendezvous_dir, host_id, hosts, gen=gen, stale_s=stale_s,
+            timeout_s=timeout_s, fleet_size=num_hosts,
+            heartbeat=heartbeat)
+        lhps = local_batch_hps(hps, num_hosts=n)
+        train_l, valid_l, test_l, scale = make_loaders(
+            lhps, coord.rank, n)
+        fp = (train_l.plan_fingerprint()
+              if hasattr(train_l, "plan_fingerprint") else None)
+        try:
+            coord.start(plan_fingerprint=fp,
+                        config_hash=runinfo.config_hash(hps))
+            if coord.is_primary and workdir:
+                # RUN.json is the fleet's topology ledger (ISSUE 8
+                # manifests): rewritten every generation with the LIVE
+                # host set and the accumulated death events, so an
+                # operator (and the chaos harness) can read exactly
+                # how the fleet shrank and where each resume landed
+                runinfo.write_manifest(
+                    workdir, kind="elastic_train", hps=hps,
+                    extra={"elastic": {
+                        "generation": gen, "num_hosts": n,
+                        "hosts": hosts, "events": events,
+                        "rendezvous_dir": os.path.abspath(
+                            rendezvous_dir)}})
+            state = train(hps, train_l, valid_loader=valid_l,
+                          test_loader=test_l, scale_factor=scale,
+                          workdir=workdir, seed=seed,
+                          num_steps=num_steps, use_mesh=use_mesh,
+                          resume=resume, trace_dir=trace_dir,
+                          profile=profile, watchdog=watchdog,
+                          halt_on_anomaly=halt_on_anomaly,
+                          coordinator=coord)
+            # clean completion: drop the liveness file so a reused
+            # rendezvous dir reads this host as "done", never "dead"
+            coord.stop(remove_heartbeat=True)
+            return state
+        except HostDeathDetected as death:
+            from sketch_rnn_tpu.train.checkpoint import latest_checkpoint
+
+            # only the NEW PRIMARY's view of latest_checkpoint is
+            # authoritative (it reads after its own consistent commit
+            # inside train()'s handler); other survivors would race
+            # that commit and record a stale cadenced save — they take
+            # the value from the published topology doc instead
+            resumed_from = (latest_checkpoint(workdir)
+                            if workdir and death.new_primary else None)
+            # a survivor count that does not divide the global batch
+            # cannot be striped onto: keep the largest workable prefix
+            # and RETIRE the rest cleanly (crashing every healthy host
+            # on the local_batch_hps ValueError mid-recovery would
+            # turn one death into a fleet-wide halt)
+            new_hosts = divisible_prefix(death.survivors,
+                                         hps.batch_size)
+            retired = [h for h in death.survivors
+                       if h not in new_hosts]
+            topo_doc = coord.commit_topology(
+                new_hosts, death.step, death.dead, resumed_from,
+                retired=retired)
+            resumed_from = topo_doc.get("resumed_from")
+            print(f"[elastic] host {host_id}: detected death of "
+                  f"{death.dead} at step {death.step}; regrouping as "
+                  f"{topo_doc['hosts']} (resume from {resumed_from}"
+                  + (f"; retired {topo_doc.get('retired')}"
+                     if topo_doc.get("retired") else "") + ")",
+                  flush=True)
+            events.append({"generation": gen, "dead": death.dead,
+                           "at_step": death.step,
+                           "resumed_from": resumed_from,
+                           "retired": topo_doc.get("retired", [])})
+            gen, hosts = topo_doc["generation"], list(topo_doc["hosts"])
+            if host_id not in hosts:
+                # deliberately retired: exit CLEANLY (liveness file
+                # removed — the fleet must not read this host as a
+                # corpse; it holds no state the survivors need)
+                print(f"[elastic] host {host_id}: retired — "
+                      f"{len(death.survivors)} survivors do not "
+                      f"divide global batch {hps.batch_size}; the "
+                      f"fleet continues as {hosts}", flush=True)
+                heartbeat.stop(remove=True)
+                return None
+            resume = True
+            if gen > max_generations:
+                raise RuntimeError(
+                    f"elastic fleet restarted {gen} times — beyond "
+                    f"max_generations={max_generations}; refusing to "
+                    f"thrash") from death
+        finally:
+            coord.stop()

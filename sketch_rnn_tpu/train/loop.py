@@ -68,7 +68,11 @@ from sketch_rnn_tpu.data.loader import DataLoader
 from sketch_rnn_tpu.data.prefetch import prefetch_batches
 from sketch_rnn_tpu.models.vae import SketchRNN
 from sketch_rnn_tpu.parallel.mesh import make_mesh, shard_batch
-from sketch_rnn_tpu.parallel.multihost import is_primary, topology
+from sketch_rnn_tpu.parallel.multihost import (
+    HostDeathDetected,
+    is_primary,
+    topology,
+)
 from sketch_rnn_tpu.train.async_ckpt import AsyncCheckpointer
 from sketch_rnn_tpu.train.checkpoint import (
     latest_checkpoint,
@@ -307,7 +311,8 @@ def train(hps: HParams,
           profile: bool = False,
           trace_dir: Optional[str] = None,
           watchdog: bool = False,
-          halt_on_anomaly: bool = False) -> TrainState:
+          halt_on_anomaly: bool = False,
+          coordinator=None) -> TrainState:
     """Train for ``num_steps`` (default ``hps.num_steps``); returns state.
 
     Resumes from the latest checkpoint in ``workdir`` when present
@@ -336,8 +341,23 @@ def train(hps: HParams,
     so a diverged state can never become ``latest_checkpoint``. Both
     off by default and bitwise-invisible when off: the drain's check
     chain is exactly ``check_finite`` and no watchdog state exists.
+
+    ``coordinator`` (ISSUE 14, train/elastic.py ElasticCoordinator)
+    makes this one host of an elastic fleet: its step barrier runs
+    once per dispatch-loop iteration (the host-death detection point
+    and the ``host.kill.hNN`` fault site), its host identity replaces
+    the jax-cluster primary gating (the light-mode fleet has no
+    ``jax.distributed``), and on a detected peer death the surviving
+    primary commits a CONSISTENT checkpoint of the live state — every
+    survivor holds the identical replicated state at the barriered
+    step — through the active save path before the
+    ``HostDeathDetected`` propagates to the restart protocol. None
+    (the default) is bitwise-invisible: no barrier, no behavior
+    change.
     """
     num_steps = hps.num_steps if num_steps is None else num_steps
+    primary = (coordinator.is_primary if coordinator is not None
+               else is_primary())
     mem_sampler = None
     if trace_dir:
         # EVERY process records and exports its own shard (ISSUE 8):
@@ -346,10 +366,29 @@ def train(hps: HParams,
         # shards instead of colliding on one path (the pre-tentpole
         # bug: the old primary-only gate hid every other host's
         # timeline entirely). scripts/trace_merge.py joins them.
-        topo = topology()
-        tele.configure(trace_dir=trace_dir,
-                       process_index=topo["process_index"],
-                       host_count=topo["host_count"])
+        # Elastic light-mode fleets (ISSUE 14) have no jax cluster —
+        # every process would stamp (0, 1) and overwrite one shared
+        # shard — so the coordinator's fleet coordinate wins: original
+        # host id + the gen-0 fleet size (stable across generations,
+        # so a dead host's missing tail is annotated by trace_merge
+        # instead of silently shrinking the declared topology).
+        if coordinator is not None:
+            # a post-death RELAUNCH reuses the live core instead of
+            # configuring a fresh one: configure() discards recorded
+            # events, and both generations export to the same shard
+            # path — reconfiguring would silently drop every
+            # survivor's pre-death timeline from the merged trace
+            cur = tele.get_telemetry()
+            if not (cur.enabled and cur.trace_dir == trace_dir
+                    and cur.process_index == coordinator.host_id):
+                tele.configure(trace_dir=trace_dir,
+                               process_index=coordinator.host_id,
+                               host_count=coordinator.fleet_size)
+        else:
+            topo = topology()
+            tele.configure(trace_dir=trace_dir,
+                           process_index=topo["process_index"],
+                           host_count=topo["host_count"])
     # fail fast: an un-evaluable valid split would otherwise only raise at
     # the FIRST eval sweep, hours into training (everything needed for the
     # check is known now)
@@ -364,7 +403,7 @@ def train(hps: HParams,
     root_key = jax.random.key(seed)
     root_key, init_key = jax.random.split(root_key)
     state = make_train_state(model, hps, init_key)
-    if is_primary():
+    if primary:
         print(f"[train] model: enc={hps.enc_model} dec={hps.dec_model} "
               f"params={param_count(state.params):,} "
               f"devices={mesh.size if mesh is not None else 1}", flush=True)
@@ -411,7 +450,7 @@ def train(hps: HParams,
     # workdir MUST be shared storage in multi-host runs — every host
     # restores from it on resume, so a per-host dir would desynchronize
     # the SPMD step counts (host 0 resumes, others restart at 0)
-    write_dir = workdir if is_primary() else None
+    write_dir = workdir if primary else None
     writer = MetricsWriter(write_dir, "train")
     eval_writer = MetricsWriter(write_dir, "valid")
     # the goodput runtime: one-window-deferred metrics conversion (the
@@ -426,7 +465,7 @@ def train(hps: HParams,
     # pre-watchdog loop.
     wd_monitor = None
     check = check_finite
-    if (watchdog or halt_on_anomaly) and is_primary():
+    if (watchdog or halt_on_anomaly) and primary:
         wd_monitor = WatchdogMonitor(write_dir,
                                      halt=halt_on_anomaly).arm()
 
@@ -445,7 +484,7 @@ def train(hps: HParams,
     # buckets would remove. Columns are pre-declared at loader build
     # (CSV header stability).
     pad_ledger = getattr(train_loader, "padding_ledger", None)
-    if getattr(train_loader, "bucket_edges", ()) and is_primary():
+    if getattr(train_loader, "bucket_edges", ()) and primary:
         sched = (f" run_sched: steps_per_call={spc} "
                  f"run_len={hps.bucket_run_len}" if run_sched else "")
         print(f"[train] bucketed execution: edges="
@@ -498,6 +537,14 @@ def train(hps: HParams,
             # uninterrupted run. No-op (one global read) when no fault
             # plan is armed.
             fault_point("train.step")
+            if coordinator is not None:
+                # elastic fleet (ISSUE 14): one barrier per dispatch-
+                # loop iteration — the host-death detection point (and
+                # the host.kill.hNN fault site, inside step_barrier).
+                # All hosts enter barrier `step` together holding the
+                # identical replicated state, which is what makes the
+                # death-time checkpoint below CONSISTENT.
+                coordinator.step_barrier(step)
             if profile_span and not trace_active and step >= profile_span[0]:
                 tele.get_telemetry().instant(
                     tele.DEVICE_TRACE_START, cat=tele.PROFILER_CAT,
@@ -620,6 +667,30 @@ def train(hps: HParams,
         # finiteness guard — divergence still stops the run before the
         # final checkpoint commits) lands here
         drain.flush()
+    except HostDeathDetected as death:
+        # elastic recovery entry (ISSUE 14): every survivor raises HERE
+        # at the same barrier step with the identical replicated state.
+        # The new primary (lowest surviving id) commits that state as a
+        # CONSISTENT checkpoint into the shared workdir — through the
+        # active async writer when armed (the PR 3 commit path; files
+        # byte-identical to sync), else the sync save — so the restart
+        # protocol (train/elastic.py) resumes from the death step
+        # instead of replaying back to the last cadenced save. Zero
+        # device steps are lost: the recovery cost is the host-side
+        # fast-forward replay only. Commit failures propagate — a fleet
+        # that cannot checkpoint must halt loudly, not restart blind.
+        if coordinator is not None and workdir and death.new_primary:
+            if ckpt is not None:
+                ckpt.save(state, scale_factor, hps)
+                ckpt.wait()
+            else:
+                save_checkpoint(workdir, state, scale_factor, hps,
+                                retries=hps.ckpt_retries,
+                                retry_backoff_s=hps.ckpt_retry_backoff_s)
+            print(f"[elastic] consistent checkpoint committed at step "
+                  f"{int(state.step)} after death of {death.dead}",
+                  flush=True)
+        raise
     except AnomalyHalt as halt:
         # --halt_on_anomaly tripped: force a post-mortem checkpoint of
         # the live state into <workdir>/incident/ — NOT the resume
@@ -694,7 +765,7 @@ def train(hps: HParams,
             save_checkpoint(write_dir, state, scale_factor, hps,
                             retries=hps.ckpt_retries,
                             retry_backoff_s=hps.ckpt_retry_backoff_s)
-    if is_primary():
+    if primary:
         totals = ledger.summary()
         print("[goodput] " + " ".join(
             f"{name}={rec['total_s']:.2f}s" for name, rec in
@@ -709,7 +780,7 @@ def train(hps: HParams,
     if trace_dir:
         tel = tele.get_telemetry()
         paths = tel.export()  # every host exports its own shard
-        if is_primary():
+        if primary:
             n_hosts = tel.host_count
             merge_hint = (" — merge the per-host shards with "
                           "scripts/trace_merge.py" if n_hosts > 1 else "")

@@ -20,8 +20,13 @@ failure *injectable, reproducible and accounted*:
   the abort-ledger / duplicate-emission path), the data
   loader's batch assembly (``data.batch``), the metrics writer
   (``metrics.write``), a drained metrics row's loss value
-  (``metrics.row``), and the training loop's step dispatch
-  (``train.step``). Sites cost one module-global read when no plan is
+  (``metrics.row``), the training loop's step dispatch
+  (``train.step``), an elastic host's step-barrier entry
+  (``host.kill.hNN``, train/elastic.py — ``kind=exit`` is an honest
+  host DEATH: the heartbeat stops beating and every surviving peer's
+  barrier detects it), and the fleet barrier exchange itself
+  (``dcn.collective``, parallel/multihost.py — the DCN-collective
+  failure class). Sites cost one module-global read when no plan is
   armed — the process default — so the chaos layer is invisible in
   production runs (the telemetry off-by-default discipline).
 

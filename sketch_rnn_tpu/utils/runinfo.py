@@ -38,6 +38,7 @@ import binascii
 import hashlib
 import json
 import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -45,6 +46,32 @@ RUN_MANIFEST = "RUN.json"
 RUN_ID_ENV = "SKETCH_RNN_RUN_ID"
 
 _run_id: Optional[str] = None
+_wall_time: Optional[float] = None
+_mint_lock = threading.Lock()
+
+
+def run_wall_time() -> float:
+    """The process's ONE wall-clock stamp (minted at first use) — the
+    run-manifest clock every history row and manifest of an invocation
+    shares. ISSUE 14 satellite: bench/resilience cells used to stamp a
+    fresh ``time.time()`` per row, so one run's committed rows carried
+    N distinct timestamps and every re-run diffed on all of them;
+    stamping the run's single clock keeps committed history rows
+    diffing cleanly (one changed value per run) and makes ``wall_time``
+    a JOIN key to the run's RUN.json ``created_unix``. Lock-guarded:
+    concurrent first calls (in-process multi-host threads) must mint
+    ONE stamp, or the join-key invariant breaks on its first use."""
+    global _wall_time
+    with _mint_lock:
+        if _wall_time is None:
+            _wall_time = time.time()
+        return _wall_time
+
+
+def set_run_wall_time(t: Optional[float]) -> None:
+    """Pin (or with None, reset) the process wall-time stamp — tests."""
+    global _wall_time
+    _wall_time = t
 
 
 def get_run_id() -> str:
@@ -129,7 +156,7 @@ def write_manifest(out_dir: str, kind: str,
     doc: Dict[str, object] = {
         "run_id": run_id,
         "kind": kind,
-        "created_unix": time.time(),
+        "created_unix": run_wall_time(),
         "config_hash": config_hash(hps),
         "host": host_topology(),
         "artifacts": {},

@@ -799,6 +799,11 @@ class Telemetry:
                 "dropped": dropped,
                 "process_index": self.process_index,
                 "host_count": self.host_count,
+                # announces the end sentinel up front (ISSUE 14): a
+                # stream whose meta carries this but whose tail lacks
+                # the sentinel was torn mid-export — even if the tear
+                # landed inside the summary block
+                "end_sentinel": True,
                 "run_id": self.run_id}) + "\n")
             for ev in events:
                 f.write(json.dumps(ev) + "\n")
@@ -816,6 +821,15 @@ class Telemetry:
                 f.write(json.dumps({
                     "type": "hist", "cat": cat, "name": name, **s,
                     "total": total, "raw": raw}) + "\n")
+            # end sentinel (ISSUE 14 satellite): a shard whose stream
+            # stops before this line was torn mid-export — a killed
+            # host's tail. trace_merge uses it (or, for pre-sentinel
+            # exports, the presence of summary lines) to annotate the
+            # merged meta with host_died instead of only warning that
+            # totals undercount. Readers skip unknown types, so old
+            # tooling is unaffected.
+            f.write(json.dumps({"type": "end",
+                                "events": len(events)}) + "\n")
 
     def export_chrome_trace(self, path: str) -> None:
         """Write a Chrome-trace ``traceEvents`` JSON (chrome://tracing /

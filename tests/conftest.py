@@ -88,24 +88,39 @@ def _no_leaked_fleet_threads():
     the test — a faulted test (injected replica death, crashed async
     save) must not leave a runtime thread behind even when its owning
     object already unregistered. A short grace window covers threads
-    that are mid-exit (a ckpt writer finishing its last commit)."""
+    that are mid-exit (a ckpt writer finishing its last commit).
+
+    ISSUE 14 extends it to the elastic runtime: coordinator/heartbeat
+    registries (train/elastic.py, parallel/multihost.py) are drained
+    and no ``host-heartbeat-*`` thread may survive a test — a leaked
+    heartbeat keeps a dead test's host looking ALIVE to any later
+    test's failure detector."""
     yield
     import threading
     import time as _time
 
+    from sketch_rnn_tpu.parallel import multihost
     from sketch_rnn_tpu.serve import fleet, loadgen
+    from sketch_rnn_tpu.train import elastic
 
     leaked_gens = loadgen.stop_all()
     leaked_fleets = fleet.stop_all()
+    leaked_coords = elastic.stop_all()
+    leaked_beats = multihost.stop_all_heartbeats()
     assert not leaked_gens, (
         f"test leaked live load generators: {leaked_gens}")
     assert not leaked_fleets, (
         f"test leaked live serve fleets: {leaked_fleets}")
+    assert not leaked_coords, (
+        f"test leaked live elastic coordinators: {leaked_coords}")
+    assert not leaked_beats, (
+        f"test leaked live host heartbeats: {leaked_beats}")
 
     def _runtime_threads():
         return sorted(t.name for t in threading.enumerate()
                       if t.is_alive() and t.name.startswith(
-                          ("fleet-replica-", "loadgen", "ckpt-writer")))
+                          ("fleet-replica-", "loadgen", "ckpt-writer",
+                           "host-heartbeat-")))
 
     deadline = _time.monotonic() + 5.0
     survivors = _runtime_threads()

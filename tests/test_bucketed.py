@@ -180,9 +180,13 @@ def test_buckets_off_prefetch_stream_unchanged():
         feeder.close()
 
 
-def test_bucketed_loader_rejects_host_striping():
+def test_bucketed_loader_rejects_uncoordinated_host_striping():
+    """ISSUE 14 narrows the old single-host-only guard: bucketing on a
+    LEGACY striped loader (each host planning its own schedule) still
+    refuses — pointing at the coordinated global plan, which is the
+    mode that lifts it (tests/test_elastic.py pins that path)."""
     seqs, labels = corpus(30)
-    with pytest.raises(RuntimeError, match="single-host"):
+    with pytest.raises(RuntimeError, match="coordinated"):
         DataLoader(seqs[0::2], small_hps(bucket_edges=(32, 64)),
                    labels=labels[0::2], global_size=30, num_hosts=2)
 

@@ -1,0 +1,547 @@
+"""Elastic multi-host training (ISSUE 14): coordinated bucket plans,
+host-death survival, topology-change-equivalent resume.
+
+The acceptance pins:
+
+- per-host ``(B, Tb)`` run schedules identical across ``num_hosts`` in
+  {2, 4}, and the two-host GLOBAL micro-batch stream bitwise equal to
+  the single-host stream (the coordinated plan contract that lifts the
+  ``data/loader.py`` multi-host bucketing guard);
+- ``fast_forward`` on host-striped loaders partitions the global
+  stream exactly and deterministically at every host count (what makes
+  a resume at a DIFFERENT topology replay the same global stream);
+- host death detected via barrier + stale heartbeat, survivors commit
+  a consistent checkpoint and recover to a final state leaf-bitwise
+  equal to an uninterrupted run at the surviving topology (in-process
+  here through the real ``host.kill.hNN`` fault site; the two-real-
+  subprocess version is scripts/resilience_bench.py's chaos cell);
+- the elastic machinery with ``num_hosts=1`` and armed-but-never-
+  firing host-kill plans are bitwise invisible.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data.loader import DataLoader, synthetic_loader
+from sketch_rnn_tpu.parallel import multihost as mh
+from sketch_rnn_tpu.train import elastic as EL
+from sketch_rnn_tpu.utils import faults
+
+BUCKET_HPS = HParams(batch_size=8, max_seq_len=24, bucket_edges=(12,),
+                     enc_rnn_size=8, dec_rnn_size=12, z_size=4,
+                     num_mixture=2, use_recurrent_dropout=False,
+                     prefetch_depth=0)
+
+
+def coord_loaders(hps_global, n_hosts, emit_global=False, seed=7,
+                  num=40, augment=True):
+    lhps = hps_global.replace(
+        batch_size=hps_global.batch_size // n_hosts)
+    return [synthetic_loader(lhps, num, seed=seed, augment=augment,
+                             host_id=h, num_hosts=n_hosts,
+                             coordinated=True,
+                             emit_global=emit_global)[0]
+            for h in range(n_hosts)]
+
+
+# -- coordinated plan: schedules + global stream (tentpole piece 1) ---------
+
+
+@pytest.mark.parametrize("n_hosts", [2, 4])
+def test_per_host_geometry_schedules_identical_and_stream_partitions(
+        n_hosts):
+    """THE guard-lift acceptance: every host's (B, Tb) schedule is
+    identical (so SPMD collectives can never see mismatched programs),
+    and the concatenation of the per-host slices reproduces the
+    single-host global stream BITWISE — augmentation included, across
+    an epoch refill."""
+    hosts = coord_loaders(BUCKET_HPS, n_hosts)
+    single = coord_loaders(BUCKET_HPS, 1)[0]
+    b_local = BUCKET_HPS.batch_size // n_hosts
+    for step in range(12):  # 40 examples / gbatch 8 -> crosses epochs
+        batches = [dl.next_batch() for dl in hosts]
+        ref = single.next_batch()
+        shapes = {x["strokes"].shape for x in batches}
+        assert len(shapes) == 1, f"step {step}: per-host geometry " \
+                                 f"diverged: {shapes}"
+        (bs, t, five), = shapes
+        assert (bs, t) == (b_local, ref["strokes"].shape[1])
+        for key in ref:
+            np.testing.assert_array_equal(
+                np.concatenate([x[key] for x in batches]), ref[key],
+                err_msg=f"step {step} leaf {key}")
+
+
+def test_plan_fingerprint_detects_same_size_corpus_divergence():
+    """Review fix: the fingerprint hashes corpus CONTENT, not just its
+    length — a stale same-sized corpus on one host must fail the
+    start-barrier divergence check, never silently train apart."""
+    a = coord_loaders(BUCKET_HPS, 1, seed=7)[0]
+    b = coord_loaders(BUCKET_HPS, 1, seed=7)[0]
+    assert a.plan_fingerprint(0) == b.plan_fingerprint(0)
+    b.strokes[3][0, 0] += 1.0  # one value of one sequence diverges
+    assert a.plan_fingerprint(0) != b.plan_fingerprint(0)
+
+
+def test_coordinated_plan_identical_across_hosts_and_topologies():
+    """The plan is a pure function of (seed, epoch, global corpus,
+    B_global) — NEVER of num_hosts: fingerprints agree across hosts
+    and across topologies sharing the global batch."""
+    two = coord_loaders(BUCKET_HPS, 2)
+    four = coord_loaders(BUCKET_HPS, 4)
+    one = coord_loaders(BUCKET_HPS, 1)[0]
+    fps = {dl.plan_fingerprint(0) for dl in two + four + [one]}
+    assert len(fps) == 1
+    assert one.plan_fingerprint(1) not in fps  # epochs differ
+    # and the guard really is lifted only for the coordinated mode
+    with pytest.raises(RuntimeError, match="coordinated"):
+        seqs = [np.ones((5, 3), np.float32)] * 10
+        DataLoader(seqs, BUCKET_HPS.replace(batch_size=4),
+                   global_size=20, num_hosts=2)
+
+
+@pytest.mark.parametrize("k_max", [3, 4])
+def test_next_stack_runs_host_striped(k_max):
+    """Bucketed K-step stacks on a host-striped loader (the lifted
+    next_stack guard): every host pops same-length stacks of the same
+    (B, Tb) run, and the stacked micro-batch stream equals the
+    next_batch stream."""
+    hps = BUCKET_HPS.replace(bucket_run_len=4)
+    a0, a1 = coord_loaders(hps, 2)
+    b0, b1 = coord_loaders(hps, 2)
+    for _ in range(6):
+        s0, s1 = a0.next_stack(k_max), a1.next_stack(k_max)
+        assert s0["strokes"].shape == s1["strokes"].shape
+        for i in range(s0["strokes"].shape[0]):
+            r0, r1 = b0.next_batch(), b1.next_batch()
+            np.testing.assert_array_equal(s0["strokes"][i],
+                                          r0["strokes"])
+            np.testing.assert_array_equal(s1["strokes"][i],
+                                          r1["strokes"])
+
+
+@pytest.mark.parametrize("bucketed", [True, False],
+                         ids=["bucketed", "random-feed"])
+@pytest.mark.parametrize("n_hosts", [1, 2, 4])
+def test_fast_forward_partitions_global_stream(n_hosts, bucketed):
+    """ISSUE 14 satellite: per-host replay streams at num_hosts in
+    {1, 2, 4} partition the global stream exactly and deterministically
+    — fast_forward(R) lands every host at the same stream position a
+    batch-by-batch consumption reaches, so a topology-change resume
+    replays the identical global stream under the new striping."""
+    hps = BUCKET_HPS if bucketed else BUCKET_HPS.replace(bucket_edges=())
+    ffwd = coord_loaders(hps, n_hosts)
+    consumed = coord_loaders(hps, n_hosts)
+    single = coord_loaders(hps, 1)[0]
+    for dl in ffwd:
+        dl.fast_forward(7)
+    for dl in consumed:
+        for _ in range(7):
+            dl.next_batch()
+    for _ in range(7):
+        single.next_batch()
+    for _ in range(3):
+        ref = single.next_batch()
+        got = [dl.next_batch() for dl in ffwd]
+        alt = [dl.next_batch() for dl in consumed]
+        for key in ref:
+            np.testing.assert_array_equal(
+                np.concatenate([x[key] for x in got]), ref[key])
+            np.testing.assert_array_equal(
+                np.concatenate([x[key] for x in alt]), ref[key])
+
+
+def test_emit_global_and_eval_batches_topology_invariant():
+    """emit_global (the light-mode replicated feed) returns the same
+    global batches on every host; eval sweeps keep identical batch
+    counts and contents across hosts."""
+    g0, g1 = coord_loaders(BUCKET_HPS, 2, emit_global=True)
+    single = coord_loaders(BUCKET_HPS, 1)[0]
+    for _ in range(4):
+        x0, x1, ref = g0.next_batch(), g1.next_batch(), \
+            single.next_batch()
+        np.testing.assert_array_equal(x0["strokes"], x1["strokes"])
+        np.testing.assert_array_equal(x0["strokes"], ref["strokes"])
+    s0, s1 = coord_loaders(BUCKET_HPS, 2, augment=False)
+    assert s0.num_eval_batches == s1.num_eval_batches > 0
+    e0, e1 = s0.get_batch(0), s1.get_batch(0)
+    assert e0["strokes"].shape == e1["strokes"].shape
+    # the two hosts hold DISJOINT row slices of one global eval batch
+    full = coord_loaders(BUCKET_HPS, 1, augment=False)[0].get_batch(0)
+    np.testing.assert_array_equal(
+        np.concatenate([e0["strokes"], e1["strokes"]]), full["strokes"])
+
+
+# -- failure detection (tentpole piece 2) -----------------------------------
+
+
+def test_rendezvous_detects_stale_host_and_waits_for_fresh(tmp_path):
+    """Barrier semantics: a missing host with a FRESH heartbeat is
+    waited for; one whose heartbeat goes stale is declared dead with
+    the correct survivor set and new-primary verdict."""
+    d = str(tmp_path)
+    hb0 = mh.HostHeartbeat(d, 0, interval_s=0.05).start()
+    try:
+        # host 1 heartbeats once, then "dies" (no thread ever runs)
+        mh._atomic_json(mh.heartbeat_path(d, 1),
+                        {"host": 1, "count": 1, "time": 0.0})
+        rdv = mh.FleetRendezvous(d, 0, [0, 1], stale_s=0.5,
+                                 timeout_s=10.0)
+        with pytest.raises(mh.HostDeathDetected) as ei:
+            rdv.barrier("step00000003", step=3)
+        assert ei.value.dead == [1] and ei.value.survivors == [0]
+        assert ei.value.step == 3 and ei.value.new_primary
+    finally:
+        hb0.stop()
+    # a fresh-heartbeat straggler is NOT dead: the barrier keeps
+    # waiting until its hard timeout, then raises the loud non-death
+    hb1 = mh.HostHeartbeat(d, 1, interval_s=0.05).start()
+    try:
+        rdv = mh.FleetRendezvous(d, 0, [0, 1], stale_s=5.0,
+                                 timeout_s=0.6)
+        with pytest.raises(RuntimeError, match="timed out"):
+            rdv.barrier("step00000004", step=4)
+    finally:
+        hb1.stop()
+
+
+def test_unbooted_peer_is_waited_for_not_killed(tmp_path):
+    """A peer with NO heartbeat file has not launched yet (clean stops
+    delete the file): the barrier must wait toward its hard timeout
+    and raise the loud launch-failure error, never declare death —
+    launch skew / reused rendezvous dirs cannot false-kill."""
+    d = str(tmp_path)
+    hb0 = mh.HostHeartbeat(d, 0, interval_s=0.05).start()
+    try:
+        rdv = mh.FleetRendezvous(d, 0, [0, 1], stale_s=0.2,
+                                 timeout_s=0.8)
+        with pytest.raises(RuntimeError, match="never heartbeated"):
+            rdv.barrier("step00000000", step=0)
+    finally:
+        hb0.stop()
+
+
+def test_clean_stop_removes_heartbeat_crash_leaves_it(tmp_path):
+    d = str(tmp_path)
+    hb = mh.HostHeartbeat(d, 3, interval_s=0.05).start()
+    hb.stop()  # crash-path default: frozen file stays (the evidence)
+    assert os.path.exists(mh.heartbeat_path(d, 3))
+    hb2 = mh.HostHeartbeat(d, 3, interval_s=0.05).start()
+    hb2.stop(remove=True)  # clean completion: no corpse left behind
+    assert not os.path.exists(mh.heartbeat_path(d, 3))
+
+
+def test_barrier_prunes_own_previous_arrival_files(tmp_path):
+    """A long run must not leave one arrival file per host per step."""
+    d = str(tmp_path)
+    rdv = mh.FleetRendezvous(d, 0, [0], stale_s=1.0, timeout_s=5.0)
+    for s in range(5):
+        rdv.barrier(f"step{s:08d}", step=s)
+    left = [n for n in os.listdir(d) if n.startswith("bar_")]
+    assert len(left) == 1  # only the latest barrier's own file
+
+
+def test_external_heartbeat_survives_coordinator_stop(tmp_path):
+    """Review fix: elastic_train's cross-generation heartbeat must keep
+    beating through a generation teardown — freezing it during the
+    regroup (loader rebuild) would let a faster peer declare a healthy
+    survivor dead."""
+    d = str(tmp_path)
+    hb = mh.HostHeartbeat(d, 0, interval_s=0.05).start()
+    try:
+        co = EL.ElasticCoordinator(d, 0, [0], heartbeat=hb)
+        co.start()
+        co.stop()  # generation teardown: external heartbeat untouched
+        assert hb._thread.is_alive()
+        t0 = mh._read_json(mh.heartbeat_path(d, 0))["time"]
+        import time
+
+        time.sleep(0.2)
+        assert mh._read_json(mh.heartbeat_path(d, 0))["time"] > t0
+    finally:
+        hb.stop()
+
+
+def test_relaunch_reuses_live_telemetry_core(tmp_path, plain_baseline):
+    """Review fix: a post-death relaunch must not configure a fresh
+    core — both generations export to ONE shard path, so the pre-death
+    events must survive into the final export."""
+    from sketch_rnn_tpu.train import train
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    tdir = str(tmp_path / "trace")
+    # "generation 0": the live core already holds events
+    tele.configure(trace_dir=tdir, process_index=0, host_count=2)
+    tele.get_telemetry().instant("gen0_marker", cat="train")
+    co = EL.ElasticCoordinator(str(tmp_path / "rdv"), 0, [0],
+                               fleet_size=2,
+                               heartbeat_interval_s=0.05)
+    co.start()
+    try:
+        dl, _, _, scale = _make_loaders(TRAIN_HPS, 0, 1)
+        train(TRAIN_HPS, dl, scale_factor=scale, workdir=None, seed=0,
+              use_mesh=False, trace_dir=tdir, coordinator=co)
+    finally:
+        co.stop()
+    stream = open(tmp_path / "trace" / "telemetry.p0000.jsonl").read()
+    assert '"gen0_marker"' in stream  # pre-relaunch events survived
+
+
+def test_elastic_trace_dir_shards_per_host(tmp_path, plain_baseline):
+    """ISSUE 14 review fix: under a coordinator, telemetry is stamped
+    with the COORDINATOR's fleet coordinate (original host id, gen-0
+    fleet size), not jax's (0, 1) — so light-mode hosts sharing a
+    trace_dir write distinct shards and a dead host reads as a missing
+    shard of the declared fleet."""
+    from sketch_rnn_tpu.train import train
+
+    tdir = str(tmp_path / "trace")
+    co = EL.ElasticCoordinator(str(tmp_path / "rdv"), host_id=1,
+                               hosts=[1], fleet_size=2,
+                               heartbeat_interval_s=0.05)
+    co.start()
+    try:
+        dl, _, _, scale = _make_loaders(TRAIN_HPS, 0, 1)
+        train(TRAIN_HPS, dl, scale_factor=scale, workdir=None, seed=0,
+              use_mesh=False, trace_dir=tdir, coordinator=co)
+    finally:
+        co.stop()
+    shard = tmp_path / "trace" / "telemetry.p0001.jsonl"
+    assert shard.exists()
+    meta = json.loads(open(shard).readline())
+    assert meta["process_index"] == 1 and meta["host_count"] == 2
+
+
+def test_coordinator_rejects_diverged_plan(tmp_path):
+    """The gen-start barrier exchanges plan fingerprints: a host whose
+    loader planned a different global schedule fails loudly."""
+    import threading
+
+    d = str(tmp_path)
+    errs = {}
+
+    def run_host(h, fp):
+        co = EL.ElasticCoordinator(d, h, [0, 1], stale_s=5.0,
+                                   timeout_s=10.0,
+                                   heartbeat_interval_s=0.05)
+        try:
+            co.start(plan_fingerprint=fp, config_hash="cfg")
+        except RuntimeError as e:
+            errs[h] = e
+        finally:
+            co.stop()
+
+    ts = [threading.Thread(target=run_host, args=(h, fp))
+          for h, fp in ((0, "aaaa"), (1, "bbbb"))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert errs and all("divergence" in str(e) for e in errs.values())
+
+
+# -- host-death survival + invisibility pins (tentpole pieces 2-3) ----------
+
+
+TRAIN_HPS = BUCKET_HPS.replace(num_steps=8, save_every=4, log_every=4,
+                               eval_every=10 ** 9,
+                               ckpt_retry_backoff_s=0.0)
+
+
+def _make_loaders(lhps, rank, n):
+    dl, scale = synthetic_loader(lhps, 40, seed=7, augment=True,
+                                 host_id=rank, num_hosts=n,
+                                 coordinated=True, emit_global=True)
+    return dl, None, None, scale
+
+
+def _leaves(state):
+    import jax
+
+    return [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(jax.device_get(state))]
+
+
+@pytest.fixture(scope="module")
+def plain_baseline():
+    from sketch_rnn_tpu.train import train
+
+    dl, _, _, scale = _make_loaders(TRAIN_HPS, 0, 1)
+    state = train(TRAIN_HPS, dl, scale_factor=scale, workdir=None,
+                  seed=0, use_mesh=False)
+    return _leaves(state)
+
+
+def test_elastic_single_host_bitwise_invisible(tmp_path, plain_baseline):
+    """Acceptance pin: the whole elastic machinery at num_hosts=1 —
+    coordinator, heartbeat, barriers, coordinated loader — reproduces
+    a plain train() leaf-bitwise."""
+    state = EL.elastic_train(
+        TRAIN_HPS, _make_loaders, rendezvous_dir=str(tmp_path / "rdv"),
+        host_id=0, num_hosts=1, workdir=str(tmp_path / "w"), seed=0,
+        use_mesh=False, heartbeat_interval_s=0.05)
+    assert all(np.array_equal(a, b)
+               for a, b in zip(plain_baseline, _leaves(state)))
+
+
+def test_armed_never_firing_host_kill_plan_invisible(tmp_path,
+                                                     plain_baseline):
+    """Acceptance pin: an armed host-kill / dcn-collective plan that
+    never fires is bitwise invisible (the injector hashes, it never
+    draws)."""
+    faults.configure(
+        "host.kill.h0@999999:kind=exit,dcn.collective@888888")
+    try:
+        state = EL.elastic_train(
+            TRAIN_HPS, _make_loaders,
+            rendezvous_dir=str(tmp_path / "rdv"), host_id=0,
+            num_hosts=1, workdir=str(tmp_path / "w"), seed=0,
+            use_mesh=False, heartbeat_interval_s=0.05)
+    finally:
+        faults.disable()
+    assert all(np.array_equal(a, b)
+               for a, b in zip(plain_baseline, _leaves(state)))
+
+
+def test_host_death_recovery_bitwise(tmp_path, plain_baseline):
+    """The in-process version of the resilience chaos cell: host 1 of
+    a 2-host fleet dies at step 5 through the REAL host.kill.h1 fault
+    site; host 0 detects it, commits a consistent checkpoint AT the
+    death step (zero device steps lost), rewrites RUN.json with the
+    surviving topology, and recovers to the plain single-host final
+    state leaf-bitwise."""
+    import threading
+
+    from sketch_rnn_tpu.utils.runinfo import read_manifest
+
+    rdir, wdir = str(tmp_path / "rdv"), str(tmp_path / "w")
+    # kind=raise: the injected fault crashes host 1's thread (its
+    # coordinator/heartbeat stop on the way out), which IS a host
+    # death as far as host 0's detector can tell. kind=exit is the
+    # subprocess cell's job (it would kill the whole test process).
+    faults.configure("host.kill.h1@5")
+    results = {}
+
+    def run_host(h):
+        try:
+            results[h] = EL.elastic_train(
+                TRAIN_HPS, _make_loaders, rendezvous_dir=rdir,
+                host_id=h, num_hosts=2, workdir=wdir, seed=0,
+                use_mesh=False, stale_s=2.0,
+                heartbeat_interval_s=0.05)
+        except BaseException as e:  # noqa: BLE001 — recorded, asserted
+            results[h] = e
+
+    try:
+        ts = [threading.Thread(target=run_host, args=(h,))
+              for h in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(180)
+    finally:
+        faults.disable()
+    assert isinstance(results[1], faults.InjectedFault)
+    state = results[0]
+    assert not isinstance(state, BaseException), state
+    assert all(np.array_equal(a, b)
+               for a, b in zip(plain_baseline, _leaves(state)))
+    # restart protocol artifacts: topology generation + RUN.json ledger
+    topo = json.load(open(EL.topology_path(rdir, 1)))
+    assert topo["hosts"] == [0] and topo["dead"] == [1]
+    assert topo["at_step"] == 5 and topo["resumed_from"] == 5
+    man = read_manifest(wdir)
+    assert man["elastic"]["hosts"] == [0]
+    assert man["elastic"]["events"][0]["dead"] == [1]
+    # zero device steps re-executed: the consistent checkpoint landed
+    # AT the detection step
+    ev = man["elastic"]["events"][0]
+    assert ev["at_step"] - ev["resumed_from"] == 0
+
+
+def test_divisible_prefix_picks_largest_workable_survivor_set():
+    """Review fix: 3 survivors at global batch 8 cannot be striped —
+    the fleet keeps the largest divisible prefix (never crashes every
+    healthy host on the re-striping ValueError) and the prefix always
+    contains the new primary."""
+    assert EL.divisible_prefix([0, 1, 2], 8) == [0, 1]
+    assert EL.divisible_prefix([0, 2, 3, 5], 8) == [0, 2, 3, 5]
+    assert EL.divisible_prefix([1, 4, 6], 7) == [1]
+    assert EL.divisible_prefix([3], 5) == [3]
+
+
+def test_commit_topology_distinguishes_retired_from_excluded(tmp_path):
+    """A host named in the topology's ``retired`` list accepts the doc
+    (clean exit); one excluded with NO retirement record was falsely
+    declared dead and must refuse."""
+    d1 = str(tmp_path / "a")
+    pri = EL.ElasticCoordinator(d1, 0, [0, 1, 2, 3], gen=0,
+                                timeout_s=5.0)
+    doc = pri.commit_topology([0, 1], 10, [3], 10, retired=[2])
+    assert doc["hosts"] == [0, 1] and doc["retired"] == [2]
+    got = EL.ElasticCoordinator(d1, 2, [0, 1, 2, 3], gen=0,
+                                timeout_s=5.0).commit_topology(
+        [0, 1], 10, [3], None, retired=[2])
+    assert got["retired"] == [2]
+    d2 = str(tmp_path / "b")
+    EL.ElasticCoordinator(d2, 0, [0, 1, 2], gen=0,
+                          timeout_s=5.0).commit_topology(
+        [0, 1], 10, [2], 10)
+    with pytest.raises(RuntimeError, match="excluded"):
+        EL.ElasticCoordinator(d2, 2, [0, 1, 2], gen=0,
+                              timeout_s=5.0).commit_topology(
+            [0, 1], 10, [], None)
+
+
+def test_dead_host_cannot_rejoin(tmp_path):
+    """Generations only shrink: a host missing from the current
+    topology is refused at elastic_train entry."""
+    rdir = str(tmp_path)
+    mh._atomic_json(EL.topology_path(rdir, 1),
+                    {"generation": 1, "hosts": [0], "dead": [1],
+                     "at_step": 5, "resumed_from": 5})
+    with pytest.raises(RuntimeError, match="do not rejoin"):
+        EL.elastic_train(TRAIN_HPS, _make_loaders, rendezvous_dir=rdir,
+                         host_id=1, num_hosts=2,
+                         workdir=str(tmp_path / "w"))
+
+
+# -- cli usage ---------------------------------------------------------------
+
+
+def test_cli_elastic_usage_errors(capsys):
+    from sketch_rnn_tpu.cli import main
+
+    base = ["train", "--synthetic", "--hparams=batch_size=8"]
+    assert main(base + ["--elastic_hosts=2"]) == 2
+    assert "--rendezvous" in capsys.readouterr().err
+    assert main(base + ["--elastic_hosts=2", "--rendezvous=/tmp/x",
+                        "--elastic_host_id=5"]) == 2
+    assert "out of range" in capsys.readouterr().err
+    assert main(base + ["--elastic_hosts=3", "--rendezvous=/tmp/x"]) == 2
+    assert "not divisible" in capsys.readouterr().err
+    assert main(base + ["--rendezvous=/tmp/x"]) == 2
+    assert "--elastic_hosts" in capsys.readouterr().err
+
+
+def test_run_wall_time_is_one_stamp_per_process(tmp_path, monkeypatch):
+    """ISSUE 14 satellite: every history row of one invocation carries
+    the SAME wall_time — the run-manifest clock — so committed smoke
+    rows diff cleanly across re-runs."""
+    import bench
+    from sketch_rnn_tpu.utils import runinfo
+
+    monkeypatch.setattr(bench, "_smoke_hist_path",
+                        lambda: str(tmp_path / "smoke.jsonl"))
+    a = bench._hist_append({"kind": "resilience", "smoke": True,
+                            "site": "x", "ok": True})
+    b = bench._hist_append({"kind": "resilience", "smoke": True,
+                            "site": "y", "ok": True})
+    assert a["wall_time"] == b["wall_time"] == runinfo.run_wall_time()
+    rows = [json.loads(l) for l in
+            open(tmp_path / "smoke.jsonl").read().splitlines()]
+    assert {r["wall_time"] for r in rows} == {runinfo.run_wall_time()}

@@ -43,10 +43,24 @@ def test_resilience_smoke(tmp_path):
     assert by_site["metrics.row"]["fault_site_in_evidence"] is True
     assert by_site["fleet.worker"]["outcome"] == "degraded"
     assert by_site["fleet.worker"]["strokes_bitwise_equal"] is True
+    # the ISSUE 14 elastic chaos cell: two real subprocess hosts, one
+    # hard-killed mid-run; the survivor recovers bitwise at the new
+    # topology with ZERO device steps re-executed (the consistent
+    # checkpoint lands AT the death step)
+    hk = by_site["host.kill"]
+    assert hk["outcome"] == "recovered" and hk["mode"] == "elastic"
+    assert hk["hard_killed"] is True
+    assert hk["final_ckpt_bytes_equal"] is True
+    assert hk["recovery_cost_steps"] == 0
+    assert hk["run_manifest_topology"]["hosts"] == [0]
     # recovery costs are deterministic step counts, never wall-clock
     assert all("wall" not in k
                for c in rec["cells"] for k in c
                if k.startswith("recovery_cost"))
+    # the run-manifest clock: one stamp for the whole invocation
+    from sketch_rnn_tpu.utils import runinfo
+
+    assert rec["wall_time"] == runinfo.run_wall_time()
 
 
 def _row(ok, site="train.step", mode="raise"):
@@ -62,6 +76,25 @@ def test_bench_summary_keys_resilience_per_site_and_mode():
     assert key_of(a) == key_of(_row(False))
     assert metric_of(_row(True)) == 1.0
     assert metric_of(_row(False)) == 0.0
+    # the elastic host-kill cell keys as its own (site, mode) cell
+    hk = _row(True, site="host.kill", mode="elastic")
+    assert key_of(hk) not in {key_of(a), key_of(b)}
+    assert metric_of(hk) == 1.0
+
+
+def test_bench_regress_gates_broken_host_kill_cell(tmp_path, capsys):
+    """ISSUE 14 satellite (CI wiring): a future ok=false host-kill row
+    gates exactly like the other binary resilience cells — BINARY_KINDS
+    already centralizes the metric, key_of the cell identity."""
+    hk = lambda ok: _row(ok, site="host.kill", mode="elastic")  # noqa: E731
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text("".join(json.dumps(hk(True)) + "\n"
+                            for _ in range(4)))
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(hk(False)) + "\n")
+    assert bench_regress.main([f"--fresh={bad}",
+                               f"--history={hist}"]) == 1
+    assert "REGRESS" in capsys.readouterr().out
 
 
 def test_bench_regress_gates_broken_resilience_cell(tmp_path, capsys):

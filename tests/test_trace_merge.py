@@ -215,6 +215,97 @@ def test_two_subprocess_shard_merge_reconciles(tmp_path):
                                    "per_host": {"0": 0, "1": 0}}
 
 
+# -- host death annotation (ISSUE 14 satellite) ------------------------------
+
+
+SMOKE_DIR = os.path.join(REPO, "tests", "data", "fleet_shards")
+
+
+def test_truncated_shard_merges_with_host_died_annotation(tmp_path):
+    """A killed host's torn export (the tail — summary lines and end
+    sentinel — cut off) must still MERGE, with an explicit host_died
+    annotation in merged meta instead of only an undercount warning;
+    exercised over a truncated COMMITTED shard."""
+    import shutil
+
+    paths = sorted(trace_merge.find_shards(SMOKE_DIR))
+    assert len(paths) >= 2
+    keep = os.path.join(str(tmp_path), os.path.basename(paths[0]))
+    shutil.copy(paths[0], keep)
+    # truncate the second shard right after its meta + a few events —
+    # exactly what a hard kill mid-export leaves behind
+    lines = open(paths[1]).read().splitlines()
+    torn = os.path.join(str(tmp_path), os.path.basename(paths[1]))
+    with open(torn, "w") as f:
+        f.write("\n".join(lines[:4]) + "\n")
+    shards = [trace_merge.load_shard(p) for p in (keep, torn)]
+    assert shards[0]["complete"] and not shards[1]["complete"]
+    merged = trace_merge.merge_shards(shards)
+    died = merged["meta"]["host_died"]
+    assert died == [shards[1]["meta"]["process_index"]]
+    by_host = {h["process_index"]: h for h in merged["meta"]["hosts"]}
+    assert by_host[died[0]]["truncated"] is True
+    assert by_host[shards[0]["meta"]["process_index"]][
+        "truncated"] is False
+    # the annotation rides the merged stream into trace_report
+    out = os.path.join(str(tmp_path), "merged")
+    assert trace_merge.main([keep, torn, "--out", out,
+                             "--quiet"]) == 0
+    rep = trace_report.report(trace_report.load(
+        os.path.join(out, trace_merge.MERGED_JSONL)))
+    assert rep["host_died"] == died
+
+
+def test_missing_shard_annotated_as_missing_not_dead(tmp_path):
+    """A host whose shard is simply ABSENT from the merge is
+    ambiguous — killed before any export, or a partial shard list
+    handed to the merge — so it lands in ``missing_hosts`` (review
+    fix: a healthy host must never be recorded as DEAD just because
+    its shard wasn't passed in); only a truncated shard is positive
+    death evidence."""
+    p0 = _make_shard(tmp_path, 0, 3, spans=4)
+    p2 = _make_shard(tmp_path, 2, 3, spans=5)
+    merged = trace_merge.merge_shards(
+        [trace_merge.load_shard(p) for p in (p0, p2)])
+    assert merged["meta"]["host_count"] == 3
+    assert merged["meta"]["missing_hosts"] == [1]
+    assert merged["meta"]["host_died"] == []
+
+
+def test_fresh_export_carries_end_sentinel(tmp_path):
+    path = _make_shard(tmp_path, 0, 1)
+    last = json.loads(open(path).read().splitlines()[-1])
+    assert last["type"] == "end"
+    assert trace_merge.load_shard(path)["complete"]
+
+
+def test_sentinel_era_shard_torn_mid_summary_is_incomplete(tmp_path):
+    """Review fix: the meta line announces the sentinel, so a modern
+    shard torn INSIDE the summary block (past the first agg line but
+    before the end sentinel) is still flagged truncated — the case a
+    bare summaries-present fallback would miss."""
+    path = _make_shard(tmp_path, 0, 2)
+    lines = open(path).read().splitlines()
+    agg_at = next(i for i, l in enumerate(lines)
+                  if json.loads(l).get("type") == "agg")
+    with open(path, "w") as f:
+        f.write("\n".join(lines[:agg_at + 1]) + "\n")
+    shard = trace_merge.load_shard(path)
+    assert shard["agg"] and not shard["complete"]
+    merged = trace_merge.merge_shards(
+        [shard, trace_merge.load_shard(_make_shard(tmp_path, 1, 2))])
+    assert merged["meta"]["host_died"] == [0]
+
+
+def test_committed_shards_not_flagged_dead():
+    """Pre-sentinel committed shards have summary lines — complete."""
+    shards = [trace_merge.load_shard(p)
+              for p in trace_merge.find_shards(SMOKE_DIR)]
+    assert all(s["complete"] for s in shards)
+    meta = trace_merge.merge_shards(shards)["meta"]
+    assert meta["host_died"] == [] and meta["missing_hosts"] == []
+
+
 # -- CI wiring ---------------------------------------------------------------
 
 
