@@ -63,14 +63,16 @@ from scripts.bench_summary import (  # noqa: E402
 # bench_summary.key_of, so a 2-replica capacity record can only ever
 # gate a fresh 2-replica capacity row. resilience rows (ISSUE 10),
 # serve_cost rows (ISSUE 11: per-class device-step attribution
-# exactness on the deterministic capacity arm) and the ISSUE 12
+# exactness on the deterministic capacity arm), the ISSUE 12
 # traffic-grid rows (serve_cache: bitwise hit parity + strictly-fewer
 # device steps; serve_autoscale: reproducible scale plan + autoscaled
-# shed strictly below fixed) carry a binary ok metric (1.0 = the cell
-# hit its expected outcome): with an all-1.0 history the cell's floor
-# sits at best * (1 - min_band) * (1 - slack) ≈ 0.855, so any future
-# 0.0 — a recovery path, the attribution identity, or a traffic-grid
-# invariant silently broken — gates as REGRESS
+# shed strictly below fixed) and the ISSUE 15 multi-task rows
+# (serve_endpoint: per-endpoint offline-bitwise parity + completeness
+# + one-compile-per-geometry accounting) carry a binary ok metric
+# (1.0 = the cell hit its expected outcome): with an all-1.0 history
+# the cell's floor sits at best * (1 - min_band) * (1 - slack) ≈
+# 0.855, so any future 0.0 — a recovery path, the attribution
+# identity, or a parity invariant silently broken — gates as REGRESS
 GATED_KINDS = ("train", "sampler", "bucket_bench", "serve_bench",
                "serve_fleet", *BINARY_KINDS)
 

@@ -30,7 +30,7 @@ import time
 # bench_regress (which imports it): a new binary kind added here is
 # automatically keyed, summarized and gated consistently.
 BINARY_KINDS = ("resilience", "serve_cost", "serve_cache",
-                "serve_autoscale")
+                "serve_autoscale", "serve_endpoint")
 
 
 def key_of(r: dict):
@@ -88,6 +88,16 @@ def key_of(r: dict):
                 f"trace={r.get('trace')} auto={r.get('autoscale')} "
                 f"n={r.get('n_requests')} u={r.get('unique')} "
                 f"dev={dev}")
+    if r.get("kind") == "serve_endpoint":
+        # multi-task serving cells (ISSUE 15): one per endpoint of the
+        # mixed-endpoint bench — offline bitwise parity + completeness
+        # + compile accounting is the binary signal, keyed on the
+        # endpoint AND the seeded mix (a different mix is a different
+        # workload)
+        return ("serveend", r.get("dec_model"),
+                f"ep={r.get('endpoint')} mix={r.get('mix')} "
+                f"B={r.get('slots')} K={r.get('chunk')} "
+                f"n={r.get('n_requests')} dev={dev}")
     if r.get("kind") == "serve_autoscale":
         # traffic-grid autoscale cells (ISSUE 12): one per (trace,
         # cache) arm pair — reproducible scale plan + autoscaled shed
@@ -314,6 +324,19 @@ def main(argv=None) -> int:
                   f"(hit_rate={l.get('hit_rate')} "
                   f"steps_saved={l.get('steps_saved')}/"
                   f"{l.get('steps_uncached')})")
+            continue
+        if k[0] == "serveend":
+            # multi-task endpoint cell (ISSUE 15): parity/completeness
+            # is the binary signal; the per-endpoint p99 (capacity +
+            # load arms) and load-arm shed count print beside it
+            def ms(v):
+                return "-" if v is None else f"{1e3 * v:.0f}"
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"latest={'ok' if l.get('ok') else 'BROKEN':>11s} "
+                  f"(n={l.get('completed')} p99[ms] "
+                  f"cap={ms(l.get('latency_p99_s'))} "
+                  f"load={ms(l.get('load_p99_s'))} "
+                  f"shed={l.get('shed')} cls={l.get('class')})")
             continue
         if k[0] == "autoscale":
             # traffic autoscale cell (ISSUE 12): the shed comparison

@@ -231,6 +231,24 @@ def main(argv=None) -> int:
                          "deterministic latency-vs-offered-load curves, "
                          "cache hit rates, scale-decision timelines and "
                          "shed fractions into --out under 'traffic'")
+    ap.add_argument("--endpoints", action="store_true",
+                    help="multi-task endpoint mode (ISSUE 15): serve a "
+                         "seeded mixed-endpoint workload (generate/"
+                         "complete/reconstruct/interpolate) through an "
+                         "endpoint-routed fleet — per-endpoint latency "
+                         "columns, per-class SLO verdicts, bitwise "
+                         "parity vs the offline serve_requests path at "
+                         "1/2 replicas + shuffled arrival, and encode-"
+                         "program compile accounting (one compile per "
+                         "(pool, prefix-edge), zero in the measured "
+                         "window) into --out under 'endpoints'")
+    ap.add_argument("--endpoint_mix", default="",
+                    help="endpoints mode: 'name:weight,...' mix "
+                         "(default generate:3,complete:3,"
+                         "reconstruct:2,interpolate:1)")
+    ap.add_argument("--frames", type=int, default=0,
+                    help="endpoints mode: interpolate latent-grid size "
+                         "(0 = mode default)")
     ap.add_argument("--trace", default="flash",
                     choices=("poisson", "diurnal", "flash", "pareto"),
                     help="traffic mode: trace shape (default flash — "
@@ -284,7 +302,7 @@ def main(argv=None) -> int:
                     help="result JSON path ('' = stdout only)")
     args = ap.parse_args(argv)
 
-    if args.traffic and "jax" not in sys.modules:
+    if (args.traffic or args.endpoints) and "jax" not in sys.modules:
         # the traffic grid's elastic arms need >= 2 devices; on a CPU
         # box, virtualize them BEFORE jax imports (the resilience_bench
         # precedent — under pytest jax is already imported and 8-way)
@@ -304,6 +322,8 @@ def main(argv=None) -> int:
 
     if args.traffic:
         return _run_traffic(args, hist_append)
+    if args.endpoints:
+        return _run_endpoints(args, hist_append)
 
     if args.smoke:
         # sized so per-step decode compute dominates per-chunk host
@@ -713,6 +733,368 @@ def _run_fleet(args, hps, model, params, slots, chunk, n, lmin, lmax,
         doc["fleet"] = fleet_rec
         with open(args.out, "w") as f:
             json.dump(doc, f, indent=2)
+    return 0
+
+
+def _run_endpoints(args, hist_append):
+    """Multi-task endpoint mode (ISSUE 15): one seeded mixed-endpoint
+    workload (generate / complete / reconstruct / interpolate) served
+    through an endpoint-routed fleet, reported the way the Gemma
+    serving comparison reports a mixed fleet — per-endpoint latency
+    columns next to per-class SLO verdicts — with the deterministic
+    acceptance signals this box can actually prove:
+
+    1. **Offline parity.** Every capacity-arm request's strokes are
+       compared BITWISE against the offline reference
+       (``serve/endpoints.serve_requests`` on a single engine at the
+       same serving geometry) at 1 and 2 replicas and under shuffled
+       arrival order — completion/reconstruction/interpolation outputs
+       are independent of batch composition, replica placement and
+       arrival order, extending the existing invariance suites.
+    2. **Cost determinism.** Two identical pre-start replays of the
+       R=1 capacity arm must reproduce the whole per-class device-step
+       cost block exactly (the ISSUE 11 discipline over the new
+       workloads; interpolation frames included).
+    3. **Compile accounting.** A traced EncodeProgram warm shows
+       EXACTLY one ``serve_encode`` compile per (pool rows, prefix
+       edge) geometry; the measured fleet window (telemetry enabled
+       after warm) shows ZERO compiles of any kind.
+    4. **Load arm.** One open-loop arm at ``--trace_rate`` with
+       admission live (shedding allowed) feeds the per-class SLO
+       tracker — the honest mixed-traffic latency/shed table.
+
+    One binary ``serve_endpoint`` row per endpoint streams into the
+    smoke history BEFORE any raise (the serve_cost/resilience
+    precedent); the record lands in --out under ``endpoints``.
+    """
+    import jax
+
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.data.loader import synthetic_loader
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import (
+        EncodeProgram,
+        ServeFleet,
+        parse_endpoint_specs,
+        serve_requests,
+    )
+    from sketch_rnn_tpu.serve.endpoints import prefix_edges
+    from sketch_rnn_tpu.serve.loadgen import (
+        OpenLoopLoadGen,
+        endpoint_mix_ids,
+        parse_endpoint_mix,
+        poisson_arrivals,
+    )
+    from sketch_rnn_tpu.serve.slo import SLOTracker, parse_slo
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    if args.smoke:
+        hps = get_default_hparams().replace(
+            batch_size=8, max_seq_len=48, enc_rnn_size=16,
+            dec_rnn_size=32, z_size=8, num_mixture=3, dec_model="lstm",
+            serve_prefix_edges=(12, 24, 48))
+        slots = args.slots or 4
+        chunk = args.chunk or 2
+        n = args.requests or 96
+        unique = args.unique or 32
+        frames = args.frames or 4
+        rate = args.trace_rate or 200.0
+        lmin = args.min_len or 3
+        lmax = args.max_len or 16
+    else:
+        hps = get_default_hparams().replace(
+            dec_model=os.environ.get("BENCH_DEC", "layer_norm"))
+        slots = args.slots or 32
+        chunk = args.chunk or 8
+        n = args.requests or 512
+        unique = args.unique or 128
+        frames = args.frames or 8
+        rate = args.trace_rate or 200.0
+        lmin = args.min_len or 16
+        lmax = args.max_len or hps.max_seq_len
+    hps = hps.replace(max_seq_len=max(hps.max_seq_len, lmax))
+    ndev = len(jax.devices())
+    if ndev < 2:
+        print(f"serve_bench: --endpoints needs >= 2 devices for the "
+              f"placement-parity arm, have {ndev}", file=sys.stderr)
+        return 2
+
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(args.seed))
+    # pen suppression (the sampler_latency.py trick): lengths are
+    # exactly the drawn caps, so every arm does identical,
+    # deterministic device work
+    params["out_b"] = params["out_b"].at[2].set(-1e9)
+
+    # prefix corpus: a normalized synthetic split standing in for the
+    # streamed QuickDraw-345 corpus (same loader layout; the streaming
+    # .ndjson path is golden-tested in tests/test_quickdraw.py)
+    loader, _ = synthetic_loader(hps, unique, seed=args.seed)
+    pool, pool_labels = loader.strokes, loader.labels
+
+    mix = parse_endpoint_mix(
+        args.endpoint_mix
+        or "generate:3,complete:3,reconstruct:2,interpolate:1")
+    names = [m[0] for m in mix]
+    ep_map, classes = parse_endpoint_specs([
+        "generate=batch:p99<=5",
+        "complete=interactive:p95<=0.25",
+        "reconstruct=interactive",
+        "interpolate=batch",
+    ])
+    caps = skewed_lengths(n, lmin, lmax, args.seed)
+    ids = endpoint_mix_ids(n, mix, args.seed)
+    kz, kreq = jax.random.split(jax.random.key(args.seed))
+    zs = np.asarray(jax.random.normal(kz, (n, hps.z_size)), np.float32)
+
+    from sketch_rnn_tpu.serve.endpoints import build_mix_requests
+
+    def build_all():
+        """A fresh request list (pure in the seed — every arm rebuilds
+        its own, uids stamped 0..n-1), via THE shared mix recipe
+        (`serve/endpoints.build_mix_requests` — the cli bench draws
+        the same stream)."""
+        reqs = build_mix_requests(hps, mix, n, args.seed, kreq, zs,
+                                  pool, pool_labels, frames=frames,
+                                  temperature=args.temperature,
+                                  caps=caps)
+        for i, r in enumerate(reqs):
+            r.uid = i
+        return reqs
+
+    mix_counts = {}
+    for i in range(n):
+        ep = names[int(ids[i])]
+        mix_counts[ep] = mix_counts.get(ep, 0) + 1
+    print(f"# endpoints: {n} requests, realized mix {mix_counts}, "
+          f"B={slots} K={chunk}, frames={frames}, edges "
+          f"{prefix_edges(hps)}", file=sys.stderr)
+
+    # -- offline reference: the single-engine serve_requests path ------
+    ref_out = serve_requests(model, hps, params, build_all(),
+                             slots=slots, chunk=chunk)
+    ref = {r.uid: r for r in ref_out["results"]}
+
+    failures = []
+
+    def check_parity(results, what):
+        for uid, r in ref.items():
+            rec = results.get(uid)
+            if rec is None:
+                failures.append(f"PARITY: request {uid} never "
+                                f"completed under {what}")
+                return
+            got = rec["result"]
+            if not np.array_equal(got.strokes5, r.strokes5):
+                failures.append(f"PARITY: request {uid} "
+                                f"({r.endpoint}) strokes differ under "
+                                f"{what}")
+                return
+            if (r.frames is None) != (got.frames is None) or (
+                    r.frames is not None
+                    and len(r.frames) != len(got.frames)):
+                failures.append(f"PARITY: request {uid} frame "
+                                f"structure differs under {what}")
+                return
+
+    def run_fleet(R, order=None, rate_hz=0.0, slo=None,
+                  measure_compiles=False):
+        fleet = ServeFleet(model, hps, params, replicas=R, slots=slots,
+                           chunk=chunk, classes=classes,
+                           endpoint_classes=ep_map, slo=slo)
+        reqs = build_all()
+        fleet.warm(reqs[0], endpoints=True)
+        tel = None
+        if measure_compiles:
+            # telemetry enabled AFTER warm (the documented order): the
+            # probes must report the measured window as cache hits
+            tel = tele.configure(trace_dir=None)
+        try:
+            if rate_hz > 0:
+                fleet.start()
+                gen = OpenLoopLoadGen(
+                    poisson_arrivals(n, rate_hz, args.seed),
+                    lambda i: fleet.submit(reqs[i])).start()
+                gen.join(timeout=900)
+            else:
+                for i in (order if order is not None else range(n)):
+                    fleet.submit(reqs[i], force=True)
+                fleet.start()
+            if not fleet.drain(timeout=900):
+                raise RuntimeError(f"fleet drain timed out (R={R}, "
+                                   f"rate={rate_hz})")
+            summ = fleet.summary()
+            res = fleet.results
+            shed = fleet.shed
+            window = None
+            if measure_compiles:
+                counters = tel.counters()
+                spans = [e for e in tel.events()
+                         if e.get("cat") == "compile"
+                         and e.get("type") == "span"]
+                window = {
+                    "jit_cache_miss": int(counters.get(
+                        ("compile", "jit_cache_miss"), 0)),
+                    "jit_cache_hit": int(counters.get(
+                        ("compile", "jit_cache_hit"), 0)),
+                    "compile_spans": len(spans),
+                }
+            return res, summ, shed, window
+        finally:
+            fleet.close()
+            if measure_compiles:
+                tele.disable()
+
+    # -- capacity arms: parity + cost determinism ----------------------
+    res1, s1, _, window = run_fleet(1, measure_compiles=True)
+    if s1["completed"] != n:
+        failures.append(f"R=1 capacity arm completed "
+                        f"{s1['completed']}/{n}")
+    check_parity(res1, "R=1 capacity (vs offline serve_requests)")
+    res1b, s1b, _, _ = run_fleet(1)
+    if s1b["cost"] != s1["cost"]:
+        failures.append(f"COST NONDETERMINISM: replayed R=1 cost "
+                        f"{s1b['cost']} != first {s1['cost']}")
+    res2, s2, _, _ = run_fleet(2)
+    check_parity(res2, "R=2 placement")
+    order = list(range(n))
+    np.random.default_rng(args.seed + 1).shuffle(order)
+    res_sh, _, _, _ = run_fleet(2, order=order)
+    check_parity(res_sh, "shuffled arrival order")
+    if window is not None and (window["jit_cache_miss"]
+                               or window["compile_spans"]):
+        failures.append(f"MEASURED-WINDOW COMPILES: {window} (warm "
+                        f"must cover every geometry)")
+
+    # -- encode compile accounting: one compile per (pool, edge) -------
+    tel = tele.configure(trace_dir=None)
+    try:
+        prog = EncodeProgram(model, hps, params, rows=slots)
+        prog.warm()
+        spans = [e for e in tel.events()
+                 if e.get("type") == "span"
+                 and e.get("name") == "serve_encode"]
+        geoms = [e["args"]["geometry"] for e in spans]
+        prog.warm()   # repeat: every geometry must be a cache hit now
+        spans2 = [e for e in tel.events()
+                  if e.get("type") == "span"
+                  and e.get("name") == "serve_encode"]
+        compile_block = {
+            "edges": list(prefix_edges(hps)),
+            "encode_rows": slots,
+            "encode_compiles": len(spans),
+            "geometries": sorted(geoms),
+            "recompiles_on_repeat": len(spans2) - len(spans),
+        }
+    finally:
+        tele.disable()
+    if len(spans) != len(prefix_edges(hps)) or \
+            len(set(geoms)) != len(geoms):
+        failures.append(f"ENCODE COMPILE ACCOUNTING: expected one "
+                        f"compile per edge {prefix_edges(hps)}, got "
+                        f"{geoms}")
+    if compile_block["recompiles_on_repeat"]:
+        failures.append(f"ENCODE RECOMPILE: a warm geometry compiled "
+                        f"again ({compile_block})")
+
+    # -- load arm: admission live, per-class SLO verdicts --------------
+    tracker = SLOTracker([parse_slo("interactive:p95<=0.25"),
+                          parse_slo("batch:p99<=5")])
+    res_load, s_load, shed_load, _ = run_fleet(1, rate_hz=rate,
+                                               slo=tracker)
+    shed_by_ep = {}
+    for srec in shed_load:
+        ep = srec.get("endpoint", "generate")
+        shed_by_ep[ep] = shed_by_ep.get(ep, 0) + 1
+
+    # -- rows: stream BEFORE any failure raise -------------------------
+    parity_ok = not any(f.startswith("PARITY") for f in failures)
+    overall_ok = not failures
+    mix_str = ",".join(f"{m[0]}:{m[1]:g}" for m in mix)
+    base = {
+        "kind": "serve_endpoint", "smoke": bool(args.smoke),
+        "device_kind": jax.devices()[0].device_kind,
+        "dec_model": hps.dec_model, "slots": slots, "chunk": chunk,
+        "n_requests": n, "mix": mix_str, "frames": frames,
+    }
+    by_ep_cap = s1["latency_by_endpoint"]
+    by_ep_load = s_load["latency_by_endpoint"]
+    rows = []
+    for ep in sorted(mix_counts):
+        cap_cell = by_ep_cap.get(ep, {})
+        load_cell = by_ep_load.get(ep, {})
+        row = {
+            **base, "endpoint": ep,
+            "class": ep_map.get(ep),
+            "completed": cap_cell.get("completed", 0),
+            "latency_p50_s": cap_cell.get("p50_s"),
+            "latency_p95_s": cap_cell.get("p95_s"),
+            "latency_p99_s": cap_cell.get("p99_s"),
+            "load_p99_s": load_cell.get("p99_s"),
+            "shed": shed_by_ep.get(ep, 0),
+            "ok": bool(overall_ok
+                       and cap_cell.get("completed", 0)
+                       == mix_counts[ep]),
+        }
+        rows.append(row)
+        hist_append(row)
+
+    endpoints_rec = {
+        "kind": "serve_endpoints",
+        **{k: base[k] for k in ("smoke", "device_kind", "dec_model",
+                                "slots", "chunk", "n_requests",
+                                "frames")},
+        "mix": mix_str,
+        "realized_mix": mix_counts,
+        "endpoint_classes": dict(ep_map),
+        "prefix_edges": list(prefix_edges(hps)),
+        "per_endpoint_capacity": by_ep_cap,
+        "per_endpoint_load": by_ep_load,
+        "load_arm": {
+            "offered_rate": rate,
+            "completed": s_load["completed"],
+            "shed": s_load["shed"],
+            "shed_frac": s_load["shed_frac"],
+            "shed_by_endpoint": shed_by_ep,
+            "latency_by_class": s_load["latency_by_class"],
+        },
+        "slo": tracker.summary(),
+        "parity": {
+            "offline_bitwise": parity_ok,
+            "replicas_checked": [1, 2],
+            "arrival_invariant": parity_ok,
+            "cost_deterministic": s1b["cost"] == s1["cost"],
+            "failures": failures,
+        },
+        "compile": {**compile_block,
+                    "measured_window": window},
+        "cost": s1["cost"],
+        "host_parallel_ceiling": measure_host_parallel_ceiling(),
+        "caveats": [
+            "wall-clock latency percentiles are host-bound on this "
+            "box (host_parallel_ceiling); the acceptance signals are "
+            "bitwise offline parity, the deterministic cost block and "
+            "the compile accounting"],
+        "rows": rows,
+    }
+    print(json.dumps(endpoints_rec, indent=2))
+    if args.out:
+        doc = {}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    doc = loaded
+            except ValueError:
+                pass
+        doc["endpoints"] = endpoints_rec
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2)
+    if failures:
+        raise RuntimeError(
+            "ENDPOINT BENCH FAILURES (rows already streamed):\n  "
+            + "\n  ".join(failures))
     return 0
 
 

@@ -331,14 +331,24 @@ def cmd_eval(args) -> int:
 def cmd_sample(args) -> int:
     from sketch_rnn_tpu.data import strokes as S
     from sketch_rnn_tpu.parallel import multihost as mh
-    from sketch_rnn_tpu.sample import (
-        encode_mu, interpolate_latents, sample, svg_grid)
+    from sketch_rnn_tpu.sample import sample, svg_grid
     mh.initialize()  # no-op unless launched as a multi-host cluster
     hps = _resolve_hps(args)
     # usage errors fail before the (expensive) checkpoint restore
     if (args.interpolate or args.reconstruct) and not hps.conditional:
         print("[cli] --interpolate/--reconstruct need a conditional "
-              "(encoder) model", file=sys.stderr)
+              "(encoder) model (hps.conditional=false)", file=sys.stderr)
+        return 2
+    if args.strokes_out and not (args.interpolate or args.reconstruct):
+        print("[cli] --strokes_out archives the endpoint demos' raw "
+              "stroke-5 arrays; add --interpolate or --reconstruct",
+              file=sys.stderr)
+        return 2
+    if args.interpolate and args.n < 2:
+        # the endpoint contract (frames >= 2) as a usage error, before
+        # the expensive restore — an interpolation needs both ends
+        print(f"[cli] --interpolate needs -n >= 2 frames, got "
+              f"{args.n}", file=sys.stderr)
         return 2
     temps = None
     if args.temperatures:
@@ -362,28 +372,87 @@ def cmd_sample(args) -> int:
     originals = None
     n = args.n
     if args.interpolate or args.reconstruct:
+        # multi-task serving parity (ISSUE 15): both demos now ride the
+        # SAME endpoint path the serving fleet runs
+        # (serve/endpoints.serve_requests: fixed-geometry encode +
+        # engine decode with per-request fold_in RNG), so the strokes
+        # here are bitwise the `interpolate`/`reconstruct` endpoint's
+        # on the same checkpoint/key/serving geometry — the satellite
+        # parity pin. --strokes_out archives the raw stroke-5 arrays
+        # (normalized model units) for exactly that comparison.
+        from sketch_rnn_tpu.serve import Request, serve_requests
         _, valid_l, _, _ = _load_data(hps, args, scale_factor=scale)
-        batch = valid_l.get_batch(0)
-        mu = encode_mu(model, state.params, batch)
         if args.interpolate:
-            z = interpolate_latents(mu[0], mu[1], n=n)
+            # --label conditions every frame's decode, exactly like
+            # the pre-endpoint path (reconstruction keeps each
+            # sketch's own dataset label, also as before)
+            reqs = [Request(key=key, endpoint="interpolate",
+                            prefix=(valid_l.strokes[0],
+                                    valid_l.strokes[1]),
+                            frames=n, temperature=args.temperature,
+                            label=args.label)]
         else:
             # the reference notebook's reconstruction demo: encode real
             # sketches, decode conditioned on their posterior means, and
             # show inputs (top row) against reconstructions (bottom row)
-            if n > mu.shape[0]:
-                print(f"[cli] requested {n} reconstructions but the valid "
-                      f"batch holds {mu.shape[0]}; clamping",
-                      file=sys.stderr)
-                n = mu.shape[0]
-            z = mu[:n]
+            if n > len(valid_l.strokes):
+                print(f"[cli] requested {n} reconstructions but the "
+                      f"valid split holds {len(valid_l.strokes)}; "
+                      f"clamping", file=sys.stderr)
+                n = len(valid_l.strokes)
+            reqs = [Request(key=jax.random.fold_in(key, i),
+                            endpoint="reconstruct",
+                            prefix=valid_l.strokes[i],
+                            temperature=args.temperature,
+                            label=(int(valid_l.labels[i])
+                                   if hps.num_classes > 0 else 0))
+                    for i in range(n)]
             originals = []
             for i in range(n):
-                s3 = S.to_normal_strokes(np.asarray(batch["strokes"][i, 1:]))
+                s3 = np.array(valid_l.strokes[i], np.float32)
                 s3[:, 0:2] *= scale
                 originals.append(s3)
-            if hps.num_classes > 0:
-                labels = np.asarray(batch["labels"][:n], np.int32)
+        out = serve_requests(model, hps, state.params, reqs,
+                             greedy=args.greedy)
+        by_uid = {r.uid: r for r in out["results"]}
+        if args.interpolate:
+            strokes5 = list(by_uid[0].frames)
+            lengths = np.asarray([len(s) for s in strokes5])
+        else:
+            strokes5 = [by_uid[i].strokes5 for i in range(n)]
+            lengths = np.asarray([by_uid[i].length for i in range(n)])
+        if args.strokes_out and mh.is_primary():
+            # primary-only, like the SVG write below: hosts hold
+            # different loader stripes, and a torn parity artifact is
+            # worse than none
+            np.savez(args.strokes_out,
+                     **{f"strokes5_{i:03d}": s
+                        for i, s in enumerate(strokes5)})
+            print(f"[cli] wrote raw stroke-5 arrays to "
+                  f"{args.strokes_out}", file=sys.stderr)
+        sketches = []
+        for s5 in strokes5:
+            s3 = S.to_normal_strokes(np.asarray(s5))
+            s3[:, 0:2] *= scale
+            sketches.append(s3)
+        if mh.is_primary():
+            if originals is not None:
+                cols = max(1, min(args.cols, n))
+                blank = np.zeros((0, 3), np.float32)
+                cells = []
+                for lo in range(0, n, cols):
+                    for row in (originals[lo:lo + cols],
+                                sketches[lo:lo + cols]):
+                        cells += row + [blank] * (cols - len(row))
+                svg_grid(cells, cols=cols, path=args.output)
+                print(f"[cli] wrote {n} input|reconstruction pairs "
+                      f"(lengths {[int(x) for x in lengths]}) to "
+                      f"{args.output}")
+            else:
+                svg_grid(sketches, cols=args.cols, path=args.output)
+                print(f"[cli] wrote {len(sketches)} interpolation "
+                      f"frames to {args.output}")
+        return 0
     if labels is None and hps.num_classes > 0:
         labels = np.full((n,), args.label, np.int32)
     if temps is not None:
@@ -415,22 +484,9 @@ def cmd_sample(args) -> int:
     # multi-host: only the primary writes (hosts hold different loader
     # stripes, so concurrent writes to a shared path would tear the file)
     if mh.is_primary():
-        if originals is not None:
-            # alternate input rows and reconstruction rows in blocks of
-            # --cols so wide requests wrap instead of one 2xN strip
-            cols = max(1, min(args.cols, n))
-            blank = np.zeros((0, 3), np.float32)
-            cells = []
-            for lo in range(0, n, cols):
-                for row in (originals[lo:lo + cols], sketches[lo:lo + cols]):
-                    cells += row + [blank] * (cols - len(row))
-            svg_grid(cells, cols=cols, path=args.output)
-            print(f"[cli] wrote {n} input|reconstruction pairs "
-                  f"(lengths {[int(x) for x in lengths]}) to {args.output}")
-        else:
-            svg_grid(sketches, cols=args.cols, path=args.output)
-            print(f"[cli] wrote {n} sketches (lengths "
-                  f"{[int(x) for x in lengths]}) to {args.output}")
+        svg_grid(sketches, cols=args.cols, path=args.output)
+        print(f"[cli] wrote {n} sketches (lengths "
+              f"{[int(x) for x in lengths]}) to {args.output}")
     return 0
 
 
@@ -483,6 +539,70 @@ def cmd_serve_bench(args) -> int:
                   f"devices but only {len(jax.devices())} are "
                   f"available", file=sys.stderr)
             return 2
+    # multi-task endpoint specs (ISSUE 15): validated HERE, before the
+    # checkpoint restore — the --slo/--classes precedent. An
+    # unconditional checkpoint rejects encoder endpoints with one line
+    # naming hps.conditional.
+    endpoints_cfg = None
+    if args.endpoints or args.endpoint_mix:
+        if args.fleet is None:
+            print("[cli] --endpoints/--endpoint_mix configure the "
+                  "multi-task fleet; add --fleet", file=sys.stderr)
+            return 2
+        from sketch_rnn_tpu.serve.admission import \
+            parse_admission_classes
+        from sketch_rnn_tpu.serve.endpoints import (ENCODER_ENDPOINTS,
+                                                    ENDPOINTS,
+                                                    parse_endpoint_specs)
+        from sketch_rnn_tpu.serve.loadgen import parse_endpoint_mix
+        try:
+            ep_map, ep_classes = parse_endpoint_specs(
+                args.endpoints,
+                classes=parse_admission_classes(args.classes))
+            mix = (parse_endpoint_mix(args.endpoint_mix)
+                   if args.endpoint_mix else
+                   tuple((e, 1.0) for e in ENDPOINTS if e in ep_map)
+                   or (("generate", 1.0),))
+        except ValueError as e:
+            print(f"[cli] {e}", file=sys.stderr)
+            return 2
+        bad = [name for name, _ in mix if name not in ENDPOINTS]
+        if bad:
+            print(f"[cli] unknown endpoint(s) {bad} in "
+                  f"--endpoint_mix; want {ENDPOINTS}", file=sys.stderr)
+            return 2
+        unrouted = [name for name, _ in mix
+                    if name not in ep_map and len(ep_classes) > 1]
+        if unrouted:
+            print(f"[cli] endpoint(s) {unrouted} in the mix have no "
+                  f"class route; add --endpoints "
+                  f"{unrouted[0]}=CLASS", file=sys.stderr)
+            return 2
+        enc_needed = sorted(set(name for name, _ in mix)
+                            & set(ENCODER_ENDPOINTS))
+        if enc_needed and not hps.conditional:
+            print(f"[cli] endpoint(s) {enc_needed} need the "
+                  f"bidirectional encoder but this checkpoint is "
+                  f"unconditional (hps.conditional=false)",
+                  file=sys.stderr)
+            return 2
+        if args.frames < 2:
+            print(f"[cli] --frames must be >= 2, got {args.frames}",
+                  file=sys.stderr)
+            return 2
+        from sketch_rnn_tpu.serve.fleet import default_pool_cap
+        pool_cap = default_pool_cap(args.slots or hps.serve_slots)
+        if any(name == "interpolate" for name, _ in mix) \
+                and args.frames > pool_cap:
+            # the grid must fit one micro-burst — fail HERE, not in
+            # the loadgen replay thread after the restore
+            print(f"[cli] --frames {args.frames} exceeds the fleet's "
+                  f"pool_cap {pool_cap} (4x slots); shrink --frames "
+                  f"or raise --slots", file=sys.stderr)
+            return 2
+        endpoints_cfg = {"map": ep_map, "classes": ep_classes,
+                         "mix": mix, "frames": args.frames,
+                         "encoder": bool(enc_needed)}
     rc = _arm_faults(args)  # chaos runs: bad specs fail before binding
     if rc:
         return rc
@@ -504,7 +624,8 @@ def cmd_serve_bench(args) -> int:
                   f"http://127.0.0.1:{server.port} (scrape while the "
                   f"bench runs, e.g. curl :{server.port}/metrics)",
                   file=sys.stderr)
-        return _serve_bench_run(args, hps, slo_tracker, server)
+        return _serve_bench_run(args, hps, slo_tracker, server,
+                                endpoints_cfg=endpoints_cfg)
     finally:
         faults.disable()
         if server is not None:
@@ -563,10 +684,16 @@ def _serve_telemetry_abort(trace_dir, tel, tele, mem_sampler) -> None:
 
 
 def _serve_bench_fleet(args, hps, model, state_params, requests,
-                       slo_tracker, server=None):
+                       slo_tracker, server=None, endpoints_cfg=None):
     """The fleet measured section: build + warm the fleet, THEN enable
     telemetry (via the shared helper — the can't-recompile-into-the-
     window ordering), then replay the open-loop schedule and drain.
+
+    With ``endpoints_cfg`` (ISSUE 15) the fleet routes each request's
+    endpoint to its admission class (``--endpoints``), the warm pass
+    also compiles the per-replica encode programs and the init-capable
+    chunk geometry, and the report grows the per-endpoint latency
+    table.
 
     Returns ``(out_metrics, fleet_report, request_rows,
     telemetry_handles)``.
@@ -576,25 +703,38 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
     from sketch_rnn_tpu.serve.loadgen import (OpenLoopLoadGen,
                                               poisson_arrivals)
 
-    classes = parse_admission_classes(args.classes)
+    if endpoints_cfg is not None:
+        classes = endpoints_cfg["classes"]
+        endpoint_classes = endpoints_cfg["map"]
+    else:
+        classes = parse_admission_classes(args.classes)
+        endpoint_classes = None
     cls_order = [c.name for c in sorted(classes.values(),
                                         key=lambda c: c.priority)]
     fleet = ServeFleet(model, hps, state_params,
                        replicas=args.fleet, slots=args.slots,
                        chunk=args.chunk, greedy=args.greedy,
-                       classes=classes, slo=slo_tracker)
+                       classes=classes, slo=slo_tracker,
+                       endpoint_classes=endpoint_classes)
     if server is not None:
         # /healthz now answers from the LIVE fleet: a replica death
         # mid-run flips the verdict to degraded (ISSUE 10)
         server.health_source = fleet.health
-    fleet.warm(requests[0])
+    fleet.warm(requests[0],
+               endpoints=bool(endpoints_cfg
+                              and endpoints_cfg.get("encoder")))
     handles = _serve_telemetry_start(args)
     try:
         for i, r in enumerate(requests):
             r.uid = i
 
         def _submit(i):
-            fleet.submit(requests[i], cls=cls_order[i % len(cls_order)])
+            if endpoints_cfg is not None:
+                # the endpoint routes to its class (fleet.submit maps)
+                fleet.submit(requests[i])
+            else:
+                fleet.submit(requests[i],
+                             cls=cls_order[i % len(cls_order)])
 
         with fleet:
             gen = OpenLoopLoadGen(
@@ -605,6 +745,7 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
             fsum = fleet.summary()
             rows = [{"uid": uid, "replica": rec["replica"],
                      "class": rec.get("class"),
+                     "endpoint": rec.get("endpoint", "generate"),
                      "queue_pos": rec.get("queue_pos"),
                      "steps": rec["result"].steps,
                      "length": rec["result"].length,
@@ -627,12 +768,53 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
         "latency_p95_s": fsum["latency"]["p95_s"],
         "latency_p99_s": fsum["latency"]["p99_s"],
     }
+    if endpoints_cfg is not None:
+        # the per-endpoint latency table (ISSUE 15): the mixed-endpoint
+        # fleet's headline surface, next to the per-class SLO verdicts
+        out_metrics["latency_by_endpoint"] = \
+            fsum["latency_by_endpoint"]
+        fsum["endpoint_mix"] = [list(m) for m in endpoints_cfg["mix"]]
+        fsum["endpoint_classes"] = dict(endpoints_cfg["map"])
     if slo_tracker is not None:
         out_metrics["slo"] = slo_tracker.summary()
     return out_metrics, fsum, rows, handles
 
 
-def _serve_bench_run(args, hps, slo_tracker, server) -> int:
+def _build_endpoint_requests(args, hps, scale, n, kz, kreq,
+                             endpoints_cfg):
+    """The seeded mixed-endpoint request list (ISSUE 15): the SHARED
+    ``serve/endpoints.build_mix_requests`` recipe (the acceptance
+    bench draws the identical stream) over prefixes from the valid
+    split (``--synthetic``/``--data_dir``) or a synthetic corpus."""
+    from sketch_rnn_tpu.serve.endpoints import build_mix_requests
+
+    mix = endpoints_cfg["mix"]
+    pool, pool_labels = [], None
+    if any(name != "generate" for name, _ in mix):
+        if args.synthetic or args.data_dir:
+            _, valid_l, _, _ = _load_data(hps, args, scale_factor=scale)
+            pool, pool_labels = valid_l.strokes, valid_l.labels
+        else:
+            # --random_init without a corpus: a normalized synthetic
+            # prefix pool (the loader computes its own scale — the
+            # random-init params have no data contract to honor)
+            from sketch_rnn_tpu.data.loader import synthetic_loader
+            loader, _ = synthetic_loader(hps, max(64, min(2 * n, 512)),
+                                         seed=args.seed)
+            pool, pool_labels = loader.strokes, loader.labels
+    z = None
+    if hps.conditional:
+        z = np.asarray(jax.random.normal(kz, (n, hps.z_size)),
+                       np.float32)
+    return build_mix_requests(hps, mix, n, args.seed, kreq, z, pool,
+                              pool_labels,
+                              frames=endpoints_cfg["frames"],
+                              temperature=args.temperature,
+                              default_label=args.label)
+
+
+def _serve_bench_run(args, hps, slo_tracker, server,
+                     endpoints_cfg=None) -> int:
     """The body of ``serve-bench`` after usage validation; the caller
     owns the metrics server's lifetime (stopped on every exit path)."""
     import time
@@ -651,15 +833,20 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
     key = jax.random.key(args.seed)
     kz, kreq = jax.random.split(key)
     n = args.n
-    z = None
-    if hps.conditional:
-        z = np.asarray(jax.random.normal(kz, (n, hps.z_size)), np.float32)
-    requests = [
-        Request(key=jax.random.fold_in(kreq, i),
-                z=None if z is None else z[i],
-                label=args.label, temperature=args.temperature)
-        for i in range(n)
-    ]
+    if endpoints_cfg is not None:
+        requests = _build_endpoint_requests(args, hps, scale, n, kz,
+                                            kreq, endpoints_cfg)
+    else:
+        z = None
+        if hps.conditional:
+            z = np.asarray(jax.random.normal(kz, (n, hps.z_size)),
+                           np.float32)
+        requests = [
+            Request(key=jax.random.fold_in(kreq, i),
+                    z=None if z is None else z[i],
+                    label=args.label, temperature=args.temperature)
+            for i in range(n)
+        ]
     writer = (MetricsWriter(args.workdir, name="serve")
               if args.log_metrics else None)
     import dataclasses
@@ -673,7 +860,7 @@ def _serve_bench_run(args, hps, slo_tracker, server) -> int:
         # operator declared.
         out_metrics, fleet_report, rows, handles = _serve_bench_fleet(
             args, hps, model, state_params, requests, slo_tracker,
-            server=server)
+            server=server, endpoints_cfg=endpoints_cfg)
         trace_dir, tel, tele, mem_sampler = handles
         slots_v, chunk_v = fleet_report["slots"], fleet_report["chunk"]
         if writer is not None:
@@ -929,6 +1116,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--label", type=int, default=0,
                    help="class id for class-conditional models")
     p.add_argument("--output", default="samples.svg")
+    p.add_argument("--strokes_out", default="",
+                   help="with --interpolate/--reconstruct: also write "
+                        "the raw stroke-5 arrays (normalized model "
+                        "units) to this .npz — the serve-vs-offline "
+                        "bitwise parity artifact (the serving "
+                        "endpoints produce these exact bytes on the "
+                        "same checkpoint/key/serving geometry)")
     p.add_argument("--cols", type=int, default=5)
     p.set_defaults(fn=cmd_sample)
 
@@ -966,6 +1160,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "assigned round-robin over the classes; with "
                         "--slo, SLO endpoints match class names. "
                         "Default: one no-deadline 'default' class")
+    p.add_argument("--endpoints", action="append", default=[],
+                   help="multi-task endpoint route for --fleet, "
+                        "repeatable: ENDPOINT=CLASS where CLASS is a "
+                        "--classes-grammar spec declaring the class "
+                        "('complete=interactive:p95<=250ms') or a bare "
+                        "class name ('interpolate=batch'; declared "
+                        "no-deadline if new). Endpoints: generate, "
+                        "complete (stroke-prefix continuation), "
+                        "reconstruct (encode->decode round trip), "
+                        "interpolate (slerp grid as one batch "
+                        "request). Encoder endpoints need a "
+                        "conditional checkpoint; validation fails "
+                        "before the restore")
+    p.add_argument("--endpoint_mix", default="",
+                   help="seeded endpoint mix for --endpoints runs, "
+                        "'name:weight,...' (e.g. 'generate:4,"
+                        "complete:3,reconstruct:2,interpolate:1'); "
+                        "default: uniform over the routed endpoints")
+    p.add_argument("--frames", type=int, default=6,
+                   help="latent-grid size of interpolate requests in "
+                        "the endpoint mix (must fit one micro-burst: "
+                        "frames <= pool_cap = 4x slots)")
     p.add_argument("--random_init", action="store_true",
                    help="fresh random params instead of a checkpoint")
     p.add_argument("--log_metrics", action="store_true",

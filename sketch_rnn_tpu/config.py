@@ -202,6 +202,15 @@ class HParams:
     #   sampler analogue of steps_per_call (one compiled program
     #   advances all slots K steps; higher K amortizes launch latency,
     #   lower K admits faster — finished slots idle at most K-1 steps)
+    serve_prefix_edges: Tuple[int, ...] = ()  # prefix bucket edges of
+    #   the multi-task endpoint encode phase (serve/endpoints.py): an
+    #   encoder-endpoint request's stroke prefix is padded to the
+    #   smallest edge that fits it, so the fixed-geometry encode
+    #   program compiles once per (pool rows, edge) — the bucketed-
+    #   execution discipline applied to serving (ISSUE 15). Strictly
+    #   ascending, terminal edge <= max_seq_len (max_seq_len is always
+    #   an implicit terminal edge). Empty (default) = the small
+    #   power-of-two ladder serve/endpoints.default_prefix_edges picks.
 
     def __post_init__(self):
         if self.enc_model not in CELL_TYPES or self.dec_model not in CELL_TYPES:
@@ -245,6 +254,19 @@ class HParams:
                     f"bucket_edges {edges} exceed max_seq_len="
                     f"{self.max_seq_len}; a bucket longer than the padded "
                     f"maximum can never be filled")
+        if self.serve_prefix_edges:
+            edges = self.serve_prefix_edges
+            if any(e <= 0 for e in edges):
+                raise ValueError(f"serve_prefix_edges must be positive "
+                                 f"pad lengths, got {edges}")
+            if list(edges) != sorted(set(edges)):
+                raise ValueError(f"serve_prefix_edges must be strictly "
+                                 f"ascending, got {edges}")
+            if edges[-1] > self.max_seq_len:
+                raise ValueError(
+                    f"serve_prefix_edges {edges} exceed max_seq_len="
+                    f"{self.max_seq_len}; a prefix longer than the "
+                    f"padded maximum can never be encoded")
         if self.bucket_shuffle_window < 1:
             raise ValueError(f"bucket_shuffle_window must be >= 1, got "
                              f"{self.bucket_shuffle_window}")

@@ -251,13 +251,12 @@ class DataLoader:
 
     def _pad_batch(self, batch: Sequence[np.ndarray],
                    nmax: Optional[int] = None) -> np.ndarray:
+        # the shared stroke-5 layout (NB.pad_batch_numpy): ONE
+        # implementation behind this, the streaming batcher's fallback
+        # and the serve endpoints' prefix padding — the bitwise
+        # serve-vs-offline parity contract depends on them agreeing
         nmax = self.hps.max_seq_len if nmax is None else nmax
-        out = np.zeros((len(batch), nmax + 1, 5), dtype=np.float32)
-        for i, s in enumerate(batch):
-            big = S.to_big_strokes(s, nmax)      # [nmax, 5]
-            out[i, 1:, :] = big
-            out[i, 0, :] = [0, 0, 1, 0, 0]       # start token
-        return out
+        return NB.pad_batch_numpy(list(batch), nmax)[0]
 
     def _assemble(self, idx: np.ndarray,
                   int16_scale: Optional[float] = None,

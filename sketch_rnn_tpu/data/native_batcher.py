@@ -156,6 +156,86 @@ def assemble_batch(seqs: List[np.ndarray], max_len: int
     return out, lens
 
 
+def pad_batch_numpy(seqs: List[np.ndarray], max_len: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """THE stroke-5 batch layout, pure numpy: ``strokes [B, max_len+1,
+    5]`` with the start token at t=0, plus ``seq_len [B]``. Bit-exact
+    to the native :func:`assemble_batch` (golden-tested) and the ONE
+    shared implementation behind ``DataLoader._pad_batch``,
+    :func:`stream_batches`' fallback and the serve endpoints'
+    ``pad_prefixes`` — the serve-vs-offline bitwise-parity contract
+    depends on these never drifting, so the layout lives once."""
+    from sketch_rnn_tpu.data import strokes as S
+
+    out = np.zeros((len(seqs), max_len + 1, 5), dtype=np.float32)
+    lens = np.empty((len(seqs),), dtype=np.int32)
+    for i, s in enumerate(seqs):
+        s = np.asarray(s, np.float32)
+        out[i, 1:, :] = S.to_big_strokes(s, max_len)
+        out[i, 0, :] = [0, 0, 1, 0, 0]
+        lens[i] = len(s)
+    return out, lens
+
+
+def stream_batches(seq_iter, batch_size: int, max_len: int,
+                   drop_last: bool = False):
+    """Assemble stroke-5 batches straight from a stroke-3 stream
+    (ISSUE 15 streaming ingestion: ``data.quickdraw.stream_stroke3`` /
+    ``stream_categories`` -> the serving fleet, no materialized corpus).
+
+    ``seq_iter`` yields stroke-3 arrays OR ``(label, stroke3)`` pairs;
+    sequences longer than ``max_len`` are dropped (the loader's
+    ``_purify`` filter contract), counted in the ``records_skipped``
+    telemetry counter when a core is enabled. Yields loader-layout
+    dicts — ``strokes [B, max_len+1, 5]`` float32 with the start token
+    at t=0, ``seq_len [B]``, ``labels [B]`` — assembled through the
+    native C++ batcher when available and the bit-exact numpy fallback
+    otherwise. The trailing partial batch is yielded at its true size
+    unless ``drop_last``.
+    """
+    if batch_size < 1 or max_len < 1:
+        raise ValueError(f"batch_size and max_len must be >= 1, got "
+                         f"{batch_size}/{max_len}")
+
+    def flush(buf_seqs, buf_labels):
+        native = assemble_batch(buf_seqs, max_len)
+        if native is None:
+            strokes, lens = pad_batch_numpy(buf_seqs, max_len)
+        else:
+            strokes, lens = native
+        return {"strokes": strokes, "seq_len": lens,
+                "labels": np.asarray(buf_labels, np.int32)}
+
+    from sketch_rnn_tpu.utils.telemetry import get_telemetry
+
+    def skip_one():
+        # ticked PER drop, not at generator exhaustion: a consumer
+        # that takes only the first K batches (islice) must still see
+        # its drops counted; zero-length records count too
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter("records_skipped", 1.0, cat="data")
+
+    buf_seqs: List[np.ndarray] = []
+    buf_labels: List[int] = []
+    for item in seq_iter:
+        if isinstance(item, tuple):
+            label, s3 = item
+        else:
+            label, s3 = 0, item
+        s3 = np.asarray(s3, np.float32)
+        if len(s3) > max_len or len(s3) == 0:
+            skip_one()
+            continue
+        buf_seqs.append(s3)
+        buf_labels.append(int(label))
+        if len(buf_seqs) == batch_size:
+            yield flush(buf_seqs, buf_labels)
+            buf_seqs, buf_labels = [], []
+    if buf_seqs and not drop_last:
+        yield flush(buf_seqs, buf_labels)
+
+
 def assemble_batch_aug(seqs: List[np.ndarray], max_len: int,
                        scale_factor: float, drop_prob: float, seed: int,
                        n_threads: int = 0

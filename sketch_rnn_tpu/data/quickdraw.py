@@ -175,6 +175,75 @@ def iter_ndjson(lines: Iterable[str],
               flush=True)
 
 
+def stream_stroke3(path: str,
+                   epsilon: float = 2.0,
+                   max_points: Optional[int] = 250,
+                   recognized_only: bool = True,
+                   skip_bad: bool = False,
+                   limit: Optional[int] = None,
+                   min_points: int = 2):
+    """Stream one category ``.ndjson`` file as stroke-3 arrays.
+
+    The streaming half of :func:`convert_ndjson` (ISSUE 15): yields
+    each drawing's canonical-preprocessed stroke-3 ``[N, 3]`` float32
+    array (integer-valued deltas — the same ``quantize=True`` pipeline
+    the ``.npz`` conversion writes) WITHOUT materializing the corpus,
+    so the full 345-category QuickDraw set can feed a serving fleet's
+    prefix corpus or the native batcher one drawing at a time.
+    Drawings shorter than ``min_points`` after simplification are
+    dropped, exactly like the converter.
+    """
+    count = 0
+    with open(path) as f:
+        for _, drawing in iter_ndjson(f, recognized_only=recognized_only,
+                                      source=path, skip_bad=skip_bad):
+            s3 = drawing_to_stroke3(drawing, epsilon=epsilon,
+                                    max_points=max_points,
+                                    quantize=True)
+            if len(s3) < min_points:
+                continue
+            yield s3
+            count += 1
+            if limit is not None and count >= limit:
+                return
+
+
+def stream_categories(data_dir: str, categories: Sequence[str],
+                      interleave: bool = True, **kw):
+    """Stream ``(label, stroke3)`` pairs from per-category ``.ndjson``
+    files under ``data_dir`` (ISSUE 15 streaming ingestion).
+
+    ``categories`` name the files (``.ndjson`` appended when missing);
+    the label is the category's index, matching ``load_dataset``'s
+    file-order labeling. ``interleave=True`` (default) round-robins
+    one drawing per category so a downstream batch window mixes
+    classes the way a pooled corpus would; ``False`` streams each file
+    to exhaustion in order. ``**kw`` passes through to
+    :func:`stream_stroke3` (epsilon / max_points / limit / skip_bad).
+    """
+    import os
+
+    paths = [os.path.join(
+        data_dir, c if c.endswith(".ndjson") else c + ".ndjson")
+        for c in categories]
+    streams = [stream_stroke3(p, **kw) for p in paths]
+    if not interleave:
+        for label, stream in enumerate(streams):
+            for s3 in stream:
+                yield label, s3
+        return
+    live = list(range(len(streams)))
+    while live:
+        done = []
+        for label in live:
+            try:
+                yield label, next(streams[label])
+            except StopIteration:
+                done.append(label)
+        for label in done:
+            live.remove(label)
+
+
 def convert_ndjson(in_path: str, out_path: str,
                    epsilon: float = 2.0,
                    max_points: int = 250,
@@ -191,17 +260,13 @@ def convert_ndjson(in_path: str, out_path: str,
     ``skip_bad`` skips corrupt lines (counted) instead of failing on
     the first one — see :func:`iter_ndjson`.
     """
-    seqs: List[np.ndarray] = []
-    with open(in_path) as f:
-        for _, drawing in iter_ndjson(f, source=in_path,
-                                      skip_bad=skip_bad):
-            s3 = drawing_to_stroke3(drawing, epsilon=epsilon,
-                                    max_points=max_points, quantize=True)
-            if len(s3) < 2:
-                continue
-            seqs.append(s3.astype(np.int16))
-            if limit is not None and len(seqs) >= limit:
-                break
+    # one pipeline: the converter is the streaming reader (ISSUE 15)
+    # materialized — the two paths cannot drift
+    seqs: List[np.ndarray] = [
+        s3.astype(np.int16)
+        for s3 in stream_stroke3(in_path, epsilon=epsilon,
+                                 max_points=max_points,
+                                 skip_bad=skip_bad, limit=limit)]
     rng = np.random.default_rng(seed)
     order = rng.permutation(len(seqs))
     seqs = [seqs[i] for i in order]

@@ -16,6 +16,16 @@ from sketch_rnn_tpu.serve.autoscale import (
     simulate_traffic,
 )
 from sketch_rnn_tpu.serve.cache import ResultCache, request_fingerprint
+from sketch_rnn_tpu.serve.endpoints import (
+    ENDPOINTS,
+    ENCODER_ENDPOINTS,
+    EncodeProgram,
+    default_prefix_edges,
+    parse_endpoint_specs,
+    plan_batch,
+    serve_requests,
+    validate_request,
+)
 from sketch_rnn_tpu.serve.engine import (
     Request,
     Result,
@@ -28,7 +38,9 @@ from sketch_rnn_tpu.serve.loadgen import (
     OpenLoopLoadGen,
     Trace,
     TraceSpec,
+    endpoint_mix_ids,
     make_trace,
+    parse_endpoint_mix,
     poisson_arrivals,
 )
 from sketch_rnn_tpu.serve.metrics_http import MetricsServer
@@ -37,6 +49,16 @@ from sketch_rnn_tpu.serve.slo import SLO, SLOTracker, parse_slo
 __all__ = [
     "AdmissionClass",
     "AdmissionController",
+    "ENDPOINTS",
+    "ENCODER_ENDPOINTS",
+    "EncodeProgram",
+    "default_prefix_edges",
+    "endpoint_mix_ids",
+    "parse_endpoint_mix",
+    "parse_endpoint_specs",
+    "plan_batch",
+    "serve_requests",
+    "validate_request",
     "Autoscaler",
     "AutoscalePolicy",
     "AutoscaleSignals",

@@ -210,14 +210,18 @@ class AdmissionController:
 
     def est_wait_s(self, replica: int) -> Optional[float]:
         """Expected queueing delay on ``replica``: its backlog worked
-        off at ``slots`` concurrent requests of the observed service
-        time (None until a completion calibrates the estimate)."""
+        off at ``slots`` concurrent units of the observed service
+        time (None until a completion calibrates the estimate).
+        Backlog is counted in decode-pool ROWS (ISSUE 15: an
+        interpolation occupies ``frames`` rows), so a grid request
+        weighs its true device cost; the EWMA sample is each
+        completion's slot-occupancy duration (see :meth:`note_done`)."""
         if self.service_s is None:
             return None
         return self._backlog[replica] * self.service_s / self.slots
 
     def place(self, cls_name: str, force: bool = False,
-              requeue: bool = False) -> Placement:
+              requeue: bool = False, cost: int = 1) -> Placement:
         """Decide one arrival: least-loaded replica, or shed.
 
         ``force`` admits unconditionally (same least-loaded placement,
@@ -227,13 +231,19 @@ class AdmissionController:
         additionally skips the ``admitted`` count: a requeued request
         was already admitted once, and re-counting it would report
         admitted > submitted on exactly the degraded runs operators
-        read the admission summary on.
+        read the admission summary on. ``cost`` (ISSUE 15) is the
+        request's decode-pool row count — ``frames`` for an
+        interpolation, 1 otherwise — so backlog, the queue cap and
+        the deadline shed estimate see the real work a grid request
+        queues, not "one request".
         """
         cls = self.classes.get(cls_name)
         if cls is None:
             raise KeyError(
                 f"unknown admission class {cls_name!r}; configured: "
                 f"{sorted(self.classes)}")
+        if cost < 1:
+            raise ValueError(f"cost must be >= 1, got {cost}")
         live = self.live_replicas
         if not live:
             raise RuntimeError(
@@ -255,19 +265,26 @@ class AdmissionController:
                                  shed_reason="deadline")
         if not requeue:
             self.admitted += 1
-        self._backlog[replica] += 1
+        self._backlog[replica] += int(cost)
         return Placement(replica=replica, queue_pos=depth,
                          est_wait_s=wait)
 
-    def note_done(self, replica: int, decode_s: float) -> None:
-        """Feed one completion: frees backlog, calibrates the
-        service-time EWMA the shed estimate runs on."""
-        if self._backlog[replica] <= 0:
+    def note_done(self, replica: int, decode_s: float,
+                  cost: int = 1) -> None:
+        """Feed one completion: frees its ``cost`` backlog rows (the
+        same count :meth:`place` charged), calibrates the service-time
+        EWMA the shed estimate runs on. The sample is ``decode_s``
+        itself even for grid requests: an interpolation's rows decode
+        CONCURRENTLY in pool slots, so each row occupies a slot for
+        ~the whole decode duration — dividing by ``cost`` would drag
+        the estimate down by frames-x and re-open exactly the shed
+        underestimate the row-cost accounting closes."""
+        if self._backlog[replica] < cost:
             raise RuntimeError(
-                f"replica {replica} completed a request with zero "
-                f"tracked backlog — placement/completion accounting "
-                f"desynced")
-        self._backlog[replica] -= 1
+                f"replica {replica} completed a cost-{cost} request "
+                f"with only {self._backlog[replica]} tracked backlog "
+                f"rows — placement/completion accounting desynced")
+        self._backlog[replica] -= int(cost)
         d = float(decode_s)
         self.service_s = (d if self.service_s is None
                           else (1 - self._ewma) * self.service_s

@@ -56,16 +56,35 @@ import numpy as np
 from sketch_rnn_tpu.utils.telemetry import get_telemetry
 
 
+def _hash_prefix(h, arr) -> None:
+    """Hash one stroke prefix with its shape as a delimiter: two
+    prefixes whose concatenated bytes agree but whose row splits differ
+    can never collide."""
+    a = np.asarray(arr, np.float32)
+    h.update(f"<{a.shape}>".encode())
+    h.update(a.tobytes())
+
+
 def request_fingerprint(req, config_hash: str = "",
                         ckpt_id: str = "") -> bytes:
     """blake2b digest of the request CONTENT + the model namespace.
 
     Content = everything the strokes may depend on (the engine's
     determinism contract): raw PRNG key data, z, label, temperature,
-    max_len. ``config_hash`` (the RUN.json HParams hash) and
-    ``ckpt_id`` (which params checkpoint is serving) namespace the
-    keyspace so different models can never collide. uid/class/queue
-    metadata never enter the hash — scheduling cannot fragment it.
+    max_len — plus, for multi-task requests (ISSUE 15), the endpoint
+    name, the prefix bytes (both sketches for interpolate, order-
+    sensitive) and the frame count. A plain generate request hashes
+    EXACTLY the pre-endpoint byte stream, so every fingerprint minted
+    before this PR is unchanged (no cold-cache regression), while two
+    endpoints can never collide on shared content: the endpoint tag is
+    inside the hash. The endpoint-DERIVED decode state (z stamped by
+    the planner, init_carry/init_prev) is deliberately NOT hashed for
+    encoder endpoints — it is a pure function of (prefix, params), and
+    hashing it would make the fingerprint depend on WHEN the planner
+    ran. ``config_hash`` (the RUN.json HParams hash) and ``ckpt_id``
+    (which params checkpoint is serving) namespace the keyspace so
+    different models can never collide. uid/class/queue metadata never
+    enter the hash — scheduling cannot fragment it.
     """
     import jax  # lazy: the serve-module discipline
 
@@ -77,11 +96,28 @@ def request_fingerprint(req, config_hash: str = "",
     key_data = np.asarray(jax.random.key_data(req.key))
     h.update(str(key_data.dtype).encode() + b"|")
     h.update(key_data.tobytes())
-    if req.z is None:
-        h.update(b"z:none")
+    endpoint = getattr(req, "endpoint", "generate") or "generate"
+    prefix = getattr(req, "prefix", None)
+    if endpoint == "generate" and prefix is None:
+        if req.z is None:
+            h.update(b"z:none")
+        else:
+            z = np.asarray(req.z, np.float32)
+            h.update(z.tobytes())
     else:
-        z = np.asarray(req.z, np.float32)
-        h.update(z.tobytes())
+        # the multi-task arm of the keyspace: the tag byte cannot
+        # appear in the legacy stream's position (legacy continues
+        # with z bytes or the literal b"z:none"), so old and new
+        # fingerprints live in disjoint domains
+        h.update(b"\x01ep:" + endpoint.encode() + b"\x00")
+        if endpoint == "interpolate":
+            a, b = prefix
+            _hash_prefix(h, a)
+            _hash_prefix(h, b)
+            h.update(f"|frames:{int(getattr(req, 'frames', 0) or 0)}"
+                     .encode())
+        else:
+            _hash_prefix(h, prefix)
     h.update(f"|{int(req.label)}|{float(req.temperature)!r}|"
              f"{req.max_len}".encode())
     return h.digest()
@@ -89,17 +125,26 @@ def request_fingerprint(req, config_hash: str = "",
 
 class CacheEntry:
     """One stored completion: the strokes plus origin metadata for the
-    hit path's trace link."""
+    hit path's trace link. Multi-task results (ISSUE 15) also carry
+    their endpoint and — for interpolations — the per-frame stroke
+    arrays; the frames are COPIES of the concatenated buffer (the
+    assembler builds ``strokes5`` with np.concatenate), so ``nbytes``
+    counts both and the byte bound stays honest."""
 
-    __slots__ = ("strokes5", "length", "steps", "origin_uid", "nbytes")
+    __slots__ = ("strokes5", "length", "steps", "origin_uid", "nbytes",
+                 "endpoint", "frames")
 
     def __init__(self, strokes5: np.ndarray, length: int, steps: int,
-                 origin_uid: int):
+                 origin_uid: int, endpoint: str = "generate",
+                 frames=None):
         self.strokes5 = strokes5
         self.length = int(length)
         self.steps = int(steps)
         self.origin_uid = int(origin_uid)
-        self.nbytes = int(strokes5.nbytes)
+        self.nbytes = int(strokes5.nbytes) + (
+            0 if frames is None else sum(int(f.nbytes) for f in frames))
+        self.endpoint = endpoint or "generate"
+        self.frames = frames
 
 
 class ResultCache:
@@ -175,7 +220,10 @@ class ResultCache:
         """Insert one completed Result's strokes (keep-first on
         duplicate fingerprints), then evict LRU until bounds hold."""
         entry = CacheEntry(result.strokes5, result.length, result.steps,
-                           result.uid)
+                           result.uid,
+                           endpoint=getattr(result, "endpoint",
+                                            "generate"),
+                           frames=getattr(result, "frames", None))
         evicted = 0
         tel = get_telemetry()
         with self._lock:

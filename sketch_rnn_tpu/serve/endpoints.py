@@ -1,0 +1,737 @@
+"""Multi-task serving endpoints: completion, reconstruction, interpolation.
+
+ISSUE 15 tentpole. The paper's model is a seq2seq VAE whose whole point
+is CONDITIONAL use — encode a sketch (or a prefix) to z, then decode —
+yet the serving fleet exposed exactly one workload: unconditional
+generation. This module opens the workload up as first-class endpoints
+over the existing engine/fleet/admission/cache machinery:
+
+- ``generate``     — the engine's native path, untouched (a pure-
+  generate burst compiles and runs the exact pre-endpoint program).
+- ``complete``     — encode a stroke-3 ``prefix`` with the
+  bidirectional encoder (posterior mean, deterministic), seed the
+  decoder carry by REPLAYING the prefix teacher-forced, then decode the
+  continuation through the normal chunked pool (the carry + last
+  prefix row ride the pool's new init leaves, serve/engine.py).
+- ``reconstruct``  — encode a full sketch -> z = mu -> a plain decode
+  conditioned on it: the round trip the reference notebook demos.
+- ``interpolate``  — encode TWO sketches, slerp a ``frames``-latent
+  grid (sample/interpolate.py — the same function the offline path
+  uses, so parity is structural), and decode the grid as a batch of
+  child rows; the parent books ONE result carrying the frame list.
+
+**The fixed-geometry encode program.** Prefix lengths vary per request,
+and a shape-per-length encode would compile per prefix — poison for a
+server (the exact failure bucketed execution solved for training).
+:class:`EncodeProgram` therefore pads every prefix to a small ladder of
+bucket edges (``hps.serve_prefix_edges``, default
+:func:`default_prefix_edges`) and a FIXED row count (the engine's slot
+width), so the JitCompileProbe sees exactly one ``serve_encode``
+compile per (pool rows, edge) geometry — the PR 4/8 house discipline.
+Padding is bitwise-invisible to the outputs: the encoder's final states
+are gathered at ``seq_len`` (pad steps past it contribute exact zeros
+through the one-hot contraction), and the replay scan masks carry
+updates at ``t < seq_len``, so a prefix encodes identically at every
+edge that fits it and in every batch composition — the invariance the
+test suite pins.
+
+**Planning contract.** Everything here is a pure function of (prefix,
+params): the planner stamps derived decode state onto requests
+(``z`` / ``init_carry`` / ``init_prev``) and expands interpolations
+into child rows with ``fold_in(parent_key, frame)`` keys, then the
+engine's per-request RNG takes over. Scheduling still changes WHEN,
+never WHAT — completion/reconstruction/interpolation strokes are
+bitwise independent of batch composition, replica placement and
+arrival order, exactly like generation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.utils.telemetry import (
+    JitCompileProbe,
+    critical_path_segments,
+    endpoint_series,
+    get_telemetry,
+    request_span_id,
+    request_trace_id,
+    span_link,
+)
+
+ENDPOINTS = ("generate", "complete", "reconstruct", "interpolate")
+ENCODER_ENDPOINTS = ("complete", "reconstruct", "interpolate")
+
+# default latent-grid size of an interpolate request (the notebook's
+# canonical 10-frame strip); Request.frames overrides per request
+DEFAULT_FRAMES = 10
+
+# interpolation FRAME rows get engine uids far above any real request
+# uid: child_uid = CHILD_UID_BASE + parent_uid * CHILD_UID_STRIDE +
+# frame. Pure in (parent uid, frame) — no shared allocator, the
+# utils/faults no-RNG-stream discipline — and collision-free for
+# parent uids < 2**28 at frames < 4096.
+CHILD_UID_BASE = 1 << 40
+CHILD_UID_STRIDE = 4096
+
+
+def default_prefix_edges(max_seq_len: int) -> Tuple[int, ...]:
+    """The small prefix-pad ladder used when ``hps.serve_prefix_edges``
+    is unset: powers of two below ``max_seq_len`` plus the terminal
+    edge — a handful of compiled encode geometries covering QuickDraw's
+    length range."""
+    return tuple(e for e in (32, 64, 128) if e < max_seq_len) \
+        + (int(max_seq_len),)
+
+
+def prefix_edges(hps: HParams) -> Tuple[int, ...]:
+    """The effective prefix bucket ladder (configured or default)."""
+    edges = tuple(hps.serve_prefix_edges) or \
+        default_prefix_edges(hps.max_seq_len)
+    if edges[-1] < hps.max_seq_len:
+        edges = edges + (hps.max_seq_len,)
+    return edges
+
+
+def prefix_edge_of(length: int, edges: Sequence[int]) -> int:
+    """Smallest edge that fits a ``length``-row prefix."""
+    for e in edges:
+        if length <= e:
+            return int(e)
+    raise ValueError(f"prefix length {length} exceeds the terminal "
+                     f"edge {edges[-1]}")
+
+
+def _check_prefix(prefix, edges: Sequence[int], what: str) -> np.ndarray:
+    try:
+        p = np.asarray(prefix, np.float32)
+    except (ValueError, TypeError) as e:
+        raise ValueError(f"{what}: prefix is not a stroke-3 array "
+                         f"({e})") from None
+    if p.ndim != 2 or p.shape[1] != 3 or len(p) < 1:
+        raise ValueError(f"{what}: prefix must be a stroke-3 "
+                         f"[n >= 1, 3] array, got shape {p.shape}")
+    if len(p) > edges[-1]:
+        raise ValueError(f"{what}: prefix has {len(p)} rows but the "
+                         f"terminal prefix edge is {edges[-1]} "
+                         f"(= max_seq_len)")
+    if not np.isfinite(p).all():
+        raise ValueError(f"{what}: prefix contains non-finite values")
+    return p
+
+
+def validate_request(req, hps: HParams, pool_cap: int = 0) -> None:
+    """Fail-fast endpoint/shape validation — the door check the fleet
+    (and ``cli serve-bench``'s pre-restore spec validation) runs.
+
+    Raises ``ValueError`` with one actionable line; notably,
+    unconditional checkpoints reject every encoder endpoint naming
+    ``hps.conditional`` (the satellite contract)."""
+    ep = req.endpoint or "generate"
+    if ep not in ENDPOINTS:
+        raise ValueError(f"unknown endpoint {ep!r}; this server "
+                         f"speaks {ENDPOINTS}")
+    if ep == "generate":
+        if req.prefix is not None:
+            raise ValueError(
+                "generate requests carry no prefix (use endpoint="
+                "'complete' to continue a stroke prefix)")
+        return
+    if not hps.conditional:
+        raise ValueError(
+            f"endpoint {ep!r} needs the bidirectional encoder but "
+            f"this checkpoint is unconditional (hps.conditional="
+            f"false)")
+    edges = prefix_edges(hps)
+    if ep == "interpolate":
+        pair = req.prefix
+        if pair is None or isinstance(pair, np.ndarray) or \
+                len(pair) != 2:
+            raise ValueError(
+                "interpolate requests carry prefix=(sketch_a, "
+                "sketch_b) — exactly two stroke-3 arrays")
+        frames = int(req.frames) or DEFAULT_FRAMES
+        if frames < 2:
+            raise ValueError(f"interpolate needs frames >= 2, got "
+                             f"{frames}")
+        if pool_cap and frames > pool_cap:
+            raise ValueError(
+                f"interpolate frames {frames} exceed the fleet's "
+                f"pool_cap {pool_cap} — the grid must fit one "
+                f"micro-burst")
+        for side, p in zip("ab", pair):
+            _check_prefix(p, edges, f"interpolate prefix {side}")
+    else:
+        _check_prefix(req.prefix, edges, ep)
+
+
+def pool_rows_of(req) -> int:
+    """Decode-pool rows one request occupies (the fleet's cost-aware
+    micro-burst chop): an interpolation decodes ``frames`` child rows,
+    everything else exactly one."""
+    if (req.endpoint or "generate") == "interpolate":
+        return int(req.frames) or DEFAULT_FRAMES
+    return 1
+
+
+# -- the fixed-geometry encode + prefix-replay program ------------------------
+
+
+def pad_prefixes(prefixes: Sequence[np.ndarray], edge: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stroke-3 prefixes -> the loader's batch layout at pad ``edge``:
+    ``strokes [B, edge + 1, 5]`` (start token at t=0) + ``seq_len [B]``.
+    Delegates to the ONE shared layout implementation
+    (``data.native_batcher.pad_batch_numpy`` — also behind
+    ``DataLoader._pad_batch``), which is what makes serve-path encodes
+    bitwise equal to the offline loader-batch path by construction."""
+    from sketch_rnn_tpu.data.native_batcher import pad_batch_numpy
+
+    return pad_batch_numpy(list(prefixes), edge)
+
+
+def make_encode_step(model, hps: HParams, params, edge: int):
+    """Build the jitted encode + prefix-replay program for one edge.
+
+    ``fn(strokes [B, edge+1, 5], seq_len [B], labels [B]?) ->
+    (mu [B, Nz], carry_flat [B, C], prev [B, 5])``:
+
+    - ``mu``: the deterministic posterior mean of each prefix (the
+      encoder consumes ``strokes[1:]`` exactly like training /
+      ``sample.interpolate.encode_mu``; pad steps past ``seq_len``
+      cannot reach the gathered final states, so mu is bitwise
+      pad-invariant across edges).
+    - ``carry_flat``: the decoder carry after teacher-forcing the
+      prefix — ``decoder_initial_carry(mu)`` advanced through inputs
+      ``START, S_1 .. S_{p-1}`` with per-row masking at ``t <
+      seq_len`` (rows past their length keep their carry, so batch
+      padding is inert).
+    - ``prev``: each row's LAST prefix stroke ``S_p`` — the decode
+      loop's first input, so the continuation's first MDN draw is the
+      model's prediction of ``S_{p+1}``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    e = int(edge)
+
+    def fn(strokes, seq_len, labels):
+        b = strokes.shape[0]
+        x_tm = jnp.transpose(strokes, (1, 0, 2))       # [E+1, B, 5]
+        mu, _ = model.encode(params, x_tm[1:], seq_len, train=False)
+        carry0 = model.decoder_initial_carry(params, mu, b)
+        inputs = x_tm[:-1]                             # [E, B, 5]
+
+        def step(carry, tx):
+            t, x_prev = tx
+            new_carry, _ = model.decode_step(params, carry, x_prev,
+                                             mu, labels)
+            live = t < seq_len
+            carry = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(
+                    live.reshape((-1,) + (1,) * (new.ndim - 1)),
+                    new, old),
+                new_carry, carry)
+            return carry, None
+
+        carry, _ = lax.scan(step, carry0,
+                            (jnp.arange(e), inputs))
+        flat = jnp.concatenate(jax.tree_util.tree_leaves(carry),
+                               axis=-1)
+        prev = jnp.take_along_axis(
+            strokes,
+            jnp.broadcast_to(seq_len[:, None, None].astype(jnp.int32),
+                             (b, 1, 5)),
+            axis=1)[:, 0]
+        return mu, flat, prev
+
+    return jax.jit(fn)
+
+
+class EncodeProgram:
+    """Per-device fixed-geometry endpoint encoder (the pre-decode burst
+    phase).
+
+    One compiled program per (``rows``, edge) geometry, each wrapped in
+    a :class:`JitCompileProbe` named ``serve_encode`` so compile
+    accounting (when/where/how long, flops/peak bytes) rides the ISSUE
+    8 machinery — the acceptance pin is exactly one compile per
+    geometry and ZERO inside a measured window (warm first, like the
+    chunk program). ``device`` pins params and every input to one
+    replica's device, the fleet's collective-free discipline.
+    """
+
+    def __init__(self, model, hps: HParams, params, rows: int,
+                 edges: Optional[Sequence[int]] = None, device=None,
+                 replica_id: Optional[int] = None):
+        import jax
+
+        if not hps.conditional:
+            raise ValueError(
+                "EncodeProgram needs a conditional model "
+                "(hps.conditional=false has no encoder)")
+        self.model = model
+        self.hps = hps
+        self.rows = int(rows)
+        if self.rows < 1:
+            raise ValueError(f"rows must be >= 1, got {rows}")
+        self.edges = tuple(edges) if edges else prefix_edges(hps)
+        self.device = device
+        self.replica_id = replica_id
+        # encode-phase parameter subset: encoder stacks + posterior
+        # heads + decoder (replay) + the z->carry projection. presig
+        # and the MDN projection are computed-then-discarded (XLA DCE
+        # drops them from the compiled program) but model.encode /
+        # decode_step read the leaves at trace time, so they ride along.
+        keep = ("enc_fwd", "enc_bwd", "mu_w", "mu_b", "presig_w",
+                "presig_b", "dec", "dec_init_w", "dec_init_b",
+                "class_embed", "out_w", "out_b")
+        self.params = jax.device_put(
+            {k: params[k] for k in keep if k in params}, device)
+        self._fns: Dict[int, JitCompileProbe] = {}
+
+    def _fn(self, edge: int) -> JitCompileProbe:
+        if edge not in self._fns:
+            self._fns[edge] = JitCompileProbe(
+                make_encode_step(self.model, self.hps, self.params,
+                                 edge),
+                "serve_encode",
+                key_of=lambda a: (tuple(a[0].shape),),
+                label_of=lambda a: (f"(B{a[0].shape[0]},"
+                                    f"E{a[0].shape[1] - 1})"))
+        return self._fns[edge]
+
+    def warm(self) -> None:
+        """Compile every edge program outside the measured window (one
+        zero-prefix batch per edge, the prefix sized to hit exactly
+        that edge's bucket) — the fleet's warm-then-measure order; the
+        probe then reports measured-window calls as cache hits."""
+        for edge in self.edges:
+            self.encode([np.zeros((edge, 3), np.float32)],
+                        [0] if self.hps.num_classes > 0 else None)
+
+    def encode(self, prefixes: Sequence[np.ndarray],
+               labels: Optional[Sequence[int]] = None
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Encode ``prefixes`` (stroke-3 arrays) through the bucketed
+        fixed-geometry programs; returns ``(mu [n, Nz], carry_flat
+        [n, C], prev [n, 5])`` aligned to the input order.
+
+        Prefixes are grouped by their bucket edge, each group is padded
+        to ``rows`` (pad rows are inert — per-row masking), and groups
+        larger than ``rows`` run in chunks — so every call dispatches
+        only the (rows, edge) geometries that were compiled once.
+        """
+        import jax
+
+        n = len(prefixes)
+        if n == 0:
+            return (np.zeros((0, self.hps.z_size), np.float32),
+                    np.zeros((0, self.model.dec.carry_size),
+                             np.float32),
+                    np.zeros((0, 5), np.float32))
+        tel = get_telemetry()
+        t0 = time.perf_counter()
+        mu = np.zeros((n, self.hps.z_size), np.float32)
+        carry = np.zeros((n, self.model.dec.carry_size), np.float32)
+        prev = np.zeros((n, 5), np.float32)
+        by_edge: Dict[int, List[int]] = {}
+        for i, p in enumerate(prefixes):
+            by_edge.setdefault(
+                prefix_edge_of(len(p), self.edges), []).append(i)
+        for edge in sorted(by_edge):
+            idxs = by_edge[edge]
+            fn = self._fn(edge)
+            for lo in range(0, len(idxs), self.rows):
+                chunk = idxs[lo:lo + self.rows]
+                group = [prefixes[i] for i in chunk]
+                pad = self.rows - len(group)
+                if pad:
+                    group = group + [np.zeros((1, 3), np.float32)] * pad
+                strokes, lens = pad_prefixes(group, edge)
+                labs = None
+                if self.hps.num_classes > 0:
+                    labs = np.zeros((self.rows,), np.int32)
+                    if labels is not None:
+                        for j, i in enumerate(chunk):
+                            labs[j] = int(labels[i])
+                args = jax.device_put((strokes, lens, labs),
+                                      self.device)
+                g_mu, g_carry, g_prev = jax.device_get(fn(*args))
+                for j, i in enumerate(chunk):
+                    mu[i] = g_mu[j]
+                    carry[i] = g_carry[j]
+                    prev[i] = g_prev[j]
+        if tel.enabled:
+            tel.emit_span(
+                "encode_phase", "serve", t0, time.perf_counter(),
+                args={"n_prefixes": n,
+                      "edges": sorted(by_edge),
+                      **({"replica": self.replica_id}
+                         if self.replica_id is not None else {})})
+        return mu, carry, prev
+
+
+# -- planning & assembly ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """One micro-burst's endpoint plan: the decode-pool request list
+    (originals stamped with derived state, interpolations replaced by
+    their frame children) plus the parent assembly map."""
+
+    engine_requests: List[Any]
+    # parent_uid -> {"request": parent, "child_uids": [uid...]}
+    parents: Dict[int, Dict[str, Any]]
+
+
+def child_uid(parent_uid: int, frame: int) -> int:
+    return CHILD_UID_BASE + int(parent_uid) * CHILD_UID_STRIDE \
+        + int(frame)
+
+
+def plan_batch(engine, requests: Sequence[Any]) -> BatchPlan:
+    """Run the encode phase for one burst and build its decode plan.
+
+    Pure-generate bursts short-circuit to an identity plan (zero
+    overhead on the legacy path). Encoder-endpoint requests are stamped
+    IN PLACE with their derived decode state — deterministic in
+    (prefix, params), so a failover re-plan on a surviving replica
+    restamps identical values. Interpolations expand into ``frames``
+    child rows keyed ``fold_in(parent_key, frame)``; the parent books
+    one result at :func:`assemble_results`.
+    """
+    import jax
+
+    needs = [r for r in requests
+             if (r.endpoint or "generate") != "generate"
+             and r.parent_uid is None]
+    if not needs:
+        return BatchPlan(list(requests), {})
+    for r in needs:
+        validate_request(r, engine.hps)
+        if r.uid is None:
+            raise ValueError(
+                "endpoint requests need explicit uids before planning "
+                "(the fleet/serve_requests allocators assign them)")
+    encoder = engine.encoder
+    jobs: List[Tuple[Any, int, np.ndarray]] = []  # (req, side, prefix)
+    for r in needs:
+        if r.endpoint == "interpolate":
+            jobs.append((r, 0, np.asarray(r.prefix[0], np.float32)))
+            jobs.append((r, 1, np.asarray(r.prefix[1], np.float32)))
+        else:
+            jobs.append((r, 0, np.asarray(r.prefix, np.float32)))
+    mu, carry, prev = encoder.encode(
+        [j[2] for j in jobs],
+        [j[0].label for j in jobs]
+        if engine.hps.num_classes > 0 else None)
+    enc_of: Dict[Tuple[int, int], int] = {
+        (id(j[0]), j[1]): k for k, j in enumerate(jobs)}
+
+    engine_requests: List[Any] = []
+    parents: Dict[int, Dict[str, Any]] = {}
+    for r in requests:
+        ep = r.endpoint or "generate"
+        if ep == "generate" or r.parent_uid is not None:
+            engine_requests.append(r)
+            continue
+        if ep == "reconstruct":
+            r.z = mu[enc_of[(id(r), 0)]]
+            engine_requests.append(r)
+        elif ep == "complete":
+            k = enc_of[(id(r), 0)]
+            r.z = mu[k]
+            r.init_carry = carry[k]
+            r.init_prev = prev[k]
+            engine_requests.append(r)
+        else:  # interpolate
+            from sketch_rnn_tpu.sample.interpolate import \
+                interpolate_latents
+
+            frames = int(r.frames) or DEFAULT_FRAMES
+            mu0 = mu[enc_of[(id(r), 0)]]
+            mu1 = mu[enc_of[(id(r), 1)]]
+            grid = np.asarray(
+                interpolate_latents(mu0, mu1, n=frames), np.float32)
+            kids = []
+            for f in range(frames):
+                cuid = child_uid(r.uid, f)
+                kids.append(dataclasses.replace(
+                    r, uid=cuid, key=jax.random.fold_in(r.key, f),
+                    z=grid[f], prefix=None, frames=0,
+                    parent_uid=r.uid, cls=None, queue_pos=None))
+                engine_requests.append(kids[-1])
+            parents[r.uid] = {"request": r,
+                              "child_uids": [k.uid for k in kids]}
+    return BatchPlan(engine_requests, parents)
+
+
+def assemble_results(plan: BatchPlan, engine_results: Sequence[Any],
+                     slo=None) -> List[Any]:
+    """Fold one burst's engine results back to request-level results.
+
+    Non-interpolate results pass through (the engine already stamped
+    their endpoint); each interpolate parent books ONE result whose
+    ``frames`` hold the per-frame strokes (``strokes5`` is their
+    concatenation), whose latency clock spans arrival -> last frame,
+    and whose ``attributed_steps`` is the exact integer sum of its
+    frames' — the cost identity stays closed. The parent's telemetry
+    (root span + complete instant + per-endpoint series) and its SLO
+    observation (``slo`` — the single-engine path's tracker; the
+    engine skips frame children so attainment counts REQUESTS) are
+    emitted here, since the engine only ever saw the children."""
+    from sketch_rnn_tpu.serve.engine import Result
+
+    if not plan.parents:
+        return list(engine_results)
+    child_parent: Dict[int, int] = {}
+    for puid, rec in plan.parents.items():
+        for cuid in rec["child_uids"]:
+            child_parent[cuid] = puid
+    by_uid = {r.uid: r for r in engine_results}
+    tel = get_telemetry()
+    out: List[Any] = []
+    done_parents = set()
+    for r in engine_results:
+        puid = child_parent.get(r.uid)
+        if puid is None:
+            out.append(r)
+            continue
+        if puid in done_parents:
+            continue
+        rec = plan.parents[puid]
+        kids = [by_uid.get(c) for c in rec["child_uids"]]
+        if any(k is None for k in kids):
+            continue  # a later result completes the grid
+        done_parents.add(puid)
+        parent = rec["request"]
+        frames = [k.strokes5 for k in kids]
+        queue_wait = min(k.queue_wait_s for k in kids)
+        latency = max(k.latency_s for k in kids)
+        res = Result(
+            uid=puid,
+            strokes5=np.concatenate(frames),
+            length=sum(k.length for k in kids),
+            steps=sum(k.steps for k in kids),
+            queue_wait_s=queue_wait,
+            decode_s=latency - queue_wait,
+            latency_s=latency,
+            attributed_steps=sum(k.attributed_steps for k in kids),
+            endpoint="interpolate",
+            frames=frames)
+        out.append(res)
+        if slo is not None:
+            slo.observe("interpolate", {
+                "queue_wait_s": res.queue_wait_s,
+                "decode_s": res.decode_s,
+                "latency_s": res.latency_s})
+        if tel.enabled:
+            now = time.perf_counter()
+            trace_id = request_trace_id(puid)
+            root_id = request_span_id("request", puid)
+            tel.emit_span(
+                "request", "serve", now - res.latency_s, now,
+                args={"uid": puid, "endpoint": "interpolate"},
+                trace=span_link(trace_id, root_id))
+            tel.instant(
+                "complete", cat="serve", ts=now,
+                args={"uid": puid, "endpoint": "interpolate",
+                      "steps": res.steps, "length": res.length,
+                      "queue_wait_s": res.queue_wait_s,
+                      "decode_s": res.decode_s,
+                      "latency_s": res.latency_s,
+                      "segments": [
+                          [k, v] for k, v in critical_path_segments(
+                              res.queue_wait_s, res.latency_s)],
+                      "attributed_steps": res.attributed_steps,
+                      "frames": len(frames),
+                      **({"class": parent.cls} if parent.cls else {})},
+                trace=span_link(trace_id,
+                                request_span_id("complete", puid),
+                                root_id))
+            tel.counter(endpoint_series("requests_completed",
+                                        "interpolate"), 1.0,
+                        cat="serve")
+            tel.observe(endpoint_series("latency_s", "interpolate"),
+                        res.latency_s, cat="serve")
+            if parent.cls is not None:
+                from sketch_rnn_tpu.utils.telemetry import class_series
+                tel.observe(class_series("latency_s", parent.cls),
+                            res.latency_s, cat="serve")
+    return out
+
+
+def serve_requests(model, hps: HParams, params, requests: List[Any],
+                   slots: int = 0, chunk: int = 0,
+                   max_len: Optional[int] = None, greedy: bool = False,
+                   recycle: bool = True, pool_pad: int = 0, slo=None,
+                   engine=None) -> Dict[str, Any]:
+    """One-call multi-task API: plan the endpoint batch, serve it
+    through a (given or fresh) engine, assemble request-level results.
+
+    This is THE offline reference path the serve-vs-offline parity
+    pins compare against: the fleet's per-replica workers run exactly
+    this plan/run/assemble sequence, so fleet strokes equal these
+    bitwise — and ``cli sample --interpolate/--reconstruct`` ride it
+    too, which is what makes the CLI's strokes bitwise equal to the
+    serve endpoint's on the same checkpoint/key."""
+    from sketch_rnn_tpu.serve.engine import ServeEngine
+
+    eng = engine or ServeEngine(model, hps, params, slots=slots,
+                                chunk=chunk, max_len=max_len,
+                                greedy=greedy)
+    for i, req in enumerate(requests):
+        if req.uid is None:
+            req.uid = i
+        validate_request(req, hps)
+    plan = plan_batch(eng, requests)
+    out = eng.run(plan.engine_requests, recycle=recycle,
+                  pool_pad=pool_pad, slo=slo)
+    results = assemble_results(plan, out["results"], slo=slo)
+    if slo is not None:
+        # re-snapshot AFTER assembly so interpolate parents' SLO
+        # observations (booked there, not in the engine) are in the
+        # returned summary
+        out["metrics"]["slo"] = slo.summary()
+    return {"results": results, "metrics": out["metrics"],
+            "engine": eng}
+
+
+def build_mix_requests(hps: HParams, mix, n: int, seed: int, kreq,
+                       z, pool, pool_labels, frames: int,
+                       temperature: float, caps=None,
+                       default_label: int = 0) -> List[Any]:
+    """THE seeded mixed-endpoint request recipe, shared by ``cli
+    serve-bench --endpoints`` and ``scripts/serve_bench.py
+    --endpoints`` so the two workloads can never drift: endpoint per
+    arrival from the weighted ``mix`` (``loadgen.endpoint_mix_ids`` —
+    the stream a trace replay draws), per-request keys
+    ``fold_in(kreq, i)``, prefixes deterministically indexed from
+    ``pool`` with a 7919 stride, completions continuing the first half
+    of their sketch, interpolations pairing a sketch with its stride-5
+    partner. ``z [n, Nz]`` feeds generate requests (None for
+    unconditional models); ``caps`` (optional ``[n]``) sets per-request
+    ``max_len``."""
+    import jax
+
+    from sketch_rnn_tpu.serve.engine import Request
+    from sketch_rnn_tpu.serve.loadgen import endpoint_mix_ids
+
+    names = [m[0] for m in mix]
+    ids = endpoint_mix_ids(n, mix, seed)
+    requests: List[Any] = []
+    for i in range(n):
+        ep = names[int(ids[i])]
+        key_i = jax.random.fold_in(kreq, i)
+        cap = None if caps is None else int(caps[i])
+        if ep == "generate":
+            requests.append(Request(
+                key=key_i, z=None if z is None else z[i],
+                label=default_label, temperature=temperature,
+                max_len=cap, endpoint="generate"))
+            continue
+        j = (i * 7919) % len(pool)
+        label = (int(pool_labels[j]) if hps.num_classes > 0
+                 else default_label)
+        if ep == "interpolate":
+            requests.append(Request(
+                key=key_i, endpoint="interpolate",
+                prefix=(pool[j], pool[(j + 5) % len(pool)]),
+                frames=frames, label=label, temperature=temperature,
+                max_len=cap))
+        elif ep == "complete":
+            p = pool[j]
+            requests.append(Request(
+                key=key_i, endpoint="complete",
+                prefix=p[:max(1, len(p) // 2)], label=label,
+                temperature=temperature, max_len=cap))
+        else:
+            requests.append(Request(
+                key=key_i, endpoint="reconstruct", prefix=pool[j],
+                label=label, temperature=temperature, max_len=cap))
+    return requests
+
+
+# -- endpoint -> admission-class mapping --------------------------------------
+
+
+def parse_endpoint_specs(specs: Sequence[str], classes=None
+                         ) -> Tuple[Dict[str, str], Dict[str, Any]]:
+    """Parse ``--endpoints`` specs into (endpoint -> class name, class
+    table).
+
+    Grammar, riding the existing ``parse_slo`` class grammar:
+
+    - ``complete=interactive:p95<=250ms`` — declare class
+      ``interactive`` (a latency SLO, the ``--classes`` grammar) and
+      route ``complete`` requests to it.
+    - ``interpolate=batch`` — route to class ``batch``; declared as a
+      no-deadline class if ``--classes`` did not already declare it.
+
+    ``classes`` seeds the table (spec order = priority, the
+    ``parse_admission_classes`` contract); endpoint-declared classes
+    append after it. Unknown endpoints and duplicate routes fail with
+    one actionable line — ``cli serve-bench`` runs this BEFORE the
+    checkpoint restore (the ``--slo``/``--classes`` precedent).
+    """
+    from sketch_rnn_tpu.serve.admission import AdmissionClass
+    from sketch_rnn_tpu.serve.slo import SLO, parse_slo
+
+    table: Dict[str, Any] = dict(classes) if classes else {}
+    ep_map: Dict[str, str] = {}
+    for spec in specs:
+        if "=" not in spec:
+            raise ValueError(
+                f"bad endpoint spec {spec!r}: want ENDPOINT=CLASS "
+                f"(e.g. 'complete=interactive:p95<=250ms' or "
+                f"'interpolate=batch')")
+        ep, _, right = spec.partition("=")
+        ep, right = ep.strip(), right.strip()
+        if ep not in ENDPOINTS:
+            raise ValueError(f"unknown endpoint {ep!r} in {spec!r}; "
+                             f"want one of {ENDPOINTS}")
+        if ep in ep_map:
+            raise ValueError(f"duplicate endpoint route for {ep!r} "
+                             f"(from {spec!r})")
+        if not right:
+            raise ValueError(f"empty class in endpoint spec {spec!r}")
+        if "<=" in right:
+            slo = parse_slo(right)
+            name = slo.endpoint
+            if name in table:
+                # a re-declaration must MATCH the existing class: a
+                # conflicting objective silently judged by the other
+                # spec is exactly the operator error this parser
+                # exists to catch
+                have = table[name].slo
+                if (have.objective_s, have.target, have.metric) != \
+                        (slo.objective_s, slo.target, slo.metric):
+                    raise ValueError(
+                        f"endpoint spec {spec!r} re-declares class "
+                        f"{name!r} with a different objective "
+                        f"({slo.key} vs the declared {have.key}) — "
+                        f"drop one or make them agree")
+            else:
+                table[name] = AdmissionClass(name=name, slo=slo,
+                                             priority=len(table))
+        else:
+            name = right
+            if name not in table:
+                # a bare class reference declares a no-deadline class
+                # (the batch-style default) when --classes did not
+                table[name] = AdmissionClass(
+                    name=name,
+                    slo=SLO(objective_s=math.inf, target=0.95,
+                            endpoint=name),
+                    priority=len(table))
+        ep_map[ep] = name
+    return ep_map, table

@@ -65,6 +65,7 @@ from sketch_rnn_tpu.utils.telemetry import (
     attribute_chunk_steps,
     class_series,
     critical_path_segments,
+    endpoint_series,
     get_telemetry,
     replica_series,
     request_parent_id,
@@ -82,15 +83,32 @@ class Request:
     ``key`` is the request's OWN PRNG key (determinism contract above).
     ``max_len`` caps emitted strokes (default: the engine's max_len).
 
-    The last three fields are ADMISSION metadata stamped by the fleet
-    scheduler (serve/fleet.py) — they explain *why* a request waited
-    (class, position in the fleet queue, true arrival instant) and ride
-    the telemetry ``complete`` events, but none of them can affect the
-    request's strokes (the determinism contract covers them: scheduling
-    metadata changes WHEN, never WHAT). ``enqueue_ts`` (a
-    ``perf_counter`` instant) backdates the latency clock to the
-    fleet-arrival time; unset, the clock starts at ``run()`` entry
-    exactly as before.
+    The admission-metadata fields (cls / queue_pos / enqueue_ts /
+    attempt) are stamped by the fleet scheduler (serve/fleet.py) — they
+    explain *why* a request waited (class, position in the fleet queue,
+    true arrival instant) and ride the telemetry ``complete`` events,
+    but none of them can affect the request's strokes (the determinism
+    contract covers them: scheduling metadata changes WHEN, never
+    WHAT). ``enqueue_ts`` (a ``perf_counter`` instant) backdates the
+    latency clock to the fleet-arrival time; unset, the clock starts at
+    ``run()`` entry exactly as before.
+
+    Multi-task serving (ISSUE 15): ``endpoint`` selects the workload —
+    ``generate`` (this engine's native path), ``complete`` (encode a
+    stroke-3 ``prefix``, replay it into the decoder carry, decode the
+    continuation), ``reconstruct`` (encode ``prefix`` -> z -> full
+    decode), ``interpolate`` (``prefix`` is a PAIR of sketches; the
+    slerp grid of ``frames`` latents decodes as a batch of child rows).
+    Encoder endpoints are planned by ``serve/endpoints.py`` BEFORE the
+    engine sees them: the planner stamps the derived decode state —
+    ``z`` (the posterior mean), and for ``complete`` the replayed
+    ``init_carry`` (flat) + ``init_prev`` (the last prefix row) the
+    chunk program re-initializes admitted slots from. ``parent_uid``
+    marks an interpolation FRAME row (an internal child of the named
+    parent request); children never book their own fleet results.
+    Everything endpoint-derived is a pure function of (prefix, params),
+    so the content fingerprint (serve/cache.py) hashes (endpoint,
+    prefix, frames) and never the derived state.
     """
 
     key: jax.Array
@@ -108,6 +126,13 @@ class Request:
     # the re-served hops); like the other admission metadata it can
     # never affect the request's strokes.
     attempt: int = 0
+    # multi-task serving (ISSUE 15) — see class docstring
+    endpoint: str = "generate"
+    prefix: Optional[Any] = None
+    frames: int = 0
+    parent_uid: Optional[int] = None
+    init_carry: Optional[np.ndarray] = None   # [C] flat replayed carry
+    init_prev: Optional[np.ndarray] = None    # [5] last prefix row
 
 
 @dataclasses.dataclass
@@ -133,6 +158,12 @@ class Result:
     # hit == recomputation provable); attributed_steps is 0 — a hit
     # costs no device steps, which is the whole point
     cached: bool = False
+    # multi-task serving (ISSUE 15): which workload produced this
+    # result; interpolate results additionally carry the per-frame
+    # stroke arrays (strokes5 is then their concatenation, so every
+    # byte-counting consumer keeps working)
+    endpoint: str = "generate"
+    frames: Optional[List[np.ndarray]] = None
 
     @property
     def ended(self) -> bool:
@@ -226,7 +257,8 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
 
     def chunk_fn(carry, prev, t, done, reset, slot_idx, pool):
         b = t.shape[0]
-        pool_keys, pool_z, pool_labels, pool_temps, pool_caps = pool
+        (pool_keys, pool_z, pool_labels, pool_temps, pool_caps,
+         pool_init_carry, pool_init_prev, pool_init_mask) = pool
         key_data = pool_keys[slot_idx]
         z = None if pool_z is None else pool_z[slot_idx]
         labels = None if pool_labels is None else pool_labels[slot_idx]
@@ -237,10 +269,28 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
         # request's initial state (init runs for all slots — one tiny
         # matmul — and the mask keeps live slots' carries)
         carry0 = model.decoder_initial_carry(params, z, b)
+        start = jnp.broadcast_to(START_TOKEN, (b, 5))
+        if pool_init_carry is not None:
+            # endpoint-planned decode state (ISSUE 15): rows whose
+            # init_mask is set start from the REPLAYED carry (sketch
+            # completion) and their last prefix row instead of the
+            # z-projected carry + START token. Pools with no planned
+            # rows pass None leaves and compile the legacy program —
+            # pure-generate bursts keep their exact pre-endpoint
+            # geometry and bytes.
+            use = pool_init_mask[slot_idx]
+            planned = model.dec.unflatten_carry(
+                pool_init_carry[slot_idx])
+            carry0 = jax.tree_util.tree_map(
+                lambda p, d: jnp.where(
+                    use.reshape((-1,) + (1,) * (p.ndim - 1)), p, d),
+                planned, carry0)
+            start = jnp.where(use[:, None], pool_init_prev[slot_idx],
+                              start)
         sel = lambda new, old: jnp.where(  # noqa: E731
             reset.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
         carry = jax.tree_util.tree_map(sel, carry0, carry)
-        prev = jnp.where(reset[:, None], START_TOKEN[None], prev)
+        prev = jnp.where(reset[:, None], start, prev)
         t = jnp.where(reset, 0, t)
         done = jnp.where(reset, False, done)
 
@@ -315,6 +365,11 @@ class ServeEngine:
                 "class_embed")
         self.params = jax.device_put(
             {k: params[k] for k in keep if k in params}, device)
+        # full parameter reference for the lazily-built endpoint encode
+        # program (ISSUE 15): kept host-side only — a generate-only
+        # engine never ships encoder weights to its device
+        self._full_params = params
+        self._encoder = None
         # compile probe (ISSUE 8): a traced cold start shows one
         # "serve_chunk" compile span with the executable's flops / peak
         # device bytes (the number that says how many slots fit in
@@ -334,6 +389,25 @@ class ServeEngine:
             label_of=lambda a: (f"(B{self.slots},K{self.chunk},"
                                 f"N{a[6][0].shape[0]})"))
         self.spans = SpanTimer(category="serve")
+
+    @property
+    def encoder(self):
+        """This engine's fixed-geometry endpoint encode program (ISSUE
+        15), built lazily on first encoder-endpoint use so generate-only
+        engines pay nothing. Raises for unconditional models — the
+        encoder endpoints need ``hps.conditional``."""
+        if self._encoder is None:
+            if not self.hps.conditional:
+                raise ValueError(
+                    "encoder endpoints (complete/reconstruct/"
+                    "interpolate) need a conditional model, but "
+                    "hps.conditional is false on this checkpoint")
+            from sketch_rnn_tpu.serve.endpoints import EncodeProgram
+            self._encoder = EncodeProgram(
+                self.model, self.hps, self._full_params,
+                rows=self.slots, device=self.device,
+                replica_id=self.replica_id)
+        return self._encoder
 
     # -- the request pool --------------------------------------------------
     #
@@ -368,6 +442,23 @@ class ServeEngine:
         n = len(requests)
         if pad and pad < n:
             raise ValueError(f"pool pad {pad} < request count {n}")
+        # endpoint guard (ISSUE 15): the engine decodes PLANNED state —
+        # an encoder endpoint that skipped the serve/endpoints planning
+        # phase would silently decode as plain generation
+        for i, req in enumerate(requests):
+            if req.endpoint == "interpolate" and req.parent_uid is None:
+                raise ValueError(
+                    f"request {i}: interpolate requests must be "
+                    f"expanded into frame rows by serve/endpoints."
+                    f"plan_batch before engine.run")
+            if (req.endpoint == "complete" and req.init_carry is None) \
+                    or (req.endpoint == "reconstruct"
+                        and req.z is None):
+                raise ValueError(
+                    f"request {i}: endpoint {req.endpoint!r} carries "
+                    f"no planned decode state — run it through "
+                    f"serve/endpoints.plan_batch (the encode phase) "
+                    f"before engine.run")
         key_data = np.stack([np.asarray(jax.random.key_data(req.key))
                              for req in requests])
         z = None
@@ -388,6 +479,28 @@ class ServeEngine:
             raise ValueError(
                 f"requests {over[:5]} exceed engine max_len "
                 f"{self.max_len}")
+        # planned decode state (ISSUE 15): present only when some
+        # request in this pool carries a replayed carry — pure-generate
+        # pools keep the legacy 5-leaf geometry (None leaves), so their
+        # compiled program and bytes are untouched by the endpoint
+        # machinery
+        init_carry = init_prev = init_mask = None
+        if any(r.init_carry is not None for r in requests):
+            cw = self.model.dec.carry_size
+            init_carry = np.zeros((n, cw), np.float32)
+            init_prev = np.zeros((n, 5), np.float32)
+            init_mask = np.zeros((n,), bool)
+            for i, r in enumerate(requests):
+                if r.init_carry is None:
+                    continue
+                ic = np.asarray(r.init_carry, np.float32)
+                if ic.shape != (cw,):
+                    raise ValueError(
+                        f"request {i}: init_carry shape {ic.shape} != "
+                        f"({cw},) (the decoder cell's flat carry)")
+                init_carry[i] = ic
+                init_prev[i] = np.asarray(r.init_prev, np.float32)
+                init_mask[i] = True
         if pad and pad > n:
             extra = pad - n
             pad_rows = lambda a, fill: np.concatenate(  # noqa: E731
@@ -399,7 +512,12 @@ class ServeEngine:
                 labels = pad_rows(labels, 0)
             temps = pad_rows(temps, 1.0)
             caps = pad_rows(caps, 1)
-        return jax.device_put((key_data, z, labels, temps, caps),
+            if init_carry is not None:
+                init_carry = pad_rows(init_carry, 0.0)
+                init_prev = pad_rows(init_prev, 0.0)
+                init_mask = pad_rows(init_mask, False)
+        return jax.device_put((key_data, z, labels, temps, caps,
+                               init_carry, init_prev, init_mask),
                               self.device)
 
     # -- the serving loop --------------------------------------------------
@@ -640,13 +758,21 @@ class ServeEngine:
                             queue_wait_s=admit_t[req.uid] - enq[req.uid],
                             decode_s=now - admit_t[req.uid],
                             latency_s=now - enq[req.uid],
-                            attributed_steps=attr_steps.get(req.uid, 0))
+                            attributed_steps=attr_steps.get(req.uid, 0),
+                            endpoint=req.endpoint or "generate")
                         results.append(res)
-                        if slo is not None:
+                        if slo is not None and req.parent_uid is None:
                             # the SLO tracker sees the EXACT Result floats,
                             # so /metrics burn rates and run()'s summary can
-                            # never tell different stories
-                            slo.observe("generate", {
+                            # never tell different stories; keyed by the
+                            # request's endpoint ("generate" for the whole
+                            # pre-endpoint world — ISSUE 15 additive).
+                            # Interpolation FRAME rows are skipped: their
+                            # assembled PARENT observes once (the end-to-
+                            # end request latency, endpoints.
+                            # assemble_results), so attainment counts
+                            # requests, never frames.
+                            slo.observe(res.endpoint, {
                                 "queue_wait_s": res.queue_wait_s,
                                 "decode_s": res.decode_s,
                                 "latency_s": res.latency_s})
@@ -713,6 +839,8 @@ class ServeEngine:
                                 ev_args["queue_pos"] = req.queue_pos
                             if self.replica_id is not None:
                                 ev_args["replica"] = self.replica_id
+                            if res.endpoint != "generate":
+                                ev_args["endpoint"] = res.endpoint
                             tel.instant("complete", cat="serve", ts=now,
                                         args=ev_args,
                                         trace=span_link(
@@ -737,6 +865,22 @@ class ServeEngine:
                                 # surface an admission class is judged by
                                 tel.observe(
                                     class_series("latency_s", req.cls),
+                                    res.latency_s, cat="serve")
+                            if req.parent_uid is None:
+                                # per-endpoint request/latency series
+                                # (ISSUE 15): the /metrics view of the
+                                # mixed-endpoint workload. Interpolate
+                                # FRAME rows are internal children —
+                                # their parent books its own series at
+                                # assembly, so endpoint counts stay
+                                # request counts, never frame counts.
+                                ep = res.endpoint
+                                tel.counter(
+                                    endpoint_series("requests_completed",
+                                                    ep),
+                                    1.0, cat="serve")
+                                tel.observe(
+                                    endpoint_series("latency_s", ep),
                                     res.latency_s, cat="serve")
                         slot_req[b] = None
                         occupied[b] = False

@@ -96,10 +96,12 @@ from sketch_rnn_tpu.serve.admission import (
     parse_admission_classes,
 )
 from sketch_rnn_tpu.serve.engine import Request, Result, ServeEngine
+from sketch_rnn_tpu.serve import endpoints as endpoints_mod
 from sketch_rnn_tpu.utils.faults import backoff_s, fault_point
 from sketch_rnn_tpu.utils.telemetry import (
     class_series,
     critical_path_segments,
+    endpoint_series,
     get_telemetry,
     request_span_id,
     request_trace_id,
@@ -111,6 +113,16 @@ from sketch_rnn_tpu.utils.telemetry import (
 # every live fleet, for the conftest no-stray-threads guard
 _LIVE: set = set()
 _LIVE_LOCK = threading.Lock()
+
+
+def default_pool_cap(slots: int) -> int:
+    """The fleet's micro-burst ceiling when none is configured: 4x the
+    slot width (amortizes per-burst fixed costs at saturation while
+    keeping light-traffic bursts small — see ServeFleet.__init__).
+    ONE home for the factor: pre-restore CLI checks (does an
+    interpolation's frame grid fit one burst?) and the fleet itself
+    must never disagree about it."""
+    return 4 * int(slots)
 
 
 class _Replica:
@@ -152,12 +164,22 @@ class _Replica:
         return sum(len(q) for q in self.queues.values())
 
     def pop_batch(self, cap: int) -> List[Request]:
-        """Up to ``cap`` queued requests in class-priority order."""
+        """Queued requests in class-priority order, chopped by DECODE-
+        POOL cost: an interpolation occupies ``frames`` pool rows
+        (ISSUE 15 — its latent grid decodes as child rows), everything
+        else one, and the micro-burst must fit the fixed ``pool_cap``
+        pad. Popping stops at the first head that no longer fits, so
+        priority order is never violated for capacity."""
         batch: List[Request] = []
+        rows = 0
         for q in self.queues.values():
-            while q and len(batch) < cap:
+            while q and rows < cap:
+                cost = endpoints_mod.pool_rows_of(q[0])
+                if rows + cost > cap:
+                    return batch
                 batch.append(q.popleft())
-            if len(batch) >= cap:
+                rows += cost
+            if rows >= cap:
                 break
         return batch
 
@@ -182,7 +204,8 @@ class ServeFleet:
                  shed_margin: float = 1.0, slo=None,
                  retry_budget: int = 2,
                  retry_backoff_s: float = 0.05,
-                 max_replicas: int = 0, cache=None):
+                 max_replicas: int = 0, cache=None,
+                 endpoint_classes: Optional[Dict[str, str]] = None):
         import jax  # lazy, the serve-module discipline
 
         devices = list(devices if devices is not None else jax.devices())
@@ -202,19 +225,33 @@ class ServeFleet:
         self.slots = int(slots or hps.serve_slots)
         self.chunk = int(chunk or hps.serve_chunk)
         # micro-burst ceiling == the one pool size every burst pads to;
-        # 4x slots amortizes the per-burst fixed costs (pool upload,
-        # pipeline fill, the all-but-empty drain tail) at saturation
-        # while keeping light-traffic bursts small (a burst only holds
-        # what was queued when the worker woke)
-        self.pool_cap = int(pool_cap or 4 * self.slots)
+        # 4x slots (default_pool_cap) amortizes the per-burst fixed
+        # costs (pool upload, pipeline fill, the all-but-empty drain
+        # tail) at saturation while keeping light-traffic bursts small
+        # (a burst only holds what was queued when the worker woke)
+        self.pool_cap = int(pool_cap or default_pool_cap(self.slots))
         if self.pool_cap < 1:
             raise ValueError(f"pool_cap must be >= 1, got {self.pool_cap}")
+        # endpoint -> admission-class routing (ISSUE 15): a submitted
+        # request with no explicit class lands in its endpoint's class
+        # (serve/endpoints.parse_endpoint_specs builds this map from
+        # the --endpoints grammar); unmapped endpoints fall back to the
+        # single-class default exactly as before
+        self.endpoint_classes = dict(endpoint_classes) \
+            if endpoint_classes else {}
         self.classes = dict(classes) if classes else \
             parse_admission_classes([])
         class_order = [c.name for c in sorted(self.classes.values(),
                                               key=lambda c: c.priority)]
         self._default_class = class_order[0] if len(class_order) == 1 \
             else None
+        bad_routes = sorted(c for c in self.endpoint_classes.values()
+                            if c not in self.classes)
+        if bad_routes:
+            raise ValueError(
+                f"endpoint_classes route to undeclared admission "
+                f"class(es) {bad_routes}; declared: "
+                f"{sorted(self.classes)}")
         self._admission = AdmissionController(
             self.classes, n_replicas=n_build, slots=self.slots,
             queue_cap=queue_cap, shed_margin=shed_margin)
@@ -275,26 +312,52 @@ class ServeFleet:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def warm(self, template: Request) -> None:
+    def warm(self, template: Request, endpoints: bool = False) -> None:
         """Compile every replica's chunk program OUTSIDE the measured
         window: one 1-step burst per replica at the fleet's fixed
         ``pool_cap`` — the exact (B, K, N) geometry every later
         micro-burst dispatches, so a measured run can never compile.
         ``template`` supplies valid request fields (z for conditional
-        models); its strokes are discarded. Runs under a suppressed
+        models); its strokes are discarded — endpoint fields are
+        stripped, and a missing z is zero-filled, so an endpoint
+        request works as the template too. Runs under a suppressed
         telemetry core (ISSUE 11): the clone's auto-assigned uid 0
         would otherwise emit a ``req-0`` span tree colliding with the
         real request 0's trace when the caller configured telemetry
-        before warming."""
+        before warming.
+
+        ``endpoints=True`` (ISSUE 15) additionally warms every
+        replica's fixed-geometry encode program at every prefix edge
+        AND the init-capable chunk program mixed bursts dispatch, so a
+        measured mixed-endpoint window sees zero compiles.
+        """
         import jax
 
         with telemetry_suppressed():
             for rep in self._replicas:
+                z = template.z
+                if self.hps.conditional and z is None:
+                    z = np.zeros((self.hps.z_size,), np.float32)
                 clone = dataclasses.replace(
-                    template, uid=None, max_len=1, cls=None,
-                    queue_pos=None, enqueue_ts=None, attempt=0)
+                    template, uid=None, z=z, max_len=1, cls=None,
+                    queue_pos=None, enqueue_ts=None, attempt=0,
+                    endpoint="generate", prefix=None, frames=0,
+                    parent_uid=None, init_carry=None, init_prev=None)
                 with jax.default_device(rep.device):
                     rep.engine.run([clone], pool_pad=self.pool_cap)
+                    if endpoints:
+                        rep.engine.encoder.warm()
+                        # the init-leaf pool geometry is its own
+                        # compiled program — warm it with a planned
+                        # 1-step completion so mixed bursts never
+                        # compile in the measured window
+                        cw = rep.engine.model.dec.carry_size
+                        planned = dataclasses.replace(
+                            clone, uid=None, endpoint="complete",
+                            init_carry=np.zeros((cw,), np.float32),
+                            init_prev=np.zeros((5,), np.float32))
+                        rep.engine.run([planned],
+                                       pool_pad=self.pool_cap)
 
     def start(self) -> "ServeFleet":
         if self._started:
@@ -580,7 +643,19 @@ class ServeFleet:
         generator calls this from its replay thread). ``force`` skips
         the shed checks (same placement — the bench's parity/capacity
         arms must complete every request)."""
-        cls_name = cls or req.cls or self._default_class
+        # endpoint door checks (ISSUE 15): shape/encoder validation
+        # fails HERE with one actionable line (an unconditional model
+        # rejects encoder endpoints naming hps.conditional), and the
+        # endpoint routes to its admission class when the caller gave
+        # none — `complete=interactive`-style serving policy
+        if (req.endpoint or "generate") != "generate" \
+                or req.prefix is not None:
+            endpoints_mod.validate_request(req, self.hps,
+                                           pool_cap=self.pool_cap)
+        cls_name = (cls or req.cls
+                    or self.endpoint_classes.get(req.endpoint
+                                                 or "generate")
+                    or self._default_class)
         if cls_name is None:
             raise ValueError(
                 f"request needs an admission class (configured: "
@@ -622,7 +697,9 @@ class ServeFleet:
                 if entry is not None:
                     self._book_cache_hit(req, cls_name, entry.strokes5,
                                          entry.length, entry.steps,
-                                         entry.origin_uid, tel)
+                                         entry.origin_uid, tel,
+                                         endpoint=entry.endpoint,
+                                         frames=entry.frames)
                     return True
                 if fp in self._pending:
                     self._pending[fp].append(req)
@@ -643,9 +720,16 @@ class ServeFleet:
             # Only materialized when tracing is on: the copy is pure
             # trace evidence, and this is the hot admission path.
             backlog = self._admission.backlog if tel.enabled else None
-            decision = self._admission.place(cls_name, force=force)
+            # cost-aware admission (ISSUE 15): a grid request charges
+            # its decode-pool rows, so backlog/queue-cap/deadline-shed
+            # see the real work it queues
+            decision = self._admission.place(
+                cls_name, force=force,
+                cost=endpoints_mod.pool_rows_of(req))
             if decision.shed:
                 self._shed.append({"uid": req.uid, "class": cls_name,
+                                   "endpoint": req.endpoint
+                                   or "generate",
                                    "reason": decision.shed_reason,
                                    "est_wait_s": decision.est_wait_s})
                 if tel.enabled:
@@ -698,7 +782,9 @@ class ServeFleet:
     def _book_cache_hit(self, req: Request, cls_name: Optional[str],
                         strokes5, length: int, steps: int,
                         origin_uid: int, tel,
-                        coalesced: bool = False) -> None:
+                        coalesced: bool = False,
+                        endpoint: str = "generate",
+                        frames=None) -> None:
         """Serve one request from cached strokes (caller holds the
         lock): book a ``cached=True`` Result with ZERO attributed
         device steps, feed the SLO tracker the (tiny) real latency,
@@ -710,10 +796,12 @@ class ServeFleet:
         qw = now - req.enqueue_ts
         res = Result(uid=req.uid, strokes5=strokes5, length=length,
                      steps=steps, queue_wait_s=qw, decode_s=0.0,
-                     latency_s=qw, attributed_steps=0, cached=True)
+                     latency_s=qw, attributed_steps=0, cached=True,
+                     endpoint=endpoint or "generate", frames=frames)
         self._results[req.uid] = {
             "result": res, "replica": None, "class": cls_name,
             "queue_pos": None, "cached": True,
+            "endpoint": res.endpoint,
             "origin_uid": origin_uid}
         if self._slo is not None:
             self._slo.observe(cls_name or DEFAULT_CLASS, {
@@ -756,6 +844,15 @@ class ServeFleet:
             if cls_name is not None:
                 tel.observe(class_series("latency_s", cls_name),
                             res.latency_s, cat="serve")
+            # the per-endpoint series (ISSUE 15): a cached completion
+            # is a completion — the ep_* counters must agree with the
+            # aggregate and with summary()'s latency_by_endpoint,
+            # which both count hits
+            tel.counter(endpoint_series("requests_completed",
+                                        res.endpoint), 1.0,
+                        cat="serve")
+            tel.observe(endpoint_series("latency_s", res.endpoint),
+                        res.latency_s, cat="serve")
         self._done_cv.notify_all()
 
     def _worker(self, rep: _Replica) -> None:
@@ -813,8 +910,20 @@ class ServeFleet:
                 # specific replica: "fleet.worker.r0@0")
                 fault_point(f"fleet.worker.r{rep.idx}")
                 with jax.default_device(rep.device):
-                    out = rep.engine.run(batch, pool_pad=self.pool_cap,
+                    # endpoint plan (ISSUE 15): the pre-decode encode
+                    # phase runs on THIS replica's device, then the
+                    # decode pool serves the planned rows; pure-
+                    # generate bursts short-circuit to an identity
+                    # plan. Inside the try: a mid-plan failure fails
+                    # over the ORIGINAL requests like any burst death
+                    # (planning is deterministic, so the survivor's
+                    # re-plan stamps identical state).
+                    plan = endpoints_mod.plan_batch(rep.engine, batch)
+                    out = rep.engine.run(plan.engine_requests,
+                                         pool_pad=self.pool_cap,
                                          burst=bid)
+                    booked = endpoints_mod.assemble_results(
+                        plan, out["results"])
             except BaseException as e:  # noqa: BLE001
                 self._on_replica_death(rep, batch, e)
                 return
@@ -835,15 +944,18 @@ class ServeFleet:
                     trace=span_link(f"burst-{bid}", f"burst-{bid}"))
             m = out["metrics"]
             with self._lock:
-                for res in out["results"]:
-                    rec = {"result": res, "replica": rep.idx}
+                for res in booked:
+                    rec = {"result": res, "replica": rep.idx,
+                           "endpoint": res.endpoint}
                     for r in batch:
                         if r.uid == res.uid:
                             rec["class"] = r.cls
                             rec["queue_pos"] = r.queue_pos
                             break
                     self._results[res.uid] = rec
-                    self._admission.note_done(rep.idx, res.decode_s)
+                    self._admission.note_done(
+                        rep.idx, res.decode_s,
+                        cost=(len(res.frames) if res.frames else 1))
                     if self._slo is not None:
                         # class-keyed endpoints: a fleet SLO names the
                         # admission class it judges
@@ -864,8 +976,12 @@ class ServeFleet:
                             self._book_cache_hit(
                                 w, w.cls, res.strokes5, res.length,
                                 res.steps, res.uid, tel,
-                                coalesced=True)
-                rep.completed += m["completed"]
+                                coalesced=True, endpoint=res.endpoint,
+                                frames=res.frames)
+                # booked REQUEST count (an interpolation's frames are
+                # engine rows, not requests — m["completed"] counts
+                # rows, the fleet counts requests)
+                rep.completed += len(booked)
                 rep.bursts += 1
                 rep.chunks += m["chunks"]
                 rep.device_steps += m["device_steps"]
@@ -1002,7 +1118,9 @@ class ServeFleet:
                 # failover is the fleet's fault, not the client's
                 # (requeue placement — same least-loaded rule over the
                 # survivors, no shed checks, no second admitted tick)
-                decision = self._admission.place(r.cls, requeue=True)
+                decision = self._admission.place(
+                    r.cls, requeue=True,
+                    cost=endpoints_mod.pool_rows_of(r))
                 r.queue_pos = decision.queue_pos
                 # stamp the attempt (ISSUE 11): the retried hops' span
                 # ids hang under this retry span, so the request stays
@@ -1135,9 +1253,15 @@ class ServeFleet:
             t0, t1 = self._t_first_submit, self._t_last_done
         wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
         by_class: Dict[str, List[float]] = {}
+        by_endpoint: Dict[str, List[float]] = {}
         for rec in recs:
             by_class.setdefault(rec.get("class") or DEFAULT_CLASS,
                                 []).append(rec["result"].latency_s)
+            ep = (rec.get("endpoint")
+                  or getattr(rec["result"], "endpoint", None)
+                  or "generate")
+            by_endpoint.setdefault(ep, []).append(
+                rec["result"].latency_s)
         lat_all = [rec["result"].latency_s for rec in recs]
 
         def pct(xs: List[float]) -> Dict[str, Optional[float]]:
@@ -1217,6 +1341,12 @@ class ServeFleet:
             "latency": pct(lat_all),
             "latency_by_class": {c: {**pct(v), "completed": len(v)}
                                  for c, v in sorted(by_class.items())},
+            # multi-task serving (ISSUE 15): the per-endpoint latency
+            # surface — serve_bench's per-endpoint columns and the
+            # README's mixed-endpoint table read exactly this block
+            "latency_by_endpoint": {e: {**pct(v), "completed": len(v)}
+                                    for e, v in
+                                    sorted(by_endpoint.items())},
             # critical-path tail attribution (ISSUE 11): the shared
             # segment schema over every completed Result — is the p99
             # queue- or decode-dominated? (None with no completions)

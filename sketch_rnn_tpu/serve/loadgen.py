@@ -118,6 +118,12 @@ class TraceSpec:
     # repetition
     unique: int = 0          # 0 = all requests distinct
     zipf_s: float = 1.1
+    # multi-task endpoint mix (ISSUE 15): ((endpoint, weight), ...) —
+    # each arrival draws its endpoint from this weighted table with a
+    # seeded stream decorrelated from arrivals and repetition ids, so
+    # the mix is a pure function of the spec like everything else.
+    # Empty = single-endpoint legacy traces (no endpoint column).
+    endpoint_mix: Tuple[Tuple[str, float], ...] = ()
 
     def __post_init__(self):
         if self.kind not in TRACE_KINDS:
@@ -135,16 +141,35 @@ class TraceSpec:
         if self.kind == "pareto" and self.pareto_alpha <= 0:
             raise ValueError(f"pareto_alpha must be > 0, got "
                              f"{self.pareto_alpha}")
+        seen = set()
+        for item in self.endpoint_mix:
+            if len(item) != 2:
+                raise ValueError(f"endpoint_mix entries are (name, "
+                                 f"weight) pairs, got {item!r}")
+            name, w = item
+            if not name or not isinstance(name, str):
+                raise ValueError(f"bad endpoint name {name!r} in "
+                                 f"endpoint_mix")
+            if name in seen:
+                raise ValueError(f"duplicate endpoint {name!r} in "
+                                 f"endpoint_mix")
+            seen.add(name)
+            if not w > 0:
+                raise ValueError(f"endpoint_mix weight for {name!r} "
+                                 f"must be > 0, got {w}")
 
 
 @dataclasses.dataclass(frozen=True)
 class Trace:
     """A realized trace: arrival offsets + the repetition mapping.
-    ``request_ids[i]`` names the CONTENT arrival ``i`` carries."""
+    ``request_ids[i]`` names the CONTENT arrival ``i`` carries;
+    ``endpoint_ids[i]`` (when the spec declares an ``endpoint_mix``)
+    indexes the mix table for arrival ``i``'s endpoint."""
 
     spec: TraceSpec
     arrivals: np.ndarray      # [n] cumulative seconds, non-decreasing
     request_ids: np.ndarray   # [n] int64 into the unique request space
+    endpoint_ids: Optional[np.ndarray] = None   # [n] into endpoint_mix
 
     @property
     def n(self) -> int:
@@ -158,6 +183,22 @@ class Trace:
         """Distinct contents actually drawn — the deterministic miss
         count a cold cache must see on this trace."""
         return int(len(np.unique(self.request_ids)))
+
+    def endpoint_of(self, i: int) -> str:
+        """Arrival ``i``'s endpoint name (``generate`` on mix-less
+        legacy traces)."""
+        if self.endpoint_ids is None:
+            return "generate"
+        return self.spec.endpoint_mix[int(self.endpoint_ids[i])][0]
+
+    def endpoint_counts(self) -> dict:
+        """Realized per-endpoint arrival counts — what the bench
+        reports as the actual mix."""
+        if self.endpoint_ids is None:
+            return {"generate": self.n}
+        names = [m[0] for m in self.spec.endpoint_mix]
+        ids, counts = np.unique(self.endpoint_ids, return_counts=True)
+        return {names[int(i)]: int(c) for i, c in zip(ids, counts)}
 
 
 def diurnal_arrivals(n: int, rate_hz: float, period_s: float,
@@ -240,6 +281,40 @@ def zipf_request_ids(n: int, unique: int, s: float,
         unique, size=n, p=p).astype(np.int64)
 
 
+def parse_endpoint_mix(spec: str) -> Tuple[Tuple[str, float], ...]:
+    """Parse an ``--endpoint_mix`` string into the TraceSpec table:
+    ``"generate:4,complete:3,reconstruct:2,interpolate:1"`` (bare names
+    default to weight 1). Validation happens in TraceSpec."""
+    out = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, w = item.partition(":")
+        try:
+            out.append((name.strip(), float(w) if w.strip() else 1.0))
+        except ValueError:
+            raise ValueError(
+                f"bad endpoint_mix weight {w!r} for {name!r} (want "
+                f"'name:weight,...')") from None
+    if not out:
+        raise ValueError(f"empty endpoint mix spec {spec!r}")
+    return tuple(out)
+
+
+def endpoint_mix_ids(n: int, mix: Tuple[Tuple[str, float], ...],
+                     seed: int) -> Optional[np.ndarray]:
+    """Seeded per-arrival endpoint assignment over the weighted mix
+    (ISSUE 15): deterministic in ``(n, mix, seed)``, stream-decorrelated
+    from arrivals (seed) and repetition ids (seed + 1) via seed + 2.
+    ``mix`` empty -> None (legacy single-endpoint traces)."""
+    if not mix:
+        return None
+    w = np.asarray([m[1] for m in mix], np.float64)
+    return np.random.default_rng(seed + 2).choice(
+        len(mix), size=n, p=w / w.sum()).astype(np.int64)
+
+
 def trace_arrivals(spec: TraceSpec) -> np.ndarray:
     """The spec's arrival schedule (dispatch on ``kind``)."""
     if spec.kind == "poisson":
@@ -257,11 +332,15 @@ def trace_arrivals(spec: TraceSpec) -> np.ndarray:
 
 
 def make_trace(spec: TraceSpec) -> Trace:
-    """Realize a spec: arrivals + Zipf repetition ids, pure in the
-    spec (two calls with equal specs return bitwise-equal arrays)."""
+    """Realize a spec: arrivals + Zipf repetition ids (+ the seeded
+    endpoint mix, ISSUE 15), pure in the spec (two calls with equal
+    specs return bitwise-equal arrays)."""
     return Trace(spec=spec, arrivals=trace_arrivals(spec),
                  request_ids=zipf_request_ids(spec.n, spec.unique,
-                                              spec.zipf_s, spec.seed))
+                                              spec.zipf_s, spec.seed),
+                 endpoint_ids=endpoint_mix_ids(spec.n,
+                                               spec.endpoint_mix,
+                                               spec.seed))
 
 
 class OpenLoopLoadGen:

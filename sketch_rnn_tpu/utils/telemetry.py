@@ -120,6 +120,19 @@ def class_series(name: str, cls: Optional[str] = None) -> str:
     return f"{name}_{_SERIES_SAFE.sub('_', str(cls))}"
 
 
+def endpoint_series(name: str, endpoint: Optional[str] = None) -> str:
+    """Per-serving-endpoint series name (ISSUE 15):
+    ``latency_s`` -> ``latency_s_ep_complete``. Rides the
+    :func:`class_series` naming contract with an ``ep_`` marker so an
+    endpoint can never collide with an admission class of the same
+    name; ``None``/empty keeps the aggregate series name. The emitter
+    (serve/engine.py, serve/endpoints.py) and every /metrics consumer
+    key the per-endpoint request/latency series identically."""
+    if not endpoint:
+        return name
+    return class_series(name, f"ep_{endpoint}")
+
+
 def site_series(name: str, site: Optional[str] = None) -> str:
     """Per-fault-site series name (ISSUE 10): ``faults_injected`` ->
     ``faults_injected_ckpt_commit`` (site dots and other non-Prometheus

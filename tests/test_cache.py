@@ -72,6 +72,87 @@ def test_keyspace_isolation_across_checkpoint_and_config():
             != request_fingerprint(r, config_hash="a", ckpt_id="bc"))
 
 
+def test_generate_fingerprints_unchanged_by_endpoint_extension():
+    """ISSUE 15 satellite: a plain generate request's fingerprint is
+    BYTE-IDENTICAL to the pre-endpoint algorithm — the cache-key
+    extension can never cold-start the existing keyspace. The old
+    algorithm is re-implemented inline as the pin."""
+    import hashlib
+
+    def legacy_fingerprint(req, config_hash="", ckpt_id=""):
+        h = hashlib.blake2b(digest_size=16)
+        h.update(config_hash.encode())
+        h.update(b"\x00")
+        h.update(ckpt_id.encode())
+        h.update(b"\x00")
+        key_data = np.asarray(jax.random.key_data(req.key))
+        h.update(str(key_data.dtype).encode() + b"|")
+        h.update(key_data.tobytes())
+        if req.z is None:
+            h.update(b"z:none")
+        else:
+            h.update(np.asarray(req.z, np.float32).tobytes())
+        h.update(f"|{int(req.label)}|{float(req.temperature)!r}|"
+                 f"{req.max_len}".encode())
+        return h.digest()
+
+    for req in (_req(0), _req(1, cap=9),
+                dataclasses.replace(_req(2), z=None),
+                dataclasses.replace(_req(3), label=4,
+                                    temperature=1.25)):
+        assert request_fingerprint(req, "cfg", "ck") == \
+            legacy_fingerprint(req, "cfg", "ck")
+
+
+def _pfx(i, n=4):
+    rng = np.random.default_rng(700 + i)
+    p = rng.standard_normal((n, 3)).astype(np.float32)
+    p[-1, 2] = 1.0
+    return p
+
+
+def test_endpoint_prefix_fields_are_collision_proof():
+    """ISSUE 15: (endpoint, prefix bytes, frames) live inside the hash
+    — two endpoints sharing content, two prefixes differing in one
+    byte, swapped interpolation order, or a different frame count can
+    never collide; scheduling metadata still never fragments the
+    keyspace, and the planner-DERIVED decode state (z / init_carry) is
+    deliberately excluded."""
+    base = dataclasses.replace(_req(0), z=None, endpoint="complete",
+                               prefix=_pfx(0))
+    fps = [request_fingerprint(base)]
+    variants = [
+        dataclasses.replace(base, endpoint="reconstruct"),
+        dataclasses.replace(base, prefix=_pfx(1)),
+        dataclasses.replace(base, prefix=_pfx(0)[:3]),
+        dataclasses.replace(_req(0), z=None),   # plain generate
+        dataclasses.replace(base, endpoint="interpolate",
+                            prefix=(_pfx(0), _pfx(1)), frames=4),
+        dataclasses.replace(base, endpoint="interpolate",
+                            prefix=(_pfx(1), _pfx(0)), frames=4),
+        dataclasses.replace(base, endpoint="interpolate",
+                            prefix=(_pfx(0), _pfx(1)), frames=5),
+    ]
+    fps += [request_fingerprint(v) for v in variants]
+    assert len(set(fps)) == len(fps)
+    # prefix content differing by ONE value differs
+    tweaked = _pfx(0).copy()
+    tweaked[1, 0] += 1.0
+    assert request_fingerprint(
+        dataclasses.replace(base, prefix=tweaked)) != fps[0]
+    # scheduling metadata: still excluded
+    assert request_fingerprint(dataclasses.replace(
+        base, uid=99, cls="interactive", queue_pos=3, attempt=2,
+        enqueue_ts=1.0)) == fps[0]
+    # planner-derived state: excluded (stamping z/init_carry after the
+    # encode phase must not change the content identity)
+    stamped = dataclasses.replace(
+        base, z=np.ones((6,), np.float32),
+        init_carry=np.ones((32,), np.float32),
+        init_prev=np.ones((5,), np.float32))
+    assert request_fingerprint(stamped) == fps[0]
+
+
 # -- bounded LRU -------------------------------------------------------------
 
 

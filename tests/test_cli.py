@@ -268,6 +268,155 @@ def test_cli_serve_bench_fleet_usage_errors(tmp_path, capsys):
     assert "SLO spec" in capsys.readouterr().err
 
 
+def test_cli_serve_bench_endpoint_usage_errors(tmp_path, capsys):
+    """ISSUE 15 satellite: endpoint/class spec validation fails fast
+    (rc 2) BEFORE the checkpoint restore, matching the --slo/--classes
+    precedent; unconditional checkpoints reject encoder endpoints with
+    one line naming hps.conditional."""
+    # --endpoints without --fleet
+    assert main(["serve-bench", "--random_init",
+                 "--endpoints", "complete=interactive:p95<=250ms",
+                 f"--workdir={tmp_path}", f"--hparams={HP}"]) == 2
+    assert "--fleet" in capsys.readouterr().err
+    # unknown endpoint name
+    assert main(["serve-bench", "--random_init", "--fleet", "1",
+                 "--endpoints", "bogus=batch",
+                 f"--workdir={tmp_path}", f"--hparams={HP}"]) == 2
+    assert "unknown endpoint" in capsys.readouterr().err
+    # malformed route (no '=')
+    assert main(["serve-bench", "--random_init", "--fleet", "1",
+                 "--endpoints", "complete",
+                 f"--workdir={tmp_path}", f"--hparams={HP}"]) == 2
+    assert "ENDPOINT=CLASS" in capsys.readouterr().err
+    # a mix endpoint with no class route (several classes declared)
+    assert main(["serve-bench", "--random_init", "--fleet", "1",
+                 "--endpoints", "complete=interactive:p95<=250ms",
+                 "--endpoints", "generate=batch",
+                 "--endpoint_mix", "generate:1,reconstruct:1",
+                 f"--workdir={tmp_path}", f"--hparams={HP}"]) == 2
+    assert "no class route" in capsys.readouterr().err
+    # unconditional checkpoint rejects encoder endpoints, naming
+    # hps.conditional — before any restore/compile
+    assert main(["serve-bench", "--random_init", "--fleet", "1",
+                 "--endpoints", "complete=interactive:p95<=250ms",
+                 f"--workdir={tmp_path}",
+                 f"--hparams={HP},conditional=false"]) == 2
+    assert "hps.conditional" in capsys.readouterr().err
+    # --strokes_out outside the endpoint demos is a usage error too
+    assert main(["sample", "--synthetic", f"--workdir={tmp_path}",
+                 "--strokes_out", str(tmp_path / "s.npz")]) == 2
+    assert "--strokes_out" in capsys.readouterr().err
+    # a one-frame interpolation is a usage error before the restore
+    # (the endpoint contract needs both ends of the grid)
+    assert main(["sample", "--synthetic", f"--workdir={tmp_path}",
+                 "--interpolate", "-n", "1"]) == 2
+    assert "-n >= 2" in capsys.readouterr().err
+
+
+def test_cli_serve_bench_mixed_endpoint_fleet(tmp_path, capsys):
+    """ISSUE 15: serve-bench --fleet --endpoints serves a seeded mixed-
+    endpoint workload, routes each endpoint to its admission class,
+    and reports the per-endpoint latency table."""
+    wd = str(tmp_path / "serve_wd")
+    assert main(["serve-bench", "--random_init", "-n", "10",
+                 "--fleet", "1", "--slots", "3", "--chunk", "2",
+                 "--frames", "3",
+                 "--endpoints", "generate=batch",
+                 "--endpoints", "complete=interactive:p95<=10",
+                 "--endpoints", "reconstruct=interactive",
+                 "--endpoints", "interpolate=batch",
+                 "--endpoint_mix",
+                 "generate:1,complete:1,reconstruct:1,interpolate:1",
+                 "--slo", "interactive:p95<=10",
+                 f"--workdir={wd}",
+                 f"--hparams={HP},serve_slots=3,serve_chunk=2"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["completed"] == 10
+    by_ep = rep["latency_by_endpoint"]
+    assert sum(v["completed"] for v in by_ep.values()) == 10
+    assert set(by_ep) <= {"generate", "complete", "reconstruct",
+                          "interpolate"}
+    f = rep["fleet"]
+    assert f["endpoint_classes"]["complete"] == "interactive"
+    assert set(f["latency_by_class"]) <= {"interactive", "batch"}
+    # SLO verdict keyed on the admission class the endpoints route to
+    assert "interactive:latency_s:p95" in rep["slo"]
+
+
+def test_cli_interpolate_parity_with_serve_endpoint(tmp_path):
+    """THE serve-vs-offline parity pin (ISSUE 15 satellite): `cli
+    sample --interpolate --strokes_out` produces stroke-5 frames
+    bitwise equal to the `interpolate` endpoint served through the
+    fleet on the same checkpoint/key/serving geometry — and
+    --reconstruct likewise equals the `reconstruct` endpoint."""
+    import dataclasses
+
+    import jax as _jax
+
+    from sketch_rnn_tpu.config import HParams
+    from sketch_rnn_tpu.data.loader import synthetic_loader
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import Request, ServeFleet
+    from sketch_rnn_tpu.train import make_train_state, save_checkpoint
+
+    hps = HParams.from_json(json.dumps(dict(
+        batch_size=8, max_seq_len=48, enc_rnn_size=12, dec_rnn_size=16,
+        z_size=6, num_mixture=3, serve_slots=4, serve_chunk=2)))
+    wd = str(tmp_path / "work")
+    os.makedirs(wd, exist_ok=True)
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, _jax.random.key(0))
+    scale = 1.0
+    save_checkpoint(wd, state, scale, hps)
+
+    out_npz = str(tmp_path / "interp.npz")
+    assert main(["sample", "--synthetic", f"--workdir={wd}", "-n", "3",
+                 "--interpolate", "--seed", "7",
+                 f"--output={tmp_path / 'i.svg'}",
+                 f"--strokes_out={out_npz}"]) == 0
+    cli_frames = np.load(out_npz)
+    cli_frames = [cli_frames[k] for k in sorted(cli_frames.files)]
+    assert len(cli_frames) == 3
+
+    # the serve side: the SAME prefixes the cli's synthetic valid
+    # loader holds (seed 2, checkpoint scale, the cli's integer grid),
+    # same key/frames/temperature, same serving geometry
+    valid_l, _ = synthetic_loader(hps, 2 * hps.batch_size, seed=2,
+                                  scale_factor=scale,
+                                  integer_grid=255.0)
+    req = Request(key=_jax.random.key(7), endpoint="interpolate",
+                  prefix=(valid_l.strokes[0], valid_l.strokes[1]),
+                  frames=3, temperature=0.5, uid=0)
+    rec_req = Request(key=_jax.random.fold_in(_jax.random.key(7), 0),
+                      endpoint="reconstruct",
+                      prefix=valid_l.strokes[0], temperature=0.5,
+                      uid=1)
+    fleet = ServeFleet(model, hps, state.params, replicas=1)
+    fleet.warm(req, endpoints=True)
+    try:
+        fleet.submit(dataclasses.replace(req))
+        fleet.submit(dataclasses.replace(rec_req))
+        fleet.start()
+        assert fleet.drain(timeout=300)
+        res = fleet.results
+    finally:
+        fleet.close()
+    for f, frame in enumerate(res[0]["result"].frames):
+        np.testing.assert_array_equal(
+            frame, cli_frames[f],
+            err_msg=f"interpolation frame {f} differs cli vs serve")
+
+    # reconstruct: cli --strokes_out vs the reconstruct endpoint
+    rec_npz = str(tmp_path / "rec.npz")
+    assert main(["sample", "--synthetic", f"--workdir={wd}", "-n", "1",
+                 "--reconstruct", "--seed", "7",
+                 f"--output={tmp_path / 'r.svg'}",
+                 f"--strokes_out={rec_npz}"]) == 0
+    cli_rec = np.load(rec_npz)
+    np.testing.assert_array_equal(cli_rec[cli_rec.files[0]],
+                                  res[1]["result"].strokes5)
+
+
 def test_graft_entry_compiles():
     import __graft_entry__ as ge
     fn, args = ge.entry()

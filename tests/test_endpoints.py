@@ -1,0 +1,569 @@
+"""Multi-task serving endpoint tests (ISSUE 15).
+
+The load-bearing invariants, extending the engine/fleet suites to the
+conditional workloads:
+
+1. **Offline parity**: the fleet's complete/reconstruct/interpolate
+   strokes are bitwise the single-engine ``serve_requests`` path's —
+   and generation served in a MIXED burst is bitwise the legacy
+   pure-generate program's (the endpoint machinery is invisible to the
+   old workload).
+2. **Geometry discipline**: prefixes encode bitwise-identically at
+   every bucket edge that fits them, in every batch composition and
+   slot position — and the JitCompileProbe sees exactly one
+   ``serve_encode`` compile per (pool rows, edge) geometry.
+3. **Semantics**: the completion replay is checked against the
+   INDEPENDENT teacher-forced ``model.decode`` path, and the
+   interpolation grid against ``sample/interpolate.interpolate_latents``
+   on the encoded posterior means.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.serve import (
+    EncodeProgram,
+    Request,
+    ServeEngine,
+    ServeFleet,
+    parse_endpoint_specs,
+    serve_requests,
+    validate_request,
+)
+from sketch_rnn_tpu.serve import endpoints as EP
+
+TINY = dict(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+            dec_rnn_size=16, z_size=6, num_mixture=3, hyper_rnn_size=8,
+            hyper_embed_size=4, serve_slots=4, serve_chunk=2,
+            serve_prefix_edges=(8, 16, 24))
+
+
+def tiny_hps(**kw) -> HParams:
+    return HParams(**{**TINY, **kw})
+
+
+def _prefix(i: int, n: int) -> np.ndarray:
+    rng = np.random.default_rng(500 + i)
+    p = rng.standard_normal((n, 3)).astype(np.float32)
+    p[:, 2] = (rng.random(n) < 0.2)
+    p[-1, 2] = 1.0
+    return p
+
+
+@pytest.fixture(scope="module")
+def setup():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    return hps, model, params
+
+
+def _mk(i: int, hps: HParams, endpoint: str, **kw) -> Request:
+    key = jax.random.key(2000 + i)
+    if endpoint == "generate":
+        rng = np.random.default_rng(i)
+        return Request(key=key, endpoint="generate",
+                       z=rng.standard_normal(hps.z_size).astype(
+                           np.float32),
+                       temperature=0.8, **kw)
+    if endpoint == "interpolate":
+        return Request(key=key, endpoint="interpolate",
+                       prefix=(_prefix(i, 3 + i % 5),
+                               _prefix(i + 50, 4 + i % 7)),
+                       frames=kw.pop("frames", 3), temperature=0.8,
+                       **kw)
+    return Request(key=key, endpoint=endpoint,
+                   prefix=_prefix(i, 3 + i % 9), temperature=0.8, **kw)
+
+
+def _mixed(hps, n=8):
+    eps = ("generate", "complete", "reconstruct", "interpolate")
+    return [_mk(i, hps, eps[i % 4], max_len=4 + i % 5)
+            for i in range(n)]
+
+
+# -- validation ---------------------------------------------------------------
+
+
+def test_validate_request_endpoint_rules(setup):
+    hps, model, params = setup
+    with pytest.raises(ValueError, match="unknown endpoint"):
+        validate_request(_mk(0, hps, "generate").__class__(
+            key=jax.random.key(0), endpoint="translate"), hps)
+    with pytest.raises(ValueError, match="no prefix"):
+        validate_request(Request(key=jax.random.key(0),
+                                 prefix=_prefix(0, 3)), hps)
+    # interpolate needs exactly two prefixes and frames >= 2
+    with pytest.raises(ValueError, match="exactly two"):
+        validate_request(Request(key=jax.random.key(0),
+                                 endpoint="interpolate",
+                                 prefix=_prefix(0, 3)), hps)
+    with pytest.raises(ValueError, match="frames >= 2"):
+        validate_request(Request(key=jax.random.key(0),
+                                 endpoint="interpolate",
+                                 prefix=(_prefix(0, 3), _prefix(1, 3)),
+                                 frames=1), hps)
+    with pytest.raises(ValueError, match="pool_cap"):
+        validate_request(Request(key=jax.random.key(0),
+                                 endpoint="interpolate",
+                                 prefix=(_prefix(0, 3), _prefix(1, 3)),
+                                 frames=9), hps, pool_cap=8)
+    # prefix shape / length / finiteness rules
+    with pytest.raises(ValueError, match=r"\[n >= 1, 3\]"):
+        validate_request(Request(key=jax.random.key(0),
+                                 endpoint="complete",
+                                 prefix=np.zeros((0, 3), np.float32)),
+                         hps)
+    with pytest.raises(ValueError, match="terminal prefix edge"):
+        validate_request(Request(key=jax.random.key(0),
+                                 endpoint="complete",
+                                 prefix=_prefix(0, 25)), hps)
+
+
+def test_unconditional_rejects_encoder_endpoints_naming_conditional():
+    """The satellite contract: the one-line error NAMES
+    hps.conditional."""
+    hps = tiny_hps(conditional=False)
+    with pytest.raises(ValueError, match="hps.conditional"):
+        validate_request(Request(key=jax.random.key(0),
+                                 endpoint="complete",
+                                 prefix=_prefix(0, 3)), hps)
+    # and the fleet door check rejects the same way
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    fleet = ServeFleet(model, hps, params, replicas=1)
+    try:
+        with pytest.raises(ValueError, match="hps.conditional"):
+            fleet.submit(Request(key=jax.random.key(0),
+                                 endpoint="reconstruct",
+                                 prefix=_prefix(0, 3)))
+    finally:
+        fleet.close()
+
+
+def test_prefix_edges_and_bucketing():
+    assert EP.default_prefix_edges(250) == (32, 64, 128, 250)
+    assert EP.default_prefix_edges(24) == (24,)
+    hps = tiny_hps()
+    assert EP.prefix_edges(hps) == (8, 16, 24)
+    assert EP.prefix_edge_of(3, (8, 16, 24)) == 8
+    assert EP.prefix_edge_of(8, (8, 16, 24)) == 8
+    assert EP.prefix_edge_of(9, (8, 16, 24)) == 16
+    with pytest.raises(ValueError, match="exceeds"):
+        EP.prefix_edge_of(25, (8, 16, 24))
+    with pytest.raises(ValueError, match="ascending"):
+        tiny_hps(serve_prefix_edges=(16, 8))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        tiny_hps(serve_prefix_edges=(8, 64))
+
+
+def test_parse_endpoint_specs_grammar():
+    ep_map, classes = parse_endpoint_specs(
+        ["complete=interactive:p95<=250ms", "reconstruct=interactive",
+         "interpolate=batch", "generate=batch"])
+    assert ep_map == {"complete": "interactive",
+                      "reconstruct": "interactive",
+                      "interpolate": "batch", "generate": "batch"}
+    assert classes["interactive"].deadline_s == pytest.approx(0.25)
+    assert np.isinf(classes["batch"].deadline_s)  # bare name: no SLA
+    assert classes["interactive"].priority < classes["batch"].priority
+    for bad, msg in (("nope=batch", "unknown endpoint"),
+                     ("complete", "ENDPOINT=CLASS"),
+                     ("complete=", "empty class"),
+                     ("complete=x:p95<=bad", "SLO")):
+        with pytest.raises(ValueError, match=msg):
+            parse_endpoint_specs([bad])
+    with pytest.raises(ValueError, match="duplicate endpoint"):
+        parse_endpoint_specs(["complete=a", "complete=b"])
+    # routes must name declared classes at fleet construction
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    with pytest.raises(ValueError, match="undeclared admission"):
+        ServeFleet(model, hps, params, replicas=1,
+                   endpoint_classes={"complete": "ghost"})
+
+
+def test_engine_guards_unplanned_endpoint_requests(setup):
+    hps, model, params = setup
+    eng = ServeEngine(model, hps, params)
+    with pytest.raises(ValueError, match="plan_batch"):
+        eng.run([_mk(0, hps, "complete", max_len=3)])
+    with pytest.raises(ValueError, match="plan_batch"):
+        eng.run([_mk(0, hps, "reconstruct", max_len=3)])
+    with pytest.raises(ValueError, match="expanded into frame rows"):
+        eng.run([_mk(0, hps, "interpolate", max_len=3)])
+
+
+# -- the serve path -----------------------------------------------------------
+
+
+def test_serve_requests_all_endpoints_complete(setup):
+    hps, model, params = setup
+    reqs = _mixed(hps, 8)
+    out = serve_requests(model, hps, params, reqs)
+    res = {r.uid: r for r in out["results"]}
+    assert set(res) == set(range(8))
+    for uid, r in res.items():
+        assert r.endpoint == reqs[uid].endpoint
+        assert r.strokes5.shape[1] == 5
+        assert np.isfinite(r.strokes5).all()
+        if r.endpoint == "interpolate":
+            assert len(r.frames) == reqs[uid].frames
+            np.testing.assert_array_equal(np.concatenate(r.frames),
+                                          r.strokes5)
+        else:
+            assert r.frames is None
+
+
+def test_solo_vs_mixed_bitwise_every_endpoint(setup):
+    """THE acceptance invariant, extended: an endpoint request's
+    strokes are bitwise identical served solo or inside a mixed
+    burst."""
+    hps, model, params = setup
+    reqs = _mixed(hps, 8)
+    ref = {r.uid: r for r in
+           serve_requests(model, hps, params, reqs)["results"]}
+    for probe in (1, 2, 3):   # complete, reconstruct, interpolate
+        solo_req = _mk(probe, hps, reqs[probe].endpoint,
+                       max_len=reqs[probe].max_len,
+                       **({"frames": reqs[probe].frames}
+                          if reqs[probe].endpoint == "interpolate"
+                          else {}))
+        solo = serve_requests(model, hps, params,
+                              [solo_req])["results"][0]
+        np.testing.assert_array_equal(
+            solo.strokes5, ref[probe].strokes5,
+            err_msg=f"{reqs[probe].endpoint} diverged solo vs mixed")
+
+
+def test_generate_in_mixed_burst_matches_legacy_program(setup):
+    """Generation served next to endpoint requests rides the
+    init-capable chunk program; its strokes must still be bitwise the
+    LEGACY pure-generate program's — the endpoint machinery is
+    invisible to the old workload."""
+    hps, model, params = setup
+    reqs = _mixed(hps, 8)
+    ref = {r.uid: r for r in
+           serve_requests(model, hps, params, reqs)["results"]}
+    eng = ServeEngine(model, hps, params)
+    for uid in (0, 4):   # the generate members
+        legacy = eng.run([dataclasses.replace(
+            _mk(uid, hps, "generate", max_len=reqs[uid].max_len),
+            uid=None)])["results"][0]
+        np.testing.assert_array_equal(legacy.strokes5,
+                                      ref[uid].strokes5)
+
+
+def test_encode_edge_and_composition_invariance(setup):
+    """A prefix encodes bitwise-identically at EVERY bucket edge that
+    fits it, in every batch composition and slot position (pad rows
+    inert) — the fixed-geometry discipline's correctness half."""
+    hps, model, params = setup
+    pfx = _prefix(7, 7)
+    outs = []
+    for edges in ((8, 24), (16, 24), (24,)):
+        enc = EncodeProgram(model, hps, params, rows=4, edges=edges)
+        outs.append(enc.encode([pfx]))
+    for got in outs[1:]:
+        for a, b in zip(outs[0], got):
+            np.testing.assert_array_equal(a[0], b[0])
+    enc = EncodeProgram(model, hps, params, rows=4)
+    a = enc.encode([pfx, _prefix(1, 3), _prefix(2, 5), _prefix(3, 7)])
+    b = enc.encode([_prefix(4, 6), pfx, _prefix(5, 2)])
+    for part_a, part_b in zip(a, b):
+        np.testing.assert_array_equal(part_a[0], part_b[1])
+    # prev really is the last prefix row (stroke-5)
+    from sketch_rnn_tpu.data import strokes as S
+    np.testing.assert_array_equal(
+        a[2][0], S.to_big_strokes(pfx, 24)[len(pfx) - 1])
+
+
+def test_complete_replay_matches_teacher_forced_decode(setup):
+    """Semantic cross-check against the INDEPENDENT training-path
+    decoder: a greedy completion's first continuation row equals the
+    argmax of the teacher-forced ``model.decode`` distribution at the
+    prefix boundary."""
+    import jax.numpy as jnp
+
+    from sketch_rnn_tpu.ops import mdn
+
+    hps, model, params = setup
+    pfx = _prefix(11, 6)
+    p = len(pfx)
+    out = serve_requests(model, hps, params,
+                         [Request(key=jax.random.key(5),
+                                  endpoint="complete", prefix=pfx,
+                                  max_len=3)],
+                         greedy=True)
+    row0 = out["results"][0].strokes5[0]
+    strokes, lens = EP.pad_prefixes([pfx], hps.max_seq_len)
+    x_tm = jnp.transpose(jnp.asarray(strokes), (1, 0, 2))
+    mu, _, _ = out["engine"].encoder.encode([pfx])
+    raw = np.asarray(model.decode(params, x_tm[:p + 1],
+                                  jnp.asarray(mu), None))[p, 0]
+    mp = mdn.get_mixture_params(jnp.asarray(raw)[None],
+                                hps.num_mixture)
+    idx = int(np.argmax(np.asarray(mp.log_pi)[0]))
+    pen = int(np.argmax(np.asarray(mp.pen_logits)[0]))
+    want = np.array([np.asarray(mp.mu1)[0, idx],
+                     np.asarray(mp.mu2)[0, idx],
+                     pen == 0, pen == 1, pen == 2], np.float32)
+    np.testing.assert_allclose(row0, want, rtol=2e-5, atol=2e-5)
+    # and a completion is NOT a plain generation from the same z:
+    # the replayed carry must matter
+    gen = serve_requests(model, hps, params,
+                         [Request(key=jax.random.key(5),
+                                  z=np.asarray(mu[0]), max_len=3)],
+                         greedy=True)["results"][0]
+    assert not np.array_equal(gen.strokes5, out["results"][0].strokes5)
+
+
+def test_interpolate_grid_matches_offline_latents(setup):
+    """The interpolation endpoint's frames are bitwise the decode of
+    ``interpolate_latents(mu_a, mu_b)`` with per-frame
+    ``fold_in(key, frame)`` keys — the exact construction
+    ``cli sample --interpolate`` now runs."""
+    from sketch_rnn_tpu.sample.interpolate import interpolate_latents
+
+    hps, model, params = setup
+    a, b = _prefix(20, 5), _prefix(21, 9)
+    key = jax.random.key(77)
+    out = serve_requests(model, hps, params,
+                         [Request(key=key, endpoint="interpolate",
+                                  prefix=(a, b), frames=4,
+                                  temperature=0.8, max_len=5)])
+    parent = out["results"][0]
+    assert len(parent.frames) == 4
+    enc = out["engine"].encoder
+    mu, _, _ = enc.encode([a, b])
+    grid = np.asarray(interpolate_latents(mu[0], mu[1], n=4),
+                      np.float32)
+    kids = [Request(key=jax.random.fold_in(key, f), z=grid[f],
+                    temperature=0.8, max_len=5) for f in range(4)]
+    ref = serve_requests(model, hps, params, kids)["results"]
+    for f, r in enumerate(sorted(ref, key=lambda r: r.uid)):
+        np.testing.assert_array_equal(parent.frames[f], r.strokes5)
+
+
+# -- fleet integration --------------------------------------------------------
+
+
+def test_fleet_mixed_endpoints_placement_and_arrival_invariance(setup):
+    """ISSUE 15 acceptance: mixed-endpoint strokes bitwise independent
+    of replica placement and arrival order, equal to the offline
+    serve_requests reference; endpoint->class routing and the
+    per-endpoint latency table land in the summary."""
+    hps, model, params = setup
+    reqs = _mixed(hps, 10)
+    ref = {r.uid: r for r in serve_requests(
+        model, hps, params,
+        [dataclasses.replace(r, uid=i)
+         for i, r in enumerate(_mixed(hps, 10))])["results"]}
+    ep_map, classes = parse_endpoint_specs(
+        ["generate=batch", "complete=interactive:p95<=5",
+         "reconstruct=interactive", "interpolate=batch"])
+
+    def run_fleet(R, order=None):
+        fleet = ServeFleet(model, hps, params, replicas=R,
+                           classes=classes, endpoint_classes=ep_map)
+        fleet.warm(reqs[0], endpoints=True)
+        try:
+            for i in (order if order is not None else range(10)):
+                fleet.submit(dataclasses.replace(_mixed(hps, 10)[i],
+                                                 uid=i))
+            fleet.start()
+            assert fleet.drain(timeout=300)
+            return fleet.results, fleet.summary()
+        finally:
+            fleet.close()
+
+    for R in (1, 2):
+        got, summ = run_fleet(R)
+        assert len(got) == 10
+        for uid, r in ref.items():
+            np.testing.assert_array_equal(
+                got[uid]["result"].strokes5, r.strokes5,
+                err_msg=f"uid {uid} ({r.endpoint}) diverged at R={R}")
+        by_ep = summ["latency_by_endpoint"]
+        assert set(by_ep) == {"generate", "complete", "reconstruct",
+                              "interpolate"}
+        assert sum(v["completed"] for v in by_ep.values()) == 10
+        # class routing applied per endpoint
+        assert got[1]["class"] == "interactive"   # complete
+        assert got[3]["class"] == "batch"         # interpolate
+        assert got[0]["class"] == "batch"         # generate
+    order = list(range(10))
+    np.random.default_rng(9).shuffle(order)
+    got, _ = run_fleet(2, order=order)
+    for uid, r in ref.items():
+        np.testing.assert_array_equal(
+            got[uid]["result"].strokes5, r.strokes5,
+            err_msg=f"uid {uid} diverged under shuffled arrival")
+
+
+def test_fleet_interpolate_cache_hit_carries_frames(setup):
+    """The cache-key extension end to end: repeated interpolate content
+    hits (bitwise, frames intact, zero device steps), while a
+    different frame count is a different content."""
+    from sketch_rnn_tpu.serve import ResultCache
+
+    hps, model, params = setup
+    cache = ResultCache(config_hash="c", ckpt_id="k")
+
+    def req(uid, frames=3):
+        return Request(key=jax.random.key(42), endpoint="interpolate",
+                       prefix=(_prefix(30, 4), _prefix(31, 6)),
+                       frames=frames, temperature=0.8, max_len=4,
+                       uid=uid)
+
+    fleet = ServeFleet(model, hps, params, replicas=1, cache=cache)
+    fleet.warm(req(None), endpoints=True)
+    try:
+        fleet.submit(req(0))
+        fleet.start()
+        assert fleet.drain(timeout=300)
+        fleet.submit(req(1))              # store hit
+        fleet.submit(req(2, frames=4))    # different content: miss
+        assert fleet.drain(timeout=300)
+        res = fleet.results
+    finally:
+        fleet.close()
+    hit = res[1]["result"]
+    assert hit.cached and hit.endpoint == "interpolate"
+    assert hit.attributed_steps == 0 and len(hit.frames) == 3
+    np.testing.assert_array_equal(hit.strokes5,
+                                  res[0]["result"].strokes5)
+    assert not res[2]["result"].cached
+    assert len(res[2]["result"].frames) == 4
+    assert cache.stats()["hits"] == 1
+
+
+def test_encode_compile_accounting(setup):
+    """The acceptance pin: exactly one ``serve_encode`` compile per
+    (pool rows, prefix-edge) geometry, repeats are cache hits, and a
+    warm-before-telemetry engine reports ZERO compiles in the measured
+    window."""
+    from sketch_rnn_tpu.utils import telemetry as tele
+
+    hps, model, params = setup
+    tel = tele.configure(trace_dir=None)
+    try:
+        prog = EncodeProgram(model, hps, params, rows=4)
+        prog.warm()
+        spans = [e for e in tel.events() if e.get("type") == "span"
+                 and e.get("name") == "serve_encode"]
+        assert len(spans) == 3          # edges (8, 16, 24)
+        geoms = [e["args"]["geometry"] for e in spans]
+        assert sorted(geoms) == ["(B4,E16)", "(B4,E24)", "(B4,E8)"]
+        prog.warm()                     # all hits, no new compiles
+        spans2 = [e for e in tel.events() if e.get("type") == "span"
+                  and e.get("name") == "serve_encode"]
+        assert len(spans2) == 3
+        counters = tel.counters()
+        assert counters[("compile", "jit_cache_miss")] == 3
+        assert counters[("compile", "jit_cache_hit")] >= 3
+    finally:
+        tele.disable()
+    # measured window: warm while telemetry is OFF, then trace a burst
+    # — the probes must report hits only
+    eng = ServeEngine(model, hps, params)
+    serve_requests(model, hps, params, _mixed(hps, 8), engine=eng)
+    tel = tele.configure(trace_dir=None)
+    try:
+        serve_requests(model, hps, params, _mixed(hps, 8), engine=eng)
+        counters = tel.counters()
+        assert counters.get(("compile", "jit_cache_miss"), 0) == 0
+        assert not [e for e in tel.events()
+                    if e.get("cat") == "compile"
+                    and e.get("type") == "span"]
+        # per-endpoint request/latency series landed (the satellite's
+        # /metrics contract rides these exact names)
+        assert counters[("serve",
+                         "requests_completed_ep_generate")] == 2
+        assert counters[("serve",
+                         "requests_completed_ep_complete")] == 2
+        assert counters[("serve",
+                         "requests_completed_ep_interpolate")] == 2
+        assert tel.histogram("latency_s_ep_reconstruct",
+                             cat="serve")["count"] == 2
+    finally:
+        tele.disable()
+
+
+def test_parse_endpoint_specs_rejects_conflicting_redeclaration():
+    """A spec that re-declares an existing class with a DIFFERENT
+    objective fails loudly instead of being silently judged by the
+    other spec; an agreeing re-declaration is fine."""
+    from sketch_rnn_tpu.serve.admission import parse_admission_classes
+
+    base = parse_admission_classes(["interactive:p95<=100ms"])
+    with pytest.raises(ValueError, match="re-declares"):
+        parse_endpoint_specs(["complete=interactive:p95<=500ms"],
+                             classes=base)
+    ep_map, _ = parse_endpoint_specs(
+        ["complete=interactive:p95<=100ms"], classes=base)
+    assert ep_map == {"complete": "interactive"}
+
+
+def test_admission_backlog_is_pool_row_cost_aware():
+    """An interpolation charges its frame count against backlog, the
+    queue cap and the wait estimate — not 'one request' (the review's
+    frames-x shed-underestimate fix)."""
+    from sketch_rnn_tpu.serve.admission import (AdmissionController,
+                                                parse_admission_classes)
+
+    adm = AdmissionController(parse_admission_classes([]),
+                              n_replicas=1, slots=2, queue_cap=8)
+    d = adm.place("default", cost=6)
+    assert d.replica == 0 and adm.backlog == [6]
+    # the 6-row grid plus one unit crosses the 8-row cap for the next
+    adm.place("default", cost=2)
+    assert adm.place("default").shed_reason == "queue_full"
+    # completion frees the full cost; the EWMA sample stays decode_s
+    # (grid rows decode concurrently — each occupies a slot for ~the
+    # whole duration, so per-row service is NOT decode_s / frames)
+    adm.note_done(0, decode_s=1.2, cost=6)
+    assert adm.backlog == [2]
+    assert adm.service_s == pytest.approx(1.2)
+    assert not adm.place("default").shed
+    with pytest.raises(RuntimeError, match="cost-9"):
+        adm.note_done(0, decode_s=0.1, cost=9)
+    with pytest.raises(ValueError, match="cost"):
+        adm.place("default", cost=0)
+
+
+def test_cache_entry_counts_frame_bytes():
+    """Interpolate cache entries hold frames COPIES next to the
+    concatenated strokes — nbytes must count both so max_bytes stays
+    an honest bound."""
+    from sketch_rnn_tpu.serve.cache import CacheEntry
+
+    frames = [np.zeros((2, 5), np.float32), np.zeros((3, 5),
+                                                     np.float32)]
+    entry = CacheEntry(np.concatenate(frames), length=5, steps=5,
+                       origin_uid=0, endpoint="interpolate",
+                       frames=frames)
+    assert entry.nbytes == 5 * 5 * 4 * 2  # concat + the frame copies
+    plain = CacheEntry(np.zeros((4, 5), np.float32), 4, 4, 0)
+    assert plain.nbytes == 4 * 5 * 4
+
+
+def test_pool_rows_and_burst_chop(setup):
+    """An interpolation occupies ``frames`` pool rows; the micro-burst
+    chop never overflows pool_cap and never reorders priorities."""
+    hps, model, params = setup
+    assert EP.pool_rows_of(_mk(0, hps, "generate")) == 1
+    assert EP.pool_rows_of(_mk(0, hps, "interpolate", frames=5)) == 5
+    # a too-large grid is refused at the fleet door
+    fleet = ServeFleet(model, hps, params, replicas=1, pool_cap=4)
+    try:
+        with pytest.raises(ValueError, match="pool_cap"):
+            fleet.submit(_mk(0, hps, "interpolate", frames=5))
+    finally:
+        fleet.close()

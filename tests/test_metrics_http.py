@@ -437,6 +437,35 @@ def test_render_prometheus_cache_and_fleet_replica_series():
     assert "# TYPE sketch_rnn_serve_fleet_replicas gauge" in text
 
 
+def test_render_prometheus_per_endpoint_series():
+    """ISSUE 15 satellite: per-endpoint request/latency series ride the
+    class_series naming contract (``..._ep_<endpoint>``) — tick them on
+    an enabled core and the exposition renders them as counters +
+    histograms with no new bookkeeping."""
+    from sketch_rnn_tpu.utils.telemetry import endpoint_series
+
+    assert endpoint_series("latency_s", "complete") == \
+        "latency_s_ep_complete"
+    assert endpoint_series("latency_s", None) == "latency_s"
+    tel = Telemetry()
+    for ep, lat in (("generate", 0.1), ("complete", 0.2),
+                    ("complete", 0.3), ("interpolate", 0.4)):
+        tel.counter(endpoint_series("requests_completed", ep), 1.0,
+                    cat="serve")
+        tel.observe(endpoint_series("latency_s", ep), lat, cat="serve")
+    text = render_prometheus(tel)
+    s = _series(text)
+    assert s["sketch_rnn_serve_requests_completed_ep_generate_total"] \
+        == 1
+    assert s["sketch_rnn_serve_requests_completed_ep_complete_total"] \
+        == 2
+    assert s[
+        "sketch_rnn_serve_requests_completed_ep_interpolate_total"] == 1
+    assert s["sketch_rnn_serve_latency_s_ep_complete_count"] == 2
+    assert "# TYPE sketch_rnn_serve_latency_s_ep_complete histogram" \
+        in text
+
+
 def test_healthz_reports_scaling_during_resize_not_degraded():
     """ISSUE 12 satellite: an in-flight elastic resize is intentional —
     /healthz must report `scaling`, not flap ok/degraded; a genuinely

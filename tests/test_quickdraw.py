@@ -11,7 +11,21 @@ from sketch_rnn_tpu.data.quickdraw import (
     drawing_to_stroke3,
     iter_ndjson,
     rdp,
+    stream_categories,
+    stream_stroke3,
 )
+
+
+def _write_ndjson(path, n, seed, word="cat", min_pts=4, max_pts=20):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            k = int(rng.integers(min_pts, max_pts))
+            xs = np.cumsum(rng.integers(-5, 6, k)) + 128
+            ys = np.cumsum(rng.integers(-5, 6, k)) + 128
+            f.write(json.dumps({
+                "word": word, "recognized": True,
+                "drawing": [[xs.tolist(), ys.tolist()]]}) + "\n")
 
 
 def test_rdp_drops_collinear_keeps_corners():
@@ -144,6 +158,97 @@ def test_quantize_exact_integer_deltas_no_drift():
     # reconstruction starts at the (dropped) first point's rounded pos
     np.testing.assert_allclose(recon + want[0], want[1:] if len(recon) ==
                                n - 1 else want, atol=0)
+
+
+# -- streaming ingestion (ISSUE 15) ------------------------------------------
+
+
+def test_stream_stroke3_matches_converter_pipeline(tmp_path):
+    """The streaming reader IS the converter's pipeline: the streamed
+    stroke-3 arrays equal the .npz conversion's pre-split sequences
+    byte-for-byte (int16-cast), so the two paths can never drift."""
+    path = tmp_path / "cat.ndjson"
+    _write_ndjson(path, 20, seed=0)
+    streamed = list(stream_stroke3(str(path), epsilon=0.5,
+                                   max_points=32))
+    assert streamed and all(s.dtype == np.float32 and s.shape[1] == 3
+                            for s in streamed)
+    # exact integer deltas (the quantize=True layout)
+    for s in streamed:
+        np.testing.assert_array_equal(s[:, :2], np.round(s[:, :2]))
+    convert_ndjson(str(path), str(tmp_path / "cat.npz"), epsilon=0.5,
+                   max_points=32, num_valid=5, num_test=5, seed=3)
+    npz = np.load(tmp_path / "cat.npz", allow_pickle=True,
+                  encoding="latin1")
+    pooled = sorted(
+        (a.tobytes() for split in ("train", "valid", "test")
+         for a in npz[split]))
+    assert sorted(s.astype(np.int16).tobytes()
+                  for s in streamed) == pooled
+    # limit bounds the stream
+    assert len(list(stream_stroke3(str(path), epsilon=0.5,
+                                   max_points=32, limit=4))) == 4
+
+
+def test_stream_stroke3_corrupt_lines(tmp_path):
+    path = tmp_path / "bad.ndjson"
+    _write_ndjson(path, 3, seed=1)
+    with open(path, "a") as f:
+        f.write("{torn json\n")
+    with pytest.raises(ValueError, match="corrupt ndjson"):
+        list(stream_stroke3(str(path)))
+    assert len(list(stream_stroke3(str(path), skip_bad=True))) == 3
+
+
+def test_stream_categories_interleaves_with_file_order_labels(tmp_path):
+    _write_ndjson(tmp_path / "cat.ndjson", 4, seed=2, word="cat")
+    _write_ndjson(tmp_path / "dog.ndjson", 6, seed=3, word="dog")
+    pairs = list(stream_categories(str(tmp_path), ["cat", "dog"]))
+    labels = [label for label, _ in pairs]
+    assert len(pairs) == 10
+    assert labels[:8] == [0, 1] * 4        # round-robin while both live
+    assert labels[8:] == [1, 1]            # dog's tail drains alone
+    seq = list(stream_categories(str(tmp_path), ["cat", "dog"],
+                                 interleave=False))
+    assert [label for label, _ in seq] == [0] * 4 + [1] * 6
+
+
+def test_stream_batches_feeds_loader_layout(tmp_path):
+    """ISSUE 15: ndjson stream -> native batcher -> loader-layout
+    stroke-5 batches with no materialized corpus; native and numpy
+    fallback paths agree bit-for-bit."""
+    from sketch_rnn_tpu.data import native_batcher as NB
+
+    _write_ndjson(tmp_path / "cat.ndjson", 5, seed=4, word="cat")
+    _write_ndjson(tmp_path / "dog.ndjson", 5, seed=5, word="dog")
+    pairs = list(stream_categories(str(tmp_path), ["cat", "dog"],
+                                   max_points=32))
+    batches = list(NB.stream_batches(iter(pairs), batch_size=4,
+                                     max_len=32))
+    assert [len(b["seq_len"]) for b in batches] == [4, 4, 2]
+    for b in batches:
+        assert b["strokes"].shape[1:] == (33, 5)
+        assert b["strokes"].dtype == np.float32
+        # start token at t=0, row lengths honored
+        np.testing.assert_array_equal(b["strokes"][:, 0, :],
+                                      [[0, 0, 1, 0, 0]] * len(b["seq_len"]))
+        assert set(b["labels"].tolist()) <= {0, 1}
+    # the numpy fallback is bit-exact to the native path on this batch
+    seqs = [s for _, s in pairs[:4]]
+    ref = NB.pad_batch_numpy(seqs, 32)
+    native = NB.assemble_batch(seqs, 32)
+    if native is not None:
+        np.testing.assert_array_equal(ref[0], native[0])
+        np.testing.assert_array_equal(ref[1], native[1])
+    # over-length sequences are dropped, not crashed on
+    long = np.zeros((40, 3), np.float32)
+    out = list(NB.stream_batches(iter([long] + seqs), batch_size=4,
+                                 max_len=32))
+    assert [len(b["seq_len"]) for b in out] == [4]
+    # drop_last drops the ragged tail
+    assert [len(b["seq_len"]) for b in NB.stream_batches(
+        iter(pairs), batch_size=4, max_len=32, drop_last=True)] \
+        == [4, 4]
 
 
 def test_convert_npz_is_1d_object_array_even_when_uniform(tmp_path):

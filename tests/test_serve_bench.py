@@ -93,6 +93,64 @@ def test_serve_bench_fleet_end_to_end_small(tmp_path, capsys):
                 == r["steps_dispatched"])
 
 
+def test_serve_bench_endpoints_end_to_end_small(tmp_path):
+    """A shrunken mixed-endpoint bench (ISSUE 15): all four endpoints
+    serve through the endpoint-routed fleet, the offline-parity /
+    cost-determinism / compile-accounting blocks hold (a failure
+    raises after streaming the rows), per-endpoint latency columns and
+    per-class SLO verdicts land in --out under 'endpoints', one binary
+    serve_endpoint row per endpoint streams to the hermetic smoke
+    history, and pre-existing records in --out are preserved."""
+    out = tmp_path / "SB.json"
+    out.write_text(json.dumps(
+        {"kind": "serve_bench", "engine_sketches_per_sec": 123.0}))
+    rc = serve_bench.main([
+        "--endpoints", "--smoke", "--slots", "4", "--chunk", "2",
+        "--requests", "48", "--unique", "16", "--min_len", "2",
+        "--max_len", "10", "--frames", "3", "--out", str(out)])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert doc["engine_sketches_per_sec"] == 123.0  # merge preserved
+    e = doc["endpoints"]
+    assert e["kind"] == "serve_endpoints" and e["smoke"] is True
+    assert set(e["realized_mix"]) == {"generate", "complete",
+                                      "reconstruct", "interpolate"}
+    assert sum(e["realized_mix"].values()) == 48
+    # the deterministic acceptance blocks all held
+    p = e["parity"]
+    assert p["offline_bitwise"] and p["arrival_invariant"]
+    assert p["cost_deterministic"] and not p["failures"]
+    assert p["replicas_checked"] == [1, 2]
+    c = e["compile"]
+    # exactly one encode compile per (pool rows, prefix edge), none on
+    # repeat, ZERO compiles of any kind in the measured window
+    assert c["encode_compiles"] == len(c["edges"])
+    assert len(set(c["geometries"])) == c["encode_compiles"]
+    assert c["recompiles_on_repeat"] == 0
+    assert c["measured_window"]["jit_cache_miss"] == 0
+    assert c["measured_window"]["compile_spans"] == 0
+    # per-endpoint latency columns + per-class SLO verdicts
+    for ep, cnt in e["realized_mix"].items():
+        cell = e["per_endpoint_capacity"][ep]
+        assert cell["completed"] == cnt
+        assert cell["p50_s"] <= cell["p99_s"]
+    assert set(e["slo"]) == {"interactive:latency_s:p95",
+                             "batch:latency_s:p99"}
+    assert e["cost"]["exact"] is True
+    # one binary serve_endpoint row per endpoint, all ok, in the
+    # hermetic smoke history
+    hist = tmp_path / "BENCH_SMOKE_HISTORY.jsonl"
+    rows = [r for r in map(json.loads, open(hist))
+            if r.get("kind") == "serve_endpoint"]
+    assert {r["endpoint"] for r in rows} == {"generate", "complete",
+                                             "reconstruct",
+                                             "interpolate"}
+    for r in rows:
+        assert r["ok"] is True
+        assert r["completed"] == e["realized_mix"][r["endpoint"]]
+        assert r["class"] in ("interactive", "batch")
+
+
 @pytest.mark.parametrize("dist", ["power", "bimodal"])
 def test_serve_bench_end_to_end_small(tmp_path, capsys, dist):
     """A shrunken smoke run: both paths execute, the record is
