@@ -30,7 +30,7 @@ import time
 # bench_regress (which imports it): a new binary kind added here is
 # automatically keyed, summarized and gated consistently.
 BINARY_KINDS = ("resilience", "serve_cost", "serve_cache",
-                "serve_autoscale", "serve_endpoint")
+                "serve_autoscale", "serve_endpoint", "rollout")
 
 
 def key_of(r: dict):
@@ -72,6 +72,13 @@ def key_of(r: dict):
         # are different measurements (ISSUE 10)
         return ("resilience", r.get("site"),
                 f"mode={r.get('mode')} dev={dev}")
+    if r.get("kind") == "rollout":
+        # zero-downtime rollout arms (ISSUE 16): one per fault site —
+        # swap-under-death, canary rejection, corrupt-candidate
+        # quarantine; the bitwise post-swap/post-rollback proof is the
+        # binary signal
+        return ("rollout", r.get("site"),
+                f"expected={r.get('expected')} dev={dev}")
     if r.get("kind") == "serve_cost":
         # deterministic per-class cost-attribution cells (ISSUE 11):
         # one per replica count of the fleet capacity arm; the binary
@@ -303,6 +310,15 @@ def main(argv=None) -> int:
             print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
                   f"latest={l.get('outcome'):>11s} "
                   f"(expected {l.get('expected')}{cost_col})")
+            continue
+        if k[0] == "rollout":
+            # rollout arm: the latest outcome is the signal (ok is
+            # binary — promoted / rolled-back / quarantined, each
+            # closed by a bitwise proof)
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"latest={l.get('outcome'):>11s} "
+                  f"(expected {l.get('expected')} "
+                  f"swapped={l.get('swapped')})")
             continue
         if k[0] == "servecost":
             # cost-attribution cell (ISSUE 11): exactness is the

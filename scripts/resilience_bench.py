@@ -37,6 +37,16 @@ and proves, per cell, that recovery happened the way the code claims:
   train.step@S:kind=exit`` — ``os._exit``, no finally blocks, the
   honest kill -9 — resumed by a second cli invocation; final
   checkpoint bytes equal the uninterrupted subprocess run's.
+- ``rollout`` matrix (ISSUE 16) ........ three arms through the live
+  ``RolloutController``, each with a BITWISE proof: a mid-traffic
+  checkpoint walk under a KILLED replica must still promote, with the
+  post-swap burst bitwise equal to a cold fleet started from the new
+  checkpoint; a rejected canary (``rollout.canary``) must roll back to
+  strokes bitwise the never-rolled fleet's; a corrupt candidate
+  (``ckpt.load.corrupt`` inside the admission gate) must be MOVED to
+  quarantine while the fleet keeps serving the old version bitwise.
+  These stream as ``kind: "rollout"`` history rows (one per arm/site),
+  gated by bench_regress like every binary kind.
 - ``host.kill`` elastic (ISSUE 14) ..... **recovered**: a 2-host
   elastic BUCKETED fleet (two real ``cli train --elastic_hosts 2``
   subprocesses, light mode — no accelerator tunnel) loses host 1 to
@@ -357,6 +367,176 @@ def cell_fleet_failover(hps, tmp, n_requests=6):
     }
 
 
+def cell_rollout(hps, tmp, n_requests=4):
+    """Zero-downtime rollout matrix (ISSUE 16): three arms through the
+    live RolloutController, each closed by a bitwise proof.
+
+    Arm 1 (swap under death): replica 0 of a 3-replica fleet is killed
+    mid-burst; the walk must still promote on the survivors — the
+    rollout never needs the dead replica — and the post-swap burst is
+    bitwise a COLD fleet started from the new checkpoint. Arm 2
+    (canary rejection): ``rollout.canary`` fires, no serving replica
+    ever sees the new params, and post-rollback strokes are bitwise
+    the never-rolled fleet's. Arm 3 (corrupt candidate):
+    ``ckpt.load.corrupt`` fires inside the admission gate; the
+    candidate is MOVED to quarantine/ (it can never retrigger a watch)
+    and the fleet keeps serving the old version bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve.engine import Request
+    from sketch_rnn_tpu.serve.fleet import ServeFleet
+    from sketch_rnn_tpu.serve.rollout import RolloutController
+    from sketch_rnn_tpu.train.checkpoint import (ckpt_id_of,
+                                                 save_checkpoint)
+    from sketch_rnn_tpu.train.state import make_train_state
+    from sketch_rnn_tpu.utils import faults
+
+    if len(jax.devices()) < 3:
+        return {"site": "rollout", "mode": "rollout",
+                "expected": "recovered", "outcome": "skipped",
+                "ok": True, "arms": [],
+                "skipped": f"needs >= 3 devices, have "
+                           f"{len(jax.devices())}"}
+
+    model = SketchRNN(hps)
+    state_old = make_train_state(
+        model, hps, jax.random.key(SEED))._replace(
+            step=jnp.asarray(10, jnp.int32))
+    state_new = make_train_state(
+        model, hps, jax.random.key(SEED + 7))._replace(
+            step=jnp.asarray(20, jnp.int32))
+    old_id, new_id = ckpt_id_of(10), ckpt_id_of(20)
+    kreq = jax.random.key(321)
+    n = n_requests
+
+    def requests(lo, hi):
+        return [Request(key=jax.random.fold_in(kreq, i), max_len=8,
+                        uid=i) for i in range(lo, hi)]
+
+    canary = [Request(key=jax.random.fold_in(kreq, 900 + i), max_len=6)
+              for i in range(3)]
+
+    def cold_burst(params, ckpt_id, lo, hi):
+        """The reference fleet: COLD-started from the target version,
+        serving the identical burst (pre-start submit: deterministic
+        placement)."""
+        fleet = ServeFleet(model, hps, params, replicas=2,
+                           slots=hps.serve_slots, chunk=hps.serve_chunk,
+                           retry_backoff_s=0.0, ckpt_id=ckpt_id)
+        for r in requests(lo, hi):
+            fleet.submit(r)
+        with fleet:
+            fleet.drain(timeout=120)
+            return fleet.results
+
+    def burst_matches(got, ref, lo, hi, want_id):
+        return all(
+            u in got and u in ref
+            and np.array_equal(got[u]["result"].strokes5,
+                               ref[u]["result"].strokes5)
+            and got[u]["result"].ckpt_id == want_id
+            for u in range(lo, hi))
+
+    def roll_arm(ckpt_dir, replicas, plan):
+        """One arm: build a fleet on the old version with traffic in
+        flight, roll toward the new checkpoint under ``plan``, then
+        drain a closing burst. Returns everything the arm asserts on."""
+        save_checkpoint(ckpt_dir, state_old, 1.0, hps)
+        p_new = save_checkpoint(ckpt_dir, state_new, 1.0, hps)
+        faults.configure(plan)
+        try:
+            fleet = ServeFleet(model, hps, state_old.params,
+                               replicas=replicas, slots=hps.serve_slots,
+                               chunk=hps.serve_chunk,
+                               retry_backoff_s=0.0, ckpt_id=old_id)
+            for r in requests(0, n):
+                fleet.submit(r)     # in flight DURING the walk
+            fleet.start()
+            ctl = RolloutController(fleet, model, hps, state_old,
+                                    canary)
+            rpt = ctl.roll_to(p_new)
+            faults.disable()
+            for r in requests(n, 2 * n):
+                fleet.submit(r)     # the closing burst
+            drained = fleet.drain(timeout=120)
+            got = fleet.results
+            health = fleet.health()
+            summ = fleet.summary()
+            serving = fleet.serving_ckpt_id
+            fleet.close()
+        finally:
+            faults.disable()
+        return rpt, drained, got, health, summ, serving, p_new
+
+    arms = []
+
+    # ---- arm 1: mid-traffic swap with replica 0 KILLED
+    rpt, drained, got, health, summ, serving, _ = roll_arm(
+        os.path.join(tmp, "roll_death"), 3, "fleet.worker.r0@0")
+    ref_new = cold_burst(state_new.params, new_id, n, 2 * n)
+    post_bitwise = burst_matches(got, ref_new, n, 2 * n, new_id)
+    ok1 = bool(rpt.get("ok") and drained and serving == new_id
+               and summ["replicas_dead"] == 1 and not health["healthy"]
+               and post_bitwise)
+    arms.append({
+        "site": "rollout.swap", "plan": "fleet.worker.r0@0",
+        "mode": "raise", "expected": "promoted",
+        "outcome": "promoted" if ok1 else "FAILED", "ok": ok1,
+        "swapped": rpt.get("swapped"), "rolled_back": False,
+        "replicas_dead": summ["replicas_dead"],
+        "post_swap_bitwise_cold_fleet": post_bitwise,
+        "healthz_degraded": not health["healthy"],
+    })
+
+    # the never-rolled reference for the rollback/quarantine arms
+    base_res = cold_burst(state_old.params, old_id, 0, n)
+
+    # ---- arm 2: canary rejection -> automatic rollback
+    rpt, drained, got, health, summ, serving, _ = roll_arm(
+        os.path.join(tmp, "roll_canary"), 2, "rollout.canary@0")
+    pre_bitwise = burst_matches(got, base_res, 0, n, old_id)
+    ok2 = bool((not rpt.get("ok")) and rpt.get("rolled_back")
+               and drained and serving == old_id and health["healthy"]
+               and pre_bitwise)
+    arms.append({
+        "site": "rollout.canary", "plan": "rollout.canary@0",
+        "mode": "raise", "expected": "rolled-back",
+        "outcome": "rolled-back" if ok2 else "FAILED", "ok": ok2,
+        "swapped": rpt.get("swapped"), "rolled_back": True,
+        "post_rollback_bitwise": pre_bitwise,
+        "healthz_healthy": health["healthy"],
+    })
+
+    # ---- arm 3: corrupt candidate -> quarantined at the gate
+    rpt, drained, got, health, summ, serving, p_new = roll_arm(
+        os.path.join(tmp, "roll_corrupt"), 2, "ckpt.load.corrupt@0")
+    qdir = os.path.join(tmp, "roll_corrupt", "quarantine")
+    quarantined = (not os.path.exists(p_new) and os.path.isdir(qdir)
+                   and any(f.endswith(".reason.txt")
+                           for f in os.listdir(qdir)))
+    bitwise3 = burst_matches(got, base_res, 0, n, old_id)
+    ok3 = bool((not rpt.get("ok")) and rpt.get("phase") == "admit"
+               and drained and serving == old_id and health["healthy"]
+               and quarantined and bitwise3)
+    arms.append({
+        "site": "ckpt.load.corrupt", "plan": "ckpt.load.corrupt@0",
+        "mode": "raise", "expected": "quarantined",
+        "outcome": "quarantined" if ok3 else "FAILED", "ok": ok3,
+        "swapped": 0, "rolled_back": False,
+        "candidate_quarantined": quarantined,
+        "fleet_kept_old_bitwise": bitwise3,
+    })
+
+    ok = all(a["ok"] for a in arms)
+    return {
+        "site": "rollout", "mode": "rollout", "expected": "recovered",
+        "outcome": "recovered" if ok else "FAILED", "ok": ok,
+        "arms": arms,
+    }
+
+
 def cell_host_kill(tmp, kill_at=10):
     """THE elastic chaos cell (ISSUE 14): kill one host of a 2-host
     bucketed elastic fleet mid-run via two REAL subprocesses; the
@@ -552,6 +732,8 @@ def main(argv=None) -> int:
                                                                tmp)),
             ("watchdog nan", lambda: cell_watchdog_nan(hps, tmp)),
             ("fleet failover", lambda: cell_fleet_failover(hps, tmp)),
+            ("rollout (swap under death + canary + quarantine)",
+             lambda: cell_rollout(hps, tmp)),
             # the elastic host-kill cell runs in SMOKE too (ISSUE 14
             # satellite: the two-process elastic smoke is tier-1) —
             # its subprocesses are the recovery path under test, not
@@ -573,6 +755,18 @@ def main(argv=None) -> int:
     # record, so committed rows diff cleanly across re-runs
     stamp = runinfo.run_wall_time()
     for c in cells:
+        if c.get("site") == "rollout":
+            # the rollout cell streams ONE binary row per arm (site =
+            # the fault site under test) — no aggregate resilience row
+            for arm in c.get("arms") or []:
+                row = {"kind": "rollout", "smoke": bool(args.smoke),
+                       "device_kind": device_kind,
+                       **{k: arm.get(k) for k in
+                          ("site", "plan", "expected", "outcome", "ok",
+                           "swapped", "rolled_back")}}
+                row = hist_append(row)
+                print(json.dumps(row))
+            continue
         row = {"kind": "resilience", "smoke": bool(args.smoke),
                "device_kind": device_kind,
                "num_steps": hps.num_steps, "save_every": hps.save_every,

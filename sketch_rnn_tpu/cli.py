@@ -516,6 +516,15 @@ def cmd_serve_bench(args) -> int:
         print("[cli] --rate/--classes configure the fleet scheduler; "
               "add --fleet", file=sys.stderr)
         return 2
+    if getattr(args, "watch_ckpt", ""):
+        # rollout needs a fleet with a survivor while one replica is
+        # off-placement in the canary/walk — validated here, before
+        # the expensive restore/compile (the --slo precedent)
+        if args.fleet is None or args.fleet < 2:
+            print("[cli] --watch_ckpt needs --fleet >= 2 (the rollout "
+                  "walk retires one replica at a time; survivors keep "
+                  "serving)", file=sys.stderr)
+            return 2
     if args.fleet is not None:
         if args.static:
             print("[cli] --static (freeze-until-batch-done) has no "
@@ -684,7 +693,8 @@ def _serve_telemetry_abort(trace_dir, tel, tele, mem_sampler) -> None:
 
 
 def _serve_bench_fleet(args, hps, model, state_params, requests,
-                       slo_tracker, server=None, endpoints_cfg=None):
+                       slo_tracker, server=None, endpoints_cfg=None,
+                       ckpt_id: str = "", template_state=None):
     """The fleet measured section: build + warm the fleet, THEN enable
     telemetry (via the shared helper — the can't-recompile-into-the-
     window ordering), then replay the open-loop schedule and drain.
@@ -715,7 +725,8 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
                        replicas=args.fleet, slots=args.slots,
                        chunk=args.chunk, greedy=args.greedy,
                        classes=classes, slo=slo_tracker,
-                       endpoint_classes=endpoint_classes)
+                       endpoint_classes=endpoint_classes,
+                       ckpt_id=ckpt_id)
     if server is not None:
         # /healthz now answers from the LIVE fleet: a replica death
         # mid-run flips the verdict to degraded (ISSUE 10)
@@ -723,6 +734,27 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
     fleet.warm(requests[0],
                endpoints=bool(endpoints_cfg
                               and endpoints_cfg.get("encoder")))
+    rollout_ctl = None
+    watch_dir = getattr(args, "watch_ckpt", "") or None
+    if watch_dir:
+        # zero-downtime rollout (ISSUE 16): follow the training run's
+        # checkpoint dir live — each new complete checkpoint is
+        # validated, canaried bitwise on a retired replica, then
+        # walked across the fleet; /healthz reports `rolling`, a bad
+        # candidate quarantines or rolls back. The watcher thread dies
+        # with fleet.close() (the controller join is wired there).
+        import dataclasses as _dc
+
+        from sketch_rnn_tpu.serve.rollout import RolloutController
+        from sketch_rnn_tpu.train.state import make_train_state
+        template = (template_state if template_state is not None
+                    else make_train_state(model, hps,
+                                          jax.random.key(0)))
+        canary = [_dc.replace(r, uid=None, max_len=8)
+                  for r in requests[:min(4, len(requests))]]
+        rollout_ctl = RolloutController(fleet, model, hps, template,
+                                        canary, slo=slo_tracker)
+        rollout_ctl.watch(watch_dir)
     handles = _serve_telemetry_start(args)
     try:
         for i, r in enumerate(requests):
@@ -742,7 +774,15 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
                 _submit).start()
             gen.join()
             fleet.drain()
+            if rollout_ctl is not None:
+                # settle any in-flight walk before summarizing, then
+                # record the lineage contract for RUN.json
+                rollout_ctl.join()
             fsum = fleet.summary()
+            if rollout_ctl is not None:
+                fsum["serving_ckpt_id"] = fleet.serving_ckpt_id
+                fsum["ckpt_lineage"] = rollout_ctl.lineage()
+                fsum["rollout_log"] = list(rollout_ctl.rollout_log)
             rows = [{"uid": uid, "replica": rec["replica"],
                      "class": rec.get("class"),
                      "endpoint": rec.get("endpoint", "generate"),
@@ -827,9 +867,13 @@ def _serve_bench_run(args, hps, slo_tracker, server,
         model = SketchRNN(hps)
         state_params = model.init_params(jax.random.key(args.seed))
         scale = 1.0
+        state = None
+        init_ckpt_id = ""
     else:
         model, state, scale, _ = _restore(hps, args.workdir)
         state_params = state.params
+        from sketch_rnn_tpu.train.checkpoint import ckpt_id_of
+        init_ckpt_id = ckpt_id_of(int(state.step))
     key = jax.random.key(args.seed)
     kz, kreq = jax.random.split(key)
     n = args.n
@@ -860,7 +904,8 @@ def _serve_bench_run(args, hps, slo_tracker, server,
         # operator declared.
         out_metrics, fleet_report, rows, handles = _serve_bench_fleet(
             args, hps, model, state_params, requests, slo_tracker,
-            server=server, endpoints_cfg=endpoints_cfg)
+            server=server, endpoints_cfg=endpoints_cfg,
+            ckpt_id=init_ckpt_id, template_state=state)
         trace_dir, tel, tele, mem_sampler = handles
         slots_v, chunk_v = fleet_report["slots"], fleet_report["chunk"]
         if writer is not None:
@@ -961,6 +1006,14 @@ def _serve_bench_run(args, hps, slo_tracker, server,
                 # the realized fleet trajectory land in RUN.json
                 extra["scale_log"] = fleet_report["scale_log"]
                 extra["replicas_live"] = fleet_report["replicas_live"]
+            if fleet_report.get("ckpt_lineage"):
+                # the ISSUE 16 lineage contract: which checkpoint
+                # served which admitted-uid window, plus the rollout
+                # state machine's event log
+                extra["serving_ckpt_id"] = \
+                    fleet_report.get("serving_ckpt_id")
+                extra["ckpt_lineage"] = fleet_report["ckpt_lineage"]
+                extra["rollout_log"] = fleet_report["rollout_log"]
         runinfo.write_manifest(
             man_dir, kind="serve_bench", hps=hps, run_id=run_id,
             artifacts=artifacts, extra=extra)
@@ -1220,6 +1273,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault_seed", type=int, default=0,
                    help="seed of the fault plan's deterministic "
                         "p=... firing decisions")
+    p.add_argument("--watch_ckpt", default="",
+                   help="zero-downtime rollout (ISSUE 16, needs "
+                        "--fleet >= 2): follow this checkpoint dir and "
+                        "hot-swap the serving fleet to each new "
+                        "complete checkpoint — validated, canaried "
+                        "bitwise on a retired replica, walked replica "
+                        "by replica, rolled back automatically on "
+                        "failure. Train in one terminal, serve-bench "
+                        "with --watch_ckpt <ckpt_dir> in another; "
+                        "RUN.json gains the checkpoint lineage")
     p.set_defaults(fn=cmd_serve_bench)
     return ap
 

@@ -132,11 +132,11 @@ class CacheEntry:
     counts both and the byte bound stays honest."""
 
     __slots__ = ("strokes5", "length", "steps", "origin_uid", "nbytes",
-                 "endpoint", "frames")
+                 "endpoint", "frames", "ckpt_id")
 
     def __init__(self, strokes5: np.ndarray, length: int, steps: int,
                  origin_uid: int, endpoint: str = "generate",
-                 frames=None):
+                 frames=None, ckpt_id: str = ""):
         self.strokes5 = strokes5
         self.length = int(length)
         self.steps = int(steps)
@@ -145,6 +145,10 @@ class CacheEntry:
             0 if frames is None else sum(int(f.nbytes) for f in frames))
         self.endpoint = endpoint or "generate"
         self.frames = frames
+        # which params checkpoint computed these strokes (ISSUE 16):
+        # stamped from the producing Result so a hit re-serves its
+        # origin's version label, never the fleet's current one
+        self.ckpt_id = str(ckpt_id or "")
 
 
 class ResultCache:
@@ -179,8 +183,15 @@ class ResultCache:
         self.evictions = 0
         self.coalesced = 0
 
-    def fingerprint(self, req) -> bytes:
-        return request_fingerprint(req, self.config_hash, self.ckpt_id)
+    def fingerprint(self, req, ckpt_id: Optional[str] = None) -> bytes:
+        """Fingerprint under this cache's namespace. ``ckpt_id``
+        overrides the constructor-time version label — the rollout path
+        (ISSUE 16) fingerprints against the fleet's CURRENT serving
+        version, which changes over the cache's lifetime, so a v1 hit
+        can never answer a v2 request."""
+        return request_fingerprint(
+            req, self.config_hash,
+            self.ckpt_id if ckpt_id is None else ckpt_id)
 
     def __len__(self) -> int:
         with self._lock:
@@ -223,7 +234,8 @@ class ResultCache:
                            result.uid,
                            endpoint=getattr(result, "endpoint",
                                             "generate"),
-                           frames=getattr(result, "frames", None))
+                           frames=getattr(result, "frames", None),
+                           ckpt_id=getattr(result, "ckpt_id", ""))
         evicted = 0
         tel = get_telemetry()
         with self._lock:

@@ -527,7 +527,11 @@ def assemble_results(plan: BatchPlan, engine_results: Sequence[Any],
             latency_s=latency,
             attributed_steps=sum(k.attributed_steps for k in kids),
             endpoint="interpolate",
-            frames=frames)
+            frames=frames,
+            # all frames of one interpolation decode on one engine
+            # (coherent-placement contract), so the parent inherits a
+            # single version stamp (ISSUE 16)
+            ckpt_id=kids[0].ckpt_id)
         out.append(res)
         if slo is not None:
             slo.observe("interpolate", {

@@ -164,6 +164,12 @@ class Result:
     # byte-counting consumer keeps working)
     endpoint: str = "generate"
     frames: Optional[List[np.ndarray]] = None
+    # zero-downtime rollout (ISSUE 16): which params checkpoint
+    # produced these strokes — stamped from the serving engine (or the
+    # cache entry, for hits), so mixed-version serving during a
+    # rolling swap is HONEST: every result names its version, and the
+    # invariance tests can prove its bytes are that version's, bitwise
+    ckpt_id: str = ""
 
     @property
     def ended(self) -> bool:
@@ -338,12 +344,20 @@ class ServeEngine:
     def __init__(self, model, hps: HParams, params, slots: int = 0,
                  chunk: int = 0, max_len: Optional[int] = None,
                  greedy: bool = False, device=None,
-                 replica_id: Optional[int] = None):
+                 replica_id: Optional[int] = None, ckpt_id: str = ""):
         self.model = model
         self.hps = hps
         self.slots = int(slots or hps.serve_slots)
         self.chunk = int(chunk or hps.serve_chunk)
         self.max_len = int(max_len or hps.max_seq_len)
+        # greedy is part of the compiled program's identity; kept so a
+        # hot-swap (ISSUE 16) rebuilds the chunk program with the same
+        # sampling mode it was constructed with
+        self.greedy = bool(greedy)
+        # which params checkpoint this engine serves (ISSUE 16):
+        # stamped onto every Result; "" = unversioned (pre-rollout
+        # callers — random-init benches, tests)
+        self.ckpt_id = str(ckpt_id or "")
         # fleet replication (ISSUE 9): ``device`` pins this engine's
         # params + request pool to one mesh device, so its chunk
         # program executes there and NOWHERE else — each replica is its
@@ -357,6 +371,16 @@ class ServeEngine:
             raise ValueError(
                 f"slots and chunk must be >= 1, got {self.slots}/"
                 f"{self.chunk}")
+        self._bind_params(params)
+        self.spans = SpanTimer(category="serve")
+
+    def _bind_params(self, params) -> None:
+        """Bind ``params`` as this engine's serving weights: device-put
+        the decode subset and bake it into a fresh chunk program.
+
+        Called at construction and by :meth:`swap_params` (ISSUE 16) —
+        a rebuild COMPILES, so the rollout controller only ever swaps
+        a retired replica outside the measured serving window."""
         # decode-path parameter subset, device-put once and baked into
         # the chunk program as constants: the encoder's weights never
         # enter a chunk, and per-call pytree processing of weight
@@ -364,7 +388,7 @@ class ServeEngine:
         keep = ("dec", "out_w", "out_b", "dec_init_w", "dec_init_b",
                 "class_embed")
         self.params = jax.device_put(
-            {k: params[k] for k in keep if k in params}, device)
+            {k: params[k] for k in keep if k in params}, self.device)
         # full parameter reference for the lazily-built endpoint encode
         # program (ISSUE 15): kept host-side only — a generate-only
         # engine never ships encoder weights to its device
@@ -382,13 +406,29 @@ class ServeEngine:
         # different size must compile (and be accounted as) its own
         # executable, never dispatch the first burst's.
         self._chunk_fn = JitCompileProbe(
-            make_chunk_step(model, hps, self.chunk, self.params, greedy),
+            make_chunk_step(self.model, self.hps, self.chunk,
+                            self.params, self.greedy),
             "serve_chunk",
             key_of=lambda a: tuple(tuple(p.shape) for p in a[6]
                                    if p is not None),
             label_of=lambda a: (f"(B{self.slots},K{self.chunk},"
                                 f"N{a[6][0].shape[0]})"))
-        self.spans = SpanTimer(category="serve")
+
+    def swap_params(self, params, ckpt_id: str = "") -> None:
+        """Hot-swap this engine's serving weights in place (ISSUE 16).
+
+        The decode subset is re-device-put, the chunk program is
+        REBUILT (params are compile-time constants — the swap is a
+        compile, which is why the rollout walk only swaps RETIRED
+        replicas and re-warms them before they rejoin placement), and
+        the lazy endpoint encoder is dropped so its next use rebuilds
+        against the new weights. Shape-invariance is the caller's
+        contract: the admission gate (train/checkpoint.py
+        ``validate_checkpoint``) proved the candidate's manifest
+        matches before any engine sees it. ``ckpt_id`` becomes the
+        version every subsequent Result is stamped with."""
+        self._bind_params(params)
+        self.ckpt_id = str(ckpt_id or "")
 
     @property
     def encoder(self):
@@ -759,7 +799,8 @@ class ServeEngine:
                             decode_s=now - admit_t[req.uid],
                             latency_s=now - enq[req.uid],
                             attributed_steps=attr_steps.get(req.uid, 0),
-                            endpoint=req.endpoint or "generate")
+                            endpoint=req.endpoint or "generate",
+                            ckpt_id=self.ckpt_id)
                         results.append(res)
                         if slo is not None and req.parent_uid is None:
                             # the SLO tracker sees the EXACT Result floats,

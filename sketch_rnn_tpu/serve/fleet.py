@@ -205,7 +205,8 @@ class ServeFleet:
                  retry_budget: int = 2,
                  retry_backoff_s: float = 0.05,
                  max_replicas: int = 0, cache=None,
-                 endpoint_classes: Optional[Dict[str, str]] = None):
+                 endpoint_classes: Optional[Dict[str, str]] = None,
+                 ckpt_id: str = ""):
         import jax  # lazy, the serve-module discipline
 
         devices = list(devices if devices is not None else jax.devices())
@@ -264,7 +265,7 @@ class ServeFleet:
                 eng = ServeEngine(model, hps, params, slots=self.slots,
                                   chunk=self.chunk, max_len=max_len,
                                   greedy=greedy, device=devices[r],
-                                  replica_id=r)
+                                  replica_id=r, ckpt_id=ckpt_id)
             rep = _Replica(r, devices[r], eng, class_order)
             rep.cond = threading.Condition(self._lock)
             if r >= n:
@@ -279,6 +280,16 @@ class ServeFleet:
         self._fp_of: Dict[int, bytes] = {}     # uid -> fingerprint
         self._pending: Dict[bytes, List] = {}  # fp -> coalesced waiters
         self._scale_log: List[Dict] = []
+        # zero-downtime rollout (ISSUE 16): the fleet's AUTHORITATIVE
+        # serving version. New submissions fingerprint under it, so a
+        # cache entry computed by checkpoint v1 can never answer a
+        # request admitted while v2 serves; the RolloutController flips
+        # it old->new only after the LAST replica swap succeeded.
+        self.serving_ckpt_id = str(ckpt_id or "")
+        # the in-flight RolloutController, if any — close() joins its
+        # walk before retiring workers so no half-swapped spare is
+        # orphaned mid-rollout
+        self._rollout = None
         if retry_budget < 0:
             raise ValueError(f"retry_budget must be >= 0, got "
                              f"{retry_budget}")
@@ -589,6 +600,67 @@ class ServeFleet:
             actions.append({"action": "retire", "replica": idx})
         return actions
 
+    # -- zero-downtime rollout plumbing (ISSUE 16) -------------------------
+
+    def rejoin_replica(self, replica: int,
+                       reason: str = "rollout") -> int:
+        """Rejoin ONE SPECIFIC retired replica into the placement set
+        (the rollout walk rejoins exactly the replica it just swapped
+        and canaried — :meth:`add_replica`'s lowest-retired pick could
+        grab a different, unswapped spare). No-op if already live."""
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("fleet is closed")
+            rep = self._replicas[replica]
+            if rep.dead:
+                raise RuntimeError(f"replica {replica} is dead")
+            if not rep.retired:
+                return replica
+            return self._rejoin_locked(rep, reason, t0)
+
+    def swap_params_retired(self, replica: int, params,
+                            ckpt_id: str = "") -> None:
+        """Hot-swap params on a RETIRED, DRAINED replica's engine.
+
+        Refuses a live or still-draining replica: the swap rebuilds the
+        chunk program (a compile), so it must never race a serving
+        burst — the rollout walk retires, waits for the worker to
+        drain-exit, swaps here, re-warms, then rejoins. The caller
+        (serve/rollout.RolloutController) owns the retired replica for
+        the duration; nothing else may rejoin it mid-swap."""
+        import jax
+
+        with self._lock:
+            rep = self._replicas[replica]
+            if rep.dead:
+                raise RuntimeError(f"replica {replica} is dead")
+            if not rep.retired or (rep.thread is not None
+                                   and rep.thread.is_alive()):
+                raise RuntimeError(
+                    f"replica {replica} must be retired and drained "
+                    f"before a param swap (retired={rep.retired}, "
+                    f"draining={rep.thread is not None})")
+        # outside the scheduler lock: the device_put + program rebuild
+        # may compile, and survivors must keep draining meanwhile
+        with jax.default_device(rep.device):
+            rep.engine.swap_params(params, ckpt_id=ckpt_id)
+
+    def wait_replica_drained(self, replica: int,
+                             timeout: float = 60.0) -> bool:
+        """Block until a retired replica's worker has drain-exited
+        (``rep.thread is None``) — the precondition for
+        :meth:`swap_params_retired`. False on timeout."""
+        deadline = time.perf_counter() + timeout
+        with self._lock:
+            rep = self._replicas[replica]
+            while rep.thread is not None and rep.thread.is_alive():
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    return False
+                self._done_cv.wait(left)
+            return True
+
     def close(self, timeout: float = 30.0) -> List[str]:
         """Stop the workers (any queued-but-unstarted work is
         abandoned) and unregister.
@@ -599,6 +671,13 @@ class ServeFleet:
         Python, so the caller gets the straggler names (also warned on
         stdout) and the process's daemon-thread teardown reaps them at
         exit. Returns the straggler thread names (empty = clean)."""
+        # bugfix (ISSUE 16): a close() racing an in-flight rollout must
+        # join the walk FIRST — stopping workers mid-walk would orphan
+        # a half-swapped retired spare (new params, never canaried,
+        # silently rejoinable by a later add_replica)
+        ctl = self._rollout
+        if ctl is not None:
+            ctl.join(timeout=timeout)
         with self._lock:
             self._stop = True
             for rep in self._replicas:
@@ -662,8 +741,12 @@ class ServeFleet:
                 f"{sorted(self.classes)})")
         tel = get_telemetry()
         # content fingerprint OUTSIDE the scheduler lock (blake2b over
-        # the request fields; the cache is consulted under it)
-        fp = (self.cache.fingerprint(req)
+        # the request fields; the cache is consulted under it) — under
+        # the fleet's CURRENT serving version (ISSUE 16), so a rollout
+        # namespaces the keyspace: v1 entries are invisible to requests
+        # admitted under v2
+        fp = (self.cache.fingerprint(
+                  req, ckpt_id=self.serving_ckpt_id or None)
               if self.cache is not None else None)
         with self._lock:
             if self._stop:
@@ -699,7 +782,8 @@ class ServeFleet:
                                          entry.length, entry.steps,
                                          entry.origin_uid, tel,
                                          endpoint=entry.endpoint,
-                                         frames=entry.frames)
+                                         frames=entry.frames,
+                                         ckpt_id=entry.ckpt_id)
                     return True
                 if fp in self._pending:
                     self._pending[fp].append(req)
@@ -784,7 +868,7 @@ class ServeFleet:
                         origin_uid: int, tel,
                         coalesced: bool = False,
                         endpoint: str = "generate",
-                        frames=None) -> None:
+                        frames=None, ckpt_id: str = "") -> None:
         """Serve one request from cached strokes (caller holds the
         lock): book a ``cached=True`` Result with ZERO attributed
         device steps, feed the SLO tracker the (tiny) real latency,
@@ -797,7 +881,11 @@ class ServeFleet:
         res = Result(uid=req.uid, strokes5=strokes5, length=length,
                      steps=steps, queue_wait_s=qw, decode_s=0.0,
                      latency_s=qw, attributed_steps=0, cached=True,
-                     endpoint=endpoint or "generate", frames=frames)
+                     endpoint=endpoint or "generate", frames=frames,
+                     # a hit re-serves its ORIGIN computation's version
+                     # stamp (ISSUE 16) — the namespace guarantees it
+                     # matches the namespace this request hashed under
+                     ckpt_id=ckpt_id)
         self._results[req.uid] = {
             "result": res, "replica": None, "class": cls_name,
             "queue_pos": None, "cached": True,
@@ -947,10 +1035,12 @@ class ServeFleet:
                 for res in booked:
                     rec = {"result": res, "replica": rep.idx,
                            "endpoint": res.endpoint}
+                    req_of = None
                     for r in batch:
                         if r.uid == res.uid:
                             rec["class"] = r.cls
                             rec["queue_pos"] = r.queue_pos
+                            req_of = r
                             break
                     self._results[res.uid] = rec
                     self._admission.note_done(
@@ -971,13 +1061,25 @@ class ServeFleet:
                     # repeats never compute, deterministically
                     fp = self._fp_of.pop(res.uid, None)
                     if fp is not None and self.cache is not None:
-                        self.cache.put(fp, res)
+                        # mixed-version honesty (ISSUE 16): store under
+                        # the PRODUCING engine's version namespace. A
+                        # request admitted under v1 but drained by an
+                        # already-swapped v2 engine must fill the v2
+                        # keyspace — filing v2 bytes under the v1 key
+                        # would let a later v1 lookup serve them.
+                        fp_put = fp
+                        if (res.ckpt_id and req_of is not None
+                                and res.ckpt_id != (self.serving_ckpt_id
+                                                    or self.cache.ckpt_id)):
+                            fp_put = self.cache.fingerprint(
+                                req_of, ckpt_id=res.ckpt_id)
+                        self.cache.put(fp_put, res)
                         for w in self._pending.pop(fp, []):
                             self._book_cache_hit(
                                 w, w.cls, res.strokes5, res.length,
                                 res.steps, res.uid, tel,
                                 coalesced=True, endpoint=res.endpoint,
-                                frames=res.frames)
+                                frames=res.frames, ckpt_id=res.ckpt_id)
                 # booked REQUEST count (an interpolation's frames are
                 # engine rows, not requests — m["completed"] counts
                 # rows, the fleet counts requests)
@@ -1221,10 +1323,21 @@ class ServeFleet:
             scaling = any(r.retired and r.thread is not None
                           and r.thread.is_alive()
                           for r in self._replicas)
+            # an in-flight model rollout (ISSUE 16): intentional, not
+            # degradation — /healthz reports `rolling` (which outranks
+            # `scaling`: the rollout's own retire/rejoin churn would
+            # otherwise read as an autoscale) with the controller's
+            # evidence block (from/to ckpt_id, replicas swapped/total)
+            roll_ev = (self._rollout.evidence()
+                       if self._rollout is not None else None)
+            rolling = bool(roll_ev and roll_ev.get("active"))
             return {
                 "healthy": not dead and self._error is None
                 and not self._failed,
                 "scaling": scaling,
+                "rolling": rolling,
+                "rollout": roll_ev,
+                "serving_ckpt_id": self.serving_ckpt_id,
                 "replicas": self.n_replicas,
                 "replicas_live": self.n_live,
                 "replicas_retired": retired,

@@ -83,7 +83,8 @@ def _fmt(v: float) -> str:
 
 
 def render_prometheus(tel: Telemetry,
-                      slo: Optional[object] = None) -> str:
+                      slo: Optional[object] = None,
+                      health: Optional[object] = None) -> str:
     """Render the core's live state as Prometheus text exposition.
 
     Pure function of one :meth:`Telemetry.snapshot` (single lock
@@ -97,6 +98,12 @@ def render_prometheus(tel: Telemetry,
     - SLOs      -> ``<prefix>_slo_*{slo="endpoint:metric:pNN"}``
     - meta      -> ``<prefix>_up``, ``_telemetry_enabled``,
       ``_telemetry_dropped_events_total``, ``_uptime_seconds``
+
+    ``health`` (an optional ``ServeFleet.health`` callable, ISSUE 16)
+    adds the ``<prefix>_serving_ckpt_info`` label series — the info-
+    metric idiom (like ``run_info``): value 1, the serving checkpoint
+    identity in the ``ckpt_id`` label, so a scrape can alert on a
+    version change without parsing /healthz.
     """
     lines = []
 
@@ -117,6 +124,13 @@ def render_prometheus(tel: Telemetry,
                f'host_count="{tel.host_count}"}}')
     emit(f"{PREFIX}_run_info", "gauge", [(run_lab, 1)],
          "run_id + fleet coordinate of this process")
+    if health is not None:
+        hx = health() if callable(health) else dict(health)
+        ckpt_lab = (f'{{ckpt_id='
+                    f'"{_label_escape(hx.get("serving_ckpt_id") or "")}'
+                    f'"}}')
+        emit(f"{PREFIX}_serving_ckpt_info", "gauge", [(ckpt_lab, 1)],
+             "which params checkpoint the fleet currently serves")
     emit(f"{PREFIX}_telemetry_enabled", "gauge",
          [("", int(tel.enabled))],
          "1 when the telemetry core records events")
@@ -191,16 +205,24 @@ def health_payload(tel: Telemetry,
     source's block included as evidence. A healthy fleet mid-resize
     (``scaling`` in the health block — an elastic retire still
     draining, ISSUE 12) reports ``scaling`` instead of flapping
-    ok/degraded: an intentional topology change is not an incident."""
+    ok/degraded: an intentional topology change is not an incident.
+    Likewise a fleet mid-model-rollout (``rolling``, ISSUE 16) reports
+    ``rolling`` — which outranks ``scaling``, because the rollout
+    walk's own retire/rejoin churn would otherwise masquerade as an
+    autoscale — with the controller's evidence (from/to ckpt_id,
+    replicas swapped/total) in the fleet block."""
     degraded = slo is not None and not slo.healthy()
     extra = None
     scaling = False
+    rolling = False
     if health is not None:
         extra = health() if callable(health) else dict(health)
         degraded = degraded or not extra.get("healthy", True)
         scaling = bool(extra.get("scaling"))
+        rolling = bool(extra.get("rolling"))
     return {
         "status": ("degraded" if degraded
+                   else "rolling" if rolling
                    else "scaling" if scaling else "ok"),
         "telemetry_enabled": bool(tel.enabled),
         "dropped_events": tel.dropped,
@@ -261,7 +283,8 @@ class MetricsServer:
                 if path == "/metrics":
                     body = render_prometheus(
                         server._resolve_telemetry(),
-                        server.slo).encode()
+                        server.slo,
+                        health=server.health_source).encode()
                     self._send(200, "text/plain; version=0.0.4;"
                                     " charset=utf-8", body)
                 elif path == "/healthz":

@@ -1,0 +1,537 @@
+"""Zero-downtime model rollout: validated hot-swap, canary, rollback.
+
+ISSUE 16 tentpole. The fleet (serve/fleet.py) already has the elastic
+primitive — retire a replica, drain it, rejoin it warm — but no way to
+change WHAT a live replica serves, so until this PR a new checkpoint
+meant a cold fleet restart and a serving gap. This module is the
+TF-system "one runtime" pattern (PAPERS.md): serving follows training's
+checkpoint lineage through a four-phase state machine that keeps
+``/healthz`` in ok/rolling the whole way:
+
+1. **ADMIT** — the candidate is fully validated BEFORE any engine sees
+   it (``train/checkpoint.validate_checkpoint``: both files complete,
+   sidecar parses, shape manifest matches the fleet's compiled
+   geometry, every float leaf finite). A failed candidate is MOVED to
+   ``quarantine/`` with a one-line ``.reason.txt`` naming the file and
+   field, the ``ckpt_quarantined`` counter ticks, and the fleet keeps
+   serving the old params — a torn or NaN checkpoint can never take
+   traffic, and can never be re-admitted by the watcher (it left the
+   checkpoint dir).
+2. **CANARY** — one replica (a retired pre-warmed spare when the fleet
+   has headroom, else the highest live index retired for the duration)
+   swaps to the new params OFF-placement and must reproduce a seeded
+   offline reference burst — ``serve_requests`` on a fresh engine with
+   the same key/geometry — **bitwise** before it rejoins. The engine's
+   determinism contract (strokes are a pure function of params + key,
+   scheduling moves WHEN, never WHAT) is what makes bitwise the right
+   bar: any diff means the swap corrupted state.
+3. **WALK** — replica by replica: retire, wait for the drain-exit,
+   swap params in place (``ServeEngine.swap_params`` rebuilds the
+   chunk program — a compile — which is exactly why it only ever runs
+   on a retired, drained engine), re-prove the canary burst bitwise on
+   the swapped engine (doubling as the warm-up, so the rejoin never
+   compiles in the measured window), rejoin. Survivors keep draining
+   throughout; mixed-version serving stays honest because every Result
+   carries its producing engine's ``ckpt_id`` and the cache stores
+   under the producing version's namespace (serve/fleet.py). Retired
+   spares are walked too — a later autoscale rejoin must never
+   resurrect old params. The fleet's authoritative
+   ``serving_ckpt_id`` flips old→new only after the LAST swap (the
+   PROMOTE instant, recorded in the lineage).
+4. **ROLLBACK** — a canary mismatch, a swap failure (injected:
+   ``rollout.swap.rNN`` / ``rollout.canary`` fault sites), or a
+   post-swap SLO burn (``slo.healthy()`` false after a rejoin) reverses
+   the walk deterministically: every already-swapped replica swaps
+   back to the held old params through the same retire/drain/swap/
+   rejoin sequence, ``serving_ckpt_id`` never flips, and the
+   ``rollout_rollbacks`` counter + ``rollout_log`` record the reversal.
+   Post-rollback strokes are bitwise the pre-rollout fleet's — pinned
+   by tests/test_rollout.py and the resilience bench's rollout cell.
+
+``CheckpointWatcher`` (thread ``rollout-watcher``) is the continuous-
+training glue: it polls a checkpoint dir and rolls the fleet to each
+new complete step — ``cli serve-bench --watch_ckpt`` picks up each
+checkpoint the trainer writes, live. ``lineage()`` is the RUN.json
+contract: which ckpt_id served which admitted-uid window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sketch_rnn_tpu.train.checkpoint import (CheckpointValidationError,
+                                             _complete_steps, _paths,
+                                             ckpt_id_of,
+                                             validate_checkpoint)
+from sketch_rnn_tpu.utils.faults import fault_point
+from sketch_rnn_tpu.utils.telemetry import (
+    get_telemetry,
+    suppressed as telemetry_suppressed,
+)
+
+
+def _clones(requests: List[Any]) -> List[Any]:
+    """Fresh unscheduled copies of the canary burst: uids are assigned
+    per run (`serve_requests` numbers them 0..n-1), scheduling fields
+    cleared — the two runs being compared must differ in params ONLY."""
+    return [dataclasses.replace(r, uid=None, cls=None, queue_pos=None,
+                                enqueue_ts=None, attempt=0)
+            for r in requests]
+
+
+def _strokes_of(out: Dict[str, Any]) -> List[np.ndarray]:
+    return [r.strokes5 for r in
+            sorted(out["results"], key=lambda r: r.uid)]
+
+
+def _bitwise(a: List[np.ndarray], b: List[np.ndarray]) -> bool:
+    return (len(a) == len(b)
+            and all(x.shape == y.shape and x.dtype == y.dtype
+                    and np.array_equal(x, y) for x, y in zip(a, b)))
+
+
+class RolloutController:
+    """Drive one ServeFleet through validated checkpoint rollouts.
+
+    Construction registers the controller on the fleet
+    (``fleet._rollout``) so ``/healthz`` can report the rolling state
+    and ``fleet.close()`` joins an in-flight walk instead of orphaning
+    a half-swapped spare. One controller per fleet; ``roll_to`` is
+    serialized by an internal lock (the watcher thread and a manual
+    caller cannot interleave walks).
+
+    ``template_state`` is the shape manifest candidates are validated
+    against (any TrainState of the serving architecture —
+    ``make_train_state(model, hps, key)`` works; values are ignored).
+    ``canary_requests`` is the seeded burst every swap must reproduce
+    bitwise; keep it small (it runs twice per rollout plus once per
+    swapped replica) but representative (conditional models should
+    exercise z).
+    """
+
+    def __init__(self, fleet, model, hps, template_state,
+                 canary_requests: List[Any],
+                 quarantine_dir: Optional[str] = None,
+                 slo=None) -> None:
+        if not canary_requests:
+            raise ValueError("canary_requests must be non-empty: the "
+                             "canary gate cannot prove a swap with an "
+                             "empty burst")
+        self.fleet = fleet
+        self.model = model
+        self.hps = hps
+        self.template_state = template_state
+        self.canary_requests = list(canary_requests)
+        self.quarantine_dir = quarantine_dir
+        self.slo = slo
+        self.rollout_log: List[Dict[str, Any]] = []
+        self._walk_lock = threading.Lock()
+        self._watcher: Optional["CheckpointWatcher"] = None
+        # evidence is REPLACED wholesale (never mutated in place) so
+        # fleet.health() — which runs under the fleet lock — can read
+        # it without taking any controller lock (no lock-order edge)
+        self._evidence: Dict[str, Any] = {"active": False}
+        # lineage: which ckpt_id served which admitted-uid window
+        # (RUN.json contract); the open window has to_uid None
+        self._lineage: List[Dict[str, Any]] = [{
+            "ckpt_id": fleet.serving_ckpt_id,
+            "from_uid": 0, "to_uid": None}]
+        fleet._rollout = self
+
+    # -- evidence / reporting ----------------------------------------------
+
+    def evidence(self) -> Dict[str, Any]:
+        """The /healthz rollout block: {active, from, to, swapped,
+        total} while a walk is in flight. Lock-free by design (see
+        __init__) — callers get a consistent snapshot dict."""
+        return dict(self._evidence)
+
+    def lineage(self) -> List[Dict[str, Any]]:
+        """Checkpoint lineage for RUN.json: ordered serving windows
+        ``{ckpt_id, from_uid, to_uid}`` (the last window is open,
+        ``to_uid`` None). A request's stamped ckpt_id and its uid's
+        window agree for every request admitted OUTSIDE a walk; during
+        a walk the Result stamp is the finer-grained truth."""
+        return [dict(w) for w in self._lineage]
+
+    def _log(self, event: str, **kv: Any) -> Dict[str, Any]:
+        entry = {"event": event, **kv}
+        self.rollout_log.append(entry)
+        return entry
+
+    def _uid_watermark(self) -> int:
+        with self.fleet._lock:
+            return self.fleet._next_uid
+
+    # -- phase 1: admission gate -------------------------------------------
+
+    def admit(self, path: str):
+        """Validate one candidate; quarantine on failure.
+
+        Returns ``(state, scale_factor, meta)`` on success. On any
+        validation failure the candidate pair is MOVED to the
+        quarantine dir (sibling ``quarantine/`` of the checkpoint by
+        default) with a one-line ``.reason.txt``, ``ckpt_quarantined``
+        ticks, and the CheckpointValidationError re-raises — the
+        caller's fleet never touched the bytes."""
+        try:
+            # (the ckpt.load.corrupt fault site lives INSIDE
+            # validate_checkpoint, so training-resume restores share
+            # the same injected-corruption surface)
+            return validate_checkpoint(path, self.template_state)
+        except CheckpointValidationError as e:
+            self._quarantine(path, e.reason)
+            raise
+        except Exception as e:  # an injected ckpt.load.corrupt raise
+            reason = f"{type(e).__name__}: {e}"
+            self._quarantine(path, reason)
+            raise CheckpointValidationError(path, reason) from e
+
+    def _quarantine(self, path: str, reason: str) -> None:
+        base = path
+        for ext in (".msgpack", ".json"):
+            if base.endswith(ext):
+                base = base[:-len(ext)]
+        qdir = self.quarantine_dir or os.path.join(
+            os.path.dirname(base) or ".", "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        moved = []
+        for ext in (".msgpack", ".json"):
+            src = base + ext
+            if os.path.exists(src):
+                shutil.move(src, os.path.join(qdir,
+                                              os.path.basename(src)))
+                moved.append(os.path.basename(src))
+        line = (f"{os.path.basename(base)}: {reason}".splitlines()
+                or [reason])[0]
+        with open(os.path.join(
+                qdir, os.path.basename(base) + ".reason.txt"),
+                "w") as f:
+            f.write(line + "\n")
+        tel = get_telemetry()
+        if tel.enabled:
+            tel.counter("ckpt_quarantined", 1.0, cat="serve")
+        self._log("quarantine", candidate=os.path.basename(base),
+                  reason=line, moved=moved, quarantine_dir=qdir)
+
+    # -- the reference / canary bursts -------------------------------------
+
+    def _reference(self, params) -> List[np.ndarray]:
+        """The seeded offline reference: `serve_requests` on a FRESH
+        single engine at the fleet's exact serving geometry. Suppressed
+        telemetry — the burst's auto-uids must not collide with live
+        request traces."""
+        from sketch_rnn_tpu.serve.endpoints import serve_requests
+
+        with telemetry_suppressed():
+            out = serve_requests(
+                self.model, self.hps, params,
+                _clones(self.canary_requests),
+                slots=self.fleet.slots, chunk=self.fleet.chunk,
+                pool_pad=self.fleet.pool_cap)
+        return _strokes_of(out)
+
+    def _burst_on(self, replica: int) -> List[np.ndarray]:
+        """Run the canary burst on a retired replica's own engine (the
+        in-place path every serving burst takes, same pool geometry —
+        so a bitwise match here both PROVES the swap and WARMS the
+        rebuilt chunk program outside the measured window)."""
+        import jax
+
+        from sketch_rnn_tpu.serve.endpoints import serve_requests
+
+        rep = self.fleet._replicas[replica]
+        with telemetry_suppressed(), jax.default_device(rep.device):
+            out = serve_requests(
+                self.model, self.hps, rep.engine._full_params,
+                _clones(self.canary_requests),
+                pool_pad=self.fleet.pool_cap, engine=rep.engine)
+        return _strokes_of(out)
+
+    # -- phases 2+3+4: canary, walk, rollback ------------------------------
+
+    def roll_to(self, path: str) -> Dict[str, Any]:
+        """Upgrade the whole fleet to the checkpoint at ``path``.
+
+        Returns a report dict: ``{"ok": bool, "phase": ..., "from":
+        ..., "to": ..., "swapped": int, "rolled_back": bool, ...}``.
+        Never raises for a bad CANDIDATE (quarantine / rollback are the
+        handled outcomes); re-raises only non-Exception escapes (an
+        injected ``kind=exit`` SystemExit must keep crashing the
+        process — that is its contract)."""
+        with self._walk_lock:
+            return self._roll_to_locked(path)
+
+    def _roll_to_locked(self, path: str) -> Dict[str, Any]:
+        fleet = self.fleet
+        tel = get_telemetry()
+        old_id = fleet.serving_ckpt_id
+
+        # ---- ADMIT
+        try:
+            state, _scale, meta = self.admit(path)
+        except CheckpointValidationError as e:
+            return {"ok": False, "phase": "admit", "from": old_id,
+                    "to": None, "swapped": 0, "rolled_back": False,
+                    "reason": e.reason}
+        new_params = state.params
+        new_id = ckpt_id_of(int(meta.get("step", 0)))
+        if new_id == old_id:
+            return {"ok": True, "phase": "noop", "from": old_id,
+                    "to": new_id, "swapped": 0, "rolled_back": False,
+                    "reason": "already serving this checkpoint"}
+        self._log("admit_ok", ckpt_id=new_id, path=path)
+
+        # the held rollback image: every engine shares the same
+        # host-side params object, so any non-dead replica donates it
+        donors = [r for r in fleet._replicas if not r.dead]
+        if not donors:
+            return {"ok": False, "phase": "admit", "from": old_id,
+                    "to": new_id, "swapped": 0, "rolled_back": False,
+                    "reason": "no live replica to roll"}
+        old_params = donors[0].engine._full_params
+
+        # the walk set, captured once: live replicas old->new one at a
+        # time, retired spares too (an autoscale rejoin must never
+        # resurrect old params), dead ones never
+        live_idx = [r.idx for r in fleet._replicas
+                    if not r.dead and not r.retired]
+        spare_idx = [r.idx for r in fleet._replicas
+                     if not r.dead and r.retired]
+        pre_live = set(live_idx)  # the placement set rollback restores
+        total = len(live_idx) + len(spare_idx)
+        self._evidence = {"active": True, "from": old_id, "to": new_id,
+                          "swapped": 0, "total": total}
+
+        t0 = time.perf_counter()
+        swapped: List[int] = []  # replicas holding new params
+        try:
+            # ---- CANARY: prove the new params on one replica
+            # off-placement before ANY serving traffic sees them
+            reference = self._reference(new_params)
+            if spare_idx:
+                canary = spare_idx[0]
+            else:
+                canary = fleet.retire_replica(reason="rollout-canary")
+                live_idx.remove(canary)
+                if not fleet.wait_replica_drained(canary):
+                    raise RuntimeError(
+                        f"canary replica {canary} did not drain")
+            fault_point("rollout.canary")
+            fleet.swap_params_retired(canary, new_params,
+                                      ckpt_id=new_id)
+            swapped.append(canary)
+            got = self._burst_on(canary)
+            if not _bitwise(reference, got):
+                raise RuntimeError(
+                    f"canary replica {canary} failed the bitwise "
+                    f"reference burst for {new_id}")
+            self._log("canary_ok", replica=canary, ckpt_id=new_id,
+                      n_requests=len(self.canary_requests))
+
+            # ---- WALK: canary rejoins first (placement never shrinks
+            # below its pre-rollout size while an old replica retires),
+            # then each live replica, then the remaining spares
+            fleet.rejoin_replica(canary, reason="rollout")
+            self._bump_swapped(1)
+            if tel.enabled:
+                tel.counter("rollout_swaps", 1.0, cat="serve")
+            self._log("swap", replica=canary, ckpt_id=new_id,
+                      canary=True)
+            self._check_slo_burn(canary)
+
+            for idx in live_idx + spare_idx[1:]:
+                fault_point(f"rollout.swap.r{idx}")
+                is_spare = idx in spare_idx
+                if not is_spare:
+                    fleet.retire_replica(idx, reason="rollout")
+                if not fleet.wait_replica_drained(idx):
+                    raise RuntimeError(
+                        f"replica {idx} did not drain for its swap")
+                fleet.swap_params_retired(idx, new_params,
+                                          ckpt_id=new_id)
+                swapped.append(idx)
+                got = self._burst_on(idx)
+                if not _bitwise(reference, got):
+                    raise RuntimeError(
+                        f"replica {idx} failed the bitwise reference "
+                        f"burst for {new_id}")
+                if not is_spare:
+                    # spares stay retired (warm headroom at the NEW
+                    # version); live replicas rejoin where they were
+                    fleet.rejoin_replica(idx, reason="rollout")
+                self._bump_swapped(1)
+                if tel.enabled:
+                    tel.counter("rollout_swaps", 1.0, cat="serve")
+                self._log("swap", replica=idx, ckpt_id=new_id,
+                          canary=False)
+                if not is_spare:
+                    self._check_slo_burn(idx)
+        except Exception as e:  # noqa: BLE001 — SystemExit passes
+            self._rollback(swapped, pre_live, old_params, old_id,
+                           new_id, repr(e))
+            self._evidence = {"active": False}
+            if tel.enabled:
+                tel.counter("rollout_rollbacks", 1.0, cat="serve")
+            return {"ok": False, "phase": "rollback", "from": old_id,
+                    "to": new_id, "swapped": 0, "rolled_back": True,
+                    "reason": repr(e)}
+
+        # ---- PROMOTE: flip the authoritative serving version — new
+        # submissions now fingerprint (and future rollbacks anchor)
+        # under new_id; close the lineage window at the flip watermark
+        watermark = self._uid_watermark()
+        fleet.serving_ckpt_id = new_id
+        if self._lineage and self._lineage[-1]["to_uid"] is None:
+            self._lineage[-1]["to_uid"] = watermark - 1
+        self._lineage.append({"ckpt_id": new_id,
+                              "from_uid": watermark, "to_uid": None})
+        self._evidence = {"active": False}
+        self._log("promote", ckpt_id=new_id, swapped=total,
+                  wall_s=round(time.perf_counter() - t0, 3))
+        return {"ok": True, "phase": "promote", "from": old_id,
+                "to": new_id, "swapped": total, "rolled_back": False}
+
+    def _bump_swapped(self, n: int) -> None:
+        ev = dict(self._evidence)
+        ev["swapped"] = ev.get("swapped", 0) + n
+        self._evidence = ev
+
+    def _check_slo_burn(self, replica: int) -> None:
+        """Post-swap SLO gate: a rejoined replica that burns the error
+        budget reverses the walk (raises into the rollback handler)."""
+        if self.slo is not None and not self.slo.healthy():
+            raise RuntimeError(
+                f"SLO burn after swapping replica {replica}: "
+                f"{self.slo.summary()}")
+
+    def _rollback(self, swapped: List[int], pre_live: set,
+                  old_params, old_id: str, new_id: str,
+                  reason: str) -> None:
+        """Reverse the walk: every replica holding new params swaps
+        back through the same retire/drain/swap/warm sequence (LIFO —
+        the most recently swapped reverts first), then the pre-rollout
+        PLACEMENT set is restored (live replicas rejoin, borrowed
+        spares return to retirement). Best-effort per replica — one
+        stuck revert must not strand the rest at the new version.
+        Deterministic: the same failure point reverses the same
+        prefix."""
+        fleet = self.fleet
+        for idx in reversed(swapped):
+            rep = fleet._replicas[idx]
+            if rep.dead:
+                continue  # a dead replica serves nothing at any version
+            try:
+                if not rep.retired:
+                    fleet.retire_replica(idx, reason="rollback")
+                if not fleet.wait_replica_drained(idx):
+                    continue
+                fleet.swap_params_retired(idx, old_params,
+                                          ckpt_id=old_id)
+                self._burst_on(idx)  # re-warm the old program
+            except Exception as e:  # noqa: BLE001
+                self._log("rollback_skip", replica=idx, error=repr(e))
+        # restore the pre-rollout placement set (this also un-retires
+        # a canary that was retired from live but failed BEFORE its
+        # swap — it still holds old params and just rejoins)
+        for idx in sorted(pre_live):
+            rep = fleet._replicas[idx]
+            if not rep.dead and rep.retired:
+                try:
+                    fleet.rejoin_replica(idx, reason="rollback")
+                except RuntimeError as e:
+                    self._log("rollback_skip", replica=idx,
+                              error=repr(e))
+        self._log("rollback", from_ckpt=new_id, to_ckpt=old_id,
+                  replicas=list(reversed(swapped)), reason=reason)
+
+    # -- watcher / lifecycle -----------------------------------------------
+
+    def watch(self, ckpt_dir: str,
+              poll_s: float = 0.5) -> "CheckpointWatcher":
+        """Start the continuous-training follower: roll to each new
+        complete checkpoint step appearing in ``ckpt_dir``."""
+        if self._watcher is not None:
+            raise RuntimeError("already watching")
+        self._watcher = CheckpointWatcher(self, ckpt_dir,
+                                          poll_s=poll_s)
+        self._watcher.start()
+        return self._watcher
+
+    def join(self, timeout: float = 30.0) -> bool:
+        """Stop the watcher (if any) and wait out an in-flight walk.
+        Called by ``fleet.close()`` so a shutdown never orphans a
+        half-swapped spare. True iff the walk finished in time."""
+        if self._watcher is not None:
+            self._watcher.stop(timeout=timeout)
+            self._watcher = None
+        got = self._walk_lock.acquire(timeout=timeout)
+        if got:
+            self._walk_lock.release()
+        return got
+
+
+class CheckpointWatcher:
+    """Poll a checkpoint dir; roll the fleet to each new complete step.
+
+    The thread is named ``rollout-watcher`` (the conftest thread guard
+    whitelists the ``rollout-`` prefix). Steps at or below the high-
+    water mark at start are considered already served — only NEW
+    checkpoints trigger a walk. A quarantined candidate disappears from
+    the dir (admit() moved it), so it can never retrigger."""
+
+    def __init__(self, controller: RolloutController, ckpt_dir: str,
+                 poll_s: float = 0.5) -> None:
+        self.controller = controller
+        self.ckpt_dir = ckpt_dir
+        self.poll_s = float(poll_s)
+        self.reports: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="rollout-watcher",
+                                        daemon=True)
+        steps = _complete_steps(ckpt_dir) \
+            if os.path.isdir(ckpt_dir) else []
+        self._seen = max(steps) if steps else -1
+
+    def start(self) -> "CheckpointWatcher":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
+    def poll_once(self) -> Optional[Dict[str, Any]]:
+        """One poll step (also the test seam): roll to the next unseen
+        complete step, oldest first, or None if nothing new."""
+        steps = sorted(s for s in _complete_steps(self.ckpt_dir)
+                       if s > self._seen) \
+            if os.path.isdir(self.ckpt_dir) else []
+        if not steps:
+            return None
+        step = steps[0]
+        self._seen = step
+        data_path, _ = _paths(self.ckpt_dir, step)
+        report = self.controller.roll_to(data_path)
+        self.reports.append(report)
+        return report
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001
+                # a poll crash must not kill the follower; the next
+                # checkpoint gets a fresh attempt (roll_to itself
+                # already converts candidate failures into reports)
+                pass
+            self._stop.wait(self.poll_s)

@@ -17,6 +17,18 @@ Versioning: ``format_version`` in the sidecar (VERDICT r4 #8). Sidecars
 without the field are version 1 (every pre-versioning checkpoint,
 e.g. the committed demo). Restore fails LOUDLY on a future version or
 a corrupt/truncated msgpack instead of half-restoring.
+
+Validation (ISSUE 16): :func:`validate_checkpoint` is the public
+candidate ADMISSION GATE the rollout controller (serve/rollout.py) and
+restore-time loading share — complete file pair, readable sidecar,
+known format version, decodable msgpack, a leaf-by-leaf shape manifest
+against the caller's template pytree, and finite parameter leaves.
+Every rejection is ONE :class:`CheckpointValidationError` line naming
+the file and the first offending field (``dec/h0/kernel: shape (4, 8)
+!= template (8, 8)``), never a mid-restore traceback — the line a
+quarantine entry, an operator and a test can all read. ``ckpt_id_of``
+mints the checkpoint identity (``ckpt_00000042``) that stamps serving
+Results, cache namespaces and RUN.json lineage.
 """
 
 from __future__ import annotations
@@ -27,6 +39,7 @@ import re
 from typing import Any, Optional, Tuple
 
 import jax
+import numpy as np
 from flax import serialization
 
 from sketch_rnn_tpu.config import HParams
@@ -44,6 +57,152 @@ FORMAT_VERSION = 1
 def _paths(ckpt_dir: str, step: int) -> Tuple[str, str]:
     base = os.path.join(ckpt_dir, f"ckpt_{step:08d}")
     return base + ".msgpack", base + ".json"
+
+
+def ckpt_id_of(step: int) -> str:
+    """The checkpoint's serving identity: the file basename without
+    extension (``ckpt_00000042``). ONE minting site — the rollout
+    controller, the result cache's version namespace, Result stamping
+    and RUN.json lineage must all agree on what a checkpoint is
+    called, and the name that already keys resume is the honest one."""
+    return f"ckpt_{int(step):08d}"
+
+
+class CheckpointValidationError(RuntimeError):
+    """A candidate checkpoint failed the admission gate. The message is
+    ONE line naming the file and the first offending field; ``path``
+    and ``reason`` carry the same split for quarantine records."""
+
+    def __init__(self, path: str, reason: str):
+        self.path = path
+        self.reason = reason
+        super().__init__(
+            f"cannot restore checkpoint {path}: {reason}")
+
+
+def _base_of(path: str) -> str:
+    """Strip a ``.msgpack``/``.json``(+``.tmp``) extension so callers
+    may name the candidate by either file of the pair."""
+    for ext in (".msgpack.tmp", ".json.tmp", ".msgpack", ".json"):
+        if path.endswith(ext):
+            return path[:-len(ext)]
+    return path
+
+
+def _manifest_mismatch(tmpl, got, prefix: str = "") -> Optional[str]:
+    """First structural difference between two flax state dicts, as a
+    one-line description naming the field path, or None when the shape
+    manifests agree. Walks template order so the failure is stable."""
+    if isinstance(tmpl, dict) or isinstance(got, dict):
+        if not (isinstance(tmpl, dict) and isinstance(got, dict)):
+            return (f"field {prefix or '<root>'} is "
+                    f"{type(got).__name__}, template expects "
+                    f"{type(tmpl).__name__}")
+        missing = [k for k in tmpl if k not in got]
+        if missing:
+            return f"field {prefix}{missing[0]} missing from checkpoint"
+        extra = [k for k in got if k not in tmpl]
+        if extra:
+            return f"field {prefix}{extra[0]} not in template"
+        for k in tmpl:
+            r = _manifest_mismatch(tmpl[k], got[k], f"{prefix}{k}/")
+            if r:
+                return r
+        return None
+    ts, gs = np.shape(tmpl), np.shape(got)
+    if ts != gs:
+        return (f"field {prefix.rstrip('/') or '<root>'} has shape "
+                f"{gs}, template expects {ts}")
+    return None
+
+
+def _first_nonfinite(sd, prefix: str = "") -> Optional[str]:
+    """First float leaf holding a NaN/Inf, by field path, or None."""
+    if isinstance(sd, dict):
+        for k in sd:
+            r = _first_nonfinite(sd[k], f"{prefix}{k}/")
+            if r:
+                return r
+        return None
+    a = np.asarray(sd)
+    if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+        bad = int(a.size - np.isfinite(a).sum())
+        return (f"field {prefix.rstrip('/') or '<root>'} has {bad} "
+                f"non-finite value(s)")
+    return None
+
+
+def validate_checkpoint(path: str, target: TrainState,
+                        check_finite: bool = True
+                        ) -> Tuple[TrainState, float, dict]:
+    """THE candidate admission gate (ISSUE 16): fully validate the
+    checkpoint at ``path`` (either file of the pair names it) against
+    ``target``'s pytree and return ``(state, scale_factor, meta)``.
+
+    Checks, in order — each failing as ONE
+    :class:`CheckpointValidationError` line naming the file and field:
+    both files of the pair exist (a torn save is incomplete, not
+    corrupt), the sidecar parses and carries ``scale_factor``, the
+    format version is known, the msgpack decodes
+    (``ckpt.load.corrupt`` fault site — the injectable disk-damage
+    arm), the shape manifest matches the template leaf-by-leaf, and
+    (``check_finite``) every float leaf is finite — a NaN'd candidate
+    must be quarantined at the gate, never hot-swapped into a serving
+    replica. Shared by :func:`restore_checkpoint` and the rollout
+    controller so training resume and serving admission can never
+    disagree about what a loadable checkpoint is."""
+    base = _base_of(path)
+    data_path, meta_path = base + ".msgpack", base + ".json"
+    if not os.path.exists(data_path):
+        raise CheckpointValidationError(
+            data_path, "msgpack missing (incomplete/torn save)")
+    if not os.path.exists(meta_path):
+        raise CheckpointValidationError(
+            meta_path, "sidecar missing (incomplete/torn save)")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except ValueError as e:
+        raise CheckpointValidationError(
+            meta_path, f"sidecar is not valid JSON ({e})") from e
+    if not isinstance(meta, dict) or "scale_factor" not in meta:
+        raise CheckpointValidationError(
+            meta_path, "sidecar field scale_factor missing")
+    version = meta.get("format_version", 1)  # pre-versioning sidecars
+    if version > FORMAT_VERSION:
+        raise CheckpointValidationError(
+            meta_path,
+            f"format_version={version} is newer than this build's "
+            f"{FORMAT_VERSION}; refusing to guess at the layout")
+    with open(data_path, "rb") as f:
+        raw = f.read()
+    try:
+        # the injectable disk-damage arm: an armed ckpt.load.corrupt
+        # plan surfaces exactly like a real torn/garbled msgpack
+        fault_point("ckpt.load.corrupt")
+        restored_sd = serialization.msgpack_restore(raw)
+    except Exception as e:  # noqa: BLE001 — classified into ONE line
+        raise CheckpointValidationError(
+            data_path,
+            f"msgpack corrupt or truncated ({len(raw)} bytes: "
+            f"{type(e).__name__}: {e})") from e
+    bad = _manifest_mismatch(serialization.to_state_dict(target),
+                             restored_sd)
+    if bad:
+        raise CheckpointValidationError(
+            data_path,
+            f"{bad} — the checkpoint was saved from different hparams "
+            f"than the template (compare its .json sidecar)")
+    if check_finite:
+        bad = _first_nonfinite(restored_sd.get("params", restored_sd))
+        if bad:
+            raise CheckpointValidationError(data_path, bad)
+    try:
+        state = serialization.from_state_dict(target, restored_sd)
+    except Exception as e:  # noqa: BLE001
+        raise CheckpointValidationError(
+            data_path, f"{type(e).__name__}: {e}") from e
+    return state, float(meta["scale_factor"]), meta
 
 
 def save_checkpoint(ckpt_dir: str, state: TrainState, scale_factor: float,
@@ -138,38 +297,18 @@ def restore_checkpoint(ckpt_dir: str, target: TrainState,
                        step: Optional[int] = None
                        ) -> Tuple[TrainState, float, dict]:
     """Restore ``(state, scale_factor, meta)``; ``target`` fixes the pytree
-    structure (build it with ``make_train_state`` from the same hparams)."""
+    structure (build it with ``make_train_state`` from the same hparams).
+
+    Loads through :func:`validate_checkpoint` (ISSUE 16), so a corrupt
+    msgpack, a future format version or a template built from different
+    hparams all fail as ONE line naming the file and the first
+    offending field instead of a mid-restore traceback."""
     if step is None:
         step = latest_checkpoint(ckpt_dir)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {ckpt_dir}")
-    data_path, meta_path = _paths(ckpt_dir, step)
-    with open(meta_path) as f:
-        meta = json.load(f)
-    version = meta.get("format_version", 1)  # pre-versioning sidecars
-    if version > FORMAT_VERSION:
-        raise RuntimeError(
-            f"{meta_path} has checkpoint format_version={version}, newer "
-            f"than this build's {FORMAT_VERSION}; refusing to guess at "
-            f"the layout — restore with a matching or newer build")
-    with open(data_path, "rb") as f:
-        raw = f.read()
-    try:
-        state = serialization.from_bytes(target, raw)
-    except Exception as e:
-        # Two distinct failures surface here and the message must not
-        # send the user down the wrong path: a truncated/corrupt msgpack
-        # (torn write outside the atomic rename, disk damage) vs a
-        # pytree-structure mismatch (restoring with different hparams —
-        # a config error, not corruption). flax reports the latter as a
-        # ValueError naming the differing structure.
-        raise RuntimeError(
-            f"cannot restore checkpoint {data_path} ({len(raw)} bytes): "
-            f"{type(e).__name__}: {e} — either the file is corrupt or "
-            f"truncated, or `target` was built from different hparams "
-            f"than the checkpoint's (compare with its .json sidecar)"
-        ) from e
-    return state, float(meta["scale_factor"]), meta
+    data_path, _ = _paths(ckpt_dir, step)
+    return validate_checkpoint(data_path, target)
 
 
 _ANY_CKPT_RE = re.compile(r"^ckpt_(\d+)\.(?:msgpack|json)(?:\.tmp)?$")

@@ -24,9 +24,15 @@ failure *injectable, reproducible and accounted*:
   (``train.step``), an elastic host's step-barrier entry
   (``host.kill.hNN``, train/elastic.py — ``kind=exit`` is an honest
   host DEATH: the heartbeat stops beating and every surviving peer's
-  barrier detects it), and the fleet barrier exchange itself
+  barrier detects it), the fleet barrier exchange itself
   (``dcn.collective``, parallel/multihost.py — the DCN-collective
-  failure class). Sites cost one module-global read when no plan is
+  failure class), and the zero-downtime rollout path (ISSUE 16,
+  serve/rollout.py + train/checkpoint.py): a candidate checkpoint's
+  msgpack decode (``ckpt.load.corrupt`` — fires inside
+  ``validate_checkpoint``, so serving admission AND training resume
+  share the injected-corruption surface), the canary gate
+  (``rollout.canary``) and each replica's swap step in the rolling
+  walk (``rollout.swap.rNN``). Sites cost one module-global read when no plan is
   armed — the process default — so the chaos layer is invisible in
   production runs (the telemetry off-by-default discipline).
 

@@ -478,3 +478,44 @@ def test_healthz_reports_scaling_during_resize_not_degraded():
     assert health_payload(tel, None, lambda: mid)["status"] == "scaling"
     # degradation outranks an in-flight resize
     assert health_payload(tel, None, lambda: bad)["status"] == "degraded"
+
+
+def test_healthz_reports_rolling_during_rollout():
+    """ISSUE 16 satellite: an in-flight model rollout reports
+    `rolling` — which outranks `scaling` (the walk's own retire/rejoin
+    churn must not masquerade as an autoscale) but never degradation —
+    with the controller's evidence in the fleet block."""
+    tel = tele.get_telemetry()
+    ev = {"active": True, "from": "ckpt_00000010",
+          "to": "ckpt_00000020", "swapped": 1, "total": 2}
+    roll = {"healthy": True, "scaling": True, "rolling": True,
+            "rollout": ev, "serving_ckpt_id": "ckpt_00000010"}
+    bad = dict(roll, healthy=False)
+    body = health_payload(tel, None, lambda: roll)
+    assert body["status"] == "rolling"
+    assert body["fleet"]["rollout"] == ev
+    assert health_payload(tel, None, lambda: bad)["status"] == "degraded"
+
+
+def test_render_prometheus_rollout_series():
+    """ISSUE 16 satellite pins: the rollout counters render through
+    the generic counter path, and the health source adds the
+    serving_ckpt_info label series (the run_info idiom)."""
+    tel = tele.configure(trace_dir=None)
+    try:
+        tel.counter("rollout_swaps", 3, cat="serve")
+        tel.counter("rollout_rollbacks", 1, cat="serve")
+        tel.counter("ckpt_quarantined", 2, cat="serve")
+        health = {"healthy": True, "serving_ckpt_id": "ckpt_00000020"}
+        text = render_prometheus(tel, None, health=lambda: health)
+        s = _series(text)
+        assert s["sketch_rnn_serve_rollout_swaps_total"] == 3
+        assert s["sketch_rnn_serve_rollout_rollbacks_total"] == 1
+        assert s["sketch_rnn_serve_ckpt_quarantined_total"] == 2
+        assert s['sketch_rnn_serving_ckpt_info'
+                 '{ckpt_id="ckpt_00000020"}'] == 1
+        # without a health source the info series is absent (the
+        # single-engine serve-bench path is unchanged)
+        assert "serving_ckpt_info" not in render_prometheus(tel, None)
+    finally:
+        tele.disable()

@@ -43,6 +43,20 @@ def test_resilience_smoke(tmp_path):
     assert by_site["metrics.row"]["fault_site_in_evidence"] is True
     assert by_site["fleet.worker"]["outcome"] == "degraded"
     assert by_site["fleet.worker"]["strokes_bitwise_equal"] is True
+    # the ISSUE 16 rollout cell: three arms, each a bitwise proof —
+    # promote under a killed replica, canary rejection rolled back,
+    # corrupt candidate quarantined
+    ro = by_site["rollout"]
+    assert ro["outcome"] == "recovered" and ro["ok"] is True
+    by_arm = {a["site"]: a for a in ro["arms"]}
+    assert by_arm["rollout.swap"]["outcome"] == "promoted"
+    assert by_arm["rollout.swap"]["post_swap_bitwise_cold_fleet"] is True
+    assert by_arm["rollout.swap"]["healthz_degraded"] is True
+    assert by_arm["rollout.canary"]["outcome"] == "rolled-back"
+    assert by_arm["rollout.canary"]["post_rollback_bitwise"] is True
+    assert by_arm["ckpt.load.corrupt"]["outcome"] == "quarantined"
+    assert by_arm["ckpt.load.corrupt"]["candidate_quarantined"] is True
+    assert by_arm["ckpt.load.corrupt"]["fleet_kept_old_bitwise"] is True
     # the ISSUE 14 elastic chaos cell: two real subprocess hosts, one
     # hard-killed mid-run; the survivor recovers bitwise at the new
     # topology with ZERO device steps re-executed (the consistent
@@ -80,6 +94,32 @@ def test_bench_summary_keys_resilience_per_site_and_mode():
     hk = _row(True, site="host.kill", mode="elastic")
     assert key_of(hk) not in {key_of(a), key_of(b)}
     assert metric_of(hk) == 1.0
+
+
+def _roll_row(ok, site="rollout.swap"):
+    return {"kind": "rollout", "site": site, "device_kind": "cpu",
+            "smoke": True, "ok": ok, "expected": "promoted",
+            "outcome": "promoted" if ok else "FAILED"}
+
+
+def test_rollout_rows_key_and_gate_like_binary_kinds(tmp_path, capsys):
+    """ISSUE 16 satellite (CI wiring): kind=rollout rows are a binary
+    kind — keyed per fault site, metric 1.0/0.0 from ok, and a future
+    ok=false row gates via bench_regress with no new plumbing."""
+    a = _roll_row(True)
+    assert key_of(a) == key_of(_roll_row(False))
+    assert key_of(a) != key_of(_roll_row(True, site="rollout.canary"))
+    assert key_of(a) != key_of(_row(True))     # never pools with
+    assert metric_of(a) == 1.0                 # resilience cells
+    assert metric_of(_roll_row(False)) == 0.0
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text("".join(json.dumps(_roll_row(True)) + "\n"
+                            for _ in range(4)))
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(_roll_row(False)) + "\n")
+    assert bench_regress.main([f"--fresh={bad}",
+                               f"--history={hist}"]) == 1
+    assert "REGRESS" in capsys.readouterr().out
 
 
 def test_bench_regress_gates_broken_host_kill_cell(tmp_path, capsys):

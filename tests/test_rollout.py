@@ -1,0 +1,508 @@
+"""Zero-downtime rollout: admission gate, canary, walk, rollback.
+
+ISSUE 16. The contract under test: a live fleet upgrades to a new
+checkpoint replica-by-replica with /healthz never leaving ok/rolling,
+every Result is bitwise ONE version (the one its ``ckpt_id`` stamp
+names), the cache never serves a v1 hit for a v2 request, a bad
+candidate is quarantined without touching the serving params, and any
+mid-walk failure rolls the fleet back bitwise to the pre-rollout
+fleet. Bitwise means bitwise: references come from ``serve_requests``
+(the offline canonical path) at the fleet's pool geometry.
+"""
+
+import dataclasses
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.cli import main
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.serve import Request, ServeFleet
+from sketch_rnn_tpu.serve.cache import ResultCache
+from sketch_rnn_tpu.serve.endpoints import serve_requests
+from sketch_rnn_tpu.serve.metrics_http import health_payload
+from sketch_rnn_tpu.serve.rollout import (CheckpointWatcher,
+                                          RolloutController)
+from sketch_rnn_tpu.train.checkpoint import (CheckpointValidationError,
+                                             ckpt_id_of,
+                                             save_checkpoint,
+                                             validate_checkpoint)
+from sketch_rnn_tpu.train.state import make_train_state
+from sketch_rnn_tpu.utils import faults
+from sketch_rnn_tpu.utils.telemetry import get_telemetry
+
+TINY = dict(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+            dec_rnn_size=16, z_size=6, num_mixture=3, hyper_rnn_size=8,
+            hyper_embed_size=4, serve_slots=2, serve_chunk=2)
+
+OLD, NEW = ckpt_id_of(10), ckpt_id_of(20)
+
+
+@pytest.fixture(scope="module")
+def env():
+    hps = HParams(**TINY)
+    model = SketchRNN(hps)
+    state_old = make_train_state(model, hps, jax.random.key(0))._replace(
+        step=jnp.asarray(10, jnp.int32))
+    state_new = make_train_state(model, hps, jax.random.key(7))._replace(
+        step=jnp.asarray(20, jnp.int32))
+    return dict(hps=hps, model=model, state_old=state_old,
+                state_new=state_new)
+
+
+def _req(i, z_dim, cap=6):
+    rng = np.random.default_rng(i)
+    return Request(key=jax.random.key(1000 + i),
+                   z=rng.standard_normal(z_dim).astype(np.float32),
+                   temperature=0.8, max_len=cap)
+
+
+def _reqs(env, uids, cap=6):
+    return [dataclasses.replace(_req(i, env["hps"].z_size, cap), uid=i)
+            for i in uids]
+
+
+def _canary(env):
+    return [_req(900 + i, env["hps"].z_size, cap=4) for i in range(3)]
+
+
+def _ckpts(env, tmp_path):
+    """Write both checkpoints into a fresh dir; return (dir, p_new)."""
+    d = str(tmp_path / "ckpts")
+    os.makedirs(d, exist_ok=True)
+    save_checkpoint(d, env["state_old"], 1.0, env["hps"])
+    p_new = save_checkpoint(d, env["state_new"], 1.0, env["hps"])
+    return d, p_new
+
+
+def _fleet(env, replicas=2, **kw):
+    fleet = ServeFleet(env["model"], env["hps"],
+                       env["state_old"].params, replicas=replicas,
+                       ckpt_id=OLD, **kw)
+    fleet.warm(_req(0, env["hps"].z_size))
+    fleet.start()
+    return fleet
+
+
+def _reference(env, params, uids, pool_pad):
+    uids = list(uids)
+    # pad is strokes-invariant (the invariance suite pins it) but must
+    # cover the burst
+    out = serve_requests(env["model"], env["hps"], params,
+                         _reqs(env, uids), slots=env["hps"].serve_slots,
+                         chunk=env["hps"].serve_chunk,
+                         pool_pad=max(pool_pad, len(uids)))
+    return {r.uid: r.strokes5 for r in out["results"]}
+
+
+# ---------------------------------------------------------------- admit
+
+
+def test_validate_checkpoint_rejects_bad_candidates(env, tmp_path):
+    """The admission gate's one-line reasons: torn file, missing
+    sidecar, non-finite leaf, shape mismatch — each a
+    CheckpointValidationError, none a partial restore."""
+    d, p_new = _ckpts(env, tmp_path)
+    tmpl = env["state_old"]
+    # the good path round-trips
+    state, scale, meta = validate_checkpoint(p_new, tmpl)
+    assert int(state.step) == 20 and scale == 1.0
+    assert int(meta["step"]) == 20
+
+    # torn payload
+    torn = str(tmp_path / "torn.msgpack")
+    with open(p_new, "rb") as f:
+        blob = f.read()
+    with open(torn, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    with open(str(tmp_path / "torn.json"), "w") as f:
+        with open(p_new[: -len(".msgpack")] + ".json") as g:
+            f.write(g.read())
+    with pytest.raises(CheckpointValidationError, match="cannot restore"):
+        validate_checkpoint(torn, tmpl)
+
+    # missing sidecar
+    lone = str(tmp_path / "lone.msgpack")
+    with open(lone, "wb") as f:
+        f.write(blob)
+    with pytest.raises(CheckpointValidationError, match="sidecar"):
+        validate_checkpoint(lone, tmpl)
+
+    # non-finite leaf
+    bad = tmpl._replace(params=jax.tree_util.tree_map(
+        lambda x: jnp.full_like(x, jnp.nan), tmpl.params))
+    nan_dir = str(tmp_path / "nan")
+    p_nan = save_checkpoint(nan_dir, bad, 1.0, env["hps"])
+    with pytest.raises(CheckpointValidationError, match="finite"):
+        validate_checkpoint(p_nan, tmpl)
+    # ...but the gate is optional for trusted callers
+    validate_checkpoint(p_nan, tmpl, check_finite=False)
+
+    # shape mismatch vs the compiled geometry
+    other_hps = HParams(**{**TINY, "dec_rnn_size": 20})
+    other = SketchRNN(other_hps)
+    wrong = make_train_state(other, other_hps, jax.random.key(1))
+    with pytest.raises(CheckpointValidationError):
+        validate_checkpoint(p_new, wrong)
+
+
+def test_corrupt_candidate_quarantined_fleet_unharmed(env, tmp_path):
+    """ckpt.load.corrupt at admit: the candidate is MOVED to
+    quarantine/ with a one-line reason, the walk never starts, and the
+    fleet keeps serving the old version bitwise."""
+    d, p_new = _ckpts(env, tmp_path)
+    fleet = _fleet(env)
+    try:
+        ref_old = _reference(env, env["state_old"].params, range(4),
+                             fleet.pool_cap)
+        ctl = RolloutController(fleet, env["model"], env["hps"],
+                                env["state_old"], _canary(env))
+        faults.configure("ckpt.load.corrupt@0", seed=0)
+        try:
+            rpt = ctl.roll_to(p_new)
+        finally:
+            faults.disable()
+        assert not rpt["ok"] and rpt["phase"] == "admit"
+        assert not rpt.get("rolled_back")
+        # candidate moved out of the ckpt dir -> can never retrigger
+        qdir = os.path.join(d, "quarantine")
+        assert not os.path.exists(p_new)
+        names = sorted(os.listdir(qdir))
+        assert any(n.endswith(".msgpack") for n in names)
+        assert any(n.endswith(".json") for n in names)
+        reason = [n for n in names if n.endswith(".reason.txt")]
+        assert len(reason) == 1
+        with open(os.path.join(qdir, reason[0])) as f:
+            body = f.read().strip()
+        assert body and "\n" not in body
+        # lineage untouched, fleet still serves the old version bitwise
+        assert fleet.serving_ckpt_id == OLD
+        assert ctl.lineage()[-1]["ckpt_id"] == OLD
+        for r in _reqs(env, range(4)):
+            fleet.submit(r)
+        assert fleet.drain(timeout=120)
+        for uid in range(4):
+            res = fleet.results[uid]["result"]
+            np.testing.assert_array_equal(res.strokes5, ref_old[uid])
+            assert res.ckpt_id == OLD
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------- the walk
+
+
+def test_rollout_promote_bitwise_with_spare(env, tmp_path):
+    """The happy path: canary on the retired spare, rolling walk over
+    the live replicas, promote. Post-swap strokes are bitwise the
+    offline reference on the NEW params, every Result is stamped with
+    the version that computed it, lineage closes the old window at the
+    promote watermark, and /healthz never reports degraded."""
+    d, p_new = _ckpts(env, tmp_path)
+    fleet = _fleet(env, replicas=2, max_replicas=3)
+    try:
+        ctl = RolloutController(fleet, env["model"], env["hps"],
+                                env["state_old"], _canary(env))
+        for r in _reqs(env, range(4)):
+            fleet.submit(r)
+        statuses = set()
+        stop = threading.Event()
+
+        def _poll():
+            while not stop.is_set():
+                statuses.add(health_payload(
+                    get_telemetry(), None, fleet.health)["status"])
+                time.sleep(0.01)
+
+        poller = threading.Thread(target=_poll, name="rollout-poller",
+                                  daemon=True)
+        poller.start()
+        try:
+            rpt = ctl.roll_to(p_new)
+        finally:
+            stop.set()
+            poller.join(timeout=10)
+        assert rpt["ok"] and rpt["phase"] == "promote"
+        assert rpt["from"] == OLD and rpt["to"] == NEW
+        assert rpt["swapped"] == 3  # 2 live + the spare
+        assert statuses <= {"ok", "rolling"}, statuses
+        assert fleet.serving_ckpt_id == NEW
+        events = [e["event"] for e in ctl.rollout_log]
+        assert events[0] == "admit_ok" and events[-1] == "promote"
+        assert "canary_ok" in events and events.count("swap") == 3
+
+        for r in _reqs(env, range(4, 10)):
+            fleet.submit(r)
+        assert fleet.drain(timeout=120)
+        h = fleet.health()
+        assert h["healthy"] and not h["rolling"]
+        assert h["serving_ckpt_id"] == NEW
+        ref_new = _reference(env, env["state_new"].params,
+                             range(4, 10), fleet.pool_cap)
+        for uid in range(4, 10):
+            res = fleet.results[uid]["result"]
+            np.testing.assert_array_equal(res.strokes5, ref_new[uid])
+            assert res.ckpt_id == NEW
+        # lineage: old window closed at the promote watermark, new
+        # window open-ended
+        lin = ctl.lineage()
+        assert [w["ckpt_id"] for w in lin] == [OLD, NEW]
+        assert lin[0]["from_uid"] == 0 and lin[0]["to_uid"] is not None
+        assert lin[1]["from_uid"] == lin[0]["to_uid"] + 1
+        assert lin[1]["to_uid"] is None
+    finally:
+        fleet.close()
+
+
+def test_mixed_version_results_are_never_blended(env, tmp_path):
+    """Traffic in flight DURING the walk: every Result's strokes are
+    bitwise the version its ckpt_id stamp names — never a blend, never
+    a stamp that disagrees with the bits."""
+    d, p_new = _ckpts(env, tmp_path)
+    fleet = _fleet(env)
+    try:
+        ctl = RolloutController(fleet, env["model"], env["hps"],
+                                env["state_old"], _canary(env))
+        uids = list(range(12))
+        ref_old = _reference(env, env["state_old"].params, uids,
+                             fleet.pool_cap)
+        ref_new = _reference(env, env["state_new"].params, uids,
+                             fleet.pool_cap)
+        for r in _reqs(env, range(4)):
+            fleet.submit(r)
+        rpt_box = {}
+
+        def _roll():
+            rpt_box["rpt"] = ctl.roll_to(p_new)
+
+        roller = threading.Thread(target=_roll, name="rollout-test",
+                                  daemon=True)
+        roller.start()
+        for r in _reqs(env, range(4, 12)):
+            fleet.submit(r)
+            time.sleep(0.02)
+        roller.join(timeout=300)
+        assert not roller.is_alive()
+        assert rpt_box["rpt"]["ok"], rpt_box["rpt"]
+        assert fleet.drain(timeout=120)
+        for uid in uids:
+            res = fleet.results[uid]["result"]
+            assert res.ckpt_id in (OLD, NEW), res.ckpt_id
+            want = ref_old if res.ckpt_id == OLD else ref_new
+            np.testing.assert_array_equal(res.strokes5, want[uid])
+    finally:
+        fleet.close()
+
+
+def test_cache_respects_version_namespace(env, tmp_path):
+    """A v1 hit can never serve a v2 request: same request content
+    across a rollout recomputes under the new version instead of
+    serving the stale entry, and entries carry their producing
+    version."""
+    cache = ResultCache(config_hash="h", ckpt_id=OLD)
+    probe = _req(0, env["hps"].z_size)
+    assert cache.fingerprint(probe, ckpt_id="v1") != \
+        cache.fingerprint(probe, ckpt_id="v2")
+
+    d, p_new = _ckpts(env, tmp_path)
+    fleet = _fleet(env, cache=ResultCache(config_hash="h", ckpt_id=OLD))
+    try:
+        base = _req(5, env["hps"].z_size)
+        fleet.submit(dataclasses.replace(base, uid=0))
+        assert fleet.drain(timeout=120)
+        # identical content -> a hit under the old version
+        fleet.submit(dataclasses.replace(base, uid=1))
+        assert fleet.drain(timeout=120)
+        st = fleet.cache.stats()
+        assert st["hits"] == 1 and st["misses"] == 1
+
+        ctl = RolloutController(fleet, env["model"], env["hps"],
+                                env["state_old"], _canary(env))
+        rpt = ctl.roll_to(p_new)
+        assert rpt["ok"], rpt
+        # identical content again -> MISS (new namespace), new bits
+        fleet.submit(dataclasses.replace(base, uid=2))
+        assert fleet.drain(timeout=120)
+        st = fleet.cache.stats()
+        assert st["misses"] == 2 and st["hits"] == 1
+        r_old = fleet.results[0]["result"]
+        r_new = fleet.results[2]["result"]
+        assert r_old.ckpt_id == OLD and r_new.ckpt_id == NEW
+        assert not np.array_equal(r_old.strokes5, r_new.strokes5)
+        # and the new entry hits for the next v2 request
+        fleet.submit(dataclasses.replace(base, uid=3))
+        assert fleet.drain(timeout=120)
+        assert fleet.cache.stats()["hits"] == 2
+        res = fleet.results[3]["result"]
+        assert res.ckpt_id == NEW
+        np.testing.assert_array_equal(res.strokes5, r_new.strokes5)
+    finally:
+        fleet.close()
+
+
+# ----------------------------------------------------------- rollback
+
+
+def test_canary_failure_rolls_back_bitwise(env, tmp_path):
+    """A canary that fails never touches the serving set: rollback is
+    recorded, the fleet's strokes stay bitwise the pre-rollout fleet,
+    and the stamps stay at the old version."""
+    d, p_new = _ckpts(env, tmp_path)
+    fleet = _fleet(env)
+    try:
+        ref_old = _reference(env, env["state_old"].params, range(8),
+                             fleet.pool_cap)
+        ctl = RolloutController(fleet, env["model"], env["hps"],
+                                env["state_old"], _canary(env))
+        for r in _reqs(env, range(4)):
+            fleet.submit(r)
+        faults.configure("rollout.canary@0", seed=0)
+        try:
+            rpt = ctl.roll_to(p_new)
+        finally:
+            faults.disable()
+        assert not rpt["ok"] and rpt["phase"] == "rollback"
+        assert rpt["rolled_back"] and "rollout.canary" in rpt["reason"]
+        assert fleet.serving_ckpt_id == OLD
+        assert fleet.n_live == 2
+        assert any(e["event"] == "rollback" for e in ctl.rollout_log)
+        for r in _reqs(env, range(4, 8)):
+            fleet.submit(r)
+        assert fleet.drain(timeout=120)
+        h = fleet.health()
+        assert h["healthy"] and not h["rolling"]
+        for uid in range(8):
+            res = fleet.results[uid]["result"]
+            np.testing.assert_array_equal(res.strokes5, ref_old[uid])
+            assert res.ckpt_id == OLD
+    finally:
+        fleet.close()
+
+
+def test_swap_fault_mid_walk_rolls_back_bitwise(env, tmp_path):
+    """A fault at the per-replica swap site after the canary passed:
+    the already-swapped replicas are walked BACK to the old params and
+    the fleet is bitwise the pre-rollout fleet again."""
+    d, p_new = _ckpts(env, tmp_path)
+    fleet = _fleet(env)
+    try:
+        ref_old = _reference(env, env["state_old"].params, range(4),
+                             fleet.pool_cap)
+        ctl = RolloutController(fleet, env["model"], env["hps"],
+                                env["state_old"], _canary(env))
+        faults.configure("rollout.swap.r0@0", seed=0)
+        try:
+            rpt = ctl.roll_to(p_new)
+        finally:
+            faults.disable()
+        assert not rpt["ok"] and rpt["rolled_back"]
+        assert fleet.serving_ckpt_id == OLD
+        assert fleet.n_live == 2
+        for r in _reqs(env, range(4)):
+            fleet.submit(r)
+        assert fleet.drain(timeout=120)
+        for uid in range(4):
+            res = fleet.results[uid]["result"]
+            np.testing.assert_array_equal(res.strokes5, ref_old[uid])
+            assert res.ckpt_id == OLD
+    finally:
+        fleet.close()
+
+
+def test_armed_never_firing_plan_is_bitwise_invisible(env, tmp_path):
+    """A rollout fault plan that is armed but never fires changes
+    nothing: the walk promotes and the strokes are bitwise the
+    offline reference — scheduling changes WHEN, never WHAT."""
+    d, p_new = _ckpts(env, tmp_path)
+    fleet = _fleet(env)
+    try:
+        ctl = RolloutController(fleet, env["model"], env["hps"],
+                                env["state_old"], _canary(env))
+        faults.configure(
+            "rollout.swap.r7@0,rollout.canary@3,ckpt.load.corrupt@5",
+            seed=0)
+        try:
+            rpt = ctl.roll_to(p_new)
+        finally:
+            faults.disable()
+        assert rpt["ok"] and rpt["phase"] == "promote"
+        for r in _reqs(env, range(4)):
+            fleet.submit(r)
+        assert fleet.drain(timeout=120)
+        ref_new = _reference(env, env["state_new"].params, range(4),
+                             fleet.pool_cap)
+        for uid in range(4):
+            res = fleet.results[uid]["result"]
+            np.testing.assert_array_equal(res.strokes5, ref_new[uid])
+            assert res.ckpt_id == NEW
+    finally:
+        fleet.close()
+
+
+# ------------------------------------------------------ watcher + CLI
+
+
+def test_checkpoint_watcher_only_rolls_new_steps(env, tmp_path):
+    """The watcher's high-water mark: steps present at construction
+    never trigger; a step saved afterwards rolls the fleet exactly
+    once (poll_once is the test seam — no thread needed)."""
+    d = str(tmp_path / "ckpts")
+    os.makedirs(d)
+    save_checkpoint(d, env["state_old"], 1.0, env["hps"])
+    fleet = _fleet(env)
+    try:
+        ctl = RolloutController(fleet, env["model"], env["hps"],
+                                env["state_old"], _canary(env))
+        watcher = CheckpointWatcher(ctl, d, poll_s=0.05)
+        assert watcher.poll_once() is None  # old step pre-seen
+        save_checkpoint(d, env["state_new"], 1.0, env["hps"])
+        rpt = watcher.poll_once()
+        assert rpt is not None and rpt["ok"], rpt
+        assert fleet.serving_ckpt_id == NEW
+        assert watcher.poll_once() is None  # served, not re-rolled
+        assert watcher.reports == [rpt]
+    finally:
+        fleet.close()
+
+
+def test_fleet_close_joins_inflight_rollout(env, tmp_path):
+    """fleet.close() during a watched rollout: the walk completes (or
+    rolls back) BEFORE workers retire — never a half-swapped fleet,
+    and the watcher thread is gone."""
+    d = str(tmp_path / "ckpts")
+    os.makedirs(d)
+    save_checkpoint(d, env["state_old"], 1.0, env["hps"])
+    fleet = _fleet(env)
+    ctl = RolloutController(fleet, env["model"], env["hps"],
+                            env["state_old"], _canary(env))
+    watcher = ctl.watch(d, poll_s=0.02)
+    save_checkpoint(d, env["state_new"], 1.0, env["hps"])
+    time.sleep(0.3)  # let the watcher pick the walk up (racing close)
+    fleet.close()
+    assert not watcher._thread.is_alive()
+    assert not ctl.evidence()["active"]
+    # uniform version across every engine: all-old (close won the
+    # race) or all-new (the walk completed) — never a mix
+    ids = {rep.engine.ckpt_id for rep in fleet._replicas
+           if rep.engine is not None}
+    assert ids == {OLD} or ids == {NEW}, ids
+    assert fleet.serving_ckpt_id in (OLD, NEW)
+
+
+def test_cli_watch_ckpt_requires_fleet(tmp_path, capsys):
+    # the walk retires one replica at a time; a 1-replica fleet would
+    # stop serving — reject before any compile
+    assert main(["serve-bench", "--random_init",
+                 "--watch_ckpt", str(tmp_path),
+                 f"--workdir={tmp_path}"]) == 2
+    assert "--fleet" in capsys.readouterr().err
+    assert main(["serve-bench", "--random_init", "--fleet", "1",
+                 "--watch_ckpt", str(tmp_path),
+                 f"--workdir={tmp_path}"]) == 2
+    assert "--fleet" in capsys.readouterr().err
