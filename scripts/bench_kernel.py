@@ -4,8 +4,18 @@ T=250 B=128 H=512, and cover the layer_norm cell).
 
 Run on a real TPU:  python scripts/bench_kernel.py
 Env: KB_T, KB_B, KB_H, KB_D, KB_DTYPE (float32|bfloat16), KB_STEPS.
+
+``--mode serve_decode`` (ISSUE 17) benches the SERVING chunk program
+instead: the engine's scan chunk vs the fused cache-resident Pallas
+decode kernel (`ops/pallas_decode.py`) at the serve geometry, plus the
+deterministic per-chunk HBM byte ledger (`modeled_chunk_bytes`) — the
+box-constraint proof arm. Emits one ``kind=serve_kernel`` row to the
+bench history (``ok`` = modeled_speedup >= 2.0, the ISSUE 17
+acceptance floor; wall-clock columns are informational off a real
+mesh — interpret mode compiles the kernel to plain XLA on CPU).
 """
 
+import argparse
 import functools
 import json
 import os
@@ -114,5 +124,119 @@ def main():
     print(json.dumps({"shape": [T, B, H, D], "dtype": DT, **results}))
 
 
+def serve_decode_main(args) -> int:
+    """The serving arm: scan chunk program vs fused decode kernel at
+    the serve geometry, + the modeled HBM byte ledger."""
+    import numpy as np
+
+    from scripts._measure import hist_append
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.ops.pallas_decode import modeled_chunk_bytes
+    from sketch_rnn_tpu.serve.engine import START_TOKEN, make_chunk_step
+
+    slots, chunk = args.slots, args.chunk
+    hps = get_default_hparams().replace(
+        dec_model=args.dec_model, dec_rnn_size=args.dec_rnn_size,
+        enc_rnn_size=16, z_size=8, num_mixture=5,
+        max_seq_len=max(chunk * 4, 32), serve_slots=slots,
+        serve_chunk=chunk, conditional=args.conditional)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(args.seed))
+
+    # one steady-state pool: every slot live, uniform caps far past
+    # the bench window — both flavors run identical, deterministic work
+    n = slots
+    keys = jax.vmap(jax.random.fold_in,
+                    (None, 0))(jax.random.key(args.seed + 1),
+                               jnp.arange(n))
+    pool = (jax.vmap(jax.random.key_data)(keys),
+            (jax.random.normal(jax.random.key(2), (n, hps.z_size))
+             if hps.conditional else None),
+            None,
+            jnp.full((n,), 0.7, jnp.float32),
+            jnp.full((n,), 10 * chunk, jnp.int32),
+            None, None, None)
+    carry = model.decoder_initial_carry(
+        params, jnp.zeros((slots, hps.z_size)), slots)
+    prev = jnp.broadcast_to(jnp.asarray(START_TOKEN, jnp.float32),
+                            (slots, 5))
+    t = jnp.zeros((slots,), jnp.int32)
+    done = jnp.zeros((slots,), bool)
+    reset = jnp.ones((slots,), bool)
+    slot_idx = jnp.arange(slots, dtype=jnp.int32)
+    state = (carry, prev, t, done, reset, slot_idx, pool)
+
+    outs = {}
+    times = {}
+    for kernel in ("scan", "pallas"):
+        fn = jax.jit(make_chunk_step(model, hps, chunk, params,
+                                     kernel=kernel))
+        outs[kernel] = fn(*state)
+        times[kernel] = timeit(lambda: fn(*state))
+    parity = float(jnp.max(jnp.abs(outs["scan"][4]
+                                   - outs["pallas"][4])))
+
+    extra = model._decoder_extra(params, pool[1], pool[2])
+    extra_dim = 0 if extra is None else int(extra.shape[-1])
+    ledger = modeled_chunk_bytes(
+        slots, chunk, hps.dec_rnn_size, 5 + extra_dim,
+        3 + 6 * hps.num_mixture, extra_dim=extra_dim)
+
+    dev = jax.devices()[0].device_kind
+    rec = {
+        "kind": "serve_kernel",
+        "smoke": dev == "cpu",
+        "device_kind": dev,
+        "dec_model": hps.dec_model,
+        "conditional": bool(hps.conditional),
+        "slots": slots,
+        "chunk": chunk,
+        "dec_rnn_size": hps.dec_rnn_size,
+        "num_mixture": hps.num_mixture,
+        "scan_chunk_ms": round(times["scan"], 3),
+        "pallas_chunk_ms": round(times["pallas"], 3),
+        "measured_ratio": round(times["scan"] / times["pallas"], 3),
+        "parity_max_diff": parity,
+        **{k: (round(v, 3) if isinstance(v, float) else v)
+           for k, v in ledger.items()},
+        # the deterministic acceptance signal (ISSUE 17): the modeled
+        # per-chunk HBM traffic ratio — wall-clock stays informational
+        # until a real mesh runs this
+        "ok": ledger["modeled_speedup"] >= 2.0,
+    }
+    print(f"# scan {times['scan']:.3f} ms/chunk, pallas "
+          f"{times['pallas']:.3f} ms/chunk, modeled HBM ratio "
+          f"{ledger['modeled_speedup']:.2f}x "
+          f"({ledger['scan_chunk_bytes']:,} -> "
+          f"{ledger['kernel_chunk_bytes']:,} bytes/chunk), parity "
+          f"{parity:.2e}", file=sys.stderr)
+    print(json.dumps(hist_append(rec)))
+    return 0 if rec["ok"] else 1
+
+
 if __name__ == "__main__":
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("train", "serve_decode"),
+                    default="train",
+                    help="train = the fwd/bwd training-kernel bench "
+                         "(default; KB_* env knobs); serve_decode = "
+                         "the ISSUE 17 serving chunk bench")
+    ap.add_argument("--slots", type=int, default=32,
+                    help="serve_decode: engine slot count B")
+    ap.add_argument("--chunk", type=int, default=8,
+                    help="serve_decode: decode steps per dispatch K")
+    ap.add_argument("--dec_rnn_size", type=int, default=256,
+                    help="serve_decode: decoder width H")
+    ap.add_argument("--dec_model", choices=("lstm", "layer_norm"),
+                    default="lstm",
+                    help="serve_decode: cell kind (the fused kernel's "
+                         "supported set)")
+    ap.add_argument("--conditional", action="store_true",
+                    help="serve_decode: z-conditional decode (adds the "
+                         "hoisted extra operand)")
+    ap.add_argument("--seed", type=int, default=0)
+    a = ap.parse_args()
+    if a.mode == "serve_decode":
+        sys.exit(serve_decode_main(a))
     main()

@@ -68,7 +68,11 @@ from scripts.bench_summary import (  # noqa: E402
 # device steps; serve_autoscale: reproducible scale plan + autoscaled
 # shed strictly below fixed) and the ISSUE 15 multi-task rows
 # (serve_endpoint: per-endpoint offline-bitwise parity + completeness
-# + one-compile-per-geometry accounting) carry a binary ok metric
+# + one-compile-per-geometry accounting) and the ISSUE 17 fused
+# decode-kernel rows (serve_kernel: the modeled per-chunk HBM ratio of
+# the cache-resident pallas kernel vs the scan chunk program holding
+# >= 2x at equal serve geometry on the committed smoke row) carry a
+# binary ok metric
 # (1.0 = the cell hit its expected outcome): with an all-1.0 history
 # the cell's floor sits at best * (1 - min_band) * (1 - slack) ≈
 # 0.855, so any future 0.0 — a recovery path, the attribution

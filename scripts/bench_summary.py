@@ -30,7 +30,8 @@ import time
 # bench_regress (which imports it): a new binary kind added here is
 # automatically keyed, summarized and gated consistently.
 BINARY_KINDS = ("resilience", "serve_cost", "serve_cache",
-                "serve_autoscale", "serve_endpoint", "rollout")
+                "serve_autoscale", "serve_endpoint", "rollout",
+                "serve_kernel")
 
 
 def key_of(r: dict):
@@ -44,10 +45,14 @@ def key_of(r: dict):
                 f"edges={';'.join(str(e) for e in r.get('bucket_edges') or ())} "
                 f"dev={dev}")
     if r.get("kind") == "serve_bench":
+        # kernel flavor and param dtype key the cell (ISSUE 17): a
+        # pallas-kernel or int8 row is a different program than the
+        # scan/f32 record; rows predating the knobs are scan/float32
         return ("serve", r.get("dec_model"),
                 f"B={r.get('slots')} K={r.get('chunk')} "
                 f"n={r.get('n_requests')} dist={r.get('len_dist')} "
-                f"dev={dev}")
+                f"kern={r.get('decode_kernel', 'scan')} "
+                f"dtype={r.get('param_dtype', 'float32')} dev={dev}")
     if r.get("kind") == "serve_fleet":
         # replica count AND offered rate key the cell (ISSUE 9): a
         # 4-replica row must never pool with a 1-replica record, and a
@@ -105,6 +110,15 @@ def key_of(r: dict):
                 f"ep={r.get('endpoint')} mix={r.get('mix')} "
                 f"B={r.get('slots')} K={r.get('chunk')} "
                 f"n={r.get('n_requests')} dev={dev}")
+    if r.get("kind") == "serve_kernel":
+        # fused decode-kernel cells (ISSUE 17): one per (cell, serve
+        # geometry, conditional) — the deterministic modeled-HBM-ratio
+        # acceptance (>= 2x) is the binary signal; measured ms columns
+        # are informational off a real mesh (interpret mode on CPU)
+        return ("servekern", r.get("dec_model"),
+                f"B={r.get('slots')} K={r.get('chunk')} "
+                f"H={r.get('dec_rnn_size')} "
+                f"cond={r.get('conditional')} dev={dev}")
     if r.get("kind") == "serve_autoscale":
         # traffic-grid autoscale cells (ISSUE 12): one per (trace,
         # cache) arm pair — reproducible scale plan + autoscaled shed
@@ -291,6 +305,28 @@ def main(argv=None) -> int:
                   f"best={metric_of(b):>11.2f} sk/s ({when}"
                   f"{_serve_lat_cols(b)}{_tail_col(b)}{sp_col})  "
                   f"latest={metric_of(l):>11.2f}")
+            # quantized-vs-full / kernel-vs-scan comparison rows
+            # (ISSUE 17): the latest row's in-run arms at the SAME
+            # workload — throughput side by side with the proof
+            # columns (work_match = identical device steps, the
+            # quantization error budget, the modeled HBM ratio)
+            full = l.get("engine_sketches_per_sec")
+            kern = l.get("kernel") or {}
+            if kern:
+                print(f"{'':8s} {'':11s} {'  kernel=pallas':40s} "
+                      f"{kern.get('sketches_per_sec'):>16.2f} sk/s "
+                      f"(vs full {full} modeled_hbm="
+                      f"{kern.get('modeled_speedup')}x parity<="
+                      f"{kern.get('parity_max_diff'):.1e} "
+                      f"work_match={kern.get('work_match')})")
+            quant = l.get("quantized") or {}
+            if quant:
+                print(f"{'':8s} {'':11s} {'  dtype=int8':40s} "
+                      f"{quant.get('sketches_per_sec'):>16.2f} sk/s "
+                      f"(vs full {full} max_err<="
+                      f"{quant.get('quantize_max_err'):.1e} over "
+                      f"{quant.get('quantized_tensors')} tensors "
+                      f"work_match={quant.get('work_match')})")
             continue
         if k[0] == "fleet":
             # fleet cell: realized throughput at (replicas, offered
@@ -353,6 +389,19 @@ def main(argv=None) -> int:
                   f"cap={ms(l.get('latency_p99_s'))} "
                   f"load={ms(l.get('load_p99_s'))} "
                   f"shed={l.get('shed')} cls={l.get('class')})")
+            continue
+        if k[0] == "servekern":
+            # fused decode-kernel cell (ISSUE 17): the modeled HBM
+            # ratio >= 2x acceptance is the binary signal; measured
+            # per-chunk ms columns beside it (informational off a
+            # real mesh — interpret mode on CPU) plus the scan-vs-
+            # kernel parity ceiling
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"latest={'ok' if l.get('ok') else 'BROKEN':>11s} "
+                  f"(modeled_hbm={l.get('modeled_speedup')}x "
+                  f"scan={l.get('scan_chunk_ms')}ms "
+                  f"pallas={l.get('pallas_chunk_ms')}ms "
+                  f"parity<={l.get('parity_max_diff'):.1e})")
             continue
         if k[0] == "autoscale":
             # traffic autoscale cell (ISSUE 12): the shed comparison

@@ -169,6 +169,128 @@ def run_config(name: str, args) -> dict:
     }
 
 
+# pallas-vs-scan stroke tolerance (ISSUE 17, documented in
+# ops/pallas_decode.py): unconditional models are bitwise; conditional
+# models diverge only through FMA re-association of the hoisted
+# extra-operand matmul — measured <= ~7e-7 per component at f32 across
+# the committed smoke geometries, gated at 1e-5
+SERVE_DECODE_TOL = 1e-5
+
+
+def serve_decode_check(args) -> int:
+    """The ISSUE 17 serve-decode parity block: per endpoint, the fused
+    pallas kernel's strokes vs the scan chunk program's within
+    ``SERVE_DECODE_TOL`` (same step counts, same pen states), and the
+    ``decode_kernel=scan`` pin served bitwise identically through both
+    construction routes (hps field vs engine argument) with a
+    ``float32`` quantization round-trip — the no-op proof the fallback
+    pin rests on (the scan path itself is untouched code)."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from sketch_rnn_tpu.config import get_default_hparams
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve.endpoints import (build_mix_requests,
+                                                serve_requests)
+    from sketch_rnn_tpu.serve.quantize import quantize_for_serving
+
+    rng = np.random.default_rng(args.seed)
+    pool = []
+    for _ in range(12):
+        n_pts = int(rng.integers(12, 28))
+        s = np.zeros((n_pts, 3), np.float32)
+        s[:, :2] = rng.normal(0, 6, (n_pts, 2)).astype(np.float32)
+        s[rng.random(n_pts) < 0.15, 2] = 1.0
+        pool.append(s)
+    mix = tuple((e, 1.0) for e in ("generate", "complete",
+                                   "reconstruct", "interpolate"))
+    table = {"kind": "serve_decode_parity", "tol": SERVE_DECODE_TOL,
+             "cells": {}}
+    ok = True
+    for cell in ("lstm", "layer_norm"):
+        hps = get_default_hparams().replace(
+            dec_model=cell, enc_model="lstm", dec_rnn_size=64,
+            enc_rnn_size=32, z_size=8, num_mixture=3, max_seq_len=48,
+            serve_slots=8, serve_chunk=4, conditional=True)
+        model = SketchRNN(hps)
+        params = model.init_params(jax.random.key(args.seed))
+        kz, kreq = jax.random.split(jax.random.key(args.seed + 1))
+        z = np.asarray(jax.random.normal(kz, (16, hps.z_size)),
+                       np.float32)
+        requests = build_mix_requests(
+            hps, mix, 16, args.seed, kreq, z, pool,
+            np.zeros(len(pool), np.int32), frames=4, temperature=0.7)
+
+        def burst(h, eng_kw=None):
+            reqs = [dataclasses.replace(r, uid=None) for r in requests]
+            if eng_kw:
+                from sketch_rnn_tpu.serve.engine import ServeEngine
+                eng = ServeEngine(model, h, params, **eng_kw)
+                out = serve_requests(model, h, params, reqs,
+                                     engine=eng)
+            else:
+                out = serve_requests(model, h, params, reqs)
+            return {r.uid: r for r in out["results"]}
+
+        scan = burst(hps)  # hps.decode_kernel defaults to "scan"
+        pallas = burst(hps.replace(decode_kernel="pallas"))
+        # the scan pin, via the engine-argument route AND a float32
+        # quantization round-trip: both must be bitwise the hps route
+        pin = burst(hps, eng_kw={"decode_kernel": "scan",
+                                 "param_dtype": "float32"})
+        qparams, qrep = quantize_for_serving(params, "float32")
+        assert qparams is params and not qrep
+        pin_bitwise = all(
+            np.array_equal(scan[u].strokes5, pin[u].strokes5)
+            for u in scan)
+        by_ep = {}
+        for u, ref in sorted(scan.items()):
+            ep = requests[u].endpoint or "generate"
+            got = pallas[u]
+            row = by_ep.setdefault(ep, {"n": 0, "max_diff": 0.0,
+                                        "steps_match": True,
+                                        "pen_match": True})
+            row["n"] += 1
+            a = np.asarray(ref.strokes5)
+            b = np.asarray(got.strokes5)
+            if a.shape != b.shape:
+                row["steps_match"] = False
+                row["max_diff"] = float("inf")
+                continue
+            row["max_diff"] = max(row["max_diff"],
+                                  float(np.max(np.abs(a - b)))
+                                  if a.size else 0.0)
+            row["pen_match"] &= bool(
+                np.array_equal(a[..., 2:], b[..., 2:]))
+            row["steps_match"] &= (ref.steps == got.steps)
+        for ep, row in by_ep.items():
+            row["ok"] = (row["max_diff"] <= SERVE_DECODE_TOL
+                         and row["steps_match"] and row["pen_match"])
+        cell_ok = pin_bitwise and all(r["ok"] for r in by_ep.values())
+        ok &= cell_ok
+        table["cells"][cell] = {"scan_pin_bitwise": pin_bitwise,
+                                "endpoints": by_ep, "ok": cell_ok}
+        for ep, row in sorted(by_ep.items()):
+            print(f"# {cell:11s} {ep:12s} n={row['n']:2d} "
+                  f"max_diff={row['max_diff']:.2e} "
+                  f"steps_match={row['steps_match']} "
+                  f"{'OK' if row['ok'] else 'FAIL'}",
+                  file=sys.stderr)
+        print(f"# {cell:11s} scan-pin bitwise: {pin_bitwise}",
+              file=sys.stderr)
+    table["ok"] = bool(ok)
+    print(json.dumps(table))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(table, f, indent=2)
+    if not ok:
+        print("# SERVE-DECODE PARITY FAIL", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="recon-NLL/KL parity table vs the reference")
@@ -176,6 +298,12 @@ def main(argv=None) -> int:
                     help="QuickDraw .npz directory (the real-data path)")
     ap.add_argument("--synthetic", action="store_true",
                     help="prove the harness on the synthetic corpus")
+    ap.add_argument("--serve_decode", action="store_true",
+                    help="ISSUE 17 serve-decode parity block instead: "
+                         "per-endpoint pallas-kernel strokes vs the "
+                         "scan chunk program within the documented "
+                         "tolerance, plus the decode_kernel=scan "
+                         "bitwise pin (no training, seconds on CPU)")
     ap.add_argument("--integer_grid", type=float, default=255.0,
                     help="synthetic corpus integer-grid scale (0 = "
                          "legacy float-natured corpus)")
@@ -197,6 +325,8 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="", help="also write the table here")
     args = ap.parse_args(argv)
 
+    if args.serve_decode:
+        return serve_decode_check(args)
     if not args.data_dir and not args.synthetic:
         print("need --data_dir (real npz) or --synthetic", file=sys.stderr)
         return 2

@@ -1643,11 +1643,91 @@ def _run(args, hps, model, params, slots, chunk, n, lmin, lmax,
         rec["static_engine_sketches_per_sec"] = st["sketches_per_sec"]
         rec["static_engine_device_steps"] = st["device_steps"]
 
+    # ISSUE 17 columns: the fused decode kernel and the int8-quantized
+    # params, each serving the SAME workload at the same geometry.
+    # Scheduling is length-driven and lengths are pinned by the pen
+    # suppression, so both arms must execute the main run's exact
+    # device-step count — inequality means the arm changed the WORK,
+    # not just the speed, and the row says so.
+    rec["decode_kernel"] = "scan"
+    rec["param_dtype"] = "float32"
+    from sketch_rnn_tpu.ops.pallas_decode import (SUPPORTED_CELLS,
+                                                  modeled_chunk_bytes)
+    if hps.dec_model in SUPPORTED_CELLS:
+        kmet, kres = run_engine(model,
+                                hps.replace(decode_kernel="pallas"),
+                                params, requests, slots, chunk)
+        ref = {r.uid: r for r in results}
+        diffs = [float(np.max(np.abs(np.asarray(r.strokes5)
+                                     - np.asarray(ref[r.uid].strokes5))))
+                 for r in kres]
+        extra_dim = (hps.z_size if hps.conditional else 0)
+        ledger = modeled_chunk_bytes(slots, chunk, hps.dec_rnn_size,
+                                     5 + extra_dim,
+                                     3 + 6 * hps.num_mixture,
+                                     extra_dim=extra_dim)
+        rec["kernel"] = {
+            "decode_kernel": "pallas",
+            "sketches_per_sec": kmet["sketches_per_sec"],
+            "wall_s": kmet["wall_s"],
+            "device_steps": kmet["device_steps"],
+            "work_match": kmet["device_steps"]
+            == eng_metrics["device_steps"],
+            "parity_max_diff": max(diffs) if diffs else 0.0,
+            "modeled_speedup": round(ledger["modeled_speedup"], 3),
+            "scan_chunk_bytes": ledger["scan_chunk_bytes"],
+            "kernel_chunk_bytes": ledger["kernel_chunk_bytes"],
+        }
+        print(f"# kernel(pallas): {kmet['sketches_per_sec']} sk/s, "
+              f"modeled HBM ratio {rec['kernel']['modeled_speedup']}x,"
+              f" parity {rec['kernel']['parity_max_diff']:.2e}",
+              file=sys.stderr)
+
+    from sketch_rnn_tpu.serve.quantize import quantize_for_serving
+    qparams, qrep = quantize_for_serving(params, "int8")
+    # the bench's -1e9 pen suppression would dominate out_b's
+    # per-tensor scale and wipe its other entries — re-pin it after
+    # quantization (exactly representable anyway: q=-127) and keep
+    # out_b out of the reported budget; real checkpoints carry no
+    # such sentinel
+    qb = np.array(qparams["out_b"])
+    qb[2] = -1e9
+    qparams["out_b"] = qb
+    qmet, _ = run_engine(model, hps, qparams, requests, slots, chunk)
+    rec["quantized"] = {
+        "param_dtype": "int8",
+        "sketches_per_sec": qmet["sketches_per_sec"],
+        "wall_s": qmet["wall_s"],
+        "device_steps": qmet["device_steps"],
+        "work_match": qmet["device_steps"]
+        == eng_metrics["device_steps"],
+        "quantized_tensors": len(qrep),
+        "quantize_max_err": max((r["max_err"] for r in qrep
+                                 if r["path"] != "out_b"),
+                                default=0.0),
+    }
+    print(f"# quantized(int8): {qmet['sketches_per_sec']} sk/s, "
+          f"{len(qrep)} tensors, max_err "
+          f"{rec['quantized']['quantize_max_err']:.2e}",
+          file=sys.stderr)
+
     print(json.dumps(rec, indent=2))
     hist_append(rec)
     if args.out:
+        # merge-preserve the other modes' blocks (fleet / traffic /
+        # endpoints) already in the doc — the fleet writer's discipline
+        doc = {}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, dict):
+                    doc = loaded
+            except ValueError:
+                pass
+        doc.update(rec)
         with open(args.out, "w") as f:
-            json.dump(rec, f, indent=2)
+            json.dump(doc, f, indent=2)
     return 0
 
 

@@ -499,6 +499,21 @@ def cmd_serve_bench(args) -> int:
     the latest checkpoint in ``--workdir`` is restored like ``sample``.
     """
     hps = _resolve_hps(args)
+    # decode-kernel / quantization flavor (ISSUE 17): flags override
+    # the hps fields, and an unsupported cell for the pallas kernel
+    # fails HERE with the refusal naming the scan fallback — before
+    # the expensive restore/compile, like every usage check below
+    if args.decode_kernel:
+        hps = hps.replace(decode_kernel=args.decode_kernel)
+    if args.quantize:
+        hps = hps.replace(serve_quantize=args.quantize)
+    if hps.decode_kernel == "pallas":
+        from sketch_rnn_tpu.ops.pallas_decode import check_cell_kind
+        try:
+            check_cell_kind(hps.dec_model)
+        except ValueError as e:
+            print(f"[cli] {e}", file=sys.stderr)
+            return 2
     # SLO specs, admission classes and the metrics port are usage
     # input: fail before the (expensive) restore/compile, like sample's
     # flag validation — a taken port must not cost the whole warmup
@@ -874,6 +889,17 @@ def _serve_bench_run(args, hps, slo_tracker, server,
         state_params = state.params
         from sketch_rnn_tpu.train.checkpoint import ckpt_id_of
         init_ckpt_id = ckpt_id_of(int(state.step))
+    # quantized serving (ISSUE 17): round the initial params through
+    # the serving precision and stamp the serving identity, exactly as
+    # rollout admission does for every later hot-swap — the engine /
+    # fleet / canary all see the same dequantized f32 tree
+    qreport = []
+    if hps.serve_quantize != "float32":
+        from sketch_rnn_tpu.serve.quantize import (quantize_for_serving,
+                                                   stamp_ckpt_id)
+        state_params, qreport = quantize_for_serving(
+            state_params, hps.serve_quantize)
+        init_ckpt_id = stamp_ckpt_id(init_ckpt_id, hps.serve_quantize)
     key = jax.random.key(args.seed)
     kz, kreq = jax.random.split(key)
     n = args.n
@@ -1024,6 +1050,11 @@ def _serve_bench_run(args, hps, slo_tracker, server,
         "slots": slots_v,
         "chunk": chunk_v,
         "static": bool(args.static),
+        "decode_kernel": hps.decode_kernel,
+        "param_dtype": hps.serve_quantize,
+        "quantized_tensors": len(qreport),
+        "quantize_max_err": max((r["max_err"] for r in qreport),
+                                default=0.0),
         "scale_factor": scale,
         "started": t0,
         **out_metrics,
@@ -1191,6 +1222,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--label", type=int, default=0,
                    help="class id for class-conditional models")
     p.add_argument("--greedy", action="store_true")
+    p.add_argument("--decode_kernel", default="",
+                   choices=["", "scan", "pallas"],
+                   help="serve decode flavor (ISSUE 17): 'scan' = the "
+                        "step-per-iteration lax.scan chunk program "
+                        "(bitwise fallback pin), 'pallas' = the fused "
+                        "cache-resident decode kernel (whole K-step "
+                        "chunk per pallas_call, carry resident in "
+                        "VMEM; interpret mode off-TPU; lstm/"
+                        "layer_norm cells only). Default: "
+                        "hps.decode_kernel")
+    p.add_argument("--quantize", default="",
+                   choices=["", "float32", "bfloat16", "int8"],
+                   help="serving-parameter precision (ISSUE 17): int8 "
+                        "= per-tensor symmetric, dequant-on-load "
+                        "(error <= scale/2 per element); bfloat16 = "
+                        "round-through-bf16. Compute stays f32; the "
+                        "served ckpt_id is stamped ':int8'/':bf16'. "
+                        "Default: hps.serve_quantize")
     p.add_argument("--static", action="store_true",
                    help="disable slot recycling (freeze-until-batch-done "
                         "schedule, for comparison)")

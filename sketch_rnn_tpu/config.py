@@ -202,6 +202,29 @@ class HParams:
     #   sampler analogue of steps_per_call (one compiled program
     #   advances all slots K steps; higher K amortizes launch latency,
     #   lower K admits faster — finished slots idle at most K-1 steps)
+    decode_kernel: str = "scan"        # serve-chunk program flavor
+    #   (ISSUE 17): "scan" = the lax.scan chunk program (the bitwise
+    #   fallback pin — decode_kernel=scan + float32 params is the
+    #   pre-kernel engine, byte for byte); "pallas" = the fused
+    #   cache-resident decode kernel (ops/pallas_decode.py): one
+    #   pallas_call per K-step chunk with the (c, h) carry, prev
+    #   stroke and t/done state resident in VMEM — no HBM carry
+    #   round-trip per step — fusing cell + projection + MDN head +
+    #   sampler per step. Interpret-mode off-TPU (the CPU tier-1
+    #   path), where its strokes are bitwise the scan program's;
+    #   lstm/layer_norm decoders only (the hyper cell refuses with a
+    #   pointer back to scan). Also selects the fused teacher-forced
+    #   prefix replay in the endpoint encode phase.
+    serve_quantize: str = "float32"    # inference param quantization
+    #   (serve/quantize.py): "int8" = per-tensor symmetric int8 with
+    #   dequant-on-load (~4x smaller params; error <= scale/2 =
+    #   max|w|/254 per tensor — the loader's int16 exact-transfer
+    #   idiom one octave coarser, EXACT for weights already on the
+    #   int8 grid); "bfloat16" = round-through-bf16 (~2x, relative
+    #   error <= 2^-8). Serving compute stays float32 — the quantized
+    #   engine runs the dequantized weights, and every Result's
+    #   ckpt_id is stamped ":int8"/":bf16" so mixed-precision serving
+    #   is honest. float32 = off (the bitwise pin).
     serve_prefix_edges: Tuple[int, ...] = ()  # prefix bucket edges of
     #   the multi-task endpoint encode phase (serve/endpoints.py): an
     #   encoder-endpoint request's stroke prefix is padded to the
@@ -241,6 +264,14 @@ class HParams:
             raise ValueError(
                 f"serve_slots and serve_chunk must be >= 1, got "
                 f"{self.serve_slots}/{self.serve_chunk}")
+        if self.decode_kernel not in ("scan", "pallas"):
+            raise ValueError(
+                f"decode_kernel must be 'scan' or 'pallas', got "
+                f"{self.decode_kernel!r}")
+        if self.serve_quantize not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"serve_quantize must be 'float32', 'bfloat16' or "
+                f"'int8', got {self.serve_quantize!r}")
         if self.bucket_edges:
             edges = self.bucket_edges
             if any(e <= 0 for e in edges):

@@ -196,8 +196,17 @@ def pad_prefixes(prefixes: Sequence[np.ndarray], edge: int
     return pad_batch_numpy(list(prefixes), edge)
 
 
-def make_encode_step(model, hps: HParams, params, edge: int):
+def make_encode_step(model, hps: HParams, params, edge: int,
+                     kernel: str = "scan"):
     """Build the jitted encode + prefix-replay program for one edge.
+
+    ``kernel`` (ISSUE 17) selects the teacher-forced replay core:
+    ``"scan"`` is the `lax.scan` below (the bitwise fallback pin);
+    ``"pallas"`` runs the replay as one fused cache-resident program
+    (`ops.pallas_decode.replay_chunk`) — the carry stays in VMEM for
+    all ``edge`` steps with the same ``t < seq_len`` row masking. The
+    encoder pass and the mu/prev extraction are identical jnp either
+    way; only the replay loop changes flavor.
 
     ``fn(strokes [B, edge+1, 5], seq_len [B], labels [B]?) ->
     (mu [B, Nz], carry_flat [B, C], prev [B, 5])``:
@@ -222,6 +231,13 @@ def make_encode_step(model, hps: HParams, params, edge: int):
 
     e = int(edge)
 
+    if kernel not in ("scan", "pallas"):
+        raise ValueError(
+            f"kernel must be 'scan' or 'pallas', got {kernel!r}")
+    if kernel == "pallas":
+        from sketch_rnn_tpu.ops.pallas_decode import check_cell_kind
+        check_cell_kind(hps.dec_model)
+
     def fn(strokes, seq_len, labels):
         b = strokes.shape[0]
         x_tm = jnp.transpose(strokes, (1, 0, 2))       # [E+1, B, 5]
@@ -229,20 +245,29 @@ def make_encode_step(model, hps: HParams, params, edge: int):
         carry0 = model.decoder_initial_carry(params, mu, b)
         inputs = x_tm[:-1]                             # [E, B, 5]
 
-        def step(carry, tx):
-            t, x_prev = tx
-            new_carry, _ = model.decode_step(params, carry, x_prev,
-                                             mu, labels)
-            live = t < seq_len
-            carry = jax.tree_util.tree_map(
-                lambda new, old: jnp.where(
-                    live.reshape((-1,) + (1,) * (new.ndim - 1)),
-                    new, old),
-                new_carry, carry)
-            return carry, None
+        if kernel == "pallas":
+            from sketch_rnn_tpu.ops.pallas_decode import replay_chunk
+            extra = model._decoder_extra(params, mu, labels)
+            carry = replay_chunk(
+                params["dec"], carry0[0], carry0[1], inputs, extra,
+                seq_len, cell_kind=hps.dec_model,
+                forget_bias=model.dec.forget_bias,
+                compute_dtype=model.dec.compute_dtype)
+        else:
+            def step(carry, tx):
+                t, x_prev = tx
+                new_carry, _ = model.decode_step(params, carry, x_prev,
+                                                 mu, labels)
+                live = t < seq_len
+                carry = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(
+                        live.reshape((-1,) + (1,) * (new.ndim - 1)),
+                        new, old),
+                    new_carry, carry)
+                return carry, None
 
-        carry, _ = lax.scan(step, carry0,
-                            (jnp.arange(e), inputs))
+            carry, _ = lax.scan(step, carry0,
+                                (jnp.arange(e), inputs))
         flat = jnp.concatenate(jax.tree_util.tree_leaves(carry),
                                axis=-1)
         prev = jnp.take_along_axis(
@@ -270,7 +295,9 @@ class EncodeProgram:
 
     def __init__(self, model, hps: HParams, params, rows: int,
                  edges: Optional[Sequence[int]] = None, device=None,
-                 replica_id: Optional[int] = None):
+                 replica_id: Optional[int] = None,
+                 decode_kernel: Optional[str] = None,
+                 param_dtype: Optional[str] = None):
         import jax
 
         if not hps.conditional:
@@ -285,6 +312,14 @@ class EncodeProgram:
         self.edges = tuple(edges) if edges else prefix_edges(hps)
         self.device = device
         self.replica_id = replica_id
+        # replay-kernel flavor + param precision label (ISSUE 17):
+        # part of each edge program's probe geometry, like the chunk
+        # program's — a flavor or precision change is a new compile in
+        # the ledger, never a silent hit (defaults thread from hps)
+        self.decode_kernel = str(decode_kernel
+                                 or getattr(hps, "decode_kernel", "scan"))
+        self.param_dtype = str(
+            param_dtype or getattr(hps, "serve_quantize", "float32"))
         # encode-phase parameter subset: encoder stacks + posterior
         # heads + decoder (replay) + the z->carry projection. presig
         # and the MDN projection are computed-then-discarded (XLA DCE
@@ -301,11 +336,14 @@ class EncodeProgram:
         if edge not in self._fns:
             self._fns[edge] = JitCompileProbe(
                 make_encode_step(self.model, self.hps, self.params,
-                                 edge),
+                                 edge, kernel=self.decode_kernel),
                 "serve_encode",
-                key_of=lambda a: (tuple(a[0].shape),),
+                key_of=lambda a: (tuple(a[0].shape),
+                                  self.decode_kernel, self.param_dtype),
                 label_of=lambda a: (f"(B{a[0].shape[0]},"
-                                    f"E{a[0].shape[1] - 1})"))
+                                    f"E{a[0].shape[1] - 1},"
+                                    f"{self.decode_kernel},"
+                                    f"{self.param_dtype})"))
         return self._fns[edge]
 
     def warm(self) -> None:

@@ -227,11 +227,23 @@ def sample_mixture_rows(mp: mdn.MixtureParams, u: jax.Array,
 
 
 def make_chunk_step(model, hps: HParams, chunk: int, params,
-                    greedy: bool = False):
+                    greedy: bool = False, kernel: str = "scan"):
     """Build the jitted fixed-shape K-step decode program.
 
     ``fn(carry, prev, t, done, reset, slot_idx, pool) ->
     (carry, prev, t, done, strokes [K, B, 5])``.
+
+    ``kernel`` selects the chunk program's decode core (ISSUE 17):
+    ``"scan"`` is the `lax.scan` step loop below — the bitwise
+    fallback pin — and ``"pallas"`` swaps the loop for the fused
+    cache-resident kernel (`ops.pallas_decode.decode_chunk`): one
+    pallas program advances all K steps with the carry resident in
+    VMEM, the uniforms pre-drawn outside with the same
+    ``fold_in(request_key, t)`` discipline (`make_uniforms` — bitwise
+    the in-loop draw for every live step; done steps' draws are
+    discarded by the live mask either way). The pool gather /
+    on-device admission prologue is IDENTICAL jnp for both flavors,
+    so determinism, admission and masking semantics cannot diverge.
 
     ``params`` (the decode-path weights) are closed over and baked into
     the compiled program as constants — the engine serves ONE model, and
@@ -260,6 +272,12 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
     or bucket N if burst sizes vary wildly.
     """
     num_mixture = hps.num_mixture
+    if kernel not in ("scan", "pallas"):
+        raise ValueError(
+            f"kernel must be 'scan' or 'pallas', got {kernel!r}")
+    if kernel == "pallas":
+        from sketch_rnn_tpu.ops.pallas_decode import check_cell_kind
+        check_cell_kind(hps.dec_model)
 
     def chunk_fn(carry, prev, t, done, reset, slot_idx, pool):
         b = t.shape[0]
@@ -299,6 +317,21 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
         prev = jnp.where(reset[:, None], start, prev)
         t = jnp.where(reset, 0, t)
         done = jnp.where(reset, False, done)
+
+        if kernel == "pallas":
+            from sketch_rnn_tpu.ops.pallas_decode import (decode_chunk,
+                                                          make_uniforms)
+            c0, h0 = carry
+            extra = model._decoder_extra(params, z, labels)
+            u = make_uniforms(keys, t, chunk)
+            strokes, c_f, h_f, t, done = decode_chunk(
+                params["dec"], params["out_w"], params["out_b"],
+                c0, h0, prev, extra, u, temps, t, done, max_steps,
+                jnp.asarray(END_TOKEN, jnp.float32),
+                cell_kind=hps.dec_model, num_mixture=num_mixture,
+                forget_bias=model.dec.forget_bias,
+                compute_dtype=model.dec.compute_dtype, greedy=greedy)
+            return (c_f, h_f), strokes[-1], t, done, strokes
 
         def body(st, _):
             carry, prev, t, done = st
@@ -344,12 +377,33 @@ class ServeEngine:
     def __init__(self, model, hps: HParams, params, slots: int = 0,
                  chunk: int = 0, max_len: Optional[int] = None,
                  greedy: bool = False, device=None,
-                 replica_id: Optional[int] = None, ckpt_id: str = ""):
+                 replica_id: Optional[int] = None, ckpt_id: str = "",
+                 decode_kernel: Optional[str] = None,
+                 param_dtype: Optional[str] = None):
         self.model = model
         self.hps = hps
         self.slots = int(slots or hps.serve_slots)
         self.chunk = int(chunk or hps.serve_chunk)
         self.max_len = int(max_len or hps.max_seq_len)
+        # chunk-program flavor + serving param precision (ISSUE 17):
+        # both are part of the compiled program's identity — they ride
+        # the JitCompileProbe geometry key so a scan->pallas or
+        # fp32->int8 swap is accounted as a NEW compile, never a
+        # silent cache hit — and default from hps so fleet/rollout
+        # construction threads them for free. param_dtype is a LABEL
+        # (quantized params arrive dequantized to f32 from
+        # serve/quantize.py); the engine's compute is unchanged.
+        self.decode_kernel = str(decode_kernel
+                                 or getattr(hps, "decode_kernel", "scan"))
+        if self.decode_kernel not in ("scan", "pallas"):
+            raise ValueError(
+                f"decode_kernel must be 'scan' or 'pallas', got "
+                f"{self.decode_kernel!r}")
+        if self.decode_kernel == "pallas":
+            from sketch_rnn_tpu.ops.pallas_decode import check_cell_kind
+            check_cell_kind(hps.dec_model)
+        self.param_dtype = str(
+            param_dtype or getattr(hps, "serve_quantize", "float32"))
         # greedy is part of the compiled program's identity; kept so a
         # hot-swap (ISSUE 16) rebuilds the chunk program with the same
         # sampling mode it was constructed with
@@ -404,17 +458,26 @@ class ServeEngine:
         # request-pool size N (make_chunk_step docstring), so the
         # geometry key is the pool leaf shapes — a second burst of a
         # different size must compile (and be accounted as) its own
-        # executable, never dispatch the first burst's.
+        # executable, never dispatch the first burst's — PLUS the
+        # kernel flavor and param dtype (ISSUE 17): a scan->pallas or
+        # fp32->int8 swap rebuilds this probe, and the key must make
+        # the rebuilt program its own geometry in the compile ledger,
+        # not a cache hit on the old flavor's.
         self._chunk_fn = JitCompileProbe(
             make_chunk_step(self.model, self.hps, self.chunk,
-                            self.params, self.greedy),
+                            self.params, self.greedy,
+                            kernel=self.decode_kernel),
             "serve_chunk",
             key_of=lambda a: tuple(tuple(p.shape) for p in a[6]
-                                   if p is not None),
+                                   if p is not None)
+            + (self.decode_kernel, self.param_dtype),
             label_of=lambda a: (f"(B{self.slots},K{self.chunk},"
-                                f"N{a[6][0].shape[0]})"))
+                                f"N{a[6][0].shape[0]},"
+                                f"{self.decode_kernel},"
+                                f"{self.param_dtype})"))
 
-    def swap_params(self, params, ckpt_id: str = "") -> None:
+    def swap_params(self, params, ckpt_id: str = "",
+                    param_dtype: Optional[str] = None) -> None:
         """Hot-swap this engine's serving weights in place (ISSUE 16).
 
         The decode subset is re-device-put, the chunk program is
@@ -426,7 +489,13 @@ class ServeEngine:
         contract: the admission gate (train/checkpoint.py
         ``validate_checkpoint``) proved the candidate's manifest
         matches before any engine sees it. ``ckpt_id`` becomes the
-        version every subsequent Result is stamped with."""
+        version every subsequent Result is stamped with.
+        ``param_dtype`` (ISSUE 17) relabels the serving precision when
+        the incoming params were quantized (serve/quantize.py) — the
+        rebuilt program then registers under its own (kernel, dtype)
+        probe geometry instead of silently cache-hitting the old."""
+        if param_dtype is not None:
+            self.param_dtype = str(param_dtype)
         self._bind_params(params)
         self.ckpt_id = str(ckpt_id or "")
 
@@ -446,7 +515,9 @@ class ServeEngine:
             self._encoder = EncodeProgram(
                 self.model, self.hps, self._full_params,
                 rows=self.slots, device=self.device,
-                replica_id=self.replica_id)
+                replica_id=self.replica_id,
+                decode_kernel=self.decode_kernel,
+                param_dtype=self.param_dtype)
         return self._encoder
 
     # -- the request pool --------------------------------------------------

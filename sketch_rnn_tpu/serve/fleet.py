@@ -620,7 +620,8 @@ class ServeFleet:
             return self._rejoin_locked(rep, reason, t0)
 
     def swap_params_retired(self, replica: int, params,
-                            ckpt_id: str = "") -> None:
+                            ckpt_id: str = "",
+                            param_dtype: Optional[str] = None) -> None:
         """Hot-swap params on a RETIRED, DRAINED replica's engine.
 
         Refuses a live or still-draining replica: the swap rebuilds the
@@ -644,7 +645,8 @@ class ServeFleet:
         # outside the scheduler lock: the device_put + program rebuild
         # may compile, and survivors must keep draining meanwhile
         with jax.default_device(rep.device):
-            rep.engine.swap_params(params, ckpt_id=ckpt_id)
+            rep.engine.swap_params(params, ckpt_id=ckpt_id,
+                                   param_dtype=param_dtype)
 
     def wait_replica_drained(self, replica: int,
                              timeout: float = 60.0) -> bool:

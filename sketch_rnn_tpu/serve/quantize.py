@@ -1,0 +1,163 @@
+"""Int8/bf16 parameter quantization for inference (ISSUE 17).
+
+The serving fleet's params are inference-only constants: they are
+device-put once per swap and baked into the chunk program. Quantizing
+them shrinks the checkpoint-admission transfer and the resident
+parameter bytes ~4x (int8) / ~2x (bf16) per replica — the Gemma-on-TPU
+serving recipe — at a *bounded, tested* accuracy cost:
+
+- **int8** — per-tensor symmetric quantization: ``scale = max|w| /
+  127``, ``q = round(w / scale)`` clipped to ``[-127, 127]``,
+  dequant-on-load ``w' = q * scale``. The round-trip error is
+  mathematically ``<= scale / 2`` per element (`max_error_bound`;
+  asserted by tests/test_quantize.py), and — the loader's int16
+  exact-transfer idiom (`data/loader.py` scale_factor machinery), one
+  octave coarser — EXACT for tensors whose values already lie on the
+  int8 grid ``scale * {-127..127}``.
+- **bfloat16** — round-through-bf16 (storage halves; the dequantized
+  f32 value is the bf16 rounding of the original, relative error
+  ``<= 2^-8``).
+
+Dequant-on-load keeps every downstream consumer untouched: the engine,
+the chunk program and the Pallas decode kernel all see float32 arrays
+— the QUANTIZED float32 arrays, so the canary gate's bitwise burst
+(`serve/rollout.py`) still holds exactly (reference and replica both
+serve the dequantized weights). `stamp_ckpt_id` marks the serving
+identity (``ckpt_00000042:int8``) so every Result names not just which
+checkpoint produced its strokes but at which precision — mixed-
+precision serving stays as honest as mixed-version serving.
+
+Scalars and integer leaves pass through untouched; so do float leaves
+quantization would zero out entirely (all-zero tensors get scale 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+QUANT_MODES = ("float32", "bfloat16", "int8")
+
+# short serving-identity tags (ckpt_id suffixes)
+_TAGS = {"int8": "int8", "bfloat16": "bf16"}
+
+
+@dataclasses.dataclass
+class QTensor:
+    """One quantized tensor: integer (or bf16) storage + dequant scale."""
+
+    q: np.ndarray          # int8 storage (or bf16 for mode=bfloat16)
+    scale: float           # dequant step; 1.0 for bfloat16
+
+    def dequantize(self) -> np.ndarray:
+        return (np.asarray(self.q, np.float32) * np.float32(self.scale)
+                ).astype(np.float32)
+
+
+def check_mode(mode: str) -> None:
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"quantization mode must be one of {QUANT_MODES}, got "
+            f"{mode!r}")
+
+
+def _quantize_leaf(w: np.ndarray, mode: str) -> QTensor:
+    if mode == "bfloat16":
+        import jax.numpy as jnp
+
+        return QTensor(q=np.asarray(jnp.asarray(w, jnp.bfloat16)),
+                       scale=1.0)
+    amax = float(np.max(np.abs(w))) if w.size else 0.0
+    scale = amax / 127.0 if amax > 0.0 else 1.0
+    q = np.clip(np.rint(np.asarray(w, np.float64) / scale),
+                -127, 127).astype(np.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def _is_quantizable(leaf: Any) -> bool:
+    a = np.asarray(leaf)
+    return a.ndim >= 1 and np.issubdtype(a.dtype, np.floating)
+
+
+def quantize_params(params: Dict[str, Any], mode: str
+                    ) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Pack a param tree for storage/transfer at ``mode`` precision.
+
+    Returns ``(packed, report)``: ``packed`` mirrors the nested dict
+    structure with quantizable float leaves replaced by
+    :class:`QTensor`; ``report`` has one row per quantized tensor —
+    ``{path, shape, scale, bound, max_err}`` where ``bound`` is the
+    guaranteed per-element error bound (``scale/2`` for int8,
+    ``max|w| * 2^-8`` for bf16) and ``max_err`` the measured round-trip
+    ``max|w - dequant|`` (always ``<= bound``; the tested budget).
+    """
+    check_mode(mode)
+    report: List[Dict[str, Any]] = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, f"{path}/{k}" if path else k)
+                    for k, v in node.items()}
+        if mode == "float32" or not _is_quantizable(node):
+            return node
+        w = np.asarray(node, np.float32)
+        qt = _quantize_leaf(w, mode)
+        err = float(np.max(np.abs(w - qt.dequantize()))) if w.size \
+            else 0.0
+        bound = qt.scale / 2.0 if mode == "int8" \
+            else float(np.max(np.abs(w)) * 2.0 ** -8) if w.size else 0.0
+        report.append({"path": path, "shape": tuple(w.shape),
+                       "scale": qt.scale, "bound": bound,
+                       "max_err": err})
+        return qt
+    return walk(params, ""), report
+
+
+def dequantize_params(packed: Dict[str, Any]) -> Dict[str, Any]:
+    """Unpack a `quantize_params` tree back to float32 arrays."""
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, QTensor):
+            return node.dequantize()
+        return node
+    return walk(packed)
+
+
+def quantize_for_serving(params: Dict[str, Any], mode: str
+                         ) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """The swap/admission entry point: round params through ``mode``.
+
+    Returns ``(params', report)`` where ``params'`` is the float32
+    tree the engine actually serves (the dequantized quantized
+    weights — identical structure, every consumer unchanged) and
+    ``report`` the per-tensor error budget. ``float32`` is the
+    identity (empty report) so call sites need no branching.
+    """
+    check_mode(mode)
+    if mode == "float32":
+        return params, []
+    packed, report = quantize_params(params, mode)
+    return dequantize_params(packed), report
+
+
+def stamp_ckpt_id(ckpt_id: str, mode: str) -> str:
+    """Serving identity of a quantized checkpoint: ``<id>:int8`` /
+    ``<id>:bf16``; float32 (and empty ids) pass through unchanged."""
+    check_mode(mode)
+    if mode == "float32" or not ckpt_id:
+        return ckpt_id
+    return f"{ckpt_id}:{_TAGS[mode]}"
+
+
+def max_error_bound(w: np.ndarray, mode: str) -> float:
+    """The guaranteed per-element round-trip error bound for ``w``."""
+    check_mode(mode)
+    if mode == "float32" or not np.asarray(w).size:
+        return 0.0
+    amax = float(np.max(np.abs(w)))
+    if mode == "int8":
+        return (amax / 127.0 if amax > 0.0 else 1.0) / 2.0
+    return amax * 2.0 ** -8

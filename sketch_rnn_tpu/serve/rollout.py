@@ -283,6 +283,23 @@ class RolloutController:
                     "reason": e.reason}
         new_params = state.params
         new_id = ckpt_id_of(int(meta.get("step", 0)))
+        # quantized admission (ISSUE 17): round the admitted params
+        # through the fleet's serving precision BEFORE the reference
+        # burst, so the canary's bitwise gate proves the QUANTIZED
+        # weights (reference and replicas both serve the dequantized
+        # tree) and every Result stamps the precision it was served at
+        quant_mode = str(getattr(self.hps, "serve_quantize", "float32"))
+        if quant_mode != "float32":
+            from sketch_rnn_tpu.serve.quantize import (quantize_for_serving,
+                                                       stamp_ckpt_id)
+
+            new_params, qreport = quantize_for_serving(new_params,
+                                                       quant_mode)
+            new_id = stamp_ckpt_id(new_id, quant_mode)
+            self._log("quantize", ckpt_id=new_id, mode=quant_mode,
+                      tensors=len(qreport),
+                      max_err=max((r["max_err"] for r in qreport),
+                                  default=0.0))
         if new_id == old_id:
             return {"ok": True, "phase": "noop", "from": old_id,
                     "to": new_id, "swapped": 0, "rolled_back": False,
@@ -326,7 +343,8 @@ class RolloutController:
                         f"canary replica {canary} did not drain")
             fault_point("rollout.canary")
             fleet.swap_params_retired(canary, new_params,
-                                      ckpt_id=new_id)
+                                      ckpt_id=new_id,
+                                      param_dtype=quant_mode)
             swapped.append(canary)
             got = self._burst_on(canary)
             if not _bitwise(reference, got):
@@ -356,7 +374,8 @@ class RolloutController:
                     raise RuntimeError(
                         f"replica {idx} did not drain for its swap")
                 fleet.swap_params_retired(idx, new_params,
-                                          ckpt_id=new_id)
+                                          ckpt_id=new_id,
+                                          param_dtype=quant_mode)
                 swapped.append(idx)
                 got = self._burst_on(idx)
                 if not _bitwise(reference, got):

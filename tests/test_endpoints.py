@@ -460,7 +460,10 @@ def test_encode_compile_accounting(setup):
                  and e.get("name") == "serve_encode"]
         assert len(spans) == 3          # edges (8, 16, 24)
         geoms = [e["args"]["geometry"] for e in spans]
-        assert sorted(geoms) == ["(B4,E16)", "(B4,E24)", "(B4,E8)"]
+        # r17: the key carries the decode-kernel flavor + param dtype
+        assert sorted(geoms) == ["(B4,E16,scan,float32)",
+                                 "(B4,E24,scan,float32)",
+                                 "(B4,E8,scan,float32)"]
         prog.warm()                     # all hits, no new compiles
         spans2 = [e for e in tel.events() if e.get("type") == "span"
                   and e.get("name") == "serve_encode"]

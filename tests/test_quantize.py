@@ -1,0 +1,207 @@
+"""Int8/bf16 inference quantization + quantized rollout admission.
+
+ISSUE 17 satellite: the quantization round-trip honors its DOCUMENTED
+error budget (every element within ``scale/2`` for int8, ``2^-8``
+relative for bf16; the per-tensor report rows agree), tensors already
+on the int8 grid transfer EXACTLY (the loader's scale_factor idiom one
+octave coarser), ``quantize_for_serving`` is a true identity at
+float32, ``stamp_ckpt_id`` marks the serving precision — and a fleet
+rolled to a checkpoint under ``serve_quantize=int8`` serves strokes
+bitwise equal to the offline reference on the QUANTIZED weights, every
+Result stamped ``<ckpt_id>:int8``.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.serve import Request, ServeFleet
+from sketch_rnn_tpu.serve.endpoints import serve_requests
+from sketch_rnn_tpu.serve.quantize import (QTensor, check_mode,
+                                           dequantize_params,
+                                           max_error_bound,
+                                           quantize_for_serving,
+                                           quantize_params,
+                                           stamp_ckpt_id)
+from sketch_rnn_tpu.serve.rollout import RolloutController
+from sketch_rnn_tpu.train.checkpoint import ckpt_id_of, save_checkpoint
+from sketch_rnn_tpu.train.state import make_train_state
+
+# ------------------------------------------------------------ round-trip
+
+
+def _tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": rng.normal(0, 1.7, (8, 16)).astype(np.float32),
+        "b": rng.normal(0, 0.02, (16,)).astype(np.float32),
+        "nested": {"k": rng.normal(0, 40.0, (3, 5)).astype(np.float32)},
+        "step": 7,                       # int scalar: passthrough
+        "scale": np.float32(1.25),       # 0-d float: passthrough
+        "idx": np.arange(4),             # int array: passthrough
+        "zero": np.zeros((4, 4), np.float32),
+    }
+
+
+@pytest.mark.parametrize("mode", ["int8", "bfloat16"])
+def test_round_trip_error_within_budget(mode):
+    """Element-wise |w - dequant| <= the documented per-tensor bound,
+    and every report row's measured max_err <= its own bound."""
+    tree = _tree()
+    packed, report = quantize_params(tree, mode)
+    out = dequantize_params(packed)
+    quant_paths = {r["path"] for r in report}
+    assert quant_paths == {"w", "b", "nested/k", "zero"}
+    for r in report:
+        assert r["max_err"] <= r["bound"] + 1e-12, r
+    for path, w in [("w", tree["w"]), ("b", tree["b"]),
+                    ("nested/k", tree["nested"]["k"])]:
+        node = out
+        for part in path.split("/"):
+            node = node[part]
+        bound = max_error_bound(w, mode)
+        assert bound > 0
+        np.testing.assert_allclose(node, w, atol=bound, rtol=0)
+        assert node.dtype == np.float32
+    # passthrough leaves are untouched (same object where possible)
+    assert out["step"] == 7 and float(out["scale"]) == 1.25
+    np.testing.assert_array_equal(out["idx"], tree["idx"])
+    # all-zero tensor: scale 1.0, exact zero round-trip
+    zrow = next(r for r in report if r["path"] == "zero")
+    assert zrow["scale"] == 1.0 and zrow["max_err"] == 0.0
+    np.testing.assert_array_equal(out["zero"], tree["zero"])
+
+
+def test_int8_grid_values_transfer_exactly():
+    """Values already on the int8 grid scale*{-127..127} round-trip
+    BITWISE — the loader's int16 exact-transfer idiom, one octave
+    coarser."""
+    scale = 0.03125  # power of two: q*scale exact in f32
+    q = np.asarray([[-127, -3, 0, 1, 64, 127]], np.float32)
+    w = (q * scale).astype(np.float32)
+    packed, report = quantize_params({"g": w}, "int8")
+    assert isinstance(packed["g"], QTensor)
+    np.testing.assert_array_equal(packed["g"].q, q.astype(np.int8))
+    np.testing.assert_array_equal(dequantize_params(packed)["g"], w)
+    assert report[0]["max_err"] == 0.0
+
+
+def test_bfloat16_is_round_through():
+    w = _tree(3)["w"]
+    out, _ = quantize_for_serving({"w": w}, "bfloat16")
+    want = np.asarray(jnp.asarray(w, jnp.bfloat16), np.float32)
+    np.testing.assert_array_equal(out["w"], want)
+
+
+def test_float32_is_identity_and_modes_validate():
+    tree = _tree(1)
+    out, report = quantize_for_serving(tree, "float32")
+    assert out is tree and report == []
+    with pytest.raises(ValueError, match="int4"):
+        check_mode("int4")
+    with pytest.raises(ValueError, match="fp8"):
+        quantize_for_serving(tree, "fp8")
+
+
+def test_stamp_ckpt_id():
+    assert stamp_ckpt_id("ckpt_00000020", "int8") == \
+        "ckpt_00000020:int8"
+    assert stamp_ckpt_id("ckpt_00000020", "bfloat16") == \
+        "ckpt_00000020:bf16"
+    assert stamp_ckpt_id("ckpt_00000020", "float32") == \
+        "ckpt_00000020"
+    assert stamp_ckpt_id("", "int8") == ""
+    with pytest.raises(ValueError):
+        stamp_ckpt_id("x", "int9")
+
+
+def test_model_params_quantize_with_bounded_error():
+    """The real param tree: every matrix/bias quantizes, the serving
+    tree keeps structure + dtypes, report rows all within budget."""
+    hps = HParams(batch_size=4, max_seq_len=16, enc_rnn_size=12,
+                  dec_rnn_size=16, z_size=6, num_mixture=3)
+    params = SketchRNN(hps).init_params(jax.random.key(0))
+    served, report = quantize_for_serving(params, "int8")
+    assert jax.tree_util.tree_structure(served) == \
+        jax.tree_util.tree_structure(params)
+    n_arrays = sum(np.asarray(p).ndim >= 1
+                   for p in jax.tree_util.tree_leaves(params))
+    assert len(report) == n_arrays
+    for r in report:
+        assert 0 <= r["max_err"] <= r["bound"] + 1e-12, r
+
+
+# ------------------------------------------------- quantized admission
+
+
+TINY = dict(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+            dec_rnn_size=16, z_size=6, num_mixture=3, hyper_rnn_size=8,
+            hyper_embed_size=4, serve_slots=2, serve_chunk=2)
+
+
+def _req(i, z_dim, cap=6):
+    rng = np.random.default_rng(i)
+    return Request(key=jax.random.key(1000 + i),
+                   z=rng.standard_normal(z_dim).astype(np.float32),
+                   temperature=0.8, max_len=cap)
+
+
+def test_rollout_admits_quantized_checkpoint(tmp_path):
+    """serve_quantize=int8: the admitted checkpoint is quantized at
+    the rollout boundary, the fleet's serving identity is the STAMPED
+    id, and every post-roll Result is bitwise the offline reference on
+    the dequantized-int8 weights — the canary gate proved the
+    quantized bits, not the full-precision ones."""
+    hps = HParams(**TINY).replace(serve_quantize="int8")
+    model = SketchRNN(hps)
+    state_old = make_train_state(model, hps, jax.random.key(0))._replace(
+        step=jnp.asarray(10, jnp.int32))
+    state_new = make_train_state(model, hps, jax.random.key(7))._replace(
+        step=jnp.asarray(20, jnp.int32))
+    d = str(tmp_path / "ckpts")
+    os.makedirs(d, exist_ok=True)
+    p_new = save_checkpoint(d, state_new, 1.0, hps)
+    stamped = stamp_ckpt_id(ckpt_id_of(20), "int8")
+    assert stamped == "ckpt_00000020:int8"
+
+    fleet = ServeFleet(model, hps, state_old.params, replicas=2,
+                       ckpt_id=ckpt_id_of(10))
+    fleet.warm(_req(0, hps.z_size))
+    fleet.start()
+    try:
+        canary = [_req(900 + i, hps.z_size, cap=4) for i in range(3)]
+        ctl = RolloutController(fleet, model, hps, state_old, canary)
+        rpt = ctl.roll_to(p_new)
+        assert rpt["ok"], rpt
+        assert fleet.serving_ckpt_id == stamped
+        events = [e["event"] for e in ctl.rollout_log]
+        assert "quantize" in events
+
+        uids = list(range(6))
+        for r in [dataclasses.replace(_req(i, hps.z_size), uid=i)
+                  for i in uids]:
+            fleet.submit(r)
+        assert fleet.drain(timeout=120)
+
+        qparams, qreport = quantize_for_serving(state_new.params,
+                                                "int8")
+        assert qreport  # the admission really had something to round
+        ref = serve_requests(
+            model, hps, qparams,
+            [dataclasses.replace(_req(i, hps.z_size), uid=i)
+             for i in uids],
+            slots=hps.serve_slots, chunk=hps.serve_chunk,
+            pool_pad=max(fleet.pool_cap, len(uids)))
+        ref = {r.uid: r.strokes5 for r in ref["results"]}
+        for uid in uids:
+            res = fleet.results[uid]["result"]
+            np.testing.assert_array_equal(res.strokes5, ref[uid])
+            assert res.ckpt_id == stamped
+    finally:
+        fleet.close()

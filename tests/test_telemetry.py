@@ -490,8 +490,9 @@ def test_traced_engine_serves_two_burst_sizes(tmp_path):
     spans = [e for e in tel.events() if e["type"] == "span"
              and e["cat"] == "compile" and e["name"] == "serve_chunk"]
     assert len(spans) == 2
+    # r17: the key carries the decode-kernel flavor + param dtype
     assert {s["args"]["geometry"] for s in spans} == {
-        "(B2,K2,N3)", "(B2,K2,N5)"}
+        "(B2,K2,N3,scan,float32)", "(B2,K2,N5,scan,float32)"}
 
 
 # -- compile & memory accounting (ISSUE 8) -----------------------------------
