@@ -31,7 +31,7 @@ import time
 # automatically keyed, summarized and gated consistently.
 BINARY_KINDS = ("resilience", "serve_cost", "serve_cache",
                 "serve_autoscale", "serve_endpoint", "rollout",
-                "serve_kernel")
+                "serve_kernel", "serve_spec")
 
 
 def key_of(r: dict):
@@ -119,6 +119,16 @@ def key_of(r: dict):
                 f"B={r.get('slots')} K={r.get('chunk')} "
                 f"H={r.get('dec_rnn_size')} "
                 f"cond={r.get('conditional')} dev={dev}")
+    if r.get("kind") == "serve_spec":
+        # speculative-decoding cells (ISSUE 18): one per (cell, draft
+        # arm, depth D) — bitwise stroke parity with the legacy engine
+        # plus deterministic accept/reject replay is the binary
+        # signal; acceptance-rate / commit-rate columns print beside
+        # it
+        return ("servespec", r.get("dec_model"),
+                f"draft={r.get('draft')} D={r.get('draft_depth')} "
+                f"B={r.get('slots')} K={r.get('chunk')} "
+                f"n={r.get('n_requests')} dev={dev}")
     if r.get("kind") == "serve_autoscale":
         # traffic-grid autoscale cells (ISSUE 12): one per (trace,
         # cache) arm pair — reproducible scale plan + autoscaled shed
@@ -175,6 +185,23 @@ def _serve_lat_cols(r: dict) -> str:
         return ""
     return " lat[ms] " + "/".join(
         "-" if v is None else f"{1e3 * v:.0f}" for _, v in ps)
+
+
+def _spec_cols(r: dict) -> str:
+    """Speculative-decoding columns for a serve row (ISSUE 18):
+    accepted steps committed per engaged device step (the scheduling
+    economics the draft buys; legacy caps at 1.0) and — when the row
+    carries a speculative block — the draft acceptance rate. Rows
+    predating the columns print nothing."""
+    cols = []
+    commit = r.get("engine_accepted_steps_per_device_step")
+    if commit is not None:
+        cols.append(f" commit={commit}")
+    spec = r.get("speculative") or {}
+    if spec.get("acceptance_rate") is not None:
+        cols.append(f" acc={spec['acceptance_rate']:.1%}"
+                    f"@D{spec.get('draft_depth')}")
+    return "".join(cols)
 
 
 def _fleet_cols(r: dict) -> str:
@@ -303,7 +330,8 @@ def main(argv=None) -> int:
             sp_col = f" {sp}x vs sampler" if sp is not None else ""
             print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
                   f"best={metric_of(b):>11.2f} sk/s ({when}"
-                  f"{_serve_lat_cols(b)}{_tail_col(b)}{sp_col})  "
+                  f"{_serve_lat_cols(b)}{_tail_col(b)}{_spec_cols(b)}"
+                  f"{sp_col})  "
                   f"latest={metric_of(l):>11.2f}")
             # quantized-vs-full / kernel-vs-scan comparison rows
             # (ISSUE 17): the latest row's in-run arms at the SAME
@@ -402,6 +430,20 @@ def main(argv=None) -> int:
                   f"scan={l.get('scan_chunk_ms')}ms "
                   f"pallas={l.get('pallas_chunk_ms')}ms "
                   f"parity<={l.get('parity_max_diff'):.1e})")
+            continue
+        if k[0] == "servespec":
+            # speculative cell (ISSUE 18): parity + replay is the
+            # binary signal; the serving economics print beside it —
+            # draft acceptance rate, accepted steps committed per
+            # device step (the legacy engine caps at 1.0), and the
+            # device steps saved vs the in-run draft-off baseline
+            ar = l.get("acceptance_rate")
+            print(f"{k[0]:8s} {k[1] or '-':11s} {k[2]:40s} "
+                  f"latest={'ok' if l.get('ok') else 'BROKEN':>11s} "
+                  f"(acc={'-' if ar is None else format(ar, '.1%')} "
+                  f"commit={l.get('accepted_steps_per_device_step')} "
+                  f"saved={l.get('device_steps_saved')}/"
+                  f"{l.get('device_steps')} steps)")
             continue
         if k[0] == "autoscale":
             # traffic autoscale cell (ISSUE 12): the shed comparison

@@ -245,6 +245,25 @@ def serve_decode_check(args) -> int:
         pin_bitwise = all(
             np.array_equal(scan[u].strokes5, pin[u].strokes5)
             for u in scan)
+        # ISSUE 18: the speculative draft+verify program, over the
+        # SAME endpoint mix (planned carries included), must emit
+        # bitwise the scan chunk program's strokes — any draft. The
+        # lstm cell uses the teacher-as-draft (acceptance ~1), the
+        # layer_norm cell a random-init draft (acceptance ~0), so the
+        # pin covers both extremes of the accept-length spectrum.
+        from sketch_rnn_tpu.models.draft import (DraftDecoder,
+                                                 self_draft_params)
+        if cell == "lstm":
+            dp = self_draft_params(params, hps)
+        else:
+            dp = DraftDecoder(hps).init_params(
+                jax.random.key(args.seed + 2))
+        spec = burst(hps, eng_kw={"draft_params": dp,
+                                  "draft_depth": 6})
+        spec_bitwise = all(
+            np.array_equal(scan[u].strokes5, spec[u].strokes5)
+            and scan[u].steps == spec[u].steps
+            for u in scan)
         by_ep = {}
         for u, ref in sorted(scan.items()):
             ep = requests[u].endpoint or "generate"
@@ -268,9 +287,11 @@ def serve_decode_check(args) -> int:
         for ep, row in by_ep.items():
             row["ok"] = (row["max_diff"] <= SERVE_DECODE_TOL
                          and row["steps_match"] and row["pen_match"])
-        cell_ok = pin_bitwise and all(r["ok"] for r in by_ep.values())
+        cell_ok = (pin_bitwise and spec_bitwise
+                   and all(r["ok"] for r in by_ep.values()))
         ok &= cell_ok
         table["cells"][cell] = {"scan_pin_bitwise": pin_bitwise,
+                                "spec_bitwise": spec_bitwise,
                                 "endpoints": by_ep, "ok": cell_ok}
         for ep, row in sorted(by_ep.items()):
             print(f"# {cell:11s} {ep:12s} n={row['n']:2d} "
@@ -278,7 +299,8 @@ def serve_decode_check(args) -> int:
                   f"steps_match={row['steps_match']} "
                   f"{'OK' if row['ok'] else 'FAIL'}",
                   file=sys.stderr)
-        print(f"# {cell:11s} scan-pin bitwise: {pin_bitwise}",
+        print(f"# {cell:11s} scan-pin bitwise: {pin_bitwise}  "
+              f"speculative bitwise: {spec_bitwise}",
               file=sys.stderr)
     table["ok"] = bool(ok)
     print(json.dumps(table))

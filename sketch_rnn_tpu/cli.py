@@ -284,6 +284,52 @@ def cmd_train(args) -> int:
     return 0
 
 
+def cmd_distill(args) -> int:
+    """Distill a draft decoder (ISSUE 18) from the latest checkpoint.
+
+    Restores the teacher from ``--workdir``, then drives the REAL train
+    loop (bucketed loader, async checkpointing, resume, telemetry) over
+    a ``DistillModel`` into ``<workdir>/draft`` — its own checkpoints,
+    draft-shaped, paired to the teacher via the RUN.json lineage block.
+    Serve the pair with ``serve-bench --draft_ckpt <workdir>/draft``.
+    """
+    from sketch_rnn_tpu.parallel import multihost as mh
+    from sketch_rnn_tpu.train import distill
+    from sketch_rnn_tpu.train.checkpoint import ckpt_id_of
+    mh.initialize()  # no-op unless launched as a multi-host cluster
+    hps = _resolve_hps(args)
+    try:
+        model, state, scale, meta = _restore(hps, args.workdir)
+    except FileNotFoundError as e:
+        print(f"[cli] distill needs a teacher checkpoint in "
+              f"--workdir: {e}", file=sys.stderr)
+        return 2
+    # the draft must train on the TEACHER's normalization — the
+    # checkpointed scale overrides the recomputed one, like eval/sample
+    # (but unlike eval/sample, distillation DOES need the train corpus,
+    # which _load_data skips for synthetic runs with a pinned scale)
+    if args.synthetic:
+        from sketch_rnn_tpu.data.loader import synthetic_loader
+        grid = (args.synthetic_grid if args.synthetic_grid > 0 else None)
+        train_l, _ = synthetic_loader(
+            mh.local_batch_hps(hps), 20 * hps.batch_size, seed=1,
+            augment=True, scale_factor=scale,
+            host_id=mh.process_index(), num_hosts=mh.process_count(),
+            integer_grid=grid)
+    else:
+        train_l, _, _, scale = _load_data(hps, args, scale_factor=scale)
+    print(f"[cli] distilling draft (size {hps.draft_rnn_size}, "
+          f"{hps.draft_num_mixture or hps.num_mixture} mixtures) from "
+          f"teacher step {int(state.step)}, scale={scale:.4f}",
+          flush=True)
+    distill(hps, state.params, train_l, args.workdir, seed=args.seed,
+            num_steps=(args.steps or None),
+            teacher_ckpt_id=ckpt_id_of(int(state.step)),
+            scale_factor=scale,
+            resume=not getattr(args, "no_resume", False))
+    return 0
+
+
 def cmd_eval(args) -> int:
     from sketch_rnn_tpu.parallel import multihost as mh
     from sketch_rnn_tpu.parallel.mesh import make_mesh
@@ -514,6 +560,61 @@ def cmd_serve_bench(args) -> int:
         except ValueError as e:
             print(f"[cli] {e}", file=sys.stderr)
             return 2
+    # speculative decoding (ISSUE 18): usage input fails HERE, before
+    # the restore/compile, like every flavor/SLO check around it
+    if not args.draft_ckpt:
+        if args.draft_depth or args.draft_tol >= 0 or args.draft_noise:
+            print("[cli] --draft_depth/--draft_tol/--draft_noise "
+                  "configure speculative decoding; add --draft_ckpt "
+                  "DIR (a distilled draft run — `cli distill` writes "
+                  "<workdir>/draft) or --draft_ckpt self",
+                  file=sys.stderr)
+            return 2
+    else:
+        if hps.decode_kernel == "pallas":
+            print("[cli] speculative decoding is scan-only (the "
+                  "draft+verify program is one combined lax.scan); "
+                  "drop --draft_ckpt or use --decode_kernel scan",
+                  file=sys.stderr)
+            return 2
+        if args.draft_depth < 0:
+            print(f"[cli] --draft_depth must be >= 0, got "
+                  f"{args.draft_depth}", file=sys.stderr)
+            return 2
+        if args.draft_ckpt == "self":
+            # self-draft: the teacher's own decode weights in draft
+            # geometry (optionally noised) — the zero-training demo.
+            # Force the matching geometry; self_draft_params refuses
+            # anything else.
+            if hps.dec_model != "lstm":
+                print(f"[cli] --draft_ckpt self needs dec_model=lstm "
+                      f"(got {hps.dec_model!r}); distill a real draft "
+                      f"instead", file=sys.stderr)
+                return 2
+            hps = hps.replace(draft_rnn_size=hps.dec_rnn_size,
+                              draft_num_mixture=0)
+        else:
+            if args.draft_noise:
+                print("[cli] --draft_noise perturbs a SELF-draft; a "
+                      "distilled draft is served as trained",
+                      file=sys.stderr)
+                return 2
+            from sketch_rnn_tpu.utils import runinfo
+            man = runinfo.read_manifest(args.draft_ckpt)
+            if man is None:
+                print(f"[cli] --draft_ckpt {args.draft_ckpt}: no "
+                      f"RUN.json manifest (want the distill run dir, "
+                      f"e.g. <teacher_workdir>/draft)", file=sys.stderr)
+                return 2
+            lineage = man.get("distill") or {}
+            if lineage:
+                # the lineage block pins the draft geometry the engine
+                # must rebuild to load this checkpoint
+                hps = hps.replace(
+                    draft_rnn_size=int(lineage.get(
+                        "draft_rnn_size", hps.draft_rnn_size)),
+                    draft_num_mixture=int(lineage.get(
+                        "draft_num_mixture", hps.draft_num_mixture)))
     # SLO specs, admission classes and the metrics port are usage
     # input: fail before the (expensive) restore/compile, like sample's
     # flag validation — a taken port must not cost the whole warmup
@@ -709,7 +810,8 @@ def _serve_telemetry_abort(trace_dir, tel, tele, mem_sampler) -> None:
 
 def _serve_bench_fleet(args, hps, model, state_params, requests,
                        slo_tracker, server=None, endpoints_cfg=None,
-                       ckpt_id: str = "", template_state=None):
+                       ckpt_id: str = "", template_state=None,
+                       draft_kw=None):
     """The fleet measured section: build + warm the fleet, THEN enable
     telemetry (via the shared helper — the can't-recompile-into-the-
     window ordering), then replay the open-loop schedule and drain.
@@ -741,7 +843,7 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
                        chunk=args.chunk, greedy=args.greedy,
                        classes=classes, slo=slo_tracker,
                        endpoint_classes=endpoint_classes,
-                       ckpt_id=ckpt_id)
+                       ckpt_id=ckpt_id, **(draft_kw or {}))
     if server is not None:
         # /healthz now answers from the LIVE fleet: a replica death
         # mid-run flips the verdict to degraded (ISSUE 10)
@@ -900,6 +1002,39 @@ def _serve_bench_run(args, hps, slo_tracker, server,
         state_params, qreport = quantize_for_serving(
             state_params, hps.serve_quantize)
         init_ckpt_id = stamp_ckpt_id(init_ckpt_id, hps.serve_quantize)
+    # speculative decoding (ISSUE 18): pair the serving params with a
+    # draft tree. The draft stays f32 even under --quantize — it is
+    # tiny, and the acceptance rule's bitwise contract is against the
+    # (already-quantize-rounded) verifier tree above, so draft
+    # precision only moves the acceptance RATE, never the strokes.
+    draft_params = None
+    if getattr(args, "draft_ckpt", ""):
+        from sketch_rnn_tpu.models.draft import (DraftDecoder,
+                                                 self_draft_params)
+        if args.draft_ckpt == "self":
+            noise = getattr(args, "draft_noise", 0.0)
+            draft_params = self_draft_params(
+                state_params, hps,
+                key=jax.random.key(args.seed + 1) if noise else None,
+                noise=noise)
+        else:
+            from sketch_rnn_tpu.train import (make_train_state,
+                                              restore_checkpoint)
+            dtemplate = make_train_state(DraftDecoder(hps), hps,
+                                         jax.random.key(0))
+            dstate, _, dmeta = restore_checkpoint(args.draft_ckpt,
+                                                  dtemplate)
+            draft_params = dstate.params
+            print(f"[cli] speculative: draft from {args.draft_ckpt} "
+                  f"step {dmeta['step']}, D="
+                  f"{args.draft_depth or hps.draft_depth}, tol="
+                  f"{args.draft_tol if args.draft_tol >= 0 else hps.draft_tol}",
+                  file=sys.stderr)
+    draft_kw = dict(
+        draft_params=draft_params,
+        draft_depth=getattr(args, "draft_depth", 0),
+        draft_tol=(args.draft_tol if getattr(args, "draft_tol", -1.0)
+                   >= 0 else None))
     key = jax.random.key(args.seed)
     kz, kreq = jax.random.split(key)
     n = args.n
@@ -931,7 +1066,8 @@ def _serve_bench_run(args, hps, slo_tracker, server,
         out_metrics, fleet_report, rows, handles = _serve_bench_fleet(
             args, hps, model, state_params, requests, slo_tracker,
             server=server, endpoints_cfg=endpoints_cfg,
-            ckpt_id=init_ckpt_id, template_state=state)
+            ckpt_id=init_ckpt_id, template_state=state,
+            draft_kw=draft_kw)
         trace_dir, tel, tele, mem_sampler = handles
         slots_v, chunk_v = fleet_report["slots"], fleet_report["chunk"]
         if writer is not None:
@@ -939,7 +1075,8 @@ def _serve_bench_run(args, hps, slo_tracker, server,
                 writer.write(i + 1, row)
     else:
         engine = ServeEngine(model, hps, state_params, slots=args.slots,
-                             chunk=args.chunk, greedy=args.greedy)
+                             chunk=args.chunk, greedy=args.greedy,
+                             **draft_kw)
         slots_v, chunk_v = engine.slots, engine.chunk
         # warmup: compile outside the timed run. The chunk program is
         # shape-specialized on the request-pool size, so the warm burst
@@ -1173,6 +1310,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "p=... firing decisions")
     p.set_defaults(fn=cmd_train)
 
+    p = sub.add_parser("distill",
+                       help="distill a draft decoder for speculative "
+                            "serving")
+    _add_common(p)
+    p.add_argument("--steps", type=int, default=0,
+                   help="distillation steps (0 = hps.num_steps); the "
+                        "run resumes from <workdir>/draft like train "
+                        "resumes from <workdir>")
+    p.add_argument("--no_resume", action="store_true",
+                   help="start the draft fresh even when "
+                        "<workdir>/draft holds checkpoints")
+    p.set_defaults(fn=cmd_distill)
+
     p = sub.add_parser("eval", help="evaluate a checkpoint")
     _add_common(p)
     p.add_argument("--split", choices=("valid", "test"), default="valid")
@@ -1240,6 +1390,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "round-through-bf16. Compute stays f32; the "
                         "served ckpt_id is stamped ':int8'/':bf16'. "
                         "Default: hps.serve_quantize")
+    p.add_argument("--draft_ckpt", default="",
+                   help="speculative decoding (ISSUE 18): serve with a "
+                        "draft decoder proposing D steps per full-model "
+                        "verification chunk. DIR = a distilled draft "
+                        "run (`cli distill` writes <workdir>/draft; "
+                        "the RUN.json lineage pins the draft "
+                        "geometry); 'self' = the teacher's own decode "
+                        "weights as the draft (zero-training demo, "
+                        "lstm only). Strokes are BITWISE the "
+                        "non-speculative engine's either way — only "
+                        "device steps change. Scan kernel only")
+    p.add_argument("--draft_depth", type=int, default=0,
+                   help="draft steps per verification chunk D "
+                        "(0 = hps.draft_depth)")
+    p.add_argument("--draft_tol", type=float, default=-1.0,
+                   help="acceptance tolerance on the continuous "
+                        "offsets, in model units (< 0 = "
+                        "hps.draft_tol); pen state always matches "
+                        "exactly or rejects")
+    p.add_argument("--draft_noise", type=float, default=0.0,
+                   help="with --draft_ckpt self: per-leaf seeded "
+                        "Gaussian weight noise, making the self-draft "
+                        "an imperfect predictor (deterministic partial "
+                        "acceptance — exercise the reject path without "
+                        "training a draft)")
     p.add_argument("--static", action="store_true",
                    help="disable slot recycling (freeze-until-batch-done "
                         "schedule, for comparison)")

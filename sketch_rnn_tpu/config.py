@@ -234,6 +234,26 @@ class HParams:
     #   ascending, terminal edge <= max_seq_len (max_seq_len is always
     #   an implicit terminal edge). Empty (default) = the small
     #   power-of-two ladder serve/endpoints.default_prefix_edges picks.
+    draft_rnn_size: int = 64           # hidden size of the speculative
+    #   draft decoder (ISSUE 18): a 1-layer narrow LSTM distilled from
+    #   the full decoder (`cli distill`) that proposes the next stroke
+    #   one combined-scan position ahead of the verifier. Small enough
+    #   that riding along with the full cell adds marginal FLOPs.
+    draft_num_mixture: int = 0         # GMM components of the draft MDN
+    #   head; 0 (default) inherits num_mixture. A truncated mixture
+    #   shrinks the draft head further at some acceptance-rate cost.
+    draft_depth: int = 32              # D: speculative positions per
+    #   verify dispatch. Each dispatch commits up to D accepted rows
+    #   plus the verifier's own correction row, so one program launch
+    #   can advance a slot D+1 steps instead of serve_chunk.
+    draft_tol: float = 0.35            # acceptance tolerance on the
+    #   continuous GMM draw: a proposal is accepted iff its pen one-hot
+    #   matches the verifier's EXACTLY (rejection over the pen-state
+    #   CDF — both samplers invert the same uniform) and |Δx|,|Δy|
+    #   deviate from the verifier's draw by <= draft_tol (data units).
+    #   Emitted rows are ALWAYS the verifier's draws, so draft_tol
+    #   trades acceptance rate against nothing — output is bitwise the
+    #   full model's at any tolerance.
 
     def __post_init__(self):
         if self.enc_model not in CELL_TYPES or self.dec_model not in CELL_TYPES:
@@ -298,6 +318,19 @@ class HParams:
                     f"serve_prefix_edges {edges} exceed max_seq_len="
                     f"{self.max_seq_len}; a prefix longer than the "
                     f"padded maximum can never be encoded")
+        if self.draft_rnn_size < 1:
+            raise ValueError(
+                f"draft_rnn_size must be >= 1, got {self.draft_rnn_size}")
+        if self.draft_num_mixture < 0:
+            raise ValueError(
+                f"draft_num_mixture must be >= 0 (0 = inherit "
+                f"num_mixture), got {self.draft_num_mixture}")
+        if self.draft_depth < 1:
+            raise ValueError(
+                f"draft_depth must be >= 1, got {self.draft_depth}")
+        if self.draft_tol < 0:
+            raise ValueError(
+                f"draft_tol must be >= 0, got {self.draft_tol}")
         if self.bucket_shuffle_window < 1:
             raise ValueError(f"bucket_shuffle_window must be >= 1, got "
                              f"{self.bucket_shuffle_window}")

@@ -1,3 +1,7 @@
+from sketch_rnn_tpu.models.draft import (DraftDecoder,
+                                         draft_mixture_count,
+                                         self_draft_params)
 from sketch_rnn_tpu.models.vae import SketchRNN
 
-__all__ = ["SketchRNN"]
+__all__ = ["SketchRNN", "DraftDecoder", "draft_mixture_count",
+           "self_draft_params"]

@@ -1,0 +1,152 @@
+"""Tiny draft decoder for speculative serving (ISSUE 18).
+
+A 1-layer narrow LSTM with its own (optionally truncated) MDN head,
+distilled from the full decoder (``cli distill`` / train.distill). In
+the serving engine's combined draft+verify scan it rides teacher-forced
+on the verifier's emitted stroke stream and proposes the NEXT row one
+position ahead; how often its proposals match the verifier (exact pen
+one-hot + ``draft_tol`` on the continuous draw) sets how many rows a
+dispatch commits. Its draws are never emitted, so its quality affects
+throughput only — correctness rests entirely on the verifier.
+
+Conditioning mirrors the full model: the draft consumes
+``[prev5 ; extra]`` where ``extra`` is the FULL model's time-invariant
+decoder features (z, class embedding) — in distillation the teacher is
+frozen, so these are fixed features, and at serve time they are already
+resident for the verifier. The z -> initial-carry projection is the
+draft's own (``draft_init_w/b``), as the carry geometry differs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.ops import linear as L
+from sketch_rnn_tpu.ops.cells import make_cell
+
+Params = Dict[str, Any]
+
+
+def draft_mixture_count(hps: HParams) -> int:
+    """Draft MDN components: ``draft_num_mixture`` or inherit the full M."""
+    return hps.draft_num_mixture if hps.draft_num_mixture > 0 \
+        else hps.num_mixture
+
+
+class DraftDecoder:
+    """Static draft-decoder definition; parameters are explicit pytrees.
+
+    Parameter keys are ``draft_``-prefixed so a draft tree can never be
+    confused with (or partially shadow) the full model's tree in a
+    checkpoint or a serve-engine binding.
+    """
+
+    def __init__(self, hps: HParams):
+        self.hps = hps
+        cd = {"float32": None,
+              "bfloat16": jnp.bfloat16}[hps.compute_dtype]
+        self.cell = make_cell("lstm", hps.draft_rnn_size, compute_dtype=cd)
+        self.num_mixture = draft_mixture_count(hps)
+        self.out_dim = 6 * self.num_mixture + 3
+
+    @property
+    def input_size(self) -> int:
+        """Matches the full model's decoder input: [prev5 ; z ; class]."""
+        hps = self.hps
+        size = 5
+        if hps.conditional:
+            size += hps.z_size
+        if hps.num_classes > 0:
+            size += hps.class_embed_size
+        return size
+
+    def init_params(self, key: jax.Array) -> Params:
+        hps = self.hps
+        keys = jax.random.split(key, 4)
+        params: Params = {
+            "draft_dec": self.cell.init_params(keys[0], self.input_size),
+            "draft_out_w": L.xavier_uniform(
+                keys[1], (hps.draft_rnn_size, self.out_dim)),
+            "draft_out_b": jnp.zeros((self.out_dim,), jnp.float32),
+        }
+        if hps.conditional:
+            params.update({
+                "draft_init_w": L.xavier_uniform(
+                    keys[2], (hps.z_size, self.cell.carry_size)),
+                "draft_init_b": jnp.zeros((self.cell.carry_size,),
+                                          jnp.float32),
+            })
+        return params
+
+    def initial_carry(self, params: Params, z: Optional[jax.Array],
+                      batch_size: int):
+        if z is None:
+            return self.cell.initial_carry(batch_size)
+        flat = jnp.tanh(
+            L.matmul(z, params["draft_init_w"], self.cell.compute_dtype)
+            + params["draft_init_b"])
+        return self.cell.unflatten_carry(flat)
+
+    def decode_step(self, params: Params, carry, x_prev: jax.Array,
+                    extra: Optional[jax.Array] = None
+                    ) -> Tuple[Any, jax.Array]:
+        """One step: ``[B, 5]`` prev stroke (+ time-invariant ``extra``
+        ``[B, E]``) -> (carry, raw draft MDN projection ``[B, 6M'+3]``)."""
+        inputs = x_prev if extra is None \
+            else jnp.concatenate([x_prev, extra], axis=-1)
+        carry, h = self.cell(params["draft_dec"], carry, inputs)
+        return carry, L.matmul(h, params["draft_out_w"],
+                               self.cell.compute_dtype) \
+            + params["draft_out_b"]
+
+
+def self_draft_params(params: Params, hps: HParams,
+                      key: Optional[jax.Array] = None,
+                      noise: float = 0.0) -> Params:
+    """Synthetic distillate: the TEACHER's decode weights copied into
+    the draft geometry, optionally perturbed by seeded Gaussian noise.
+
+    ``noise=0`` yields a draft whose proposals are bitwise the
+    verifier's draws (acceptance == 1 — the machinery/accounting pin);
+    small ``noise`` stands in for a distilled draft — deterministic
+    partial acceptance with mixed accept lengths, no training run
+    needed (serve_bench's smoke arm; real drafts come from ``cli
+    distill``). Requires the degenerate geometry a copy implies:
+    ``dec_model == "lstm"``, ``draft_rnn_size == dec_rnn_size`` and an
+    inherited mixture count.
+    """
+    if hps.dec_model != "lstm":
+        raise ValueError(
+            f"self_draft_params copies an LSTM decoder; dec_model="
+            f"{hps.dec_model!r} has a different carry/param geometry")
+    if hps.draft_rnn_size != hps.dec_rnn_size:
+        raise ValueError(
+            f"self_draft_params needs draft_rnn_size == dec_rnn_size, "
+            f"got {hps.draft_rnn_size} != {hps.dec_rnn_size}")
+    if draft_mixture_count(hps) != hps.num_mixture:
+        raise ValueError(
+            f"self_draft_params needs an inherited mixture count, got "
+            f"draft_num_mixture={hps.draft_num_mixture} vs "
+            f"num_mixture={hps.num_mixture}")
+    draft: Params = {
+        "draft_dec": jax.tree_util.tree_map(jnp.asarray, params["dec"]),
+        "draft_out_w": jnp.asarray(params["out_w"]),
+        "draft_out_b": jnp.asarray(params["out_b"]),
+    }
+    if hps.conditional:
+        draft["draft_init_w"] = jnp.asarray(params["dec_init_w"])
+        draft["draft_init_b"] = jnp.asarray(params["dec_init_b"])
+    if noise:
+        if key is None:
+            raise ValueError("noise > 0 needs a PRNG key")
+        leaves, treedef = jax.tree_util.tree_flatten(draft)
+        leaves = [
+            leaf + noise * jax.random.normal(
+                jax.random.fold_in(key, i), leaf.shape, jnp.float32)
+            for i, leaf in enumerate(leaves)]
+        draft = jax.tree_util.tree_unflatten(treedef, leaves)
+    return draft

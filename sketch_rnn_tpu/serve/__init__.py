@@ -32,6 +32,7 @@ from sketch_rnn_tpu.serve.engine import (
     ServeEngine,
     generate_many,
     make_chunk_step,
+    make_spec_chunk_step,
 )
 from sketch_rnn_tpu.serve.fleet import ServeFleet
 from sketch_rnn_tpu.serve.loadgen import (
@@ -74,6 +75,7 @@ __all__ = [
     "fleet_signals",
     "generate_many",
     "make_chunk_step",
+    "make_spec_chunk_step",
     "make_trace",
     "parse_admission_classes",
     "plan_decisions",

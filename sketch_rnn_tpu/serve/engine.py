@@ -363,6 +363,143 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
     return jax.jit(chunk_fn)
 
 
+def make_spec_chunk_step(model, draft_model, hps: HParams, depth: int,
+                         params, draft_params, tol: float,
+                         greedy: bool = False):
+    """Build the jitted speculative (draft+verify) dispatch program
+    (ISSUE 18).
+
+    ``fn(carry, prev, t, done, reset, slot_idx, pool) ->
+    (carry, prev, t, done, strokes [D+1, B, 5], acc [B], drafted [B])``
+    where ``carry`` is the pair ``(full_carry, draft_carry)`` — the
+    draft cell's state rides the same opaque device round-trip as the
+    verifier's.
+
+    One dispatch runs a COMBINED scan over ``D+1`` positions. At every
+    position both models consume the same ``prev`` row and the same
+    per-request ``fold_in(request_key, t)`` 4-uniform block:
+
+    - the FULL model steps exactly the legacy chunk body (same
+      decode_step, same ``sample_mixture_rows`` draw ``v``) — since
+      ``prev`` is always a previously-EMITTED verifier row, the
+      emitted stream is bitwise the legacy engine's, unconditionally;
+    - the DRAFT cell rides along teacher-forced on that stream and
+      proposes ``d`` for the same position from its own (truncated)
+      MDN head.
+
+    The acceptance rule — exact rejection over the pen-state CDF (both
+    samplers invert the SAME uniform ``u[1]``, so pen one-hots must
+    match exactly) plus ``|Δx|,|Δy| <= tol`` on the continuous GMM
+    draw — decides how many rows the dispatch COMMITS: emission stops
+    after the first rejected proposal, whose position emits the
+    verifier's own draw (the correction row — so every dispatch
+    advances a live slot by >= 1 row), and position ``D`` is the bonus
+    row (no proposal to judge; the whole draft ran clean). Because
+    emitted rows are ALWAYS the verifier's draws, the output
+    distribution is trivially the full model's — bitwise, a strictly
+    stronger guarantee than classic speculative sampling's
+    distributional one — and the accept length is a pure function of
+    (key, draft params, verifier params): deterministic, replayable
+    from the trace seed, independent of scheduling.
+
+    ``acc``/``drafted`` count this dispatch's accepted / judged
+    proposals per slot (the bonus row is emitted but never judged),
+    feeding the acceptance-rate ledger. The prologue is the SAME jnp
+    admission code as ``make_chunk_step`` plus the draft carry's own
+    z -> tanh init; endpoint rows with a planned replay carry start
+    the DRAFT from its z-init (draft state only modulates throughput,
+    never output — no replay machinery needed on the draft side).
+
+    Scan-flavor only: the Pallas decode kernel has no draft lane, and
+    the engine refuses the combination up front.
+    """
+    num_mixture = hps.num_mixture
+    draft_m = draft_model.num_mixture
+    if depth < 1:
+        raise ValueError(f"draft depth must be >= 1, got {depth}")
+
+    def chunk_fn(carry, prev, t, done, reset, slot_idx, pool):
+        fcarry, dcarry = carry
+        b = t.shape[0]
+        (pool_keys, pool_z, pool_labels, pool_temps, pool_caps,
+         pool_init_carry, pool_init_prev, pool_init_mask) = pool
+        key_data = pool_keys[slot_idx]
+        z = None if pool_z is None else pool_z[slot_idx]
+        labels = None if pool_labels is None else pool_labels[slot_idx]
+        temps = pool_temps[slot_idx]
+        max_steps = pool_caps[slot_idx]
+        keys = jax.random.wrap_key_data(key_data)
+        carry0 = model.decoder_initial_carry(params, z, b)
+        dcarry0 = draft_model.initial_carry(draft_params, z, b)
+        start = jnp.broadcast_to(START_TOKEN, (b, 5))
+        if pool_init_carry is not None:
+            use = pool_init_mask[slot_idx]
+            planned = model.dec.unflatten_carry(
+                pool_init_carry[slot_idx])
+            carry0 = jax.tree_util.tree_map(
+                lambda p, d: jnp.where(
+                    use.reshape((-1,) + (1,) * (p.ndim - 1)), p, d),
+                planned, carry0)
+            start = jnp.where(use[:, None], pool_init_prev[slot_idx],
+                              start)
+        sel = lambda new, old: jnp.where(  # noqa: E731
+            reset.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
+        fcarry = jax.tree_util.tree_map(sel, carry0, fcarry)
+        dcarry = jax.tree_util.tree_map(sel, dcarry0, dcarry)
+        prev = jnp.where(reset[:, None], start, prev)
+        t = jnp.where(reset, 0, t)
+        done = jnp.where(reset, False, done)
+        # time-invariant draft conditioning: the FULL model's features
+        # (z, class embedding) — frozen inputs from the draft's view
+        extra = model._decoder_extra(params, z, labels)
+
+        def body(st, i):
+            fcarry, dcarry, prev, t, done, stop, acc, drf = st
+            kstep = jax.vmap(jax.random.fold_in)(keys, t)
+            u = jax.vmap(lambda k: jax.random.uniform(k, (4,)))(kstep)
+            # verifier: the legacy chunk body's ops, verbatim
+            new_fc, raw = model.decode_step(params, fcarry, prev, z,
+                                            labels)
+            mp = mdn.get_mixture_params(raw, num_mixture)
+            v = sample_mixture_rows(mp, u, temps, greedy=greedy)
+            # draft proposal for the SAME position from the SAME
+            # uniforms — rejection sampling over a shared inverse-CDF
+            new_dc, draw = draft_model.decode_step(draft_params, dcarry,
+                                                   prev, extra)
+            dmp = mdn.get_mixture_params(draw, draft_m)
+            d = sample_mixture_rows(dmp, u, temps, greedy=greedy)
+            pen_ok = jnp.all(d[:, 2:] == v[:, 2:], axis=-1)
+            off_ok = (jnp.abs(d[:, 0] - v[:, 0]) <= tol) \
+                & (jnp.abs(d[:, 1] - v[:, 1]) <= tol)
+            emit = ~done & ~stop
+            stroke = jnp.where(emit[:, None], v, END_TOKEN[None])
+            gate = lambda new, old: jnp.where(  # noqa: E731
+                emit.reshape((-1,) + (1,) * (new.ndim - 1)), new, old)
+            fcarry = jax.tree_util.tree_map(gate, new_fc, fcarry)
+            dcarry = jax.tree_util.tree_map(gate, new_dc, dcarry)
+            prev = jnp.where(emit[:, None], stroke, prev)
+            t = t + emit.astype(jnp.int32)
+            done = done | (emit & (stroke[:, 4] > 0.5)) \
+                | (emit & (t >= max_steps))
+            # judged positions: emitted, non-bonus; the correction row
+            # (first miss) is emitted THEN emission stops
+            judged = emit & (i < depth)
+            acc = acc + (judged & pen_ok & off_ok).astype(jnp.int32)
+            drf = drf + judged.astype(jnp.int32)
+            stop = stop | (emit & ~(pen_ok & off_ok) & (i < depth))
+            return (fcarry, dcarry, prev, t, done, stop, acc, drf), \
+                stroke
+
+        zi = jnp.zeros((b,), jnp.int32)
+        stop = jnp.zeros((b,), bool)
+        (fcarry, dcarry, prev, t, done, _, acc, drf), strokes = lax.scan(
+            body, (fcarry, dcarry, prev, t, done, stop, zi, zi),
+            jnp.arange(depth + 1))
+        return (fcarry, dcarry), prev, t, done, strokes, acc, drf
+
+    return jax.jit(chunk_fn)
+
+
 class ServeEngine:
     """Continuous-batching generation over ``slots`` decoder slots.
 
@@ -379,12 +516,27 @@ class ServeEngine:
                  greedy: bool = False, device=None,
                  replica_id: Optional[int] = None, ckpt_id: str = "",
                  decode_kernel: Optional[str] = None,
-                 param_dtype: Optional[str] = None):
+                 param_dtype: Optional[str] = None,
+                 draft_params=None, draft_depth: int = 0,
+                 draft_tol: Optional[float] = None):
         self.model = model
         self.hps = hps
         self.slots = int(slots or hps.serve_slots)
         self.chunk = int(chunk or hps.serve_chunk)
         self.max_len = int(max_len or hps.max_seq_len)
+        # speculative decoding (ISSUE 18): ``draft_params`` arms the
+        # draft+verify dispatch program (make_spec_chunk_step) — one
+        # combined scan advances a slot up to draft_depth+1 rows per
+        # dispatch while emitting ONLY the full model's draws, so
+        # draft=on is bitwise draft=off (which is bitwise the legacy
+        # engine: with no draft params this constructor builds the
+        # pre-ISSUE-18 program, byte for byte). depth/tol default from
+        # hps so fleet construction threads them for free.
+        self.speculative = draft_params is not None
+        self.draft_depth = int(draft_depth or hps.draft_depth) \
+            if self.speculative else 0
+        self.draft_tol = float(hps.draft_tol if draft_tol is None
+                               else draft_tol)
         # chunk-program flavor + serving param precision (ISSUE 17):
         # both are part of the compiled program's identity — they ride
         # the JitCompileProbe geometry key so a scan->pallas or
@@ -402,6 +554,11 @@ class ServeEngine:
         if self.decode_kernel == "pallas":
             from sketch_rnn_tpu.ops.pallas_decode import check_cell_kind
             check_cell_kind(hps.dec_model)
+        if self.speculative and self.decode_kernel == "pallas":
+            raise ValueError(
+                "speculative decoding is scan-only: the fused Pallas "
+                "decode kernel has no draft lane — drop draft_params "
+                "or use decode_kernel='scan'")
         self.param_dtype = str(
             param_dtype or getattr(hps, "serve_quantize", "float32"))
         # greedy is part of the compiled program's identity; kept so a
@@ -425,6 +582,13 @@ class ServeEngine:
             raise ValueError(
                 f"slots and chunk must be >= 1, got {self.slots}/"
                 f"{self.chunk}")
+        if self.speculative:
+            from sketch_rnn_tpu.models.draft import DraftDecoder
+            self._draft_model = DraftDecoder(hps)
+            self._draft_params = jax.device_put(draft_params, self.device)
+        else:
+            self._draft_model = None
+            self._draft_params = None
         self._bind_params(params)
         self.spans = SpanTimer(category="serve")
 
@@ -462,18 +626,32 @@ class ServeEngine:
         # kernel flavor and param dtype (ISSUE 17): a scan->pallas or
         # fp32->int8 swap rebuilds this probe, and the key must make
         # the rebuilt program its own geometry in the compile ledger,
-        # not a cache hit on the old flavor's.
+        # not a cache hit on the old flavor's. The (draft_on, D)
+        # fields (ISSUE 18) make arming speculation or changing draft
+        # depth its own geometry too — they sit BEFORE the (kernel,
+        # dtype) pair so key[:-2] stays the flavor-independent pool
+        # geometry the probe pins compare.
+        if self.speculative:
+            fn = make_spec_chunk_step(
+                self.model, self._draft_model, self.hps,
+                self.draft_depth, self.params, self._draft_params,
+                self.draft_tol, self.greedy)
+        else:
+            fn = make_chunk_step(self.model, self.hps, self.chunk,
+                                 self.params, self.greedy,
+                                 kernel=self.decode_kernel)
         self._chunk_fn = JitCompileProbe(
-            make_chunk_step(self.model, self.hps, self.chunk,
-                            self.params, self.greedy,
-                            kernel=self.decode_kernel),
+            fn,
             "serve_chunk",
             key_of=lambda a: tuple(tuple(p.shape) for p in a[6]
                                    if p is not None)
+            + (self.speculative, self.draft_depth)
             + (self.decode_kernel, self.param_dtype),
             label_of=lambda a: (f"(B{self.slots},K{self.chunk},"
                                 f"N{a[6][0].shape[0]},"
-                                f"{self.decode_kernel},"
+                                + (f"D{self.draft_depth},"
+                                   if self.speculative else "")
+                                + f"{self.decode_kernel},"
                                 f"{self.param_dtype})"))
 
     def swap_params(self, params, ckpt_id: str = "",
@@ -702,8 +880,11 @@ class ServeEngine:
         nslots = self.slots
 
         # device-resident loop state (opaque round-trip); the host owns
-        # only the two [B] scheduling vectors
+        # only the two [B] scheduling vectors. Speculative mode carries
+        # the (full, draft) state PAIR through the same round-trip.
         carry = self.model.dec.initial_carry(nslots)
+        if self.speculative:
+            carry = (carry, self._draft_model.cell.initial_carry(nslots))
         prev = jnp.broadcast_to(START_TOKEN, (nslots, 5))
         t_dev = jnp.zeros((nslots,), jnp.int32)
         done_dev = jnp.ones((nslots,), bool)   # all slots start empty
@@ -759,13 +940,24 @@ class ServeEngine:
                 # .copy(): the CPU backend can alias numpy args
                 # zero-copy, and the scheduler mutates these while the
                 # async-dispatched chunk is still reading them
-                carry, prev, t_dev, done_dev, strokes_dev = \
-                    self._chunk_fn(carry, prev, t_dev, done_dev,
-                                   reset.copy(), slot_idx.copy(), pool)
+                if self.speculative:
+                    (carry, prev, t_dev, done_dev, strokes_dev,
+                     acc_dev, drf_dev) = \
+                        self._chunk_fn(carry, prev, t_dev, done_dev,
+                                       reset.copy(), slot_idx.copy(),
+                                       pool)
+                    out = (t_dev, done_dev, strokes_dev, acc_dev,
+                           drf_dev)
+                else:
+                    carry, prev, t_dev, done_dev, strokes_dev = \
+                        self._chunk_fn(carry, prev, t_dev, done_dev,
+                                       reset.copy(), slot_idx.copy(),
+                                       pool)
+                    out = (t_dev, done_dev, strokes_dev)
                 reset[:] = False
                 cidx = n_disp
                 n_disp += 1
-                return (t_dev, done_dev, strokes_dev), cidx
+                return out, cidx
 
         # Depth-1 software pipelining (the prefetch.py discipline on
         # the output side): chunk i+1 is dispatched BEFORE chunk i's
@@ -786,7 +978,20 @@ class ServeEngine:
         # ceil(max_len / K) + 2 entries — the longest possible request
         # lifetime in chunks (caps force done) plus pipeline slack.
         ring: Dict[int, Any] = {}   # cidx -> (t, strokes)
-        horizon = -(-self.max_len // self.chunk) + 2
+        # speculative dispatches commit a VARIABLE row count (>= 1 per
+        # live slot — the correction row), so the ring horizon is the
+        # worst case of one row per dispatch, not max_len / K
+        horizon = (self.max_len + 2 if self.speculative
+                   else -(-self.max_len // self.chunk) + 2)
+        # acceptance ledger (ISSUE 18): judged/accepted draft proposals
+        # and engaged slot-steps (eligible slots x K per fetched chunk
+        # — the denominator of accepted-steps/device-step; the legacy
+        # engine's rows-emitted/engaged ratio is <= 1 by construction,
+        # a speculative dispatch commits up to (D+1) rows per K-step
+        # ledger unit)
+        spec_acc = 0
+        spec_drf = 0
+        engaged_steps = 0
         occupied = np.zeros((nslots,), bool)
         n_live = 0
         # deterministic device-step cost attribution (ISSUE 11): each
@@ -830,7 +1035,20 @@ class ServeEngine:
                 t_prev = t_host    # chunk cidx-1's t: the row-delta base
                 fault_point(chunk_site)
                 with self.spans.span("fetch"):
-                    t_host, done, strokes = jax.device_get(fut)
+                    if self.speculative:
+                        t_host, done, strokes, acc, drf = \
+                            jax.device_get(fut)
+                        # done slots / stale occupants draft nothing
+                        # (emit gating), so the full [B] sums are exact
+                        spec_acc += int(acc.sum())
+                        spec_drf += int(drf.sum())
+                        if tel.enabled:
+                            tel.counter("draft_steps_accepted",
+                                        int(acc.sum()), cat="serve")
+                            tel.counter("draft_steps_proposed",
+                                        int(drf.sum()), cat="serve")
+                    else:
+                        t_host, done, strokes = jax.device_get(fut)
                 n_chunks += 1
                 t = t_host
                 now = time.perf_counter()
@@ -841,6 +1059,7 @@ class ServeEngine:
                     base = np.where(first_chunk == cidx, 0, t_prev)
                     live_slot_steps += int(
                         (t - base)[eligible].sum())
+                    engaged_steps += int(eligible.sum()) * self.chunk
                     live_idx = np.nonzero(eligible)[0]
                     if len(live_idx):
                         shares = attribute_chunk_steps(self.chunk,
@@ -1048,6 +1267,16 @@ class ServeEngine:
             tel.counter("device_steps_dispatched",
                         n_chunks * self.chunk, cat="serve")
             tel.counter("device_steps_idle", idle_steps, cat="serve")
+            # speculative headline gauges (ISSUE 18): the /metrics view
+            # of this run's acceptance rate and rows-per-ledger-step —
+            # same floats as the returned metrics block below
+            tel.gauge("accepted_steps_per_device_step",
+                      round(int(sum(r.steps for r in results))
+                            / max(engaged_steps, 1), 4), cat="serve")
+            if self.speculative:
+                tel.gauge("draft_acceptance_rate",
+                          round(spec_acc / max(spec_drf, 1), 4),
+                          cat="serve")
         lat = np.array([r.latency_s for r in results]) if results else \
             np.zeros((1,))
         metrics = {
@@ -1063,6 +1292,14 @@ class ServeEngine:
             # invariant trace_query and the fleet summary reconcile
             "steps_attributed": int(sum(attr_steps.values())),
             "steps_idle": int(idle_steps),
+            # speculative throughput surface (ISSUE 18): emitted rows
+            # per engaged K-step ledger unit. The legacy chunk program
+            # advances an engaged slot at most K rows per K steps, so
+            # this is <= 1 by construction without a draft; a
+            # speculative dispatch commits up to D+1 rows per unit.
+            "accepted_steps_per_device_step": round(
+                int(sum(r.steps for r in results))
+                / max(engaged_steps, 1), 4),
             "slot_utilization": round(
                 live_slot_steps / max(n_chunks * self.chunk * self.slots,
                                       1), 4),
@@ -1081,6 +1318,15 @@ class ServeEngine:
                  for r in results]),
             "spans": self.spans.summary(),
         }
+        if self.speculative:
+            metrics["speculative"] = {
+                "draft_depth": self.draft_depth,
+                "draft_tol": self.draft_tol,
+                "draft_steps_proposed": spec_drf,
+                "draft_steps_accepted": spec_acc,
+                "acceptance_rate": round(
+                    spec_acc / max(spec_drf, 1), 4),
+            }
         if slo is not None:
             metrics["slo"] = slo.summary()
         return {"results": results, "metrics": metrics}

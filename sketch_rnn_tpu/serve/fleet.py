@@ -206,7 +206,9 @@ class ServeFleet:
                  retry_backoff_s: float = 0.05,
                  max_replicas: int = 0, cache=None,
                  endpoint_classes: Optional[Dict[str, str]] = None,
-                 ckpt_id: str = ""):
+                 ckpt_id: str = "", draft_params=None,
+                 draft_depth: int = 0,
+                 draft_tol: Optional[float] = None):
         import jax  # lazy, the serve-module discipline
 
         devices = list(devices if devices is not None else jax.devices())
@@ -262,10 +264,18 @@ class ServeFleet:
         self._replicas: List[_Replica] = []
         for r in range(n_build):
             with jax.default_device(devices[r]):
+                # speculative decoding (ISSUE 18): every replica gets
+                # the same draft — draft state is per-engine, and the
+                # acceptance rule is replica-independent (pure in key /
+                # draft params / verifier params), so fleet placement
+                # still can never change a request's strokes
                 eng = ServeEngine(model, hps, params, slots=self.slots,
                                   chunk=self.chunk, max_len=max_len,
                                   greedy=greedy, device=devices[r],
-                                  replica_id=r, ckpt_id=ckpt_id)
+                                  replica_id=r, ckpt_id=ckpt_id,
+                                  draft_params=draft_params,
+                                  draft_depth=draft_depth,
+                                  draft_tol=draft_tol)
             rep = _Replica(r, devices[r], eng, class_order)
             rep.cond = threading.Condition(self._lock)
             if r >= n:

@@ -18,6 +18,8 @@ from sketch_rnn_tpu.train.checkpoint import (
     save_checkpoint,
     write_checkpoint,
 )
+from sketch_rnn_tpu.train.distill import (DistillModel, distill,
+                                          draft_dir_of)
 from sketch_rnn_tpu.train.elastic import ElasticCoordinator, elastic_train
 from sketch_rnn_tpu.train.loop import evaluate, evaluate_per_class, train
 from sketch_rnn_tpu.train.metrics import MetricsDrain, MetricsWriter
@@ -45,6 +47,9 @@ __all__ = [
     "MetricsDrain",
     "MetricsWriter",
     "train",
+    "DistillModel",
+    "distill",
+    "draft_dir_of",
     "ElasticCoordinator",
     "elastic_train",
     "evaluate",

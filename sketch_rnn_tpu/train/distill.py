@@ -1,0 +1,190 @@
+"""Draft-decoder distillation through the real train stack (ISSUE 18).
+
+``DistillModel`` wraps a FROZEN full model (the teacher) and exposes the
+``init_params`` / ``loss`` contract ``train.loop.train`` drives — so a
+distillation run exercises the exact production stack (bucketed loader,
+steps_per_call dispatch, async checkpointing, resume, telemetry) with
+zero forked loop code: ``train(..., model=DistillModel(hps, teacher))``.
+
+The objective trains the draft to be a cheap PREDICTOR of the teacher's
+sampling behavior, which is what the serving acceptance rule scores:
+
+- **offset GMM NLL + pen CE on the data** (the canonical
+  ``mdn.reconstruction_loss``), teacher-forced on the corpus strokes
+  and conditioned on the teacher's posterior MEAN z (no sampling — the
+  distillation loss is deterministic per batch, which keeps the resume
+  bitwise-replay property of the train loop meaningful);
+- **soft pen distillation**: cross-entropy of the draft's pen logits
+  against the teacher's pen PROBABILITIES at every real step. The
+  acceptance rule rejects on the pen one-hot EXACTLY (both samplers
+  invert the same uniform), so matching the teacher's pen CDF is where
+  draft quality buys accept length most directly.
+
+Teacher parameters are closed over as constants: gradients flow only
+into the draft tree, and the saved checkpoints hold ONLY draft params
+(their own shapes, their own resume lineage under ``<workdir>/draft``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.models.draft import DraftDecoder
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.ops import linear as L
+from sketch_rnn_tpu.ops import mdn
+from sketch_rnn_tpu.ops.rnn import length_reverse_indices, run_rnn
+
+Params = Dict[str, Any]
+
+
+class DistillModel:
+    """Frozen teacher + trainable draft, as one train-loop model."""
+
+    def __init__(self, hps: HParams, teacher_params: Params):
+        self.hps = hps
+        self.teacher = SketchRNN(hps)
+        self.draft = DraftDecoder(hps)
+        # frozen constants in the compiled step: grad flows only into
+        # the draft tree the loop owns
+        self.teacher_params = jax.tree_util.tree_map(
+            jnp.asarray, teacher_params)
+
+    def init_params(self, key: jax.Array) -> Params:
+        return self.draft.init_params(key)
+
+    def loss(self, params: Params, batch: Dict[str, jax.Array],
+             key: jax.Array, kl_weight: jax.Array, train: bool = True,
+             axis_name: Optional[str] = None
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        """Distillation loss on a loader batch; one fused computation.
+
+        Returns the train loop's canonical metric keys (kl terms are
+        zero constants — the draft has no latent) plus ``pen_distill``,
+        the soft-pen knowledge-distillation term.
+        """
+        hps = self.hps
+        tp = self.teacher_params
+        raw_bm = batch["strokes"]
+        seq_len = batch["seq_len"]
+        weights = batch.get("weights")
+        # entry-path prep, the vae._forward recipe: int16 dequant ->
+        # time-major -> f32 upcast (and the batch-major reverse gather
+        # for the encoder's backward direction)
+        raw_rev = None
+        if hps.conditional:
+            rev_bm = length_reverse_indices(raw_bm.shape[1] - 1,
+                                            seq_len).T
+            raw_rev = jnp.take_along_axis(raw_bm[:, 1:],
+                                          rev_bm[:, :, None], axis=1)
+
+        def prep(bm):
+            if bm.dtype == jnp.int16:
+                sc = batch["transfer_scale"].astype(jnp.float32)
+                f = bm.astype(jnp.float32)
+                bm = jnp.concatenate(
+                    [f[..., :2] / sc[:, None, None], f[..., 2:]], axis=-1)
+            return jnp.transpose(bm, (1, 0, 2)).astype(jnp.float32)
+
+        strokes = prep(raw_bm)                   # [T+1, B, 5]
+        x_in, x_target = strokes[:-1], strokes[1:]
+        labels = batch.get("labels") if hps.num_classes > 0 else None
+        z = None
+        if hps.conditional:
+            # posterior MEAN, never a sample: the draft must predict
+            # the teacher's serving-time behavior for a FIXED z, and a
+            # deterministic loss keeps distillation bitwise-resumable
+            mu, _ = self.teacher.encode(tp, x_target, seq_len,
+                                        train=False,
+                                        x_rev_tm=prep(raw_rev))
+            z = mu
+        extra = self.teacher._decoder_extra(tp, z, labels)
+        # teacher soft pen targets (teacher-forced, eval mode)
+        traw = self.teacher.decode(tp, x_in, z, labels, train=False)
+        t_pen = jax.nn.softmax(
+            mdn.get_mixture_params(traw, hps.num_mixture).pen_logits)
+        # draft forward: its cell over the same teacher-forced stream,
+        # same time-invariant conditioning, its own z -> carry init
+        b = x_in.shape[1]
+        carry0 = self.draft.initial_carry(params, z, b)
+        _, hs = run_rnn(self.draft.cell, params["draft_dec"], x_in,
+                        carry0, x_extra=extra)
+        draw = L.matmul(hs, params["draft_out_w"],
+                        self.draft.cell.compute_dtype) \
+            + params["draft_out_b"]
+        dmp = mdn.get_mixture_params(draw, self.draft.num_mixture)
+        offset_nll, pen_ce = mdn.reconstruction_loss(
+            dmp, x_target, hps.max_seq_len, mask_pen=not train,
+            weights=weights, axis_name=axis_name)
+        # soft pen distillation, masked to real steps and normalized
+        # like reconstruction_loss (max_seq_len x global batch)
+        t_steps = x_in.shape[0]
+        mask = (jnp.arange(t_steps)[:, None]
+                < seq_len[None, :]).astype(jnp.float32)     # [T, B]
+        if weights is not None:
+            mask = mask * weights[None, :].astype(jnp.float32)
+        kd = -jnp.sum(t_pen * jax.nn.log_softmax(dmp.pen_logits, -1),
+                      axis=-1)                              # [T, B]
+        num = jnp.sum(kd * mask)
+        den = jnp.float32(b) if weights is None \
+            else jnp.maximum(jnp.sum(weights.astype(jnp.float32)), 1.0)
+        if axis_name:
+            num = jax.lax.psum(num, axis_name)
+            den = jax.lax.psum(den, axis_name)
+        pen_distill = num / (hps.max_seq_len * den)
+        recon = offset_nll + pen_ce
+        total = recon + pen_distill
+        metrics = {
+            "loss": total,
+            "recon": recon,
+            "offset_nll": offset_nll,
+            "pen_ce": pen_ce,
+            "pen_distill": pen_distill,
+            "kl": jnp.float32(0.0),
+            "kl_raw": jnp.float32(0.0),
+            "kl_weight": jnp.asarray(kl_weight, jnp.float32),
+        }
+        return total, metrics
+
+
+def draft_dir_of(workdir: str) -> str:
+    """The draft run's home under a teacher workdir: its checkpoints
+    have draft shapes and must never collide with the teacher's."""
+    return os.path.join(workdir, "draft")
+
+
+def distill(hps: HParams, teacher_params: Params, train_loader,
+            workdir: str, seed: int = 0,
+            num_steps: Optional[int] = None,
+            teacher_ckpt_id: str = "", **train_kw):
+    """Distill a draft decoder via the production train loop.
+
+    Trains ``DistillModel(hps, teacher_params)`` into
+    ``<workdir>/draft`` (own checkpoints, own resume) and records the
+    pairing lineage in that directory's RUN.json: which teacher
+    checkpoint this draft was distilled from, and the draft geometry a
+    serving engine must rebuild to load it. Returns the final
+    TrainState (``state.params`` is the draft tree).
+    """
+    from sketch_rnn_tpu.train.loop import train
+    from sketch_rnn_tpu.utils import runinfo
+
+    dmodel = DistillModel(hps, teacher_params)
+    out = draft_dir_of(workdir)
+    state = train(hps, train_loader, workdir=out, seed=seed,
+                  num_steps=num_steps, model=dmodel, **train_kw)
+    runinfo.write_manifest(
+        out, kind="distill", hps=hps,
+        extra={"distill": {
+            "teacher_ckpt_id": teacher_ckpt_id,
+            "teacher_workdir": os.path.abspath(workdir),
+            "draft_rnn_size": hps.draft_rnn_size,
+            "draft_num_mixture": dmodel.draft.num_mixture,
+            "steps": int(state.step),
+        }})
+    return state

@@ -312,7 +312,8 @@ def train(hps: HParams,
           trace_dir: Optional[str] = None,
           watchdog: bool = False,
           halt_on_anomaly: bool = False,
-          coordinator=None) -> TrainState:
+          coordinator=None,
+          model=None) -> TrainState:
     """Train for ``num_steps`` (default ``hps.num_steps``); returns state.
 
     Resumes from the latest checkpoint in ``workdir`` when present
@@ -354,6 +355,14 @@ def train(hps: HParams,
     ``HostDeathDetected`` propagates to the restart protocol. None
     (the default) is bitwise-invisible: no barrier, no behavior
     change.
+
+    ``model`` (ISSUE 18): an alternative model object implementing the
+    ``init_params(key)`` / ``loss(params, batch, key, kl_weight,
+    train, axis_name)`` contract — the distillation loop
+    (train/distill.py DistillModel) trains a draft decoder through
+    THIS exact stack (bucketed loader, async checkpointing, telemetry,
+    resume) instead of forking a second loop. None (the default)
+    builds the standard ``SketchRNN(hps)``, bitwise-unchanged.
     """
     num_steps = hps.num_steps if num_steps is None else num_steps
     primary = (coordinator.is_primary if coordinator is not None
@@ -397,7 +406,8 @@ def train(hps: HParams,
             f"valid split is not evaluable ({len(valid_loader)} local "
             f"examples, batch_size={hps.batch_size}); enlarge the split, "
             f"reduce batch_size, or pass valid_loader=None")
-    model = SketchRNN(hps)
+    if model is None:
+        model = SketchRNN(hps)
     mesh = make_mesh(hps) if use_mesh else None
 
     root_key = jax.random.key(seed)

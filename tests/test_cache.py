@@ -323,6 +323,46 @@ def test_hit_is_bitwise_recomputation_and_zero_steps(tiny_setup):
         fleet2.close()
 
 
+def test_draft_on_and_draft_off_share_the_cache(tiny_setup):
+    """ISSUE 18 satellite: speculative decoding changes how FAST rows
+    are produced, never WHAT — draft config is engine state, not
+    request content, so a draft-on fleet's fills hit for a draft-off
+    fleet at the SAME fingerprints, bitwise, with zero attributed
+    device steps (fingerprints never hash draft config, so the
+    reverse direction shares them by construction)."""
+    from sketch_rnn_tpu.models.draft import self_draft_params
+    from sketch_rnn_tpu.serve import ServeFleet
+
+    hps, model, params = tiny_setup
+    hps = hps.replace(draft_rnn_size=hps.dec_rnn_size)
+    dp = self_draft_params(params, hps, key=jax.random.key(9),
+                           noise=0.05)
+    cache = ResultCache(config_hash="cfg", ckpt_id="ck")
+    fleet = ServeFleet(model, hps, params, replicas=1, cache=cache,
+                       draft_params=dp, draft_depth=4)
+    try:
+        fleet.submit(dataclasses.replace(_req(11), uid=0))
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        fill = fleet.results[0]["result"]
+    finally:
+        fleet.close()
+    assert not fill.cached
+    fleet2 = ServeFleet(model, hps, params, replicas=1, cache=cache)
+    try:
+        fleet2.submit(dataclasses.replace(_req(11), uid=1))
+        fleet2.start()
+        assert fleet2.drain(timeout=120)
+        hit = fleet2.results[1]["result"]
+        origin = fleet2.results[1]["origin_uid"]
+    finally:
+        fleet2.close()
+    assert hit.cached and hit.attributed_steps == 0
+    assert origin == 0
+    np.testing.assert_array_equal(hit.strokes5, fill.strokes5)
+    assert cache.stats()["hits"] == 1
+
+
 def test_cached_request_carries_trace_link_to_origin(tiny_setup):
     """ISSUE 12 trace contract: a cached request's tree is fresh (its
     own trace id, a root span over its own clock) and its cache_hit

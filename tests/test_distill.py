@@ -1,0 +1,139 @@
+"""Draft-decoder distillation tests (ISSUE 18 satellite).
+
+``DistillModel`` must drive the UNCHANGED production train loop (the
+loss contract: canonical metric keys, deterministic per batch, grads
+into the draft tree only), ``distill()`` must leave a paired draft
+checkpoint with its teacher lineage in RUN.json and resume like any
+train run — and the artifact it writes must load straight into a
+speculative serve engine whose output stays bitwise the legacy one
+(a truncated-mixture draft head included).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+from sketch_rnn_tpu.models.draft import DraftDecoder
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.train import (DistillModel, distill, draft_dir_of,
+                                  latest_checkpoint, make_train_state,
+                                  restore_checkpoint)
+from sketch_rnn_tpu.utils import runinfo
+
+TINY = dict(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+            dec_rnn_size=16, z_size=6, num_mixture=3, draft_rnn_size=8,
+            draft_num_mixture=2, eval_every=10**9, save_every=2,
+            log_every=2)
+
+METRIC_KEYS = {"loss", "recon", "offset_nll", "pen_ce", "pen_distill",
+               "kl", "kl_raw", "kl_weight"}
+
+
+def _hps(**kw) -> HParams:
+    return HParams(**{**TINY, **kw})
+
+
+def _loader(hps, n=32, seed=0):
+    seqs, labels = make_synthetic_strokes(
+        n, num_classes=1, min_len=8, max_len=hps.max_seq_len - 2,
+        seed=seed)
+    return DataLoader(seqs, hps, labels=labels, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    hps = _hps()
+    teacher = SketchRNN(hps)
+    tparams = teacher.init_params(jax.random.key(0))
+    return hps, teacher, tparams
+
+
+def test_distill_loss_contract(setup):
+    """Canonical train-loop metric keys (zero KL — the draft has no
+    latent), a deterministic loss per batch, and gradients that are
+    finite and land in every draft leaf."""
+    hps, _, tparams = setup
+    dm = DistillModel(hps, tparams)
+    params = dm.init_params(jax.random.key(1))
+    assert all(k.startswith("draft_") for k in params)
+    batch = _loader(hps).get_batch(0)
+    key = jax.random.key(2)
+    jloss = jax.jit(lambda p: dm.loss(p, batch, key, kl_weight=0.5))
+    loss1, m1 = jloss(params)
+    loss2, m2 = jloss(params)
+    assert set(m1) == METRIC_KEYS
+    assert float(m1["kl"]) == float(m1["kl_raw"]) == 0.0
+    assert float(m1["loss"]) == pytest.approx(
+        float(m1["recon"]) + float(m1["pen_distill"]))
+    # deterministic (the teacher conditions on its posterior MEAN z)
+    assert float(loss1) == float(loss2)
+    grads = jax.jit(
+        jax.grad(lambda p: dm.loss(p, batch, key, 0.0)[0]))(params)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        a = np.asarray(leaf)
+        assert np.all(np.isfinite(a))
+        assert np.any(a != 0.0)
+
+
+def test_distill_end_to_end_lineage_resume_and_serving(setup, tmp_path):
+    """``distill()`` through the real loop: draft checkpoints under
+    <workdir>/draft, teacher lineage in that RUN.json, resume continues
+    rather than restarts — and the distilled (truncated-head) draft
+    loads into a speculative engine that stays bitwise the legacy
+    engine."""
+    hps, teacher, tparams = setup
+    wd = str(tmp_path)
+    loader = _loader(hps)
+    state = distill(hps.replace(num_steps=2), tparams, loader, wd,
+                    seed=3, teacher_ckpt_id="ckpt_00000002",
+                    use_mesh=False)
+    ddir = draft_dir_of(wd)
+    assert ddir.startswith(wd)
+    assert int(state.step) == 2
+    assert latest_checkpoint(ddir) == 2
+    man = runinfo.read_manifest(ddir)
+    assert man["kind"] == "distill"
+    lin = man["distill"]
+    assert lin["teacher_ckpt_id"] == "ckpt_00000002"
+    assert lin["teacher_workdir"] == os.path.abspath(wd)
+    assert lin["draft_rnn_size"] == hps.draft_rnn_size
+    assert lin["draft_num_mixture"] == 2
+    assert lin["steps"] == 2
+    # resume: two more steps continue from the saved draft state
+    state2 = distill(hps.replace(num_steps=4), tparams, loader, wd,
+                     seed=3, teacher_ckpt_id="ckpt_00000002",
+                     use_mesh=False)
+    assert int(state2.step) == 4
+    assert runinfo.read_manifest(ddir)["distill"]["steps"] == 4
+    # the checkpoint restores into the draft template (draft shapes,
+    # draft_-prefixed keys — never confusable with the teacher's tree)
+    template = make_train_state(DraftDecoder(hps), hps,
+                                jax.random.key(0))
+    rstate, _, _ = restore_checkpoint(ddir, template)
+    assert int(rstate.step) == 4
+    assert all(k.startswith("draft_") for k in rstate.params)
+    for a, b in zip(jax.tree_util.tree_leaves(rstate.params),
+                    jax.tree_util.tree_leaves(state2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and it serves: the distilled draft's engine is bitwise legacy
+    from sketch_rnn_tpu.serve.engine import Request, ServeEngine
+
+    reqs = lambda: [  # noqa: E731
+        Request(key=jax.random.key(500 + i),
+                z=np.asarray(jax.random.normal(jax.random.key(i),
+                                               (hps.z_size,))),
+                temperature=0.8, max_len=10, uid=i)
+        for i in range(4)]
+    legacy = ServeEngine(teacher, hps, tparams, slots=2, chunk=2)
+    spec = ServeEngine(teacher, hps, tparams, slots=2, chunk=2,
+                       draft_params=rstate.params, draft_depth=3)
+    ref = {r.uid: r.strokes5 for r in legacy.run(reqs())["results"]}
+    out = spec.run(reqs())
+    got = {r.uid: r.strokes5 for r in out["results"]}
+    for u in ref:
+        np.testing.assert_array_equal(ref[u], got[u])
+    assert out["metrics"]["speculative"]["draft_steps_proposed"] > 0

@@ -214,7 +214,12 @@ def test_config_validates_serving_knobs():
 def test_probe_geometry_key_covers_kernel_and_dtype():
     """A scan->pallas or fp32->int8 swap changes the chunk program's
     probe geometry key — a new compile, never a silent cache hit at
-    the same pool shape."""
+    the same pool shape. Arming speculation or changing draft depth
+    (ISSUE 18) is likewise its own geometry, and the (draft_on, D)
+    fields sit BEFORE (kernel, dtype) so key[:-2] stays the
+    flavor-independent comparison the pins rest on."""
+    from sketch_rnn_tpu.models.draft import self_draft_params
+
     hps, model, params = _setup("lstm", conditional=True)
     pool = _pool(hps)
     args = (None, None, None, None, None, None, pool)
@@ -225,10 +230,26 @@ def test_probe_geometry_key_covers_kernel_and_dtype():
     keys[("scan", "int8")] = eng._chunk_fn._geom(args)
     eng2 = ServeEngine(model, hps, params, decode_kernel="pallas")
     keys[("pallas", "float32")] = eng2._chunk_fn._geom(args)
-    assert len(set(keys.values())) == 3
-    # the pool-shape part of the key is shared: only flavor/dtype vary
+    hps_d = hps.replace(draft_rnn_size=hps.dec_rnn_size,
+                        draft_num_mixture=0)
+    dp = self_draft_params(params, hps_d)
+    for d in (4, 8):
+        eng3 = ServeEngine(model, hps_d, params, draft_params=dp,
+                           draft_depth=d)
+        keys[("spec", d)] = eng3._chunk_fn._geom(args)
+    assert len(set(keys.values())) == 5
+    # the pool-shape part of the key is shared: only flavor/dtype/
+    # draft-arming vary
     assert keys[("scan", "float32")][:-2] == \
         keys[("pallas", "float32")][:-2]
+    shapes = tuple(tuple(p.shape) for p in pool if p is not None)
+    for k in keys.values():
+        assert k[:len(shapes)] == shapes
+    # the (draft_on, D) fields are exactly the slice between the pool
+    # shapes and the (kernel, dtype) tail
+    assert keys[("scan", "float32")][:-2][len(shapes):] == (False, 0)
+    assert keys[("spec", 4)][:-2][len(shapes):] == (True, 4)
+    assert keys[("spec", 8)][:-2][len(shapes):] == (True, 8)
 
 
 def test_engine_run_pallas_end_to_end():

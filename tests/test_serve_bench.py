@@ -151,6 +151,61 @@ def test_serve_bench_endpoints_end_to_end_small(tmp_path):
         assert r["class"] in ("interactive", "batch")
 
 
+def test_serve_bench_speculative_end_to_end_small(tmp_path):
+    """A shrunken speculative bench (ISSUE 18): all four arm kinds run
+    (legacy baseline, noisy self-draft, exact self-draft, random
+    draft), every arm's strokes stay bitwise the legacy engine's, the
+    accept/reject sequence replays deterministically, the commit-rate
+    gate clears > 1.5 on the bimodal mix, one binary serve_spec row per
+    (cell, D) streams to the hermetic smoke history, and pre-existing
+    records in --out are preserved."""
+    out = tmp_path / "SB.json"
+    out.write_text(json.dumps(
+        {"kind": "serve_bench", "engine_sketches_per_sec": 123.0}))
+    rc = serve_bench.main([
+        "--speculative", "--smoke", "--slots", "4", "--chunk", "4",
+        "--requests", "16", "--min_len", "4", "--max_len", "32",
+        "--depths", "16", "--draft_noise", "0.002", "--out", str(out)])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert doc["engine_sketches_per_sec"] == 123.0  # merge preserved
+    s = doc["speculative"]
+    assert s["kind"] == "serve_speculative" and s["smoke"] is True
+    # the deterministic acceptance signals all held (a failure raises
+    # AFTER streaming the rows)
+    p = s["parity"]
+    assert p["bitwise_vs_legacy"] and p["replay_deterministic"]
+    assert not p["failures"]
+    # the ISSUE 18 throughput gate: accepted-steps/device-step > 1.5
+    assert s["gate"]["metric"] == "accepted_steps_per_device_step"
+    assert s["gate"]["pass"] and s["gate"]["best"] > 1.5
+    arms = {(a["dec_model"], a["draft"]): a for a in s["arms"]}
+    assert set(arms) == {("lstm", "self+noise"), ("lstm", "self"),
+                         ("layer_norm", "random")}
+    # exact self-draft: the acceptance-1.0 accounting pin, and it
+    # saves device steps vs its cell's baseline
+    exact = arms[("lstm", "self")]
+    assert exact["acceptance_rate"] == 1.0
+    assert exact["device_steps_saved"] > 0
+    assert (exact["device_steps"]
+            < s["baseline"]["lstm"]["device_steps"])
+    # random draft: near-zero acceptance, outputs still bitwise (ok)
+    assert arms[("layer_norm", "random")]["acceptance_rate"] < 0.5
+    for a in s["arms"]:
+        assert a["ok"] is True
+        assert a["n_requests"] == 16 and a["draft_depth"] == 16
+    # legacy baselines can never exceed 1 emitted row per device step
+    for b in s["baseline"].values():
+        assert b["accepted_steps_per_device_step"] <= 1.0
+    # one binary serve_spec row per (cell, D) in the hermetic history
+    hist = tmp_path / "BENCH_SMOKE_HISTORY.jsonl"
+    rows = [r for r in map(json.loads, open(hist))
+            if r.get("kind") == "serve_spec"]
+    assert len(rows) == 3
+    assert all(r["ok"] is True and r["smoke"] is True for r in rows)
+    assert {(r["dec_model"], r["draft"]) for r in rows} == set(arms)
+
+
 @pytest.mark.parametrize("dist", ["power", "bimodal"])
 def test_serve_bench_end_to_end_small(tmp_path, capsys, dist):
     """A shrunken smoke run: both paths execute, the record is

@@ -1,0 +1,280 @@
+"""Speculative decoding tests (ISSUE 18): the acceptance rule, the
+draft+verify engine program, and its accounting.
+
+The load-bearing contract: emitted rows are ALWAYS the verifier's own
+draws — the draft decides only how MANY rows a dispatch commits — so a
+speculative engine is bitwise the legacy engine for every draft, and
+the accept/reject sequence is a pure function of (request key, draft
+params, verifier params): deterministic, replayable from the trace
+seed, invariant to slot count and batch composition. The rejection rule
+is exact over the pen-state CDF (both samplers invert the SAME uniform)
+plus ``draft_tol`` on the continuous GMM draw.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.models.draft import (DraftDecoder, draft_mixture_count,
+                                         self_draft_params)
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.ops import mdn
+from sketch_rnn_tpu.serve.engine import (Request, ServeEngine,
+                                         sample_mixture_rows)
+
+TINY = dict(batch_size=4, max_seq_len=48, enc_rnn_size=12,
+            dec_rnn_size=16, z_size=6, num_mixture=3, serve_slots=4,
+            serve_chunk=4, draft_rnn_size=16, draft_num_mixture=0)
+
+
+# -- the acceptance rule, at the sampler level -------------------------------
+
+
+def _pen_mp(pen_probs, n):
+    """[n, ·] MixtureParams with the given pen distribution and a
+    deterministic continuous head (one component, sigma ~ 0)."""
+    p = jnp.log(jnp.asarray(pen_probs, jnp.float32))
+    return mdn.MixtureParams(
+        log_pi=jnp.zeros((n, 1)), mu1=jnp.zeros((n, 1)),
+        mu2=jnp.zeros((n, 1)),
+        log_s1=jnp.full((n, 1), -30.0), log_s2=jnp.full((n, 1), -30.0),
+        rho=jnp.zeros((n, 1)),
+        pen_logits=jnp.broadcast_to(p, (n, 3)))
+
+
+def test_pen_rejection_is_exact_cdf_inversion():
+    """The unit matrix behind 'exact rejection over the pen-state CDF':
+    verifier and draft invert the SAME uniform u[1], so their pen
+    one-hots disagree exactly when u[1] falls where the two CDFs
+    bracket different categories — at temperature 1 with verifier pen
+    probs (.5,.3,.2) vs draft (.3,.4,.3) that is u in (.3,.5] u
+    (.7,.8], nowhere else."""
+    grid = np.array([0.05, 0.15, 0.25, 0.31, 0.40, 0.49, 0.51, 0.60,
+                     0.69, 0.71, 0.75, 0.79, 0.81, 0.90, 0.95],
+                    np.float32)
+    n = len(grid)
+    u = jnp.stack([jnp.full((n,), 0.5), jnp.asarray(grid),
+                   jnp.full((n,), 0.5), jnp.full((n,), 0.5)], axis=-1)
+    temps = jnp.ones((n,))
+    v = sample_mixture_rows(_pen_mp([0.5, 0.3, 0.2], n), u, temps)
+    d = sample_mixture_rows(_pen_mp([0.3, 0.4, 0.3], n), u, temps)
+    # both draws ARE the inverse CDF of their own pen distribution
+    cat = lambda cdf: np.minimum(  # noqa: E731
+        (grid[:, None] > np.asarray(cdf)[None, :]).sum(-1), 2)
+    np.testing.assert_array_equal(np.argmax(np.asarray(v[:, 2:]), -1),
+                                  cat([0.5, 0.8, 1.0]))
+    np.testing.assert_array_equal(np.argmax(np.asarray(d[:, 2:]), -1),
+                                  cat([0.3, 0.7, 1.0]))
+    # the engine's pen_ok predicate == analytic CDF-disagreement set
+    pen_ok = np.all(np.asarray(d[:, 2:] == v[:, 2:]), axis=-1)
+    disagree = ((grid > 0.3) & (grid <= 0.5)) | ((grid > 0.7)
+                                                 & (grid <= 0.8))
+    np.testing.assert_array_equal(pen_ok, ~disagree)
+
+
+def test_identical_pen_cdfs_always_accept():
+    """Exactness: a draft matching the verifier's pen distribution can
+    never be pen-rejected, for ANY uniform — the rule has no epsilon."""
+    u = jax.random.uniform(jax.random.key(0), (256, 4))
+    temps = jnp.full((256,), 0.7)
+    probs = [0.25, 0.6, 0.15]
+    v = sample_mixture_rows(_pen_mp(probs, 256), u, temps)
+    d = sample_mixture_rows(_pen_mp(probs, 256), u, temps)
+    np.testing.assert_array_equal(np.asarray(v[:, 2:]),
+                                  np.asarray(d[:, 2:]))
+
+
+# -- engine-level fixtures ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def setup():
+    hps = HParams(**TINY).replace(dec_model="lstm", conditional=True)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(0))
+    # pen suppression (the bench trick): request lengths are exactly
+    # the drawn caps, so dispatch/step accounting is pure scheduling
+    # math and the multi-dispatch geometry below is guaranteed
+    params["out_b"] = params["out_b"].at[2].set(-1e9)
+    dnoisy = self_draft_params(params, hps, key=jax.random.key(7),
+                               noise=0.05)
+    return hps, model, params, dnoisy
+
+
+def _reqs(hps, caps):
+    return [Request(key=jax.random.key(100 + i),
+                    z=np.asarray(jax.random.normal(jax.random.key(i),
+                                                   (hps.z_size,))),
+                    temperature=0.8, max_len=int(c), uid=i)
+            for i, c in enumerate(caps)]
+
+
+CAPS = (18, 24, 7, 32, 12, 24)
+
+
+def _by_uid(out):
+    return {r.uid: r for r in out["results"]}
+
+
+@pytest.fixture(scope="module")
+def legacy_out(setup):
+    hps, model, params, _ = setup
+    return ServeEngine(model, hps, params).run(_reqs(hps, CAPS))
+
+
+@pytest.fixture(scope="module")
+def spec_eng(setup):
+    hps, model, params, dnoisy = setup
+    return ServeEngine(model, hps, params, draft_params=dnoisy,
+                       draft_depth=4)
+
+
+@pytest.fixture(scope="module")
+def spec_out(spec_eng, setup):
+    hps = setup[0]
+    return spec_eng.run(_reqs(hps, CAPS))
+
+
+# -- bitwise parity + mixed accept lengths -----------------------------------
+
+
+def test_mixed_accept_lengths_bitwise_vs_legacy(setup, legacy_out,
+                                                spec_out):
+    """THE tentpole pin: a noisy draft yields partial acceptance —
+    mixed accept lengths across slots and dispatches (the 32-cap
+    request spans >= 4 dispatches at D=4) — and the emitted strokes
+    are STILL bitwise the legacy engine's, per uid."""
+    hps, model, params, dnoisy = setup
+    legacy, spec = legacy_out, spec_out
+    ref, got = _by_uid(legacy), _by_uid(spec)
+    assert set(ref) == set(got)
+    for u in ref:
+        assert ref[u].steps == got[u].steps == CAPS[u]
+        np.testing.assert_array_equal(ref[u].strokes5, got[u].strokes5)
+    sp = spec["metrics"]["speculative"]
+    assert sp["draft_depth"] == 4
+    assert sp["draft_steps_proposed"] > 0
+    # genuinely MIXED: neither all-accept nor all-reject
+    assert 0 < sp["draft_steps_accepted"] < sp["draft_steps_proposed"]
+    assert sp["acceptance_rate"] == round(
+        sp["draft_steps_accepted"] / sp["draft_steps_proposed"], 4)
+    assert spec["metrics"]["chunks"] >= 3
+    # the legacy engine advances at most K rows per engaged K steps
+    assert legacy["metrics"]["accepted_steps_per_device_step"] <= 1.0
+    assert "speculative" not in legacy["metrics"]
+    assert not ServeEngine(model, hps, params).speculative
+
+
+def test_exact_self_draft_hits_the_commit_ceiling(setup, legacy_out):
+    """noise=0 self-draft: every judged proposal accepted (acceptance
+    1.0 bitwise — the accounting pin), every dispatch commits D+1 rows
+    to a live slot, and the commit rate beats the legacy engine's."""
+    hps, model, params, _ = setup
+    dself = self_draft_params(params, hps)
+    legacy = legacy_out
+    spec = ServeEngine(model, hps, params, draft_params=dself,
+                       draft_depth=4).run(_reqs(hps, CAPS))
+    ref, got = _by_uid(legacy), _by_uid(spec)
+    for u in ref:
+        np.testing.assert_array_equal(ref[u].strokes5, got[u].strokes5)
+    sp = spec["metrics"]["speculative"]
+    assert sp["acceptance_rate"] == 1.0
+    assert sp["draft_steps_accepted"] == sp["draft_steps_proposed"] > 0
+    assert (spec["metrics"]["accepted_steps_per_device_step"]
+            > legacy["metrics"]["accepted_steps_per_device_step"])
+    assert (spec["metrics"]["device_steps"]
+            < legacy["metrics"]["device_steps"])
+
+
+# -- purity / determinism ----------------------------------------------------
+
+
+def test_accept_schedule_is_per_slot_pure(setup, spec_eng, spec_out):
+    """The accept length is a pure function of (request key, draft
+    params, verifier params): strokes AND the aggregate accept/reject
+    ledger are invariant to slot count and submission order — batch
+    composition can never leak into a slot's accept schedule."""
+    hps, model, params, dnoisy = setup
+    outs = [
+        spec_out,  # slots=4, submission order
+        ServeEngine(model, hps, params, slots=2, draft_params=dnoisy,
+                    draft_depth=4).run(_reqs(hps, CAPS)),
+        spec_eng.run(_reqs(hps, CAPS)[::-1]),  # reversed order
+    ]
+    base = _by_uid(outs[0])
+    sp0 = outs[0]["metrics"]["speculative"]
+    for out in outs[1:]:
+        got = _by_uid(out)
+        assert set(got) == set(base)
+        for u in base:
+            np.testing.assert_array_equal(base[u].strokes5,
+                                          got[u].strokes5)
+        sp = out["metrics"]["speculative"]
+        assert sp["draft_steps_proposed"] == sp0["draft_steps_proposed"]
+        assert sp["draft_steps_accepted"] == sp0["draft_steps_accepted"]
+
+
+def test_accept_reject_sequence_replays_from_trace_seed(setup, spec_eng,
+                                                        spec_out):
+    """ISSUE 18 acceptance: a rerun of the same engine AND a fresh
+    request list rebuilt from the trace seed (the per-request keys)
+    reproduce the accept/reject accounting and the strokes exactly."""
+    hps = setup[0]
+    out1 = spec_out
+    out2 = spec_eng.run(_reqs(hps, CAPS))
+    assert (out1["metrics"]["speculative"]
+            == out2["metrics"]["speculative"])
+    assert (out1["metrics"]["device_steps"]
+            == out2["metrics"]["device_steps"])
+    a, b = _by_uid(out1), _by_uid(out2)
+    for u in a:
+        np.testing.assert_array_equal(a[u].strokes5, b[u].strokes5)
+
+
+# -- draft geometry + construction-time validation ---------------------------
+
+
+def test_truncated_draft_head_geometry():
+    hps = HParams(**TINY).replace(num_mixture=5, draft_num_mixture=2)
+    assert draft_mixture_count(hps) == 2
+    draft = DraftDecoder(hps)
+    assert draft.out_dim == 6 * 2 + 3
+    p = draft.init_params(jax.random.key(0))
+    assert p["draft_out_w"].shape == (hps.draft_rnn_size, 15)
+    assert all(k.startswith("draft_") for k in p)
+    # inherit when unset
+    assert draft_mixture_count(hps.replace(draft_num_mixture=0)) == 5
+
+
+def test_self_draft_params_validation(setup):
+    hps, model, params, _ = setup
+    with pytest.raises(ValueError, match="dec_model"):
+        self_draft_params(params, hps.replace(dec_model="layer_norm"))
+    with pytest.raises(ValueError, match="draft_rnn_size"):
+        self_draft_params(params, hps.replace(draft_rnn_size=8))
+    with pytest.raises(ValueError, match="mixture"):
+        self_draft_params(params, hps.replace(draft_num_mixture=2))
+    with pytest.raises(ValueError, match="key"):
+        self_draft_params(params, hps, noise=0.1)
+    # noise=0 is the teacher's own weights, bitwise
+    dp = self_draft_params(params, hps)
+    np.testing.assert_array_equal(np.asarray(dp["draft_out_w"]),
+                                  np.asarray(params["out_w"]))
+
+
+def test_engine_refuses_bad_speculative_configs(setup):
+    hps, model, params, dnoisy = setup
+    with pytest.raises(ValueError, match="scan-only"):
+        ServeEngine(model, hps, params, draft_params=dnoisy,
+                    draft_depth=4, decode_kernel="pallas")
+    with pytest.raises(ValueError, match="depth"):
+        ServeEngine(model, hps, params, draft_params=dnoisy,
+                    draft_depth=-1)
+    # depth/tol default from hps when unset
+    eng = ServeEngine(model, hps, params, draft_params=dnoisy)
+    assert eng.draft_depth == hps.draft_depth
+    assert eng.draft_tol == hps.draft_tol
