@@ -71,7 +71,10 @@ from scripts.bench_summary import (  # noqa: E402
 # + one-compile-per-geometry accounting) and the ISSUE 17 fused
 # decode-kernel rows (serve_kernel: the modeled per-chunk HBM ratio of
 # the cache-resident pallas kernel vs the scan chunk program holding
-# >= 2x at equal serve geometry on the committed smoke row) carry a
+# >= 2x at equal serve geometry on the committed smoke row) and the
+# ISSUE 19 multi-tenant rows (serve_tenant: per-tenant completion +
+# bitwise isolation vs a single-tenant fleet; serve_prefix: the exact
+# encode-reuse ledger with zero tenant-swap compiles) carry a
 # binary ok metric
 # (1.0 = the cell hit its expected outcome): with an all-1.0 history
 # the cell's floor sits at best * (1 - min_band) * (1 - slack) ≈

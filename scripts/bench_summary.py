@@ -31,7 +31,8 @@ import time
 # automatically keyed, summarized and gated consistently.
 BINARY_KINDS = ("resilience", "serve_cost", "serve_cache",
                 "serve_autoscale", "serve_endpoint", "rollout",
-                "serve_kernel", "serve_spec")
+                "serve_kernel", "serve_spec", "serve_tenant",
+                "serve_prefix")
 
 
 def key_of(r: dict):
@@ -129,6 +130,25 @@ def key_of(r: dict):
                 f"draft={r.get('draft')} D={r.get('draft_depth')} "
                 f"B={r.get('slots')} K={r.get('chunk')} "
                 f"n={r.get('n_requests')} dev={dev}")
+    if r.get("kind") == "serve_tenant":
+        # multi-tenant cells (ISSUE 19): one per tenant of the paged
+        # fleet — completion + bitwise isolation vs a single-tenant
+        # fleet on that tenant's checkpoint is the binary signal,
+        # keyed on the tenant AND the fleet shape (a different tenant
+        # count is a different paging workload)
+        return ("servetenant", r.get("dec_model"),
+                f"tenant={r.get('tenant')} T={r.get('n_tenants')} "
+                f"B={r.get('slots')} K={r.get('chunk')} "
+                f"n={r.get('n_requests')} dev={dev}")
+    if r.get("kind") == "serve_prefix":
+        # shared-prefix encode-reuse cells (ISSUE 19): the exact
+        # radix ledger (computes == distinct == predicted, reused rows
+        # bitwise the recompute, zero tenant-swap compiles) is the
+        # binary signal for the whole fleet run
+        return ("serveprefix", r.get("dec_model"),
+                f"T={r.get('n_tenants')} B={r.get('slots')} "
+                f"K={r.get('chunk')} n={r.get('n_requests')} "
+                f"dev={dev}")
     if r.get("kind") == "serve_autoscale":
         # traffic-grid autoscale cells (ISSUE 12): one per (trace,
         # cache) arm pair — reproducible scale plan + autoscaled shed

@@ -728,6 +728,53 @@ def cmd_serve_bench(args) -> int:
         endpoints_cfg = {"map": ep_map, "classes": ep_classes,
                          "mix": mix, "frames": args.frames,
                          "encoder": bool(enc_needed)}
+    # multi-tenant serving (ISSUE 19): usage input fails HERE, before
+    # the restore/compile, like every spec check above
+    tenants_cfg = None
+    if args.tenants or args.tenant_mix or args.tenant_cap \
+            or args.tenant_slo:
+        if args.fleet is None:
+            print("[cli] --tenants/--tenant_mix/--tenant_cap/"
+                  "--tenant_slo configure the multi-tenant fleet; add "
+                  "--fleet", file=sys.stderr)
+            return 2
+        if args.tenants < 2:
+            print(f"[cli] --tenants needs >= 2 tenants (got "
+                  f"{args.tenants}); a single-tenant fleet is just "
+                  f"--fleet", file=sys.stderr)
+            return 2
+        if args.draft_ckpt:
+            print("[cli] --tenants serves value-paged params, which "
+                  "excludes speculative decoding (the draft+verify "
+                  "program bakes both trees); drop --draft_ckpt",
+                  file=sys.stderr)
+            return 2
+        if args.tenant_cap < 0:
+            print(f"[cli] --tenant_cap must be >= 0, got "
+                  f"{args.tenant_cap}", file=sys.stderr)
+            return 2
+        from sketch_rnn_tpu.serve.admission import parse_tenant_slos
+        from sketch_rnn_tpu.serve.loadgen import parse_tenant_mix
+        names = [f"tn{i}" for i in range(args.tenants)]
+        try:
+            tslos = parse_tenant_slos(args.tenant_slo)
+            tmix = (parse_tenant_mix(args.tenant_mix)
+                    if args.tenant_mix
+                    else tuple((t, 1.0) for t in [""] + names))
+        except ValueError as e:
+            print(f"[cli] {e}", file=sys.stderr)
+            return 2
+        known = set(names) | {""}
+        bad = sorted({t for t, _ in tmix} - known) \
+            + sorted(set(tslos) - known)
+        if bad:
+            print(f"[cli] unknown tenant(s) {bad} in --tenant_mix/"
+                  f"--tenant_slo; --tenants {args.tenants} registers "
+                  f"tn0..tn{args.tenants - 1} ('' = base)",
+                  file=sys.stderr)
+            return 2
+        tenants_cfg = {"names": names, "mix": tmix,
+                       "cap": args.tenant_cap, "slos": tslos}
     rc = _arm_faults(args)  # chaos runs: bad specs fail before binding
     if rc:
         return rc
@@ -750,7 +797,8 @@ def cmd_serve_bench(args) -> int:
                   f"bench runs, e.g. curl :{server.port}/metrics)",
                   file=sys.stderr)
         return _serve_bench_run(args, hps, slo_tracker, server,
-                                endpoints_cfg=endpoints_cfg)
+                                endpoints_cfg=endpoints_cfg,
+                                tenants_cfg=tenants_cfg)
     finally:
         faults.disable()
         if server is not None:
@@ -811,7 +859,8 @@ def _serve_telemetry_abort(trace_dir, tel, tele, mem_sampler) -> None:
 def _serve_bench_fleet(args, hps, model, state_params, requests,
                        slo_tracker, server=None, endpoints_cfg=None,
                        ckpt_id: str = "", template_state=None,
-                       draft_kw=None):
+                       draft_kw=None, tenants_cfg=None,
+                       tenant_store=None):
     """The fleet measured section: build + warm the fleet, THEN enable
     telemetry (via the shared helper — the can't-recompile-into-the-
     window ordering), then replay the open-loop schedule and drain.
@@ -838,12 +887,20 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
         endpoint_classes = None
     cls_order = [c.name for c in sorted(classes.values(),
                                         key=lambda c: c.priority)]
+    tenant_kw = {}
+    if tenant_store is not None:
+        # value-paged multi-tenant serving (ISSUE 19): the fleet holds
+        # ONE base tree + delta pages; tenant swaps are device_puts
+        tenant_kw = dict(tenants=tenant_store,
+                         tenant_cap=tenants_cfg["cap"],
+                         tenant_slos=tenants_cfg["slos"])
     fleet = ServeFleet(model, hps, state_params,
                        replicas=args.fleet, slots=args.slots,
                        chunk=args.chunk, greedy=args.greedy,
                        classes=classes, slo=slo_tracker,
                        endpoint_classes=endpoint_classes,
-                       ckpt_id=ckpt_id, **(draft_kw or {}))
+                       ckpt_id=ckpt_id, **tenant_kw,
+                       **(draft_kw or {}))
     if server is not None:
         # /healthz now answers from the LIVE fleet: a replica death
         # mid-run flips the verdict to degraded (ISSUE 10)
@@ -903,6 +960,7 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
             rows = [{"uid": uid, "replica": rec["replica"],
                      "class": rec.get("class"),
                      "endpoint": rec.get("endpoint", "generate"),
+                     "tenant": rec.get("tenant", ""),
                      "queue_pos": rec.get("queue_pos"),
                      "steps": rec["result"].steps,
                      "length": rec["result"].length,
@@ -932,6 +990,14 @@ def _serve_bench_fleet(args, hps, model, state_params, requests,
             fsum["latency_by_endpoint"]
         fsum["endpoint_mix"] = [list(m) for m in endpoints_cfg["mix"]]
         fsum["endpoint_classes"] = dict(endpoints_cfg["map"])
+    if tenant_store is not None:
+        # the per-tenant surface (ISSUE 19): latency/SLO/shed split by
+        # tenant + the paged-adapter memory table, straight from the
+        # fleet summary's tenants block
+        out_metrics["latency_by_tenant"] = \
+            fsum["tenants"]["latency_by_tenant"]
+        out_metrics["tenant_swaps"] = fsum["tenants"]["tenant_swaps"]
+        fsum["tenant_mix"] = [list(m) for m in tenants_cfg["mix"]]
     if slo_tracker is not None:
         out_metrics["slo"] = slo_tracker.summary()
     return out_metrics, fsum, rows, handles
@@ -970,8 +1036,49 @@ def _build_endpoint_requests(args, hps, scale, n, kz, kreq,
                               default_label=args.label)
 
 
+def _tenant_store_of(state_params, names, seed, ckpt_id):
+    """Build the multi-tenant adapter store for ``--tenants`` (ISSUE
+    19): N seeded stand-in fine-tunes registered as sparse int8-delta
+    pages against the served tree. tn0 is a bitwise copy (the
+    zero-delta proof rides every run), tn1 nudges every float leaf
+    (the full quantized-delta path), the rest nudge only the output
+    head — the realistic per-customer fine-tune shape."""
+    from sketch_rnn_tpu.serve.tenants import TenantStore
+
+    base = jax.tree_util.tree_map(lambda a: np.asarray(a), state_params)
+
+    def perturb(want, pseed):
+        rng = np.random.default_rng(pseed)
+
+        def walk(node, path=""):
+            if isinstance(node, dict):
+                return {k: walk(v, f"{path}/{k}" if path else k)
+                        for k, v in node.items()}
+            a = np.asarray(node)
+            hit = want is True or any(w in path for w in want)
+            if (hit and np.issubdtype(a.dtype, np.floating)
+                    and a.ndim >= 1):
+                d = 0.01 * rng.standard_normal(a.shape)
+                return (a + d).astype(a.dtype)
+            return a
+        return walk(base)
+
+    store = TenantStore(base, base_ckpt_id=ckpt_id or "base")
+    for i, t in enumerate(names):
+        want = [] if i == 0 else (True if i == 1
+                                  else ["out_w", "out_b"])
+        rep = store.register(t, perturb(want, seed + 1000 + i))
+        print(f"[cli] tenant {t}: {rep['pages']} adapter page(s), "
+              f"{rep['nbytes']} bytes", file=sys.stderr)
+    mt = store.memory_table()
+    print(f"[cli] adapter memory: resident {mt['resident_bytes']} / "
+          f"{mt['tenants']} full trees {mt['full_bytes']} "
+          f"(ratio {mt['ratio']:.3f})", file=sys.stderr)
+    return store
+
+
 def _serve_bench_run(args, hps, slo_tracker, server,
-                     endpoints_cfg=None) -> int:
+                     endpoints_cfg=None, tenants_cfg=None) -> int:
     """The body of ``serve-bench`` after usage validation; the caller
     owns the metrics server's lifetime (stopped on every exit path)."""
     import time
@@ -1052,6 +1159,19 @@ def _serve_bench_run(args, hps, slo_tracker, server,
                     label=args.label, temperature=args.temperature)
             for i in range(n)
         ]
+    tenant_store = None
+    if tenants_cfg is not None:
+        # register the tenant fleet's adapter pages against the SERVED
+        # tree (post-quantize: pages delta the tree replicas hold) and
+        # stamp each request's tenant from the seeded mix stream
+        from sketch_rnn_tpu.serve.loadgen import tenant_mix_ids
+        tenant_store = _tenant_store_of(state_params,
+                                        tenants_cfg["names"],
+                                        args.seed, init_ckpt_id)
+        tmix = tenants_cfg["mix"]
+        tids = tenant_mix_ids(n, tmix, args.seed)
+        for i, r in enumerate(requests):
+            r.tenant = tmix[int(tids[i])][0]
     writer = (MetricsWriter(args.workdir, name="serve")
               if args.log_metrics else None)
     import dataclasses
@@ -1067,7 +1187,8 @@ def _serve_bench_run(args, hps, slo_tracker, server,
             args, hps, model, state_params, requests, slo_tracker,
             server=server, endpoints_cfg=endpoints_cfg,
             ckpt_id=init_ckpt_id, template_state=state,
-            draft_kw=draft_kw)
+            draft_kw=draft_kw, tenants_cfg=tenants_cfg,
+            tenant_store=tenant_store)
         trace_dir, tel, tele, mem_sampler = handles
         slots_v, chunk_v = fleet_report["slots"], fleet_report["chunk"]
         if writer is not None:
@@ -1459,6 +1580,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="latent-grid size of interpolate requests in "
                         "the endpoint mix (must fit one micro-burst: "
                         "frames <= pool_cap = 4x slots)")
+    p.add_argument("--tenants", type=int, default=0,
+                   help="multi-tenant serving for --fleet (ISSUE 19): "
+                        "register N >= 2 seeded stand-in fine-tunes "
+                        "('tn0'..) as sparse int8-delta adapter pages "
+                        "against the served checkpoint and serve them "
+                        "through ONE value-paged fleet — tenant swaps "
+                        "are pure device_puts (zero compiles), results "
+                        "and cache fingerprints carry per-tenant "
+                        "ckpt_ids, and the summary grows the "
+                        "per-tenant latency/SLO/shed + adapter-memory "
+                        "block. Excludes --draft_ckpt (the "
+                        "draft+verify program bakes its params)")
+    p.add_argument("--tenant_mix", default="",
+                   help="seeded tenant mix for --tenants runs, "
+                        "'name:weight,...' over tn0..tnN-1 (':1' "
+                        "weights the base checkpoint); default: "
+                        "uniform over base + every tenant")
+    p.add_argument("--tenant_cap", type=int, default=0,
+                   help="fair-share cap on outstanding pool rows per "
+                        "tenant (0 = uncapped); admission sheds a "
+                        "tenant at its cap BEFORE queue checks, so one "
+                        "hot tenant cannot starve the rest")
+    p.add_argument("--tenant_slo", action="append", default=[],
+                   help="per-tenant SLO spec, repeatable: "
+                        "tenant:class:pNN<=SECONDS (e.g. "
+                        "'tn0:interactive:p95<=250ms', class optional) "
+                        "— attainment is tracked and reported per "
+                        "tenant, never pooled")
     p.add_argument("--random_init", action="store_true",
                    help="fresh random params instead of a checkpoint")
     p.add_argument("--log_metrics", action="store_true",

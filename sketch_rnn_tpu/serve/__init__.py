@@ -5,6 +5,7 @@ from sketch_rnn_tpu.serve.admission import (
     AdmissionClass,
     AdmissionController,
     parse_admission_classes,
+    parse_tenant_slos,
 )
 from sketch_rnn_tpu.serve.autoscale import (
     AutoscalePolicy,
@@ -42,10 +43,17 @@ from sketch_rnn_tpu.serve.loadgen import (
     endpoint_mix_ids,
     make_trace,
     parse_endpoint_mix,
+    parse_tenant_mix,
     poisson_arrivals,
+    tenant_mix_ids,
 )
 from sketch_rnn_tpu.serve.metrics_http import MetricsServer
 from sketch_rnn_tpu.serve.slo import SLO, SLOTracker, parse_slo
+from sketch_rnn_tpu.serve.tenants import (
+    PrefixReuseIndex,
+    TenantStore,
+    tree_nbytes,
+)
 
 __all__ = [
     "AdmissionClass",
@@ -83,7 +91,13 @@ __all__ = [
     "simulate_traffic",
     "request_fingerprint",
     "MetricsServer",
+    "PrefixReuseIndex",
     "SLO",
     "SLOTracker",
+    "TenantStore",
     "parse_slo",
+    "parse_tenant_mix",
+    "parse_tenant_slos",
+    "tenant_mix_ids",
+    "tree_nbytes",
 ]

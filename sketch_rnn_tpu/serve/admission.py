@@ -98,6 +98,43 @@ def parse_admission_classes(specs: Sequence[str]
     return out
 
 
+def parse_tenant_slos(specs: Sequence[str]) -> Dict[str, List[SLO]]:
+    """Parse ``--tenant_slo`` specs into per-tenant SLO lists (ISSUE
+    19).
+
+    Grammar: ``tenant:class:pNN<=VALUE`` — the leading segment names
+    the tenant, the remainder is exactly the :func:`parse_slo` /
+    :func:`parse_admission_classes` grammar with the class in the
+    endpoint slot (``acme:interactive:p95<=250ms``). A two-segment
+    spec (``acme:p95<=250ms``) applies to the :data:`DEFAULT_CLASS`.
+    The fleet judges each tenant with its own
+    :class:`~sketch_rnn_tpu.serve.slo.SLOTracker`, so attainment is
+    reported per tenant, never pooled.
+    """
+    out: Dict[str, List[SLO]] = {}
+    seen = set()
+    for spec in specs:
+        left, sep, _ = spec.partition("<=")
+        segs = [s.strip() for s in left.strip().split(":")]
+        if not sep or len(segs) < 2 or not segs[0]:
+            raise ValueError(
+                f"bad tenant SLO spec {spec!r}: want "
+                f"tenant:class:pNN<=SECONDS (e.g. "
+                f"'acme:interactive:p95<=250ms')")
+        tenant = segs[0]
+        slo = parse_slo(spec.partition(":")[2])
+        if len(segs) == 2:
+            # no class segment: judge the tenant's default class
+            slo = dataclasses.replace(slo, endpoint=DEFAULT_CLASS)
+        if (tenant, slo.key) in seen:
+            raise ValueError(
+                f"duplicate tenant SLO {tenant}:{slo.key} "
+                f"(from {spec!r})")
+        seen.add((tenant, slo.key))
+        out.setdefault(tenant, []).append(slo)
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class Placement:
     """One admission decision. ``replica`` is None iff shed."""
@@ -127,7 +164,8 @@ class AdmissionController:
 
     def __init__(self, classes: Dict[str, AdmissionClass],
                  n_replicas: int, slots: int, queue_cap: int = 0,
-                 shed_margin: float = 1.0, ewma: float = 0.2):
+                 shed_margin: float = 1.0, ewma: float = 0.2,
+                 tenant_cap: int = 0):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if slots < 1:
@@ -150,6 +188,15 @@ class AdmissionController:
         self.service_s: Optional[float] = None   # EWMA decode_s
         self.admitted = 0
         self.shed: Dict[str, int] = {c: 0 for c in self.classes}
+        # tenant fair-share (ISSUE 19): cap a single tenant's
+        # OUTSTANDING decode-pool rows (queued + running, fleet-wide)
+        # at ``tenant_cap`` so one tenant's flash crowd sheds its own
+        # excess ("tenant_cap" reason) instead of filling every queue
+        # and starving the rest. 0 disables the check; outstanding rows
+        # are tracked either way so the summary can report them.
+        self.tenant_cap = int(tenant_cap)
+        self._tenant_out: Dict[str, int] = {}
+        self.shed_by_tenant: Dict[str, int] = {}
 
     @property
     def backlog(self) -> List[int]:
@@ -221,7 +268,8 @@ class AdmissionController:
         return self._backlog[replica] * self.service_s / self.slots
 
     def place(self, cls_name: str, force: bool = False,
-              requeue: bool = False, cost: int = 1) -> Placement:
+              requeue: bool = False, cost: int = 1,
+              tenant: str = "") -> Placement:
         """Decide one arrival: least-loaded replica, or shed.
 
         ``force`` admits unconditionally (same least-loaded placement,
@@ -235,7 +283,12 @@ class AdmissionController:
         request's decode-pool row count — ``frames`` for an
         interpolation, 1 otherwise — so backlog, the queue cap and
         the deadline shed estimate see the real work a grid request
-        queues, not "one request".
+        queues, not "one request". ``tenant`` (ISSUE 19) charges the
+        request's rows to that tenant's fair share — the tenant-cap
+        shed fires BEFORE the queue/deadline checks, because a tenant
+        over its share must shed even when the fleet has room (that is
+        the fairness rule: its excess never occupies capacity another
+        tenant could use).
         """
         cls = self.classes.get(cls_name)
         if cls is None:
@@ -254,23 +307,50 @@ class AdmissionController:
         replica = min(live, key=lambda r: (self._backlog[r], r))
         depth = self._backlog[replica]
         wait = self.est_wait_s(replica)
+        tenant = str(tenant or "")
         if not force and not requeue:
+            if (self.tenant_cap
+                    and self._tenant_out.get(tenant, 0) + cost
+                    > self.tenant_cap):
+                self.shed[cls_name] += 1
+                self.shed_by_tenant[tenant] = \
+                    self.shed_by_tenant.get(tenant, 0) + 1
+                return Placement(replica=None,
+                                 shed_reason="tenant_cap")
             if self.queue_cap and depth >= self.queue_cap:
                 self.shed[cls_name] += 1
+                if tenant:
+                    self.shed_by_tenant[tenant] = \
+                        self.shed_by_tenant.get(tenant, 0) + 1
                 return Placement(replica=None, shed_reason="queue_full")
             if (wait is not None and math.isfinite(cls.deadline_s)
                     and wait > cls.deadline_s * self.shed_margin):
                 self.shed[cls_name] += 1
+                if tenant:
+                    self.shed_by_tenant[tenant] = \
+                        self.shed_by_tenant.get(tenant, 0) + 1
                 return Placement(replica=None, est_wait_s=wait,
                                  shed_reason="deadline")
         if not requeue:
             self.admitted += 1
+            # a requeued request's rows are still outstanding from its
+            # original placement — re-charging would double-count
+            self._tenant_out[tenant] = \
+                self._tenant_out.get(tenant, 0) + int(cost)
         self._backlog[replica] += int(cost)
         return Placement(replica=replica, queue_pos=depth,
                          est_wait_s=wait)
 
+    def drop_tenant(self, tenant: str, cost: int = 1) -> None:
+        """Release a tenant's outstanding rows WITHOUT a completion —
+        the fleet's terminal-failure path (retry budget exhausted), so
+        a failed request cannot leak fair-share capacity forever."""
+        tenant = str(tenant or "")
+        self._tenant_out[tenant] = max(
+            0, self._tenant_out.get(tenant, 0) - int(cost))
+
     def note_done(self, replica: int, decode_s: float,
-                  cost: int = 1) -> None:
+                  cost: int = 1, tenant: str = "") -> None:
         """Feed one completion: frees its ``cost`` backlog rows (the
         same count :meth:`place` charged), calibrates the service-time
         EWMA the shed estimate runs on. The sample is ``decode_s``
@@ -285,6 +365,9 @@ class AdmissionController:
                 f"with only {self._backlog[replica]} tracked backlog "
                 f"rows — placement/completion accounting desynced")
         self._backlog[replica] -= int(cost)
+        tenant = str(tenant or "")
+        self._tenant_out[tenant] = max(
+            0, self._tenant_out.get(tenant, 0) - int(cost))
         d = float(decode_s)
         self.service_s = (d if self.service_s is None
                           else (1 - self._ewma) * self.service_s
@@ -303,6 +386,10 @@ class AdmissionController:
             "service_est_s": (None if self.service_s is None
                               else round(self.service_s, 6)),
             "queue_cap": self.queue_cap,
+            "tenant_cap": self.tenant_cap,
+            "shed_by_tenant": dict(self.shed_by_tenant),
+            "tenant_outstanding": {t: v for t, v
+                                   in self._tenant_out.items() if v},
             "classes": {c.name: {"deadline_s": c.deadline_s,
                                  "target": c.slo.target,
                                  "priority": c.priority}

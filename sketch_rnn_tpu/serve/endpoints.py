@@ -197,7 +197,7 @@ def pad_prefixes(prefixes: Sequence[np.ndarray], edge: int
 
 
 def make_encode_step(model, hps: HParams, params, edge: int,
-                     kernel: str = "scan"):
+                     kernel: str = "scan", param_args: bool = False):
     """Build the jitted encode + prefix-replay program for one edge.
 
     ``kernel`` (ISSUE 17) selects the teacher-forced replay core:
@@ -224,6 +224,12 @@ def make_encode_step(model, hps: HParams, params, edge: int,
     - ``prev``: each row's LAST prefix stroke ``S_p`` — the decode
       loop's first input, so the continuation's first MDN draw is the
       model's prediction of ``S_{p+1}``.
+
+    ``param_args=True`` (ISSUE 19): the weights ride as a traced
+    TRAILING argument (``fn(strokes, seq_len, labels, params)``)
+    instead of baked constants, so a multi-tenant value swap reuses
+    the compiled program — the encode-side twin of
+    ``make_chunk_step``'s value-paged mode.
     """
     import jax
     import jax.numpy as jnp
@@ -238,7 +244,7 @@ def make_encode_step(model, hps: HParams, params, edge: int,
         from sketch_rnn_tpu.ops.pallas_decode import check_cell_kind
         check_cell_kind(hps.dec_model)
 
-    def fn(strokes, seq_len, labels):
+    def encode_impl(params, strokes, seq_len, labels):
         b = strokes.shape[0]
         x_tm = jnp.transpose(strokes, (1, 0, 2))       # [E+1, B, 5]
         mu, _ = model.encode(params, x_tm[1:], seq_len, train=False)
@@ -277,6 +283,14 @@ def make_encode_step(model, hps: HParams, params, edge: int,
             axis=1)[:, 0]
         return mu, flat, prev
 
+    if param_args:
+        def fn(strokes, seq_len, labels, p):
+            return encode_impl(p, strokes, seq_len, labels)
+    else:
+        baked = params
+
+        def fn(strokes, seq_len, labels):
+            return encode_impl(baked, strokes, seq_len, labels)
     return jax.jit(fn)
 
 
@@ -293,11 +307,21 @@ class EncodeProgram:
     replica's device, the fleet's collective-free discipline.
     """
 
+    # encode-phase parameter subset: encoder stacks + posterior
+    # heads + decoder (replay) + the z->carry projection. presig
+    # and the MDN projection are computed-then-discarded (XLA DCE
+    # drops them from the compiled program) but model.encode /
+    # decode_step read the leaves at trace time, so they ride along.
+    _KEEP = ("enc_fwd", "enc_bwd", "mu_w", "mu_b", "presig_w",
+             "presig_b", "dec", "dec_init_w", "dec_init_b",
+             "class_embed", "out_w", "out_b")
+
     def __init__(self, model, hps: HParams, params, rows: int,
                  edges: Optional[Sequence[int]] = None, device=None,
                  replica_id: Optional[int] = None,
                  decode_kernel: Optional[str] = None,
-                 param_dtype: Optional[str] = None):
+                 param_dtype: Optional[str] = None,
+                 param_args: bool = False):
         import jax
 
         if not hps.conditional:
@@ -320,23 +344,42 @@ class EncodeProgram:
                                  or getattr(hps, "decode_kernel", "scan"))
         self.param_dtype = str(
             param_dtype or getattr(hps, "serve_quantize", "float32"))
-        # encode-phase parameter subset: encoder stacks + posterior
-        # heads + decoder (replay) + the z->carry projection. presig
-        # and the MDN projection are computed-then-discarded (XLA DCE
-        # drops them from the compiled program) but model.encode /
-        # decode_step read the leaves at trace time, so they ride along.
-        keep = ("enc_fwd", "enc_bwd", "mu_w", "mu_b", "presig_w",
-                "presig_b", "dec", "dec_init_w", "dec_init_b",
-                "class_embed", "out_w", "out_b")
+        # value-paged params (ISSUE 19): like the chunk program, the
+        # encode programs take the weights as a traced trailing
+        # argument so a congruent tenant swap is a pure device_put —
+        # the per-edge probes and their warm compile caches survive
+        self.param_args = bool(param_args)
         self.params = jax.device_put(
-            {k: params[k] for k in keep if k in params}, device)
+            {k: params[k] for k in self._KEEP if k in params}, device)
         self._fns: Dict[int, JitCompileProbe] = {}
+
+    def swap_params(self, params) -> None:
+        """Value-swap the encode-phase weights (ISSUE 19). Requires
+        ``param_args=True`` and a congruent tree — the compiled edge
+        programs are reused, so the swap is compile-free."""
+        import jax
+
+        if not self.param_args:
+            raise ValueError(
+                "EncodeProgram.swap_params needs param_args=True (the "
+                "baked-constant programs cannot take new values)")
+        new = {k: params[k] for k in self._KEEP if k in params}
+        old_leaves, old_tree = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_tree = jax.tree_util.tree_flatten(new)
+        if old_tree != new_tree or any(
+                getattr(o, "shape", None) != np.asarray(n).shape
+                for o, n in zip(old_leaves, new_leaves)):
+            raise ValueError(
+                "EncodeProgram.swap_params needs a congruent param "
+                "tree (same structure and leaf shapes)")
+        self.params = jax.device_put(new, self.device)
 
     def _fn(self, edge: int) -> JitCompileProbe:
         if edge not in self._fns:
             self._fns[edge] = JitCompileProbe(
                 make_encode_step(self.model, self.hps, self.params,
-                                 edge, kernel=self.decode_kernel),
+                                 edge, kernel=self.decode_kernel,
+                                 param_args=self.param_args),
                 "serve_encode",
                 key_of=lambda a: (tuple(a[0].shape),
                                   self.decode_kernel, self.param_dtype),
@@ -402,7 +445,11 @@ class EncodeProgram:
                             labs[j] = int(labels[i])
                 args = jax.device_put((strokes, lens, labs),
                                       self.device)
-                g_mu, g_carry, g_prev = jax.device_get(fn(*args))
+                if self.param_args:
+                    out = fn(*args, self.params)
+                else:
+                    out = fn(*args)
+                g_mu, g_carry, g_prev = jax.device_get(out)
                 for j, i in enumerate(chunk):
                     mu[i] = g_mu[j]
                     carry[i] = g_carry[j]
@@ -434,6 +481,76 @@ class BatchPlan:
 def child_uid(parent_uid: int, frame: int) -> int:
     return CHILD_UID_BASE + int(parent_uid) * CHILD_UID_STRIDE \
         + int(frame)
+
+
+def _encode_with_reuse(engine, encoder, index, jobs, labels_of):
+    """Run one burst's encode phase through a shared
+    :class:`~sketch_rnn_tpu.serve.tenants.PrefixReuseIndex` (ISSUE 19).
+
+    Jobs are grouped by their radix key — ``(tenant, prefix-hash,
+    edge, label)`` — BEFORE touching the index, so within-burst
+    duplicates claim one compute (and can never self-deadlock on their
+    own in-flight entry). Index hits stamp the stored host rows; the
+    remaining distinct keys run through ``encoder.encode`` exactly
+    once each and publish their rows, coalescing racing workers on
+    other replicas. The encode program is deterministic in (prefix,
+    params), so a stamped reuse is bitwise what recomputing would
+    produce — which is what makes **encode computes == distinct
+    (tenant, prefix, edge)** a safe identity rather than an
+    approximation.
+    """
+    n = len(jobs)
+    hps = engine.hps
+    mu = np.zeros((n, hps.z_size), np.float32)
+    carry = np.zeros((n, engine.model.dec.carry_size), np.float32)
+    prev = np.zeros((n, 5), np.float32)
+    tenant = getattr(engine, "serving_tenant", "") or engine.ckpt_id
+    groups: Dict[tuple, List[int]] = {}
+    order: List[tuple] = []
+    for pos, (r, _side, prefix) in enumerate(jobs):
+        key = index.key(tenant, prefix,
+                        prefix_edge_of(len(prefix), encoder.edges),
+                        int(r.label or 0))
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(pos)
+    compute_keys: List[tuple] = []
+    for key in order:
+        status, rows = index.acquire(key)
+        if status == "hit":
+            for pos in groups[key]:
+                mu[pos], carry[pos], prev[pos] = rows
+        else:
+            compute_keys.append(key)
+    try:
+        if compute_keys:
+            reps = [jobs[groups[key][0]] for key in compute_keys]
+            c_mu, c_carry, c_prev = encoder.encode(
+                [j[2] for j in reps], labels_of(reps))
+            for i, key in enumerate(compute_keys):
+                rows = (c_mu[i].copy(), c_carry[i].copy(),
+                        c_prev[i].copy())
+                index.fill(key, rows)
+                for pos in groups[key]:
+                    mu[pos], carry[pos], prev[pos] = rows
+    except BaseException:
+        # release unfilled claims so a coalesced waiter can take over
+        # (fill already popped the successful ones — abandon is a
+        # no-op for those)
+        for key in compute_keys:
+            index.abandon(key)
+        raise
+    # within-burst duplicates beyond each group's representative also
+    # avoided an encode; fold them into the index's reuse ledger (the
+    # acquire-hit path counted the cross-burst ones)
+    index.note_reuses(n - len(compute_keys)
+                      - (len(order) - len(compute_keys)))
+    tel = get_telemetry()
+    if tel.enabled:
+        tel.counter("encode_compute", len(compute_keys), cat="serve")
+        tel.counter("encode_reuse", n - len(compute_keys), cat="serve")
+    return mu, carry, prev
 
 
 def plan_batch(engine, requests: Sequence[Any]) -> BatchPlan:
@@ -468,10 +585,15 @@ def plan_batch(engine, requests: Sequence[Any]) -> BatchPlan:
             jobs.append((r, 1, np.asarray(r.prefix[1], np.float32)))
         else:
             jobs.append((r, 0, np.asarray(r.prefix, np.float32)))
-    mu, carry, prev = encoder.encode(
-        [j[2] for j in jobs],
-        [j[0].label for j in jobs]
-        if engine.hps.num_classes > 0 else None)
+    labels_of = (lambda js: [j[0].label for j in js]) \
+        if engine.hps.num_classes > 0 else (lambda js: None)
+    index = getattr(engine, "encode_reuse", None)
+    if index is None:
+        mu, carry, prev = encoder.encode([j[2] for j in jobs],
+                                         labels_of(jobs))
+    else:
+        mu, carry, prev = _encode_with_reuse(engine, encoder, index,
+                                             jobs, labels_of)
     enc_of: Dict[Tuple[int, int], int] = {
         (id(j[0]), j[1]): k for k, j in enumerate(jobs)}
 

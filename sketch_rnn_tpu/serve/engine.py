@@ -133,6 +133,13 @@ class Request:
     parent_uid: Optional[int] = None
     init_carry: Optional[np.ndarray] = None   # [C] flat replayed carry
     init_prev: Optional[np.ndarray] = None    # [5] last prefix row
+    # multi-tenant serving (ISSUE 19): which registered fine-tune's
+    # params serve this request ("" = the fleet's base checkpoint).
+    # Routing metadata like cls — the fleet pages a replica to the
+    # tenant's adapter before decoding, and the result cache
+    # fingerprints under the tenant's ckpt_id — but unlike cls it DOES
+    # select the strokes (a different tenant is a different model).
+    tenant: str = ""
 
 
 @dataclasses.dataclass
@@ -227,7 +234,8 @@ def sample_mixture_rows(mp: mdn.MixtureParams, u: jax.Array,
 
 
 def make_chunk_step(model, hps: HParams, chunk: int, params,
-                    greedy: bool = False, kernel: str = "scan"):
+                    greedy: bool = False, kernel: str = "scan",
+                    param_args: bool = False):
     """Build the jitted fixed-shape K-step decode program.
 
     ``fn(carry, prev, t, done, reset, slot_idx, pool) ->
@@ -249,6 +257,17 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
     the compiled program as constants — the engine serves ONE model, and
     shipping ~10 weight leaves through jit argument processing on every
     chunk is measurable host time at serving chunk rates.
+
+    ``param_args=True`` (ISSUE 19, multi-tenant value-paged mode)
+    instead makes the params a TRACED TRAILING ARGUMENT:
+    ``fn(carry, prev, t, done, reset, slot_idx, pool, params)``. The
+    compiled program is then pure in the weights, so a tenant swap is a
+    pure ``device_put`` of new values into the same executable — zero
+    compiles, which is the multi-tenant acceptance bar — at the cost of
+    the per-chunk pytree processing the constant mode avoids. The
+    math is IDENTICAL jnp either way; the fleet's single-tenant parity
+    references run value-paged too, so bitwise comparisons never cross
+    the constant/argument boundary.
 
     ``pool`` is the device-resident REQUEST POOL — ``[N, ...]`` arrays
     of every pending request's fields (raw PRNG key data, z, label,
@@ -279,7 +298,7 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
         from sketch_rnn_tpu.ops.pallas_decode import check_cell_kind
         check_cell_kind(hps.dec_model)
 
-    def chunk_fn(carry, prev, t, done, reset, slot_idx, pool):
+    def chunk_impl(params, carry, prev, t, done, reset, slot_idx, pool):
         b = t.shape[0]
         (pool_keys, pool_z, pool_labels, pool_temps, pool_caps,
          pool_init_carry, pool_init_prev, pool_init_mask) = pool
@@ -360,6 +379,16 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
             body, (carry, prev, t, done), None, length=chunk)
         return carry, prev, t, done, strokes
 
+    if param_args:
+        def chunk_fn(carry, prev, t, done, reset, slot_idx, pool, p):
+            return chunk_impl(p, carry, prev, t, done, reset,
+                              slot_idx, pool)
+    else:
+        baked = params
+
+        def chunk_fn(carry, prev, t, done, reset, slot_idx, pool):
+            return chunk_impl(baked, carry, prev, t, done, reset,
+                              slot_idx, pool)
     return jax.jit(chunk_fn)
 
 
@@ -518,9 +547,25 @@ class ServeEngine:
                  decode_kernel: Optional[str] = None,
                  param_dtype: Optional[str] = None,
                  draft_params=None, draft_depth: int = 0,
-                 draft_tol: Optional[float] = None):
+                 draft_tol: Optional[float] = None,
+                 param_args: bool = False):
         self.model = model
         self.hps = hps
+        # value-paged params (ISSUE 19): multi-tenant fleets build their
+        # engines with param_args=True so the chunk/encode programs take
+        # the weights as traced arguments — swap_params between
+        # congruent trees is then a pure device_put into the SAME
+        # compiled executables (zero compiles; the probe instance and
+        # its warm cache survive). Default off: single-tenant serving
+        # keeps the baked-constant programs bitwise unchanged.
+        self.param_args = bool(param_args)
+        # which tenant's params this engine currently serves ("" =
+        # base); stamped by the fleet's per-burst paging and read by
+        # the planner's prefix-reuse index key
+        self.serving_tenant = ""
+        # optional fleet-shared PrefixReuseIndex (serve/tenants.py);
+        # plan_batch consults it when set
+        self.encode_reuse = None
         self.slots = int(slots or hps.serve_slots)
         self.chunk = int(chunk or hps.serve_chunk)
         self.max_len = int(max_len or hps.max_seq_len)
@@ -559,6 +604,12 @@ class ServeEngine:
                 "speculative decoding is scan-only: the fused Pallas "
                 "decode kernel has no draft lane — drop draft_params "
                 "or use decode_kernel='scan'")
+        if self.speculative and self.param_args:
+            raise ValueError(
+                "value-paged params (param_args) and speculative "
+                "decoding are mutually exclusive: the draft+verify "
+                "program bakes BOTH param trees as constants — serve "
+                "multi-tenant fleets without draft_params")
         self.param_dtype = str(
             param_dtype or getattr(hps, "serve_quantize", "float32"))
         # greedy is part of the compiled program's identity; kept so a
@@ -592,6 +643,10 @@ class ServeEngine:
         self._bind_params(params)
         self.spans = SpanTimer(category="serve")
 
+    # the decode-path weight leaves a chunk program consumes
+    _DECODE_KEEP = ("dec", "out_w", "out_b", "dec_init_w", "dec_init_b",
+                    "class_embed")
+
     def _bind_params(self, params) -> None:
         """Bind ``params`` as this engine's serving weights: device-put
         the decode subset and bake it into a fresh chunk program.
@@ -603,10 +658,9 @@ class ServeEngine:
         # the chunk program as constants: the encoder's weights never
         # enter a chunk, and per-call pytree processing of weight
         # leaves is measurable at serving chunk rates
-        keep = ("dec", "out_w", "out_b", "dec_init_w", "dec_init_b",
-                "class_embed")
         self.params = jax.device_put(
-            {k: params[k] for k in keep if k in params}, self.device)
+            {k: params[k] for k in self._DECODE_KEEP if k in params},
+            self.device)
         # full parameter reference for the lazily-built endpoint encode
         # program (ISSUE 15): kept host-side only — a generate-only
         # engine never ships encoder weights to its device
@@ -639,7 +693,13 @@ class ServeEngine:
         else:
             fn = make_chunk_step(self.model, self.hps, self.chunk,
                                  self.params, self.greedy,
-                                 kernel=self.decode_kernel)
+                                 kernel=self.decode_kernel,
+                                 param_args=self.param_args)
+        # value-paged mode appends params as a TRAILING traced argument
+        # (a[7]); the geometry key stays the pool-shape tuple at a[6] —
+        # the ISSUE 19 contract that the key must NOT grow a tenant
+        # dimension (tenants are congruent, so their values share one
+        # executable and tenant swaps are compile-free by construction)
         self._chunk_fn = JitCompileProbe(
             fn,
             "serve_chunk",
@@ -671,11 +731,46 @@ class ServeEngine:
         ``param_dtype`` (ISSUE 17) relabels the serving precision when
         the incoming params were quantized (serve/quantize.py) — the
         rebuilt program then registers under its own (kernel, dtype)
-        probe geometry instead of silently cache-hitting the old."""
+        probe geometry instead of silently cache-hitting the old.
+
+        Value-paged mode (ISSUE 19, ``param_args=True``): when the
+        incoming tree is CONGRUENT with the currently bound one (same
+        structure, leaf shapes and dtypes) and the precision label is
+        unchanged, the swap is a pure ``device_put`` of new values —
+        the chunk program, its :class:`JitCompileProbe` instance and
+        the lazily-built endpoint encoder all survive with their warm
+        compile caches, so tenant paging costs ZERO compiles. A
+        non-congruent tree (a genuinely different model) falls back to
+        the legacy rebuild."""
+        relabel = (param_dtype is not None
+                   and str(param_dtype) != self.param_dtype)
+        if (self.param_args and not relabel
+                and self._congruent(params)):
+            self.params = jax.device_put(
+                {k: params[k] for k in self._DECODE_KEEP
+                 if k in params}, self.device)
+            self._full_params = params
+            if self._encoder is not None:
+                self._encoder.swap_params(params)
+            self.ckpt_id = str(ckpt_id or "")
+            return
         if param_dtype is not None:
             self.param_dtype = str(param_dtype)
         self._bind_params(params)
         self.ckpt_id = str(ckpt_id or "")
+
+    def _congruent(self, params) -> bool:
+        """Whether ``params``' decode subset matches the bound one in
+        structure, shapes and dtypes — the value-swap precondition."""
+        new = {k: params[k] for k in self._DECODE_KEEP if k in params}
+        old_leaves, old_tree = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_tree = jax.tree_util.tree_flatten(new)
+        if old_tree != new_tree:
+            return False
+        return all(
+            getattr(o, "shape", None) == np.asarray(n).shape
+            and getattr(o, "dtype", None) == np.asarray(n).dtype
+            for o, n in zip(old_leaves, new_leaves))
 
     @property
     def encoder(self):
@@ -695,7 +790,8 @@ class ServeEngine:
                 rows=self.slots, device=self.device,
                 replica_id=self.replica_id,
                 decode_kernel=self.decode_kernel,
-                param_dtype=self.param_dtype)
+                param_dtype=self.param_dtype,
+                param_args=self.param_args)
         return self._encoder
 
     # -- the request pool --------------------------------------------------
@@ -948,6 +1044,15 @@ class ServeEngine:
                                        pool)
                     out = (t_dev, done_dev, strokes_dev, acc_dev,
                            drf_dev)
+                elif self.param_args:
+                    # value-paged mode: the weights ride as a traced
+                    # trailing argument, so the executable is shared
+                    # across congruent tenant swaps
+                    carry, prev, t_dev, done_dev, strokes_dev = \
+                        self._chunk_fn(carry, prev, t_dev, done_dev,
+                                       reset.copy(), slot_idx.copy(),
+                                       pool, self.params)
+                    out = (t_dev, done_dev, strokes_dev)
                 else:
                     carry, prev, t_dev, done_dev, strokes_dev = \
                         self._chunk_fn(carry, prev, t_dev, done_dev,

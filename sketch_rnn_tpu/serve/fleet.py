@@ -73,6 +73,26 @@ all scrapeable live via ``serve/metrics_http.py``'s ``/metrics`` +
   ``/healthz`` reports ``scaling`` while a retire is still draining
   (an intentional resize must not read as degradation).
 
+- **Multi-tenant paging (ISSUE 19).** A :class:`~sketch_rnn_tpu.serve.
+  tenants.TenantStore` attached as ``tenants`` turns the fleet
+  multi-tenant: every engine is built in VALUE-PAGED mode (params are
+  traced arguments, not baked constants — serve/engine.py), so a
+  worker flips its replica to a burst's tenant with a pure value swap
+  that never compiles. Bursts are single-tenant (``pop_batch`` stops
+  at a tenant boundary, like the capacity stop), admission charges
+  each request to its tenant's fair share (``tenant_cap`` rows
+  fleet-wide; over-share requests shed with reason ``tenant_cap``
+  even when the fleet has room), the result cache fingerprints under
+  ``tenants.ckpt_id_of(tenant)`` so tenants can never collide on
+  byte-identical content, per-tenant SLOs (``tenant_slos``) are judged
+  by per-tenant trackers, and a fleet-shared
+  :class:`~sketch_rnn_tpu.serve.tenants.PrefixReuseIndex` in front of
+  the encode planner makes encode computes == distinct
+  (tenant, prefix, edge) exactly. Placement stays invisible to
+  outputs: a tenant's strokes are a pure function of (request content,
+  that tenant's materialized params), pinned bitwise against
+  single-tenant reference fleets.
+
 Every started fleet registers process-wide so the tier-1 conftest
 guard can prove no test leaks worker threads (:func:`stop_all`).
 """
@@ -97,6 +117,8 @@ from sketch_rnn_tpu.serve.admission import (
 )
 from sketch_rnn_tpu.serve.engine import Request, Result, ServeEngine
 from sketch_rnn_tpu.serve import endpoints as endpoints_mod
+from sketch_rnn_tpu.serve.slo import SLOTracker
+from sketch_rnn_tpu.serve.tenants import PrefixReuseIndex
 from sketch_rnn_tpu.utils.faults import backoff_s, fault_point
 from sketch_rnn_tpu.utils.telemetry import (
     class_series,
@@ -108,6 +130,7 @@ from sketch_rnn_tpu.utils.telemetry import (
     span_link,
     suppressed as telemetry_suppressed,
     tail_attribution,
+    tenant_series,
 )
 
 # every live fleet, for the conftest no-stray-threads guard
@@ -159,6 +182,7 @@ class _Replica:
         self.attributed_steps = 0
         self.idle_steps = 0
         self.burst_seq = 0  # keys the per-burst trace span ids
+        self.tenant_swaps = 0  # value-paged tenant flips (ISSUE 19)
 
     def pending(self) -> int:
         return sum(len(q) for q in self.queues.values())
@@ -169,15 +193,29 @@ class _Replica:
         (ISSUE 15 — its latent grid decodes as child rows), everything
         else one, and the micro-burst must fit the fixed ``pool_cap``
         pad. Popping stops at the first head that no longer fits, so
-        priority order is never violated for capacity."""
+        priority order is never violated for capacity.
+
+        Bursts are SINGLE-TENANT (ISSUE 19): the whole burst runs on
+        one materialized param tree, so popping also stops at the
+        first head whose tenant differs from the first popped
+        request's — the same keep-priority-order rule as the capacity
+        stop (skipping ahead to lower-priority same-tenant work would
+        violate class priority). Tenant-less fleets are unaffected:
+        every request's tenant is ``""``."""
         batch: List[Request] = []
         rows = 0
+        tenant: Optional[str] = None
         for q in self.queues.values():
             while q and rows < cap:
+                if tenant is not None and (q[0].tenant or "") != tenant:
+                    return batch
                 cost = endpoints_mod.pool_rows_of(q[0])
                 if rows + cost > cap:
                     return batch
-                batch.append(q.popleft())
+                r = q.popleft()
+                if tenant is None:
+                    tenant = r.tenant or ""
+                batch.append(r)
                 rows += cost
             if rows >= cap:
                 break
@@ -208,7 +246,9 @@ class ServeFleet:
                  endpoint_classes: Optional[Dict[str, str]] = None,
                  ckpt_id: str = "", draft_params=None,
                  draft_depth: int = 0,
-                 draft_tol: Optional[float] = None):
+                 draft_tol: Optional[float] = None,
+                 tenants=None, tenant_cap: int = 0,
+                 tenant_slos: Optional[Dict[str, List]] = None):
         import jax  # lazy, the serve-module discipline
 
         devices = list(devices if devices is not None else jax.devices())
@@ -255,9 +295,25 @@ class ServeFleet:
                 f"endpoint_classes route to undeclared admission "
                 f"class(es) {bad_routes}; declared: "
                 f"{sorted(self.classes)}")
+        # multi-tenant paging (ISSUE 19): with a TenantStore attached,
+        # `params` is the shared BASE tree and every engine is built
+        # VALUE-PAGED (params as traced arguments), so workers flip a
+        # replica between tenants without compiling. The shared
+        # PrefixReuseIndex dedupes encode work across replicas.
+        self.tenants = tenants
+        self.tenant_cap = int(tenant_cap)
+        self._tenant_slos_cfg = {t: list(s)
+                                 for t, s in (tenant_slos or {}).items()}
+        self._tenant_slo = {t: SLOTracker(s)
+                            for t, s in self._tenant_slos_cfg.items()}
+        self.encode_reuse = (PrefixReuseIndex()
+                             if tenants is not None else None)
+        if tenants is not None and not ckpt_id:
+            ckpt_id = tenants.base_ckpt_id
         self._admission = AdmissionController(
             self.classes, n_replicas=n_build, slots=self.slots,
-            queue_cap=queue_cap, shed_margin=shed_margin)
+            queue_cap=queue_cap, shed_margin=shed_margin,
+            tenant_cap=self.tenant_cap)
         self._slo = slo
         self._lock = threading.Lock()
         self._done_cv = threading.Condition(self._lock)
@@ -275,7 +331,9 @@ class ServeFleet:
                                   replica_id=r, ckpt_id=ckpt_id,
                                   draft_params=draft_params,
                                   draft_depth=draft_depth,
-                                  draft_tol=draft_tol)
+                                  draft_tol=draft_tol,
+                                  param_args=tenants is not None)
+            eng.encode_reuse = self.encode_reuse
             rep = _Replica(r, devices[r], eng, class_order)
             rep.cond = threading.Condition(self._lock)
             if r >= n:
@@ -396,6 +454,11 @@ class ServeFleet:
         tel = get_telemetry()
         if tel.enabled:
             tel.gauge("fleet_replicas", self.n_live, cat="serve")
+            if self.tenants is not None:
+                # the paged-adapter residency gauge (ISSUE 19): how
+                # many tenant fine-tunes this ONE fleet is serving
+                tel.gauge("tenant_adapters_resident",
+                          float(len(self.tenants.tenants)), cat="serve")
         return self
 
     def reset(self) -> None:
@@ -444,7 +507,18 @@ class ServeFleet:
             self._admission = AdmissionController(
                 self.classes, n_replicas=self.n_replicas,
                 slots=self.slots, queue_cap=self._admission.queue_cap,
-                shed_margin=self._admission.shed_margin)
+                shed_margin=self._admission.shed_margin,
+                tenant_cap=self._admission.tenant_cap)
+            # fresh per-tenant SLO verdicts and a fresh encode-reuse
+            # index per measurement arm (ISSUE 19): the reuse index's
+            # compute/reuse ledger is a measured-window quantity, so
+            # each arm starts cold (computes == distinct holds per arm)
+            self._tenant_slo = {t: SLOTracker(s)
+                                for t, s in self._tenant_slos_cfg.items()}
+            if self.encode_reuse is not None:
+                self.encode_reuse = PrefixReuseIndex()
+                for rep in self._replicas:
+                    rep.engine.encode_reuse = self.encode_reuse
             # restore the INITIAL topology (ISSUE 12): arms that
             # autoscaled re-measure from the same starting fleet.
             # Running fleets get workers spawned/retired to match;
@@ -486,6 +560,7 @@ class ServeFleet:
                 rep.device_steps = 0
                 rep.live_slot_steps = 0.0
                 rep.attributed_steps = rep.idle_steps = 0
+                rep.tenant_swaps = 0
 
     # -- elastic scaling (ISSUE 12) ----------------------------------------
 
@@ -751,14 +826,33 @@ class ServeFleet:
             raise ValueError(
                 f"request needs an admission class (configured: "
                 f"{sorted(self.classes)})")
+        # tenant door check (ISSUE 19): an unregistered tenant fails
+        # HERE with one actionable line — inside a worker it would be
+        # a burst death that fails over forever
+        tenant = str(req.tenant or "")
+        if self.tenants is not None:
+            if tenant not in self.tenants:
+                raise ValueError(
+                    f"unknown tenant {tenant!r}: registered "
+                    f"{sorted(self.tenants.tenants)} (empty string "
+                    f"serves the base tree)")
+        elif tenant:
+            raise ValueError(
+                f"request names tenant {tenant!r} but the fleet has "
+                f"no TenantStore attached")
         tel = get_telemetry()
         # content fingerprint OUTSIDE the scheduler lock (blake2b over
         # the request fields; the cache is consulted under it) — under
         # the fleet's CURRENT serving version (ISSUE 16), so a rollout
         # namespaces the keyspace: v1 entries are invisible to requests
-        # admitted under v2
-        fp = (self.cache.fingerprint(
-                  req, ckpt_id=self.serving_ckpt_id or None)
+        # admitted under v2. Multi-tenant fleets (ISSUE 19) fingerprint
+        # under the TENANT's serving identity instead: two tenants'
+        # byte-identical requests land in disjoint keyspaces, so a hit
+        # is always the requester's OWN adapter's bytes.
+        fp_ckpt = (self.tenants.ckpt_id_of(tenant)
+                   if self.tenants is not None
+                   else (self.serving_ckpt_id or None))
+        fp = (self.cache.fingerprint(req, ckpt_id=fp_ckpt)
               if self.cache is not None else None)
         with self._lock:
             if self._stop:
@@ -795,7 +889,8 @@ class ServeFleet:
                                          entry.origin_uid, tel,
                                          endpoint=entry.endpoint,
                                          frames=entry.frames,
-                                         ckpt_id=entry.ckpt_id)
+                                         ckpt_id=entry.ckpt_id,
+                                         tenant=tenant)
                     return True
                 if fp in self._pending:
                     self._pending[fp].append(req)
@@ -821,11 +916,13 @@ class ServeFleet:
             # see the real work it queues
             decision = self._admission.place(
                 cls_name, force=force,
-                cost=endpoints_mod.pool_rows_of(req))
+                cost=endpoints_mod.pool_rows_of(req),
+                tenant=tenant)
             if decision.shed:
                 self._shed.append({"uid": req.uid, "class": cls_name,
                                    "endpoint": req.endpoint
                                    or "generate",
+                                   "tenant": tenant,
                                    "reason": decision.shed_reason,
                                    "est_wait_s": decision.est_wait_s})
                 if tel.enabled:
@@ -834,6 +931,10 @@ class ServeFleet:
                     tel.counter("requests_shed", 1.0, cat="serve")
                     tel.counter(class_series("requests_shed", cls_name),
                                 1.0, cat="serve")
+                    if tenant:
+                        tel.counter(tenant_series("requests_shed",
+                                                  tenant), 1.0,
+                                    cat="serve")
                     # a shed request never completes, so its submit
                     # instant IS its whole trace — a self-rooted
                     # single-span tree, never an orphan
@@ -880,7 +981,8 @@ class ServeFleet:
                         origin_uid: int, tel,
                         coalesced: bool = False,
                         endpoint: str = "generate",
-                        frames=None, ckpt_id: str = "") -> None:
+                        frames=None, ckpt_id: str = "",
+                        tenant: str = "") -> None:
         """Serve one request from cached strokes (caller holds the
         lock): book a ``cached=True`` Result with ZERO attributed
         device steps, feed the SLO tracker the (tiny) real latency,
@@ -901,10 +1003,18 @@ class ServeFleet:
         self._results[req.uid] = {
             "result": res, "replica": None, "class": cls_name,
             "queue_pos": None, "cached": True,
-            "endpoint": res.endpoint,
+            "endpoint": res.endpoint, "tenant": tenant,
             "origin_uid": origin_uid}
         if self._slo is not None:
             self._slo.observe(cls_name or DEFAULT_CLASS, {
+                "queue_wait_s": res.queue_wait_s,
+                "decode_s": res.decode_s,
+                "latency_s": res.latency_s})
+        tslo = self._tenant_slo.get(tenant)
+        if tslo is not None:
+            # per-tenant SLO verdicts key on the ADMISSION CLASS (the
+            # tenant:class:pNN grammar) — a cached completion counts
+            tslo.observe(cls_name or DEFAULT_CLASS, {
                 "queue_wait_s": res.queue_wait_s,
                 "decode_s": res.decode_s,
                 "latency_s": res.latency_s})
@@ -953,6 +1063,11 @@ class ServeFleet:
                         cat="serve")
             tel.observe(endpoint_series("latency_s", res.endpoint),
                         res.latency_s, cat="serve")
+            if tenant:
+                tel.counter(tenant_series("requests_completed", tenant),
+                            1.0, cat="serve")
+                tel.observe(tenant_series("latency_s", tenant),
+                            res.latency_s, cat="serve")
         self._done_cv.notify_all()
 
     def _worker(self, rep: _Replica) -> None:
@@ -1010,6 +1125,24 @@ class ServeFleet:
                 # specific replica: "fleet.worker.r0@0")
                 fault_point(f"fleet.worker.r{rep.idx}")
                 with jax.default_device(rep.device):
+                    # tenant paging (ISSUE 19): flip this replica to
+                    # the burst's tenant with a pure VALUE swap —
+                    # value-paged engines keep their compiled chunk +
+                    # encode programs (the geometry key never sees a
+                    # tenant dimension), so the flip is a device_put,
+                    # never a compile. Bursts are single-tenant by
+                    # pop_batch's tenant stop.
+                    if self.tenants is not None and batch:
+                        t = batch[0].tenant or ""
+                        if t != rep.engine.serving_tenant:
+                            rep.engine.swap_params(
+                                self.tenants.materialize(t),
+                                ckpt_id=self.tenants.ckpt_id_of(t))
+                            rep.engine.serving_tenant = t
+                            rep.tenant_swaps += 1
+                            if tel.enabled:
+                                tel.counter("tenant_swaps", 1.0,
+                                            cat="serve")
                     # endpoint plan (ISSUE 15): the pre-decode encode
                     # phase runs on THIS replica's device, then the
                     # decode pool serves the planned rows; pure-
@@ -1054,10 +1187,14 @@ class ServeFleet:
                             rec["queue_pos"] = r.queue_pos
                             req_of = r
                             break
+                    tn = ((req_of.tenant or "")
+                          if req_of is not None else "")
+                    rec["tenant"] = tn
                     self._results[res.uid] = rec
                     self._admission.note_done(
                         rep.idx, res.decode_s,
-                        cost=(len(res.frames) if res.frames else 1))
+                        cost=(len(res.frames) if res.frames else 1),
+                        tenant=tn)
                     if self._slo is not None:
                         # class-keyed endpoints: a fleet SLO names the
                         # admission class it judges
@@ -1066,6 +1203,19 @@ class ServeFleet:
                             "queue_wait_s": res.queue_wait_s,
                             "decode_s": res.decode_s,
                             "latency_s": res.latency_s})
+                    tslo = self._tenant_slo.get(tn)
+                    if tslo is not None:
+                        # per-tenant SLO (ISSUE 19): each tenant is
+                        # judged by its OWN tracker, never pooled
+                        tslo.observe(rec.get("class") or DEFAULT_CLASS, {
+                            "queue_wait_s": res.queue_wait_s,
+                            "decode_s": res.decode_s,
+                            "latency_s": res.latency_s})
+                    if tel.enabled and tn:
+                        tel.counter(tenant_series("requests_completed",
+                                                  tn), 1.0, cat="serve")
+                        tel.observe(tenant_series("latency_s", tn),
+                                    res.latency_s, cat="serve")
                     # result cache fill + coalesced fan-out (ISSUE
                     # 12): the completed PRIMARY stores its strokes,
                     # then every repeat that arrived while it was in
@@ -1087,11 +1237,14 @@ class ServeFleet:
                                 req_of, ckpt_id=res.ckpt_id)
                         self.cache.put(fp_put, res)
                         for w in self._pending.pop(fp, []):
+                            # a coalesced waiter shares its primary's
+                            # fingerprint, hence its tenant namespace
                             self._book_cache_hit(
                                 w, w.cls, res.strokes5, res.length,
                                 res.steps, res.uid, tel,
                                 coalesced=True, endpoint=res.endpoint,
-                                frames=res.frames, ckpt_id=res.ckpt_id)
+                                frames=res.frames, ckpt_id=res.ckpt_id,
+                                tenant=(w.tenant or ""))
                 # booked REQUEST count (an interpolation's frames are
                 # engine rows, not requests — m["completed"] counts
                 # rows, the fleet counts requests)
@@ -1178,6 +1331,13 @@ class ServeFleet:
                         "reason": f"retry budget ({self.retry_budget}) "
                                   f"exhausted",
                         "error": repr(exc)}
+                    # terminal failure releases the tenant's
+                    # fair-share rows (ISSUE 19) — note_done never
+                    # fires for this request, and leaking them would
+                    # throttle the tenant forever
+                    self._admission.drop_tenant(
+                        r.tenant or "",
+                        cost=endpoints_mod.pool_rows_of(r))
                     if tel.enabled:
                         tel.counter("requests_failed", 1.0, cat="serve")
                         # a failed request never reaches the engine's
@@ -1343,7 +1503,7 @@ class ServeFleet:
             roll_ev = (self._rollout.evidence()
                        if self._rollout is not None else None)
             rolling = bool(roll_ev and roll_ev.get("active"))
-            return {
+            out = {
                 "healthy": not dead and self._error is None
                 and not self._failed,
                 "scaling": scaling,
@@ -1358,6 +1518,19 @@ class ServeFleet:
                 "requests_requeued": self._requeues,
                 "fatal": repr(self._error) if self._error else None,
             }
+            if self.tenants is not None:
+                # multi-tenant evidence (ISSUE 19): which fine-tunes
+                # are resident and which tenant each replica's params
+                # are currently paged to
+                out["tenants"] = {
+                    "adapters_resident": len(self.tenants.tenants),
+                    "registered": sorted(self.tenants.tenants),
+                    "serving": [r.engine.serving_tenant
+                                for r in self._replicas],
+                    "tenant_swaps": sum(r.tenant_swaps
+                                        for r in self._replicas),
+                }
+            return out
 
     def summary(self) -> Dict[str, Any]:
         """Fleet-level aggregate: throughput, per-class latency
@@ -1372,8 +1545,11 @@ class ServeFleet:
             submitted = self._submitted
             reps = [(r.idx, r.completed, r.bursts, r.chunks,
                      r.device_steps, r.live_slot_steps, r.dead,
-                     r.attributed_steps, r.idle_steps, r.retired)
+                     r.attributed_steps, r.idle_steps, r.retired,
+                     r.tenant_swaps)
                     for r in self._replicas]
+            tenant_slo = {t: trk.summary()
+                          for t, trk in self._tenant_slo.items()}
             scale_log = list(self._scale_log)
             t0, t1 = self._t_first_submit, self._t_last_done
         wall = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
@@ -1412,8 +1588,9 @@ class ServeFleet:
                 live / max(chunks * self.chunk * self.slots, 1), 4),
             "dead": dead, "retired": retired,
             "steps_attributed": attr, "steps_idle": idle,
+            "tenant_swaps": tswaps,
         } for idx, comp, bursts, chunks, steps, live, dead, attr, idle,
-          retired in reps]
+          retired, tswaps in reps]
         n_cached = sum(1 for rec in recs if rec.get("cached"))
         # per-class device-step cost (ISSUE 11): integer sums of the
         # engine's deterministic per-request attribution; `exact` pins
@@ -1425,6 +1602,35 @@ class ServeFleet:
             c = rec.get("class") or DEFAULT_CLASS
             steps_by_class[c] = (steps_by_class.get(c, 0)
                                  + rec["result"].attributed_steps)
+        # multi-tenant accounting (ISSUE 19): per-tenant completion/
+        # latency split, per-tenant SLO verdicts, fair-share sheds,
+        # the paged-adapter memory table and the encode-reuse ledger —
+        # the block scripts/serve_bench.py --tenants commits verbatim
+        tenants_block = None
+        if self.tenants is not None:
+            by_tenant: Dict[str, List[float]] = {}
+            for rec in recs:
+                by_tenant.setdefault(rec.get("tenant") or "",
+                                     []).append(rec["result"].latency_s)
+            shed_by_tenant: Dict[str, int] = {}
+            for s in shed:
+                tn = s.get("tenant") or ""
+                shed_by_tenant[tn] = shed_by_tenant.get(tn, 0) + 1
+            tenants_block = {
+                "registered": sorted(self.tenants.tenants),
+                "tenant_cap": self.tenant_cap,
+                "tenant_swaps": sum(r["tenant_swaps"]
+                                    for r in per_replica),
+                "latency_by_tenant": {
+                    t: {**pct(v), "completed": len(v)}
+                    for t, v in sorted(by_tenant.items())},
+                "shed_by_tenant": shed_by_tenant,
+                "slo_by_tenant": tenant_slo,
+                "memory": self.tenants.memory_table(),
+                "encode_reuse": (self.encode_reuse.stats()
+                                 if self.encode_reuse is not None
+                                 else None),
+            }
         total_attr = sum(r["steps_attributed"] for r in per_replica)
         total_idle = sum(r["steps_idle"] for r in per_replica)
         total_steps = sum(r["device_steps"] for r in per_replica)
@@ -1481,6 +1687,7 @@ class ServeFleet:
                                          rec["result"].latency_s))
                  for rec in recs]),
             "cost": cost,
+            "tenants": tenants_block,
             "per_replica": per_replica,
             # the fleet's critical path in DEVICE STEPS: max over
             # replicas — deterministic for a closed burst, and the

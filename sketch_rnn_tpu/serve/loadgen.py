@@ -124,6 +124,13 @@ class TraceSpec:
     # the mix is a pure function of the spec like everything else.
     # Empty = single-endpoint legacy traces (no endpoint column).
     endpoint_mix: Tuple[Tuple[str, float], ...] = ()
+    # multi-tenant mix (ISSUE 19): ((tenant, weight), ...) — each
+    # arrival draws the tenant whose fine-tune serves it, from its own
+    # seeded stream (seed + 3, decorrelated from arrivals / repetition
+    # ids / endpoint mix). The Zipf knob above already models skewed
+    # POPULARITY of contents; this table models skewed tenant traffic
+    # shares. Empty = single-tenant legacy traces (no tenant column).
+    tenant_mix: Tuple[Tuple[str, float], ...] = ()
 
     def __post_init__(self):
         if self.kind not in TRACE_KINDS:
@@ -141,22 +148,23 @@ class TraceSpec:
         if self.kind == "pareto" and self.pareto_alpha <= 0:
             raise ValueError(f"pareto_alpha must be > 0, got "
                              f"{self.pareto_alpha}")
-        seen = set()
-        for item in self.endpoint_mix:
-            if len(item) != 2:
-                raise ValueError(f"endpoint_mix entries are (name, "
-                                 f"weight) pairs, got {item!r}")
-            name, w = item
-            if not name or not isinstance(name, str):
-                raise ValueError(f"bad endpoint name {name!r} in "
-                                 f"endpoint_mix")
-            if name in seen:
-                raise ValueError(f"duplicate endpoint {name!r} in "
-                                 f"endpoint_mix")
-            seen.add(name)
-            if not w > 0:
-                raise ValueError(f"endpoint_mix weight for {name!r} "
-                                 f"must be > 0, got {w}")
+        for field, mix in (("endpoint_mix", self.endpoint_mix),
+                           ("tenant_mix", self.tenant_mix)):
+            seen = set()
+            for item in mix:
+                if len(item) != 2:
+                    raise ValueError(f"{field} entries are (name, "
+                                     f"weight) pairs, got {item!r}")
+                name, w = item
+                if not name or not isinstance(name, str):
+                    raise ValueError(f"bad name {name!r} in {field}")
+                if name in seen:
+                    raise ValueError(f"duplicate name {name!r} in "
+                                     f"{field}")
+                seen.add(name)
+                if not w > 0:
+                    raise ValueError(f"{field} weight for {name!r} "
+                                     f"must be > 0, got {w}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -170,6 +178,7 @@ class Trace:
     arrivals: np.ndarray      # [n] cumulative seconds, non-decreasing
     request_ids: np.ndarray   # [n] int64 into the unique request space
     endpoint_ids: Optional[np.ndarray] = None   # [n] into endpoint_mix
+    tenant_ids: Optional[np.ndarray] = None     # [n] into tenant_mix
 
     @property
     def n(self) -> int:
@@ -198,6 +207,22 @@ class Trace:
             return {"generate": self.n}
         names = [m[0] for m in self.spec.endpoint_mix]
         ids, counts = np.unique(self.endpoint_ids, return_counts=True)
+        return {names[int(i)]: int(c) for i, c in zip(ids, counts)}
+
+    def tenant_of(self, i: int) -> str:
+        """Arrival ``i``'s tenant name ("" — the base checkpoint — on
+        mix-less legacy traces)."""
+        if self.tenant_ids is None:
+            return ""
+        return self.spec.tenant_mix[int(self.tenant_ids[i])][0]
+
+    def tenant_counts(self) -> dict:
+        """Realized per-tenant arrival counts — what the bench reports
+        as the actual tenant mix."""
+        if self.tenant_ids is None:
+            return {"": self.n}
+        names = [m[0] for m in self.spec.tenant_mix]
+        ids, counts = np.unique(self.tenant_ids, return_counts=True)
         return {names[int(i)]: int(c) for i, c in zip(ids, counts)}
 
 
@@ -315,6 +340,41 @@ def endpoint_mix_ids(n: int, mix: Tuple[Tuple[str, float], ...],
         len(mix), size=n, p=w / w.sum()).astype(np.int64)
 
 
+def parse_tenant_mix(spec: str) -> Tuple[Tuple[str, float], ...]:
+    """Parse a ``--tenant_mix`` string into the TraceSpec table:
+    ``"acme:4,globex:2,initech:1"`` (bare names default to weight 1) —
+    the :func:`parse_endpoint_mix` grammar with tenant names.
+    Validation happens in TraceSpec."""
+    out = []
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, w = item.partition(":")
+        try:
+            out.append((name.strip(), float(w) if w.strip() else 1.0))
+        except ValueError:
+            raise ValueError(
+                f"bad tenant_mix weight {w!r} for {name!r} (want "
+                f"'name:weight,...')") from None
+    if not out:
+        raise ValueError(f"empty tenant mix spec {spec!r}")
+    return tuple(out)
+
+
+def tenant_mix_ids(n: int, mix: Tuple[Tuple[str, float], ...],
+                   seed: int) -> Optional[np.ndarray]:
+    """Seeded per-arrival tenant assignment over the weighted mix
+    (ISSUE 19): deterministic in ``(n, mix, seed)``, decorrelated from
+    every other trace stream via seed + 3. ``mix`` empty -> None
+    (legacy single-tenant traces)."""
+    if not mix:
+        return None
+    w = np.asarray([m[1] for m in mix], np.float64)
+    return np.random.default_rng(seed + 3).choice(
+        len(mix), size=n, p=w / w.sum()).astype(np.int64)
+
+
 def trace_arrivals(spec: TraceSpec) -> np.ndarray:
     """The spec's arrival schedule (dispatch on ``kind``)."""
     if spec.kind == "poisson":
@@ -340,7 +400,9 @@ def make_trace(spec: TraceSpec) -> Trace:
                                               spec.zipf_s, spec.seed),
                  endpoint_ids=endpoint_mix_ids(spec.n,
                                                spec.endpoint_mix,
-                                               spec.seed))
+                                               spec.seed),
+                 tenant_ids=tenant_mix_ids(spec.n, spec.tenant_mix,
+                                           spec.seed))
 
 
 class OpenLoopLoadGen:

@@ -143,6 +143,37 @@ def quantize_for_serving(params: Dict[str, Any], mode: str
     return dequantize_params(packed), report
 
 
+def quantize_delta(base: np.ndarray, target: np.ndarray) -> QTensor:
+    """Symmetric-int8 encode of ``target - base`` (ISSUE 19 adapters).
+
+    The multi-tenant parameter pages store a tenant checkpoint as an
+    int8 *diff* against the fleet's shared base tree. Same machinery and
+    same proof as :func:`_quantize_leaf`: the decoded delta is within
+    ``scale/2`` per element of the true delta (`max_error_bound` on the
+    delta), and an all-zero delta encodes to ``q == 0, scale == 1``.
+    (The tenant store never stores zero pages at all — an unchanged
+    leaf is served as the base array object itself, which is how the
+    "zero-delta tenant is bitwise the base" guarantee avoids even the
+    ``-0.0 + 0.0`` sign-bit edge of IEEE-754 addition.)
+    """
+    base = np.asarray(base, np.float32)
+    target = np.asarray(target, np.float32)
+    if base.shape != target.shape:
+        raise ValueError(
+            f"adapter delta needs congruent leaves, got base "
+            f"{base.shape} vs tenant {target.shape}")
+    return _quantize_leaf(np.asarray(target, np.float64)
+                          - np.asarray(base, np.float64), "int8")
+
+
+def apply_delta(base: np.ndarray, delta: QTensor) -> np.ndarray:
+    """Decode one adapter page entry: ``base + dequant(delta)`` in
+    float32. The inverse of :func:`quantize_delta` up to the documented
+    ``scale/2`` per-element budget; exact for a zero delta."""
+    return (np.asarray(base, np.float32) + delta.dequantize()
+            ).astype(np.float32)
+
+
 def stamp_ckpt_id(ckpt_id: str, mode: str) -> str:
     """Serving identity of a quantized checkpoint: ``<id>:int8`` /
     ``<id>:bf16``; float32 (and empty ids) pass through unchanged."""

@@ -1,0 +1,321 @@
+"""Paged multi-tenant parameters + shared-prefix encode reuse (ISSUE 19).
+
+Production sketch serving is per-category / per-user fine-tunes, not
+one checkpoint. Serving N checkpoints as N fleets costs N× resident
+params, N× compiles and zero cross-tenant capacity sharing. This module
+makes N tenants fit ONE fleet:
+
+- :class:`TenantStore` — one shared float32 *base* tree plus a sparse,
+  delta-encoded *adapter page* per tenant. A page stores only the
+  leaves that differ from the base, as symmetric-int8 diffs
+  (`serve/quantize.py`'s machinery: decoded delta within ``scale/2``
+  per element of the true delta). Leaves bitwise equal to the base are
+  not stored at all — ``materialize()`` returns the base array objects
+  themselves for those paths, so a tenant whose fine-tune touched
+  nothing is *bitwise* the base, and adapter-resident memory is
+  ``base + Σ page_bytes`` instead of ``N × full``.
+- :class:`PrefixReuseIndex` — a radix index over stroke-prefix hashes
+  in front of the :class:`~sketch_rnn_tpu.serve.endpoints.EncodeProgram`:
+  identical prefixes across ``complete``/``reconstruct`` requests
+  (templated UIs) reuse one encode output instead of re-encoding. The
+  encode program is a pure function of (prefix, params), so a reused
+  ``(mu, carry, prev)`` is bitwise what a recompute would produce; the
+  index coalesces concurrent misses (cache-style in-flight events) so
+  **encode computes == distinct (tenant, prefix, edge) exactly**, even
+  across racing replica workers.
+
+Adapter apply is shape-invariant by construction (`register` rejects
+non-congruent trees), which is what lets the fleet page a replica
+between tenants with a pure value swap — the chunk/encode programs'
+``JitCompileProbe`` geometry keys never see a tenant dimension, so
+tenant swaps show **zero compiles** in the measured window
+(serve/engine.py's value-paged mode; asserted by scripts/serve_bench.py
+``--tenants``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from sketch_rnn_tpu.serve.quantize import (
+    QTensor,
+    apply_delta,
+    quantize_delta,
+)
+
+BASE_TENANT = ""  # requests with no tenant serve the base tree
+
+
+def _walk(tree: Any, path: str = "") -> Iterator[Tuple[str, Any]]:
+    """Yield ``(path, leaf)`` in deterministic (insertion) order, the
+    same ``a/b/c`` path grammar as quantize_params."""
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk(v, f"{path}/{k}" if path else str(k))
+    else:
+        yield path, tree
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total leaf bytes of a param tree (numpy/JAX arrays + scalars)."""
+    total = 0
+    for _, leaf in _walk(tree):
+        total += int(np.asarray(leaf).nbytes)
+    return total
+
+
+def _page_nbytes(entry: Any) -> int:
+    if isinstance(entry, QTensor):
+        return int(entry.q.nbytes) + 8  # int8 payload + float64 scale
+    return int(np.asarray(entry).nbytes)
+
+
+class TenantStore:
+    """Base param tree + sparse int8-delta adapter pages per tenant.
+
+    ``register(tenant, params)`` diffs ``params`` against the base:
+    bitwise-equal leaves are skipped, quantizable float leaves become
+    :class:`QTensor` int8 deltas, anything else (scalars, int arrays)
+    is stored raw. ``materialize(tenant)`` rebuilds the tenant's float32
+    tree — base leaf objects where the page is silent, ``base +
+    dequant(delta)`` where it is not — which is exactly the tree the
+    fleet serves AND the tree single-tenant parity references must
+    serve (the raw fine-tune differs from its page decode by up to
+    ``scale/2`` per element; the page decode is the serving truth).
+    """
+
+    def __init__(self, base_params: Dict[str, Any],
+                 base_ckpt_id: str = "base"):
+        if not isinstance(base_params, dict) or not base_params:
+            raise ValueError("TenantStore needs a non-empty base param "
+                             "tree (nested dict of arrays)")
+        self.base = base_params
+        self.base_ckpt_id = str(base_ckpt_id or "base")
+        self.base_nbytes = tree_nbytes(base_params)
+        self._base_leaves: Dict[str, Any] = dict(_walk(base_params))
+        # tenant -> {"pages": {path: QTensor|ndarray}, "ckpt_id": str,
+        #            "nbytes": int, "report": [rows]}
+        self._adapters: Dict[str, Dict[str, Any]] = {}
+
+    # -- registration -------------------------------------------------
+
+    def register(self, tenant: str, params: Dict[str, Any],
+                 ckpt_id: str = "") -> Dict[str, Any]:
+        """Encode ``params`` as a delta page against the base.
+
+        Returns the adapter report: per-leaf rows ({path, shape, scale,
+        bound, max_err}) plus page/byte totals. Raises if the tree is
+        not congruent with the base (paged serving is value-swap only —
+        a new geometry would mean a recompile, which multi-tenant
+        serving forbids).
+        """
+        tenant = str(tenant)
+        if not tenant:
+            raise ValueError("tenant name must be non-empty (the empty "
+                             "string names the base tree)")
+        if tenant in self._adapters:
+            raise ValueError(f"tenant {tenant!r} already registered")
+        leaves = dict(_walk(params))
+        if set(leaves) != set(self._base_leaves):
+            missing = sorted(set(self._base_leaves) - set(leaves))
+            extra = sorted(set(leaves) - set(self._base_leaves))
+            raise ValueError(
+                f"tenant {tenant!r} tree is not congruent with the "
+                f"base: missing={missing[:4]} extra={extra[:4]}")
+        pages: Dict[str, Any] = {}
+        report: List[Dict[str, Any]] = []
+        for path, base_leaf in self._base_leaves.items():
+            leaf = leaves[path]
+            b = np.asarray(base_leaf)
+            t = np.asarray(leaf)
+            if b.shape != t.shape:
+                raise ValueError(
+                    f"tenant {tenant!r} leaf {path!r} shape {t.shape} "
+                    f"!= base {b.shape}: adapters must be "
+                    f"shape-invariant")
+            if b.dtype == t.dtype and np.array_equal(
+                    b, t) and not np.any(np.isnan(b)):
+                continue  # bitwise the base: no page entry
+            if t.ndim >= 1 and np.issubdtype(t.dtype, np.floating):
+                qt = quantize_delta(b, t)
+                err = float(np.max(np.abs(
+                    np.asarray(t, np.float32) - apply_delta(b, qt)))
+                ) if t.size else 0.0
+                pages[path] = qt
+                report.append({"path": path, "shape": tuple(t.shape),
+                               "scale": qt.scale,
+                               "bound": qt.scale / 2.0, "max_err": err})
+            else:
+                pages[path] = np.array(t)  # raw page (scalars, ints)
+                report.append({"path": path, "shape": tuple(t.shape),
+                               "scale": None, "bound": 0.0,
+                               "max_err": 0.0})
+        nbytes = sum(_page_nbytes(p) for p in pages.values())
+        self._adapters[tenant] = {
+            "pages": pages,
+            "ckpt_id": str(ckpt_id or f"{self.base_ckpt_id}+{tenant}"),
+            "nbytes": nbytes,
+            "report": report,
+        }
+        return {"tenant": tenant, "pages": len(pages), "nbytes": nbytes,
+                "report": report}
+
+    # -- lookup -------------------------------------------------------
+
+    @property
+    def tenants(self) -> List[str]:
+        return list(self._adapters)
+
+    def __contains__(self, tenant: str) -> bool:
+        return tenant == BASE_TENANT or tenant in self._adapters
+
+    def ckpt_id_of(self, tenant: str) -> str:
+        """The serving identity a tenant's Results (and cache
+        fingerprints) carry — distinct per tenant, so the result
+        cache's ckpt_id namespace isolates tenants for free."""
+        if tenant == BASE_TENANT:
+            return self.base_ckpt_id
+        return str(self._adapters[tenant]["ckpt_id"])
+
+    def adapter_report(self, tenant: str) -> List[Dict[str, Any]]:
+        return list(self._adapters[tenant]["report"])
+
+    def materialize(self, tenant: str) -> Dict[str, Any]:
+        """The float32 tree served for ``tenant``: base + decoded page.
+
+        Paths without a page entry return the base array OBJECTS (no
+        copy — this is both the memory story and the bitwise story);
+        a tenant with an empty page materializes a tree whose every
+        leaf is the base leaf itself.
+        """
+        if tenant == BASE_TENANT:
+            return self.base
+        pages = self._adapters[tenant]["pages"]
+
+        def build(node, path=""):
+            if isinstance(node, dict):
+                return {k: build(v, f"{path}/{k}" if path else str(k))
+                        for k, v in node.items()}
+            entry = pages.get(path)
+            if entry is None:
+                return node
+            if isinstance(entry, QTensor):
+                return apply_delta(np.asarray(node), entry)
+            return entry
+        return build(self.base)
+
+    # -- accounting ---------------------------------------------------
+
+    def memory_table(self) -> Dict[str, Any]:
+        """The adapter-memory-vs-N×full comparison SERVE_BENCH commits.
+
+        ``resident_bytes`` = one base tree + every adapter page;
+        ``full_bytes`` = what N separate full trees would cost
+        (tenants are congruent with the base, so each is
+        ``base_nbytes``). ``ratio`` is the acceptance number: < 0.5 at
+        N >= 4 because pages are sparse int8.
+        """
+        n = len(self._adapters)
+        adapters = {t: int(a["nbytes"])
+                    for t, a in self._adapters.items()}
+        resident = self.base_nbytes + sum(adapters.values())
+        full = n * self.base_nbytes
+        return {
+            "tenants": n,
+            "base_bytes": int(self.base_nbytes),
+            "adapter_bytes": adapters,
+            "resident_bytes": int(resident),
+            "full_bytes": int(full),
+            "ratio": (resident / full) if full else None,
+        }
+
+
+class PrefixReuseIndex:
+    """Radix index over stroke-prefix hashes: encode-once per distinct
+    ``(tenant, prefix, edge, label)``.
+
+    ``acquire(key)`` either returns a stored ``(mu, carry, prev)`` (a
+    *reuse*) or claims the key for computation (a *compute*); a second
+    worker racing on the same key blocks on an in-flight event instead
+    of recomputing — the same coalescing idiom as the result cache's
+    ``_pending`` map, moved to the encode layer. ``fill`` publishes the
+    computed rows; ``abandon`` releases a claim after a failure so a
+    waiter can take over (the failed claim is not counted).
+
+    The index is host-side numpy and fleet-shared: rows computed on one
+    replica's device are reused when planning bursts on any other.
+    Bitwise safety rests on the encode program being deterministic in
+    (prefix, params) — asserted end-to-end by the ``--tenants`` bench,
+    which recomputes a sample of reused rows and compares bytes.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._entries: Dict[tuple, tuple] = {}
+        self._inflight: Dict[tuple, bool] = {}
+        self.computes = 0
+        self.reuses = 0
+
+    @staticmethod
+    def key(tenant: str, prefix: np.ndarray, edge: int,
+            label: int = 0) -> tuple:
+        """Hash a stroke prefix into the index key. Shape is folded in
+        before the bytes so ``[2,3]`` content can never collide with a
+        ``[3,2]`` reshape of the same bytes."""
+        a = np.ascontiguousarray(np.asarray(prefix, np.float32))
+        h = hashlib.blake2b(digest_size=16)
+        h.update(repr(a.shape).encode("utf-8"))
+        h.update(a.tobytes())
+        return (str(tenant), h.hexdigest(), int(edge), int(label))
+
+    def acquire(self, key: tuple
+                ) -> Tuple[str, Optional[tuple]]:
+        """Returns ``("hit", rows)`` or ``("compute", None)``; blocks
+        while another thread holds an in-flight claim on ``key``."""
+        with self._cond:
+            while True:
+                if key in self._entries:
+                    self.reuses += 1
+                    return "hit", self._entries[key]
+                if key not in self._inflight:
+                    self._inflight[key] = True
+                    self.computes += 1
+                    return "compute", None
+                self._cond.wait()
+
+    def fill(self, key: tuple, rows: tuple) -> None:
+        with self._cond:
+            self._entries[key] = rows
+            self._inflight.pop(key, None)
+            self._cond.notify_all()
+
+    def note_reuses(self, n: int) -> None:
+        """Fold ``n`` additional avoided encodes into the reuse ledger
+        (within-burst duplicates the planner stamped from one
+        compute)."""
+        if n:
+            with self._lock:
+                self.reuses += int(n)
+
+    def abandon(self, key: tuple) -> None:
+        """Release a claim without publishing (compute failed); the
+        claim is uncounted so ``computes`` only counts successes."""
+        with self._cond:
+            if self._inflight.pop(key, None):
+                self.computes -= 1
+            self._cond.notify_all()
+
+    @property
+    def distinct(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"computes": self.computes, "reuses": self.reuses,
+                    "distinct": len(self._entries)}

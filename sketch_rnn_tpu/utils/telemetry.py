@@ -133,6 +133,19 @@ def endpoint_series(name: str, endpoint: Optional[str] = None) -> str:
     return class_series(name, f"ep_{endpoint}")
 
 
+def tenant_series(name: str, tenant: Optional[str] = None) -> str:
+    """Per-tenant series name (ISSUE 19): ``requests_completed`` ->
+    ``requests_completed_tn_acme``. Rides the :func:`class_series`
+    naming contract with a ``tn_`` marker so a tenant can never collide
+    with an admission class or endpoint of the same name; ``None``/empty
+    keeps the aggregate series name. The emitter (serve/fleet.py) and
+    every /metrics consumer key the per-tenant request/latency/shed
+    series identically."""
+    if not tenant:
+        return name
+    return class_series(name, f"tn_{tenant}")
+
+
 def site_series(name: str, site: Optional[str] = None) -> str:
     """Per-fault-site series name (ISSUE 10): ``faults_injected`` ->
     ``faults_injected_ckpt_commit`` (site dots and other non-Prometheus

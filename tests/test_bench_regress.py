@@ -246,3 +246,65 @@ def test_serve_cost_rows_gate_binary_exactness(tmp_path, capsys):
     assert bench_regress.main(
         ["--fresh", bad_fresh, "--history", poisoned]) == 1
     capsys.readouterr()
+
+
+def _tenant_row(ok, tenant="tn0", n_tenants=4, **kw):
+    return {"kind": "serve_tenant", "dec_model": "lstm", "slots": 4,
+            "chunk": 2, "n_requests": 48, "n_tenants": n_tenants,
+            "device_kind": "cpu", "smoke": True,
+            "tenant": tenant, "ckpt_id": f"seed0+{tenant}",
+            "adapter_pages": 2, "adapter_bytes": 709,
+            "completed": 10 if ok else 7, "shed": 0,
+            "bitwise_isolated": ok, "ok": ok, **kw}
+
+
+def _prefix_row(ok, **kw):
+    return {"kind": "serve_prefix", "dec_model": "lstm", "slots": 4,
+            "chunk": 2, "n_requests": 48, "n_tenants": 4,
+            "device_kind": "cpu", "smoke": True, "encode_jobs": 37,
+            "computes": 26, "reuses": 11 if ok else 0,
+            "distinct": 26, "predicted_distinct": 26 if ok else 30,
+            "tenant_swaps": 41, "window_compiles": 0 if ok else 3,
+            "ok": ok, **kw}
+
+
+def test_tenant_and_prefix_rows_gate_binary(tmp_path, capsys):
+    """ISSUE 19 satellite: the multi-tenant cells gate like the other
+    binary kinds — serve_tenant keyed per (tenant, fleet shape),
+    serve_prefix one cell per fleet run, any fresh isolation/ledger
+    miss is a REGRESS, and a recorded miss never poisons the
+    baseline."""
+    from scripts.bench_summary import key_of, metric_of
+
+    for row in (_tenant_row, _prefix_row):
+        assert metric_of(row(True)) == 1.0
+        assert metric_of(row(False)) == 0.0
+        assert key_of(row(True)) == key_of(row(False))
+    assert key_of(_tenant_row(True))[0] == "servetenant"
+    assert key_of(_prefix_row(True))[0] == "serveprefix"
+    # one cell per tenant, and tenant cells never pool across fleet
+    # shapes (a different tenant count is a different paging workload)
+    assert key_of(_tenant_row(True)) != key_of(
+        _tenant_row(True, tenant="tn1"))
+    assert key_of(_tenant_row(True)) != key_of(
+        _tenant_row(True, n_tenants=2))
+    assert key_of(_tenant_row(True)) != key_of(_prefix_row(True))
+
+    hist = _write(tmp_path / "h.jsonl",
+                  [_tenant_row(True) for _ in range(4)]
+                  + [_prefix_row(True) for _ in range(4)])
+    ok_fresh = _write(tmp_path / "ok.jsonl",
+                      [_tenant_row(True), _prefix_row(True)])
+    bad_fresh = _write(tmp_path / "bad.jsonl", [_prefix_row(False)])
+    assert bench_regress.main(
+        ["--fresh", ok_fresh, "--history", hist]) == 0
+    capsys.readouterr()
+    assert bench_regress.main(
+        ["--fresh", bad_fresh, "--history", hist]) == 1
+    assert "REGRESS" in capsys.readouterr().out
+    # a recorded isolation failure is evidence, not a baseline
+    poisoned = _write(tmp_path / "p.jsonl",
+                      [_prefix_row(True) for _ in range(4)]
+                      + [_prefix_row(False)])
+    assert bench_regress.main(
+        ["--fresh", bad_fresh, "--history", poisoned]) == 1

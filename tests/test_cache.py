@@ -442,3 +442,56 @@ def test_failed_primary_fails_coalesced_waiters(tiny_setup):
             np.testing.assert_array_equal(
                 rec["result"].strokes5,
                 results[origin]["result"].strokes5)
+
+
+def test_tenant_namespaces_are_collision_proof(tiny_setup):
+    """ISSUE 19 satellite: two tenants submitting BYTE-IDENTICAL
+    requests occupy two distinct cache fingerprints (the ckpt_id
+    namespace), so one tenant can never be served another tenant's
+    strokes — and a tenant's store hit is bitwise the computation its
+    OWN adapter produced."""
+    from sketch_rnn_tpu.serve import ServeFleet, TenantStore
+
+    hps, model, params = tiny_setup
+    base = jax.tree_util.tree_map(np.asarray, params)
+    store = TenantStore(base, base_ckpt_id="ck")
+    rng = np.random.default_rng(5)
+    tuned = dict(base)
+    tuned["out_w"] = (base["out_w"] + 0.05 * rng.standard_normal(
+        base["out_w"].shape)).astype(np.float32)
+    store.register("acme", tuned)
+
+    # unit pin: identical content, distinct namespaces
+    r = _req(7)
+    cache = ResultCache(config_hash="cfg")
+    assert (cache.fingerprint(r, ckpt_id=store.ckpt_id_of(""))
+            != cache.fingerprint(r, ckpt_id=store.ckpt_id_of("acme")))
+
+    fleet = ServeFleet(model, hps, base, replicas=1, cache=cache,
+                       tenants=store)
+    try:
+        # same bytes, different tenants: BOTH must compute (miss)
+        fleet.submit(dataclasses.replace(_req(7), uid=0, tenant=""))
+        fleet.submit(dataclasses.replace(_req(7), uid=1,
+                                         tenant="acme"))
+        fleet.start()
+        assert fleet.drain(timeout=120)
+        assert cache.stats()["hits"] == 0
+        assert cache.stats()["misses"] == 2
+        # the repeat hits ITS OWN tenant's fill, bitwise
+        fleet.submit(dataclasses.replace(_req(7), uid=2,
+                                         tenant="acme"))
+        assert fleet.drain(timeout=120)
+        res = fleet.results
+    finally:
+        fleet.close()
+    assert cache.stats()["hits"] == 1
+    hit = res[2]["result"]
+    assert hit.cached and res[2]["origin_uid"] == 1
+    np.testing.assert_array_equal(hit.strokes5,
+                                  res[1]["result"].strokes5)
+    assert hit.ckpt_id == "ck+acme"
+    assert res[0]["result"].ckpt_id == "ck"
+    # the adapter really changed the computation the namespaces guard
+    assert (res[0]["result"].strokes5.tobytes()
+            != res[1]["result"].strokes5.tobytes())

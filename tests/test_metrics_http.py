@@ -519,3 +519,63 @@ def test_render_prometheus_rollout_series():
         assert "serving_ckpt_info" not in render_prometheus(tel, None)
     finally:
         tele.disable()
+
+
+def test_render_prometheus_per_tenant_series_and_residency_gauge():
+    """ISSUE 19 satellite: per-tenant request/latency/shed series ride
+    the class_series contract with a ``tn_`` marker (a tenant can never
+    collide with a class or endpoint of the same name), and a
+    multi-tenant fleet's start() publishes the paged-adapter residency
+    gauge ``tenant_adapters_resident`` — scraped here off a real fleet
+    (never warmed: the gauge is start-time state, not decode work)."""
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.serve import ServeFleet, TenantStore
+    from sketch_rnn_tpu.utils.telemetry import (
+        class_series,
+        tenant_series,
+    )
+
+    assert tenant_series("requests_completed", "acme") == \
+        "requests_completed_tn_acme"
+    assert tenant_series("latency_s", None) == "latency_s"
+    # the tn_ marker keeps namespaces apart: a tenant NAMED like a
+    # class renders a different series than the class itself
+    assert tenant_series("latency_s", "interactive") != \
+        class_series("latency_s", "interactive")
+
+    hps = HParams(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+                  dec_rnn_size=16, z_size=6, num_mixture=3,
+                  serve_slots=2, serve_chunk=2)
+    model = SketchRNN(hps)
+    params = jax.tree_util.tree_map(
+        np.asarray, model.init_params(jax.random.key(0)))
+    store = TenantStore(params, base_ckpt_id="ck")
+    store.register("acme", params)
+    store.register("globex", params)
+
+    tel = tele.configure(trace_dir=None)
+    try:
+        fleet = ServeFleet(model, hps, params, replicas=1,
+                           tenants=store)
+        try:
+            fleet.start()
+        finally:
+            fleet.close()
+        for t, lat in (("acme", 0.1), ("acme", 0.3), ("globex", 0.2)):
+            tel.counter(tenant_series("requests_completed", t), 1.0,
+                        cat="serve")
+            tel.observe(tenant_series("latency_s", t), lat, cat="serve")
+        tel.counter(tenant_series("requests_shed", "globex"), 1.0,
+                    cat="serve")
+        text = render_prometheus(tel)
+    finally:
+        tele.disable()
+    s = _series(text)
+    assert s["sketch_rnn_serve_tenant_adapters_resident"] == 2
+    assert "# TYPE sketch_rnn_serve_tenant_adapters_resident gauge" \
+        in text
+    assert s["sketch_rnn_serve_requests_completed_tn_acme_total"] == 2
+    assert s["sketch_rnn_serve_requests_completed_tn_globex_total"] == 1
+    assert s["sketch_rnn_serve_requests_shed_tn_globex_total"] == 1
+    assert s["sketch_rnn_serve_latency_s_tn_acme_count"] == 2
+    assert "# TYPE sketch_rnn_serve_latency_s_tn_acme histogram" in text

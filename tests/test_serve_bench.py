@@ -206,6 +206,72 @@ def test_serve_bench_speculative_end_to_end_small(tmp_path):
     assert {(r["dec_model"], r["draft"]) for r in rows} == set(arms)
 
 
+def test_serve_bench_tenants_end_to_end_small(tmp_path):
+    """A shrunken multi-tenant bench (ISSUE 19): T delta-paged tenants
+    interleave through ONE value-paged fleet with ZERO compiles in the
+    measured window (tenant swaps > 0), the shared-prefix radix index
+    reports encode computes == distinct keys EXACTLY (and reused rows
+    recheck bitwise against a fresh encode), every tenant is bitwise a
+    single-tenant fleet on its own checkpoint (shuffled arrival +
+    failover-requeue replay included), binary serve_tenant/serve_prefix
+    rows stream to the hermetic smoke history, and pre-existing
+    records in --out are preserved."""
+    out = tmp_path / "SB.json"
+    out.write_text(json.dumps(
+        {"kind": "serve_bench", "engine_sketches_per_sec": 123.0}))
+    # --tenant_mix without the base stream: one fewer single-tenant
+    # reference fleet to build — the committed T=4 bench covers the
+    # base tenant; this tier-1 pin budgets compiles, not coverage
+    rc = serve_bench.main([
+        "--tenants", "2", "--smoke", "--slots", "4", "--chunk", "2",
+        "--requests", "16", "--unique", "4", "--min_len", "2",
+        "--max_len", "8", "--tenant_mix", "tn0:1,tn1:1",
+        "--out", str(out)])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert doc["engine_sketches_per_sec"] == 123.0  # merge preserved
+    t = doc["tenants"]
+    assert t["kind"] == "serve_tenants" and t["smoke"] is True
+    assert t["n_tenants"] == 2
+    assert sum(t["realized_tenants"].values()) == 16
+    assert set(t["realized_tenants"]) == {"tn0", "tn1"}
+    # the deterministic acceptance blocks all held (a failure raises
+    # AFTER streaming the rows)
+    p = t["parity"]
+    assert not p["failures"]
+    assert all(p["bitwise_by_tenant"].values())
+    assert p["shuffle_failover_bitwise"] is True
+    assert p["replicas_dead_in_failover_arm"] == 1
+    # zero compiles in the measured window while tenants actually flip
+    cap = t["capacity"]
+    assert cap["tenant_swaps"] > 0
+    assert cap["measured_window"]["jit_cache_miss"] == 0
+    assert cap["measured_window"]["compile_spans"] == 0
+    assert cap["cost"]["exact"] is True
+    # the exact encode-reuse ledger: computes == distinct == predicted,
+    # nothing encoded twice, and the reuse recheck ran per tenant
+    er = t["encode_reuse"]
+    assert er["computes"] == er["distinct"] == er["predicted_distinct"]
+    assert er["computes"] + er["reuses"] == er["encode_jobs"]
+    assert er["rechecked_bitwise"] == len(t["realized_tenants"])
+    # paged adapters: tn0 is the zero-delta proof, tn1 the full delta
+    assert t["adapters"]["tn0"]["pages"] == 0
+    assert t["adapters"]["tn1"]["pages"] > 0
+    assert t["memory"]["resident_bytes"] < t["memory"]["full_bytes"]
+    # per-tenant SLO attainment + shed reported separately per tenant
+    assert set(t["load_arm"]["slo_by_tenant"]) == {"tn0", "tn1"}
+    # one binary serve_tenant row per tenant + one serve_prefix row in
+    # the hermetic smoke history, streamed before any raise
+    hist = tmp_path / "BENCH_SMOKE_HISTORY.jsonl"
+    rows = [json.loads(line) for line in open(hist)]
+    trows = [r for r in rows if r.get("kind") == "serve_tenant"]
+    assert {r["tenant"] for r in trows} == {"tn0", "tn1"}
+    assert all(r["ok"] and r["bitwise_isolated"] for r in trows)
+    prows = [r for r in rows if r.get("kind") == "serve_prefix"]
+    assert len(prows) == 1 and prows[0]["ok"] is True
+    assert prows[0]["window_compiles"] == 0
+
+
 @pytest.mark.parametrize("dist", ["power", "bimodal"])
 def test_serve_bench_end_to_end_small(tmp_path, capsys, dist):
     """A shrunken smoke run: both paths execute, the record is

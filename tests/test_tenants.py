@@ -1,0 +1,286 @@
+"""Host-side unit tests for the multi-tenant layer (ISSUE 19).
+
+TenantStore page encoding (sparse int8 deltas, the zero-delta
+bitwise-base guarantee, registration validation, the memory table),
+the PrefixReuseIndex exact ledger (hit/compute/abandon/coalescing,
+shape-folded keys), the per-tenant SLO / mix parse grammars, and the
+AdmissionController tenant fair-share cap. Everything here is pure
+numpy + threads — the fleet-level end-to-end proofs (zero tenant-swap
+compiles, bitwise single-tenant parity, the reuse recheck) live in
+tests/test_serve_bench.py's ``--tenants`` run.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.serve.admission import (
+    DEFAULT_CLASS,
+    AdmissionController,
+    parse_admission_classes,
+    parse_tenant_slos,
+)
+from sketch_rnn_tpu.serve.loadgen import parse_tenant_mix, tenant_mix_ids
+from sketch_rnn_tpu.serve.quantize import QTensor
+from sketch_rnn_tpu.serve.tenants import (
+    PrefixReuseIndex,
+    TenantStore,
+    tree_nbytes,
+)
+
+
+def _base_tree(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "enc": {"w": rng.standard_normal((8, 16)).astype(np.float32),
+                "b": np.zeros((16,), np.float32)},
+        "out_w": rng.standard_normal((16, 6)).astype(np.float32),
+        "out_b": rng.standard_normal((6,)).astype(np.float32),
+        "steps": np.int64(1000),
+    }
+
+
+# -- TenantStore --------------------------------------------------------
+
+
+def test_store_zero_delta_tenant_is_bitwise_the_base_objects():
+    base = _base_tree()
+    store = TenantStore(base, base_ckpt_id="ck7")
+    rep = store.register("acme", {k: (dict(v) if isinstance(v, dict)
+                                      else v) for k, v in base.items()})
+    assert rep["pages"] == 0 and rep["nbytes"] == 0
+    tree = store.materialize("acme")
+    # the base array OBJECTS, not copies: no -0.0 + 0.0 sign-bit edge
+    assert tree["enc"]["w"] is base["enc"]["w"]
+    assert tree["out_w"] is base["out_w"]
+    assert tree["steps"] is base["steps"]
+    # the base tenant "" materializes the base tree itself
+    assert store.materialize("") is base
+
+
+def test_store_sparse_page_round_trip_within_scale_half():
+    base = _base_tree()
+    store = TenantStore(base)
+    rng = np.random.default_rng(3)
+    tuned = {**base, "out_w": (base["out_w"]
+                               + 0.01 * rng.standard_normal(
+                                   base["out_w"].shape)
+                               ).astype(np.float32)}
+    rep = store.register("acme", tuned)
+    # only the touched leaf gets a page
+    assert rep["pages"] == 1
+    (row,) = rep["report"]
+    assert row["path"] == "out_w"
+    assert row["max_err"] <= row["bound"] + 1e-12
+    assert row["bound"] == row["scale"] / 2.0
+    tree = store.materialize("acme")
+    err = np.max(np.abs(tree["out_w"] - tuned["out_w"]))
+    assert err <= row["bound"] + 1e-12
+    # untouched leaves are still the base objects
+    assert tree["enc"]["w"] is base["enc"]["w"]
+    assert tree["out_b"] is base["out_b"]
+
+
+def test_store_non_float_leaf_pages_raw_and_exact():
+    base = _base_tree()
+    store = TenantStore(base)
+    tuned = {**base, "steps": np.int64(2000)}
+    rep = store.register("acme", tuned)
+    assert rep["pages"] == 1
+    assert store.materialize("acme")["steps"] == 2000
+
+
+def test_store_register_validation():
+    base = _base_tree()
+    store = TenantStore(base)
+    with pytest.raises(ValueError, match="non-empty"):
+        store.register("", base)
+    store.register("acme", base)
+    with pytest.raises(ValueError, match="already registered"):
+        store.register("acme", base)
+    missing = {k: v for k, v in base.items() if k != "out_b"}
+    with pytest.raises(ValueError, match="not congruent"):
+        store.register("t2", missing)
+    bad_shape = {**base, "out_w": np.zeros((4, 6), np.float32)}
+    with pytest.raises(ValueError, match="shape-invariant"):
+        store.register("t3", bad_shape)
+    with pytest.raises(ValueError, match="non-empty base"):
+        TenantStore({})
+
+
+def test_store_ckpt_ids_and_contains():
+    store = TenantStore(_base_tree(), base_ckpt_id="seed42")
+    store.register("acme", _base_tree())
+    store.register("globex", _base_tree(), ckpt_id="globex_v3")
+    assert store.ckpt_id_of("") == "seed42"
+    assert store.ckpt_id_of("acme") == "seed42+acme"
+    assert store.ckpt_id_of("globex") == "globex_v3"
+    assert "" in store and "acme" in store and "initech" not in store
+    assert store.tenants == ["acme", "globex"]
+
+
+def test_store_memory_table_sparse_pages_beat_full_trees():
+    base = _base_tree()
+    store = TenantStore(base)
+    rng = np.random.default_rng(9)
+    for i in range(4):
+        tuned = {**base, "out_b": (base["out_b"]
+                                   + 0.01 * rng.standard_normal((6,))
+                                   ).astype(np.float32)}
+        store.register(f"tn{i}", tuned)
+    mem = store.memory_table()
+    assert mem["tenants"] == 4
+    assert mem["base_bytes"] == tree_nbytes(base)
+    assert mem["full_bytes"] == 4 * mem["base_bytes"]
+    assert mem["resident_bytes"] == (mem["base_bytes"]
+                                     + sum(mem["adapter_bytes"].values()))
+    assert mem["ratio"] < 0.5
+
+
+# -- PrefixReuseIndex ---------------------------------------------------
+
+
+def test_index_key_folds_shape_tenant_edge_and_label():
+    a = np.arange(6, dtype=np.float32)
+    k = PrefixReuseIndex.key("t", a.reshape(2, 3), 12)
+    assert k != PrefixReuseIndex.key("t", a.reshape(3, 2), 12)
+    assert k != PrefixReuseIndex.key("u", a.reshape(2, 3), 12)
+    assert k != PrefixReuseIndex.key("t", a.reshape(2, 3), 24)
+    assert k != PrefixReuseIndex.key("t", a.reshape(2, 3), 12, label=1)
+    assert k == PrefixReuseIndex.key("t", a.reshape(2, 3).copy(), 12)
+
+
+def test_index_ledger_compute_fill_hit_abandon():
+    idx = PrefixReuseIndex()
+    k = PrefixReuseIndex.key("t", np.ones((3, 5), np.float32), 12)
+    status, rows = idx.acquire(k)
+    assert status == "compute" and rows is None
+    payload = (np.zeros(4), np.ones(4), np.zeros(5))
+    idx.fill(k, payload)
+    status, rows = idx.acquire(k)
+    assert status == "hit" and rows is payload
+    idx.note_reuses(2)
+    assert idx.stats() == {"computes": 1, "reuses": 3, "distinct": 1}
+    # a failed compute releases its claim uncounted
+    k2 = PrefixReuseIndex.key("t", np.zeros((2, 5), np.float32), 24)
+    assert idx.acquire(k2)[0] == "compute"
+    idx.abandon(k2)
+    assert idx.stats()["computes"] == 1
+    # the key is free again: the next worker claims it
+    assert idx.acquire(k2)[0] == "compute"
+    assert idx.distinct == 1
+
+
+def test_index_coalesces_racing_miss_into_one_compute():
+    idx = PrefixReuseIndex()
+    k = PrefixReuseIndex.key("t", np.ones((2, 5), np.float32), 12)
+    assert idx.acquire(k)[0] == "compute"  # main thread holds the claim
+    got = []
+
+    def waiter():
+        got.append(idx.acquire(k))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    th.join(timeout=0.2)
+    assert th.is_alive() and not got  # blocked on the in-flight claim
+    idx.fill(k, ("rows",))
+    th.join(timeout=5)
+    assert not th.is_alive()
+    assert got == [("hit", ("rows",))]
+    assert idx.stats() == {"computes": 1, "reuses": 1, "distinct": 1}
+
+
+# -- parse grammars -----------------------------------------------------
+
+
+def test_parse_tenant_slos_grammar():
+    out = parse_tenant_slos(["acme:interactive:p95<=250ms",
+                             "acme:p99<=5",
+                             "globex:batch:p50<=2"])
+    assert set(out) == {"acme", "globex"}
+    by_key = {s.endpoint: s for s in out["acme"]}
+    assert by_key["interactive"].objective_s == pytest.approx(0.25)
+    # a two-segment spec judges the tenant's default class
+    assert by_key[DEFAULT_CLASS].objective_s == pytest.approx(5.0)
+    for bad in ("p95<=250ms",          # no tenant segment
+                "acme:interactive",    # no <= objective
+                ":p95<=1"):            # empty tenant name
+        with pytest.raises(ValueError, match="bad tenant SLO"):
+            parse_tenant_slos([bad])
+    with pytest.raises(ValueError, match="duplicate tenant SLO"):
+        parse_tenant_slos(["acme:p95<=1", "acme:default:p95<=2"])
+
+
+def test_parse_tenant_mix_and_ids():
+    mix = parse_tenant_mix("acme:4,globex:2,initech")
+    assert mix == (("acme", 4.0), ("globex", 2.0), ("initech", 1.0))
+    # the endpoint-mix grammar quirk: ":1" is the base tenant ""
+    assert parse_tenant_mix(":1") == (("", 1.0),)
+    with pytest.raises(ValueError, match="bad tenant_mix weight"):
+        parse_tenant_mix("acme:heavy")
+    with pytest.raises(ValueError, match="empty tenant mix"):
+        parse_tenant_mix(" , ")
+    ids = tenant_mix_ids(64, mix, seed=7)
+    assert ids.shape == (64,) and set(np.unique(ids)) <= {0, 1, 2}
+    assert np.array_equal(ids, tenant_mix_ids(64, mix, seed=7))
+    assert not np.array_equal(ids, tenant_mix_ids(64, mix, seed=8))
+    assert tenant_mix_ids(64, (), seed=7) is None
+
+
+# -- AdmissionController tenant fair share ------------------------------
+
+
+def _controller(**kw):
+    return AdmissionController(parse_admission_classes([]),
+                               n_replicas=2, slots=4, **kw)
+
+
+def test_tenant_cap_sheds_own_excess_not_other_tenants():
+    ctrl = _controller(tenant_cap=3)
+    for _ in range(3):
+        assert not ctrl.place(DEFAULT_CLASS, tenant="acme").shed
+    p = ctrl.place(DEFAULT_CLASS, tenant="acme")
+    assert p.shed and p.shed_reason == "tenant_cap"
+    assert ctrl.shed_by_tenant == {"acme": 1}
+    # the cap is per tenant: another tenant (and the base "") admit fine
+    assert not ctrl.place(DEFAULT_CLASS, tenant="globex").shed
+    assert not ctrl.place(DEFAULT_CLASS, tenant="").shed
+    # cost counts rows, not requests: a 3-row grid blows the cap alone
+    p = ctrl.place(DEFAULT_CLASS, cost=3, tenant="globex")
+    assert p.shed and p.shed_reason == "tenant_cap"
+
+
+def test_tenant_cap_fires_before_queue_checks():
+    # the fleet has room (empty queues, queue_cap far away) but the
+    # tenant is over its share: the shed reason must say so
+    ctrl = _controller(tenant_cap=1, queue_cap=100)
+    assert not ctrl.place(DEFAULT_CLASS, tenant="acme").shed
+    p = ctrl.place(DEFAULT_CLASS, tenant="acme")
+    assert p.shed and p.shed_reason == "tenant_cap"
+
+
+def test_tenant_outstanding_released_by_done_and_drop_not_requeue():
+    ctrl = _controller(tenant_cap=2)
+    a = ctrl.place(DEFAULT_CLASS, tenant="acme")
+    ctrl.place(DEFAULT_CLASS, tenant="acme")
+    assert ctrl.summary()["tenant_outstanding"] == {"acme": 2}
+    # a failover requeue was already charged once: no double count
+    ctrl.place(DEFAULT_CLASS, requeue=True, tenant="acme")
+    assert ctrl.summary()["tenant_outstanding"] == {"acme": 2}
+    # completion frees the fair share
+    ctrl.note_done(a.replica, 0.01, tenant="acme")
+    assert ctrl.summary()["tenant_outstanding"] == {"acme": 1}
+    assert not ctrl.place(DEFAULT_CLASS, tenant="acme").shed
+    # terminal failure releases without a completion (no leak)
+    ctrl.drop_tenant("acme", cost=2)
+    assert ctrl.summary()["tenant_outstanding"] == {}
+
+
+def test_tenant_cap_bypassed_by_force():
+    ctrl = _controller(tenant_cap=1)
+    ctrl.place(DEFAULT_CLASS, tenant="acme")
+    assert not ctrl.place(DEFAULT_CLASS, tenant="acme",
+                          force=True).shed
+    assert ctrl.shed_by_tenant == {}
