@@ -32,7 +32,7 @@ import time
 BINARY_KINDS = ("resilience", "serve_cost", "serve_cache",
                 "serve_autoscale", "serve_endpoint", "rollout",
                 "serve_kernel", "serve_spec", "serve_tenant",
-                "serve_prefix")
+                "serve_prefix", "runtime")
 
 
 def key_of(r: dict):
@@ -148,6 +148,14 @@ def key_of(r: dict):
         return ("serveprefix", r.get("dec_model"),
                 f"T={r.get('n_tenants')} B={r.get('slots')} "
                 f"K={r.get('chunk')} n={r.get('n_requests')} "
+                f"dev={dev}")
+    if r.get("kind") == "runtime":
+        # unified-dispatch-runtime cells (ISSUE 20): one per scheduler
+        # site (train_stack / eval_sweep / engine_pipeline /
+        # fleet_burst / encode_burst / donation) — bitwise schedule
+        # parity with the pre-PR loop (or the donation peak-bytes
+        # contract holding) is the binary signal
+        return ("runtime", r.get("site"),
                 f"dev={dev}")
     if r.get("kind") == "serve_autoscale":
         # traffic-grid autoscale cells (ISSUE 12): one per (trace,

@@ -1,0 +1,588 @@
+#!/usr/bin/env python
+"""Unified dispatch runtime bench (ISSUE 20): prove the
+``GeometryRunScheduler`` bitwise against the five legacy schedules it
+replaced, and measure the buffer-donation HBM win.
+
+Six binary ``kind=runtime`` arms, one row each (``scripts/
+bench_summary.py`` keys them ``("runtime", site, dev)``):
+
+- ``train_stack``   — ``dispatch_stack`` (stacked K-scan + remainder
+  replay) vs the FROZEN pre-PR loop body: final train state, per-run
+  metrics, (use, dispatches) and the ledger window all bitwise equal,
+  zero extra compiles on the legacy pass.
+- ``eval_sweep``    — ``geometry_runs`` span schedule vs the frozen
+  inline chunker on synthetic geometry patterns, plus a real tiny
+  model sweep: ``train.loop._sweep_rows`` rows vs the frozen pre-PR
+  generator, bitwise.
+- ``engine_pipeline`` — a tiny ``ServeEngine`` run: host_syncs ==
+  dispatches == chunks (depth-1 pipeline, zero syncs between
+  dispatches), realized K-amortization exact, strokes bitwise equal to
+  per-request single-slot runs (batch-composition independence) and to
+  a second cold engine (determinism), one compile total.
+- ``fleet_burst``   — ``form_burst`` vs the FROZEN pre-PR
+  ``pop_batch`` body across priority/cost/tenant configurations:
+  identical bursts AND identical residual queues, drained to empty.
+- ``encode_burst``  — ``bucket_runs`` schedule vs the frozen by-edge
+  chunker, and ``EncodeProgram.encode`` outputs bitwise equal to the
+  FROZEN pre-PR encode loop run on the same compiled programs; repeat
+  encodes deterministic with zero new compiles.
+- ``donation``      — AOT-compile donated vs undonated train-step and
+  serve-chunk programs; effective high water = ``peak_bytes -
+  alias_bytes`` (see ``utils.telemetry.executable_stats``). Smoke
+  gates on the machinery (alias present, reduction positive); the full
+  run gates the GOODPUT geometry at >= 25% train-step reduction and
+  ``--goodput`` folds the measured block into GOODPUT.json.
+
+The box constraint holds throughout: every acceptance signal is
+deterministic scheduling math or compiled-program memory accounting —
+no arm reads a wall clock.
+
+Usage::
+
+    python scripts/runtime_bench.py --smoke          # tiny, CPU, tier-1
+    python scripts/runtime_bench.py                  # full donation geom
+    python scripts/runtime_bench.py --goodput        # + update GOODPUT.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import OrderedDict, deque
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from scripts._measure import hist_append  # noqa: E402
+from sketch_rnn_tpu.config import HParams  # noqa: E402
+from sketch_rnn_tpu.data.loader import (  # noqa: E402
+    DataLoader,
+    make_synthetic_strokes,
+)
+from sketch_rnn_tpu.models.vae import SketchRNN  # noqa: E402
+from sketch_rnn_tpu.runtime.scheduler import (  # noqa: E402
+    GeometryRunScheduler,
+    default_scheduler,
+)
+from sketch_rnn_tpu.utils.telemetry import executable_stats  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(batch_size=4, max_seq_len=16, enc_rnn_size=16, dec_rnn_size=24,
+            z_size=8, num_mixture=3)
+
+# the GOODPUT measurement geometry (bench.py's train probe): the >=25%
+# donation acceptance number is pinned at this shape
+GOODPUT_GEOM = dict(batch_size=2, max_seq_len=8, enc_rnn_size=512,
+                    dec_rnn_size=256, z_size=32, num_mixture=5)
+
+
+def _hps(**kw) -> HParams:
+    return HParams(**{**TINY, **kw})
+
+
+def _loader(hps, n=64, seed=0):
+    seqs, labels = make_synthetic_strokes(
+        n, num_classes=max(hps.num_classes, 1), min_len=3,
+        max_len=hps.max_seq_len - 2, seed=seed)
+    return DataLoader(seqs, hps, labels=labels, augment=False, seed=seed)
+
+
+def _tree_equal(a, b) -> bool:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(la, lb))
+
+
+def _copy_tree(t):
+    return jax.tree_util.tree_map(jnp.copy, t)
+
+
+# -- frozen legacy references (pre-PR loop bodies, verbatim semantics) ------
+
+
+def _legacy_dispatch_stack(single_step, multi_step, state, batch,
+                           step, remaining, root_key, k):
+    """The pre-PR ``train.loop.dispatch_stack`` body, frozen here as
+    the parity reference (no ledger, direct ``device_get``-free
+    dispatch)."""
+    kk = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+    use = min(kk, remaining)
+    if use == k:
+        state, metrics = multi_step(state, batch, root_key)
+        return state, metrics, use, 1
+    per_step = []
+    for i in range(use):
+        b_i = jax.tree_util.tree_map(lambda x: x[i], batch)
+        state, m = single_step(
+            state, b_i, jax.random.fold_in(root_key, step + i))
+        per_step.append(m)
+    return (state,
+            GeometryRunScheduler.replay_window_metrics(per_step),
+            use, use)
+
+
+def _legacy_sweep_rows(params, loader, eval_step, key, multi):
+    """The pre-PR ``train.loop._sweep_rows`` body (mesh-less), frozen."""
+    n = loader.num_eval_batches
+    multi_step, k_max = multi if multi is not None else (None, 1)
+    pad_len = getattr(loader, "eval_pad_len", None)
+    i = 0
+    while i < n:
+        k = min(k_max, n - i) if multi_step is not None else 1
+        if k > 1 and pad_len is not None:
+            run, p0 = 1, pad_len(i)
+            while run < k and pad_len(i + run) == p0:
+                run += 1
+            k = run
+        if k > 1:
+            batches = [loader.get_batch(j) for j in range(i, i + k)]
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *batches)
+            out = jax.device_get(multi_step(params, stacked, key,
+                                            jnp.arange(i, i + k)))
+            for j in range(k):
+                yield {m: v[j] for m, v in out.items()}
+        else:
+            batch = loader.get_batch(i)
+            yield {m: np.asarray(v) for m, v in dict(
+                eval_step(params, batch,
+                          jax.random.fold_in(key, i))).items()}
+        i += k
+
+
+def _legacy_geometry_spans(n, k_max, geom_of):
+    """The frozen span schedule of the pre-PR eval chunker."""
+    i = 0
+    while i < n:
+        k = min(k_max, n - i)
+        if k > 1 and geom_of is not None:
+            run, g0 = 1, geom_of(i)
+            while run < k and geom_of(i + run) == g0:
+                run += 1
+            k = run
+        yield i, k
+        i += k
+
+
+def _legacy_pop_batch(queues, cap, cost_of):
+    """The pre-PR ``serve.fleet._Replica.pop_batch`` body, frozen."""
+    batch = []
+    rows = 0
+    tenant = None
+    for q in queues.values():
+        while q and rows < cap:
+            if tenant is not None and (q[0].tenant or "") != tenant:
+                return batch
+            cost = cost_of(q[0])
+            if rows + cost > cap:
+                return batch
+            r = q.popleft()
+            if tenant is None:
+                tenant = r.tenant or ""
+            batch.append(r)
+            rows += cost
+        if rows >= cap:
+            break
+    return batch
+
+
+def _legacy_encode(enc, prefixes, labels=None):
+    """The pre-PR ``EncodeProgram.encode`` loop body, frozen; runs on
+    ``enc``'s own compiled programs so the comparison isolates the
+    SCHEDULE, not the math."""
+    from sketch_rnn_tpu.serve.endpoints import pad_prefixes, prefix_edge_of
+
+    n = len(prefixes)
+    mu = np.zeros((n, enc.hps.z_size), np.float32)
+    carry = np.zeros((n, enc.model.dec.carry_size), np.float32)
+    prev = np.zeros((n, 5), np.float32)
+    spans = []
+    by_edge: Dict[int, List[int]] = {}
+    for i, p in enumerate(prefixes):
+        by_edge.setdefault(
+            prefix_edge_of(len(p), enc.edges), []).append(i)
+    for edge in sorted(by_edge):
+        idxs = by_edge[edge]
+        fn = enc._fn(edge)
+        for lo in range(0, len(idxs), enc.rows):
+            chunk = idxs[lo:lo + enc.rows]
+            spans.append((edge, tuple(chunk)))
+            group = [prefixes[i] for i in chunk]
+            pad = enc.rows - len(group)
+            if pad:
+                group = group + [np.zeros((1, 3), np.float32)] * pad
+            strokes, lens = pad_prefixes(group, edge)
+            labs = None
+            if enc.hps.num_classes > 0:
+                labs = np.zeros((enc.rows,), np.int32)
+                if labels is not None:
+                    for j, i in enumerate(chunk):
+                        labs[j] = int(labels[i])
+            args = jax.device_put((strokes, lens, labs), enc.device)
+            out = fn(*args, enc.params) if enc.param_args else fn(*args)
+            g_mu, g_carry, g_prev = jax.device_get(out)
+            for j, i in enumerate(chunk):
+                mu[i] = g_mu[j]
+                carry[i] = g_carry[j]
+                prev[i] = g_prev[j]
+    return (mu, carry, prev), spans
+
+
+# -- arms -------------------------------------------------------------------
+
+
+def arm_train_stack(seed: int) -> dict:
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+    from sketch_rnn_tpu.train.step import make_multi_train_step
+
+    k, total = 3, 8
+    hps = _hps()
+    model = SketchRNN(hps)
+    loader = _loader(hps, seed=seed)
+    single = make_train_step(model, hps)
+    multi = make_multi_train_step(model, hps, steps_per_call=k,
+                                  key_by_global_step=True)
+    root = jax.random.key(seed + 7)
+    state_a = make_train_state(model, hps, jax.random.key(seed))
+    state_b = make_train_state(model, hps, jax.random.key(seed))
+    batches = [loader.get_batch(i) for i in range(total)]
+
+    sched = default_scheduler()
+    led0 = sched.ledger.snapshot()
+    rows_a, rows_b = [], []
+    step = 0
+    while step < total:  # runs of [3, 3, 2]: full stack x2 + replay
+        kk = min(k, total - step)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack(xs), *batches[step:step + kk])
+        state_a, m_a, use_a, nd_a = sched.dispatch_stack(
+            single, multi, state_a, stacked, step, total - step, root, k)
+        state_b, m_b, use_b, nd_b = _legacy_dispatch_stack(
+            single, multi, state_b, stacked, step, total - step, root, k)
+        rows_a.append((jax.device_get(m_a), use_a, nd_a))
+        rows_b.append((jax.device_get(m_b), use_b, nd_b))
+        step += use_a
+    compiles_mid = single._cache_size() + multi._cache_size()
+    led = sched.ledger.window(led0)
+
+    metrics_eq = all(
+        ua == ub and na == nb and set(ma) == set(mb) and _tree_equal(ma, mb)
+        for (ma, ua, na), (mb, ub, nb) in zip(rows_a, rows_b))
+    state_eq = _tree_equal(jax.device_get(state_a),
+                           jax.device_get(state_b))
+    ledger_ok = (led["micro_items"] == total
+                 and led["dispatches"] == sum(nd for _, _, nd in rows_b)
+                 and led["dispatches_saved"]
+                 == total - sum(nd for _, _, nd in rows_b))
+    no_recompile = (single._cache_size() + multi._cache_size()
+                    == compiles_mid)
+    return {"site": "train_stack", "ok": bool(
+        metrics_eq and state_eq and ledger_ok and no_recompile),
+        "runs": len(rows_a), "micro_steps": total,
+        "dispatches": led["dispatches"],
+        "dispatches_saved": led["dispatches_saved"],
+        "state_bitwise": bool(state_eq), "metrics_bitwise": bool(metrics_eq),
+        "ledger_exact": bool(ledger_ok),
+        "no_recompile": bool(no_recompile)}
+
+
+def arm_eval_sweep(seed: int) -> dict:
+    from sketch_rnn_tpu.train import make_eval_step
+    from sketch_rnn_tpu.train.loop import _sweep_rows
+    from sketch_rnn_tpu.train.step import make_multi_eval_step
+
+    # span-schedule parity across synthetic geometry patterns (pure
+    # scheduling math, no model): uniform, boundaries, k_max=1, k>n
+    sched = default_scheduler()
+    patterns = [
+        (7, 3, None),
+        (7, 3, [16, 16, 16, 32, 32, 16, 16]),
+        (6, 4, [8, 16, 8, 16, 8, 16]),
+        (5, 1, [8, 8, 8, 8, 8]),
+        (2, 8, [16, 16]),
+        (9, 3, [8] * 9),
+    ]
+    spans_eq = True
+    for n, k_max, geoms in patterns:
+        geom_of = (None if geoms is None else (lambda i, g=geoms: g[i]))
+        spans_eq &= (list(sched.geometry_runs(n, k_max, geom_of))
+                     == list(_legacy_geometry_spans(n, k_max, geom_of)))
+
+    # real sweep: unified _sweep_rows vs the frozen pre-PR generator on
+    # the SAME compiled programs -> rows bitwise
+    hps = _hps()
+    model = SketchRNN(hps)
+    loader = _loader(hps, n=80, seed=seed)
+    params = model.init_params(jax.random.key(seed))
+    eval_step = make_eval_step(model, hps)
+    multi = (make_multi_eval_step(model, hps), 3)
+    key = jax.random.key(seed + 3)
+    rows_u = list(_sweep_rows(params, loader, eval_step, None, key, multi))
+    rows_l = list(_legacy_sweep_rows(params, loader, eval_step, key, multi))
+    rows_eq = (len(rows_u) == len(rows_l)
+               and loader.num_eval_batches == len(rows_u)
+               and all(set(a) == set(b) and _tree_equal(a, b)
+                       for a, b in zip(rows_u, rows_l)))
+    return {"site": "eval_sweep", "ok": bool(spans_eq and rows_eq),
+            "span_patterns": len(patterns), "spans_bitwise": bool(spans_eq),
+            "sweep_batches": loader.num_eval_batches,
+            "rows_bitwise": bool(rows_eq)}
+
+
+def arm_engine_pipeline(seed: int) -> dict:
+    from sketch_rnn_tpu.serve.engine import Request, ServeEngine
+
+    hps = _hps(conditional=False, num_classes=0, serve_slots=4,
+               serve_chunk=4)
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(seed))
+
+    def reqs():
+        return [Request(key=jax.random.key(100 + i), temperature=0.6)
+                for i in range(6)]
+
+    eng = ServeEngine(model, hps, params)
+    out = eng.run(reqs())
+    m = out["metrics"]
+    by_uid = {r.uid: np.asarray(r.strokes5) for r in out["results"]}
+
+    # depth-1 pipeline accounting: one dispatch and ONE host sync per
+    # chunk — zero syncs between dispatches — and exact K-amortization
+    counts_ok = (m["dispatches"] == m["chunks"]
+                 and m["host_syncs"] == m["chunks"]
+                 and m["dispatches_saved"] == m["chunks"] * (hps.serve_chunk - 1)
+                 and m["device_steps"] == m["chunks"] * hps.serve_chunk
+                 and eng.sched.compile_count() == 1)
+
+    # determinism: a second cold engine reproduces strokes + schedule
+    eng2 = ServeEngine(model, hps, params)
+    out2 = eng2.run(reqs())
+    det_ok = (out2["metrics"]["chunks"] == m["chunks"]
+              and all(np.array_equal(by_uid[r.uid], np.asarray(r.strokes5))
+                      for r in out2["results"]))
+
+    # batch-composition independence: each request run SOLO on a fresh
+    # same-geometry engine is bitwise the pooled run (per-request RNG
+    # folded from request keys — the serve acceptance invariant)
+    eng1 = ServeEngine(model, hps, params)
+    solo_ok = True
+    for i, req in enumerate(reqs()):
+        r1 = eng1.run([req])["results"][0]
+        solo_ok &= np.array_equal(by_uid[i], np.asarray(r1.strokes5))
+    return {"site": "engine_pipeline",
+            "ok": bool(counts_ok and det_ok and solo_ok),
+            "chunks": int(m["chunks"]), "dispatches": int(m["dispatches"]),
+            "host_syncs": int(m["host_syncs"]),
+            "dispatches_saved": int(m["dispatches_saved"]),
+            "counts_exact": bool(counts_ok), "deterministic": bool(det_ok),
+            "solo_bitwise": bool(solo_ok)}
+
+
+def arm_fleet_burst(seed: int) -> dict:
+    from sketch_rnn_tpu.serve.endpoints import pool_rows_of
+    from sketch_rnn_tpu.serve.engine import Request
+
+    sched = default_scheduler()
+    key = jax.random.key(0)  # form_burst never reads it; shared is fine
+
+    def build(spec):
+        qs: "OrderedDict[str, deque]" = OrderedDict()
+        for uid, (cls, endpoint, frames, tenant) in enumerate(spec):
+            qs.setdefault(cls, deque()).append(Request(
+                key=key, uid=uid, endpoint=endpoint, frames=frames,
+                tenant=tenant))
+        return qs
+
+    configs = [
+        # uniform cost, one class: bursts of 4,4,2
+        (4, [("rt", "generate", 0, "")] * 10),
+        # two priority classes, mixed interpolate costs
+        (6, [("rt", "generate", 0, ""), ("rt", "interpolate", 3, ""),
+             ("rt", "interpolate", 5, ""), ("batch", "generate", 0, ""),
+             ("batch", "interpolate", 2, ""), ("batch", "generate", 0, "")]),
+        # tenant purity: boundary stops mid-class and across classes
+        (8, [("rt", "generate", 0, "a"), ("rt", "generate", 0, "a"),
+             ("rt", "generate", 0, "b"), ("rt", "interpolate", 4, "a"),
+             ("batch", "generate", 0, "b"), ("batch", "generate", 0, "a")]),
+        # frames=0 interpolate costs DEFAULT_FRAMES (10); head fills cap
+        (12, [("rt", "interpolate", 0, ""), ("rt", "generate", 0, ""),
+              ("rt", "interpolate", 0, ""), ("batch", "generate", 0, "")]),
+        # head exactly fills the cap
+        (5, [("rt", "interpolate", 5, ""), ("rt", "generate", 0, "")]),
+    ]
+    ok = True
+    bursts = 0
+    for cap, spec in configs:
+        q_u, q_l = build(spec), build(spec)
+        for _ in range(len(spec) + 1):
+            b_u = sched.form_burst(q_u.values(), cap, cost_of=pool_rows_of,
+                                   group_of=lambda r: r.tenant or "")
+            b_l = _legacy_pop_batch(q_l, cap, pool_rows_of)
+            ok &= [r.uid for r in b_u] == [r.uid for r in b_l]
+            ok &= all([r.uid for r in q_u[c]] == [r.uid for r in q_l[c]]
+                      for c in q_u)
+            bursts += 1
+            if not b_u and not b_l:
+                break
+        ok &= not any(q_u.values()) and not any(q_l.values())
+    return {"site": "fleet_burst", "ok": bool(ok),
+            "configs": len(configs), "bursts": bursts}
+
+
+def arm_encode_burst(seed: int) -> dict:
+    from sketch_rnn_tpu.serve.endpoints import EncodeProgram, prefix_edge_of
+
+    hps = _hps(conditional=True, num_classes=0,
+               serve_prefix_edges=(4, 8, 16))
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(seed))
+    enc = EncodeProgram(model, hps, params, rows=3)
+    rng = np.random.RandomState(seed)
+    lens = [3, 7, 2, 12, 5, 9, 4]  # spans >1 bucket edge at rows=3
+    prefixes = [rng.randn(L, 3).astype(np.float32) for L in lens]
+
+    sched = default_scheduler()
+    spans_u = [(e, tuple(c)) for e, c in sched.bucket_runs(
+        len(prefixes),
+        lambda i: prefix_edge_of(len(prefixes[i]), enc.edges), enc.rows)]
+    out_u = enc.encode(prefixes)
+    out_l, spans_l = _legacy_encode(enc, prefixes)
+    sched_eq = spans_u == spans_l
+    out_eq = all(np.array_equal(a, b) for a, b in zip(out_u, out_l))
+    compiles = sched.compile_count()
+    out_r = enc.encode(prefixes)  # warm repeat: deterministic, 0 compiles
+    repeat_eq = (all(np.array_equal(a, b) for a, b in zip(out_u, out_r))
+                 and sched.compile_count() == compiles)
+    edges_used = len({e for e, _ in spans_u})
+    return {"site": "encode_burst",
+            "ok": bool(sched_eq and out_eq and repeat_eq),
+            "prefixes": len(prefixes), "edges": edges_used,
+            "runs": len(spans_u), "schedule_bitwise": bool(sched_eq),
+            "outputs_bitwise": bool(out_eq),
+            "repeat_deterministic": bool(repeat_eq)}
+
+
+def _train_mem(hps, donate: bool, seed: int) -> dict:
+    from sketch_rnn_tpu.train import make_train_state, make_train_step
+
+    model = SketchRNN(hps)
+    state = make_train_state(model, hps, jax.random.key(seed))
+    batch = _loader(hps, n=8, seed=seed).get_batch(0)
+    step = make_train_step(model, hps, donate=donate)
+    compiled = step._fn.lower(state, batch, jax.random.key(1)).compile()
+    return executable_stats(compiled)
+
+
+def _serve_mem(hps, donate: bool, seed: int) -> dict:
+    from sketch_rnn_tpu.serve.engine import START_TOKEN, make_chunk_step
+
+    model = SketchRNN(hps)
+    params = model.init_params(jax.random.key(seed))
+    slots, chunk = hps.serve_slots, hps.serve_chunk
+    keys = jax.vmap(jax.random.fold_in,
+                    (None, 0))(jax.random.key(seed + 1), jnp.arange(slots))
+    pool = (jax.vmap(jax.random.key_data)(keys), None, None,
+            jnp.full((slots,), 0.7, jnp.float32),
+            jnp.full((slots,), 10 * chunk, jnp.int32), None, None, None)
+    # unconditional: initial_carry aliases one zeros buffer into both
+    # carry leaves — copy so the donated program gets distinct buffers
+    carry = jax.tree_util.tree_map(
+        jnp.copy, model.decoder_initial_carry(params, None, slots))
+    state = (carry,
+             jnp.broadcast_to(jnp.asarray(START_TOKEN, jnp.float32),
+                              (slots, 5)),
+             jnp.zeros((slots,), jnp.int32), jnp.zeros((slots,), bool),
+             jnp.ones((slots,), bool), jnp.arange(slots, dtype=jnp.int32),
+             pool)
+    fn = make_chunk_step(model, hps, chunk, params, donate=donate)
+    return executable_stats(fn.lower(*state).compile())
+
+
+def _effective(st: dict) -> float:
+    return st["peak_bytes"] - st.get("alias_bytes", 0.0)
+
+
+def arm_donation(smoke: bool, seed: int, goodput: bool) -> dict:
+    geom = TINY if smoke else GOODPUT_GEOM
+    hps = _hps(**{k: v for k, v in geom.items() if k in geom})
+    plain = _train_mem(hps, donate=False, seed=seed)
+    don = _train_mem(hps, donate=True, seed=seed)
+    train_red = 1.0 - _effective(don) / _effective(plain)
+
+    shps = _hps(conditional=False, num_classes=0, serve_slots=4,
+                serve_chunk=8)
+    s_plain = _serve_mem(shps, donate=False, seed=seed)
+    s_don = _serve_mem(shps, donate=True, seed=seed)
+    serve_red = 1.0 - _effective(s_don) / _effective(s_plain)
+
+    # smoke gates the MACHINERY (donation aliases buffers, effective
+    # peak drops); the full run gates the >=25% acceptance number at
+    # the GOODPUT geometry
+    ok = (don.get("alias_bytes", 0) > 0 and s_don.get("alias_bytes", 0) > 0
+          and train_red > 0 and (smoke or train_red >= 0.25))
+    block = {
+        "geometry": geom,
+        "train_peak_bytes": plain["peak_bytes"],
+        "train_donated_peak_bytes": don["peak_bytes"],
+        "train_donated_alias_bytes": don.get("alias_bytes", 0.0),
+        "train_effective_reduction": round(train_red, 4),
+        "serve_chunk_peak_bytes": s_plain["peak_bytes"],
+        "serve_chunk_donated_alias_bytes": s_don.get("alias_bytes", 0.0),
+        "serve_chunk_effective_reduction": round(serve_red, 4),
+    }
+    if goodput and not smoke:
+        path = os.path.join(REPO, "GOODPUT.json")
+        data = json.load(open(path)) if os.path.exists(path) else {}
+        data["donation"] = block
+        with open(path, "w") as f:
+            json.dump(data, f, indent=1)
+            f.write("\n")
+    return {"site": "donation", "ok": bool(ok), **block}
+
+
+ARMS = ("train_stack", "eval_sweep", "engine_pipeline", "fleet_burst",
+        "encode_burst", "donation")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny geometries; gate machinery, not the "
+                         "full donation number")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--goodput", action="store_true",
+                    help="fold the full-geometry donation block into "
+                         "GOODPUT.json (ignored with --smoke)")
+    ap.add_argument("--sites", default=",".join(ARMS),
+                    help="comma-separated arm subset")
+    args = ap.parse_args(argv)
+
+    dev = jax.devices()[0].device_kind
+    sites = [s for s in args.sites.split(",") if s]
+    all_ok = True
+    for site in sites:
+        if site == "donation":
+            rec = arm_donation(args.smoke, args.seed, args.goodput)
+        else:
+            rec = globals()[f"arm_{site}"](args.seed)
+        rec = {"kind": "runtime", "smoke": bool(args.smoke),
+               "device_kind": dev, **rec}
+        stamped = hist_append(rec)
+        all_ok &= bool(rec["ok"])
+        print(json.dumps(stamped))
+    print(f"runtime_bench: {'OK' if all_ok else 'FAIL'} "
+          f"({len(sites)} sites)")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -211,6 +211,25 @@ def cmd_train(args) -> int:
         print("[cli] --rendezvous/--elastic_host_id configure the "
               "elastic fleet; add --elastic_hosts N", file=sys.stderr)
         return 2
+    serve_n = getattr(args, "serve_fleet", 0)
+    if serve_n:
+        # co-resident usage validation (ISSUE 20): fail before any
+        # expensive work
+        if elastic_n:
+            print("[cli] --serve_fleet is single-process co-residency; "
+                  "it does not compose with --elastic_hosts",
+                  file=sys.stderr)
+            return 2
+        if serve_n < 2:
+            print(f"[cli] --serve_fleet {serve_n} cannot serve through "
+                  f"a rollout walk (one replica drains at a time); "
+                  f"use N >= 2", file=sys.stderr)
+            return 2
+        if not args.workdir:
+            print("[cli] --serve_fleet needs --workdir: the fleet "
+                  "follows the training checkpoint directory",
+                  file=sys.stderr)
+            return 2
     rc = _arm_faults(args)
     if rc:
         return rc
@@ -272,13 +291,38 @@ def cmd_train(args) -> int:
               f"{len(train_l)} train / {len(valid_l)} valid sketches, "
               f"scale={scale:.4f}, devices={jax.device_count()}",
               flush=True)
-        train(hps, train_l, valid_l, test_l, scale_factor=scale,
-              workdir=args.workdir, seed=args.seed,
-              resume=not getattr(args, "no_resume", False),
-              profile=getattr(args, "profile", False),
-              trace_dir=getattr(args, "trace_dir", "") or None,
-              watchdog=getattr(args, "watchdog", False),
-              halt_on_anomaly=getattr(args, "halt_on_anomaly", False))
+        if serve_n:
+            from sketch_rnn_tpu.runtime.coresident import \
+                coresident_train
+
+            _, summary = coresident_train(
+                hps, train_l, valid_l, test_l, scale_factor=scale,
+                workdir=args.workdir, seed=args.seed,
+                replicas=serve_n,
+                poll_s=getattr(args, "serve_poll", 0.25),
+                resume=not getattr(args, "no_resume", False),
+                profile=getattr(args, "profile", False),
+                trace_dir=getattr(args, "trace_dir", "") or None,
+                watchdog=getattr(args, "watchdog", False),
+                halt_on_anomaly=getattr(args, "halt_on_anomaly",
+                                        False))
+            print(f"[cli] co-resident fleet: "
+                  f"{len(summary['rollouts'])} rollout(s), served "
+                  f"through ckpt {summary['serving_ckpt_id']}, "
+                  f"{summary['health_degraded']}/"
+                  f"{summary['health_samples']} degraded health "
+                  f"samples, lineage in "
+                  f"{os.path.join(args.workdir, 'RUN.json')}",
+                  flush=True)
+        else:
+            train(hps, train_l, valid_l, test_l, scale_factor=scale,
+                  workdir=args.workdir, seed=args.seed,
+                  resume=not getattr(args, "no_resume", False),
+                  profile=getattr(args, "profile", False),
+                  trace_dir=getattr(args, "trace_dir", "") or None,
+                  watchdog=getattr(args, "watchdog", False),
+                  halt_on_anomaly=getattr(args, "halt_on_anomaly",
+                                          False))
     finally:
         faults.disable()
     return 0
@@ -1413,6 +1457,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint into <workdir>/incident/ — the "
                         "resume directory is never touched, so a "
                         "diverged state cannot wedge resume-from-latest")
+    p.add_argument("--serve_fleet", type=int, default=0,
+                   help="co-resident train-and-serve (ISSUE 20): run "
+                        "an N-replica serving fleet (N >= 2) in THIS "
+                        "process while training; every async "
+                        "checkpoint the loop saves is rolled out to "
+                        "the live fleet through the validated/canaried "
+                        "rollout path (admission gate, per-replica "
+                        "walk, automatic rollback), /healthz staying "
+                        "ok/rolling throughout. The serving lineage "
+                        "(which checkpoint served which request "
+                        "window) is merged into <workdir>/RUN.json. "
+                        "0 (default) = train only")
+    p.add_argument("--serve_poll", type=float, default=0.25,
+                   help="co-resident checkpoint watcher poll period "
+                        "in seconds")
     p.add_argument("--fault_plan", default="",
                    help="chaos run (utils/faults.py): arm deterministic "
                         "fault injection, e.g. 'train.step@12:kind=exit' "

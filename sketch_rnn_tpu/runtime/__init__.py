@@ -1,0 +1,40 @@
+"""Unified dispatch runtime (ISSUE 20): one geometry-run scheduler.
+
+Five host-side schedulers grew up independently around the same
+pattern — group work into fixed-shape runs, dispatch stacked, replay
+remainders, never sync the host between dispatches:
+
+- ``train.loop.dispatch_stack``      (bucket-run training scheduler)
+- ``train.loop._sweep_rows``         (geometry-chunked eval sweep)
+- ``serve.engine.ServeEngine.run``   (depth-1 pipelined chunk loop)
+- ``serve.fleet._Replica.pop_batch`` (class-priority micro-bursts)
+- ``serve.endpoints.EncodeProgram``  (prefix-bucketed encode bursts)
+
+:mod:`sketch_rnn_tpu.runtime.scheduler` owns THE copies of those
+mechanics — run formation, stacked dispatch + remainder replay, the
+depth-1 pipeline, geometry-keyed program registration, buffer-donation
+policy and the shared dispatch/host-sync ledger — and the five sites
+delegate to it, so the dispatch contract can no longer drift between
+training and serving. :mod:`sketch_rnn_tpu.runtime.coresident` cashes
+in the unification: one process that trains AND serves, the training
+loop's async checkpoints feeding the serving fleet's rollout path
+live.
+"""
+
+from sketch_rnn_tpu.runtime.coresident import (  # noqa: F401
+    CoResident,
+    coresident_train,
+)
+from sketch_rnn_tpu.runtime.scheduler import (  # noqa: F401
+    DispatchLedger,
+    GeometryRunScheduler,
+    default_scheduler,
+)
+
+__all__ = [
+    "CoResident",
+    "DispatchLedger",
+    "GeometryRunScheduler",
+    "coresident_train",
+    "default_scheduler",
+]

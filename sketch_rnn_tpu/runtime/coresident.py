@@ -1,0 +1,309 @@
+"""Co-resident train-and-serve: one process, one model lineage
+(ISSUE 20).
+
+``cli train --serve_fleet N`` trains while an N-replica
+:class:`~sketch_rnn_tpu.serve.fleet.ServeFleet` serves the SAME model
+in the same process: training's async checkpoints land in the workdir,
+the PR 16 :class:`~sketch_rnn_tpu.serve.rollout.CheckpointWatcher`
+picks each one up, and the rollout controller walks the fleet to it
+live — admission-validated, canary-gated, rolled back on failure. The
+fleet serves throughout: ``/healthz`` reports only ``ok`` / ``rolling``
+(or ``scaling``), never ``degraded``, and a post-swap request is
+bitwise what a cold engine started from the same checkpoint computes
+(the rollout acceptance bar, re-proven here under a LIVE training
+producer instead of a test writing checkpoints by hand).
+
+The loop also closes: completed requests are a stroke corpus, and
+:meth:`CoResident.corpus` converts their stroke-5 Results back to
+stroke-3 so ``data.native_batcher.stream_batches`` can assemble train
+batches straight from the serving fleet's output — the
+continual-learning smoke (serve -> collect -> train on what was
+served) with no materialized dataset.
+
+Threads follow the repo's naming discipline (the conftest guard
+whitelists prefixes): the watcher is ``rollout-watcher`` (PR 16), the
+health sampler ``coresident-health``, the request feeder
+``coresident-loadgen``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["CoResident", "coresident_train", "default_canaries",
+           "stroke5_to_stroke3"]
+
+
+def stroke5_to_stroke3(strokes5, length: Optional[int] = None
+                       ) -> np.ndarray:
+    """A served Result's stroke-5 rows back to the stroke-3 ingestion
+    format ``(dx, dy, pen_lift)``: column 3 is the lift bit; the final
+    row closes its stroke (the end-of-sketch row, when drawn, is
+    excluded by ``length`` — ``Result.length``'s contract)."""
+    s5 = np.asarray(strokes5, np.float32)
+    if length is not None:
+        s5 = s5[:max(int(length), 1)]
+    s3 = s5[:, [0, 1, 3]].copy()
+    s3[-1, 2] = 1.0
+    return s3
+
+
+def default_canaries(hps, n: int = 3, cap: int = 4) -> List[Any]:
+    """A small seeded canary burst (the per-swap bitwise gate):
+    conditional models exercise z, as the rollout contract asks."""
+    import jax
+
+    from sketch_rnn_tpu.serve.engine import Request
+
+    reqs = []
+    for i in range(n):
+        rng = np.random.default_rng(9000 + i)
+        reqs.append(Request(
+            key=jax.random.key(9000 + i),
+            z=(rng.standard_normal(hps.z_size).astype(np.float32)
+               if hps.conditional else None),
+            temperature=0.8, max_len=cap))
+    return reqs
+
+
+class CoResident:
+    """A live serving fleet following a training run's checkpoints.
+
+    Construction warms and starts the fleet, registers a
+    :class:`RolloutController` and points its watcher at ``ckpt_dir``
+    (the training workdir). A background sampler polls ``/healthz``
+    continuously; its log is the never-degraded evidence. Use as a
+    context manager, or call :meth:`close`.
+    """
+
+    def __init__(self, model, hps, params, ckpt_dir: str,
+                 replicas: int = 2, ckpt_id: str = "",
+                 canary_requests: Optional[Sequence[Any]] = None,
+                 poll_s: float = 0.25,
+                 health_period_s: float = 0.1) -> None:
+        import jax
+
+        from sketch_rnn_tpu.serve.fleet import ServeFleet
+        from sketch_rnn_tpu.serve.rollout import RolloutController
+        from sketch_rnn_tpu.train.state import make_train_state
+
+        if replicas < 2:
+            raise ValueError(
+                f"co-resident serving needs >= 2 replicas (got "
+                f"{replicas}): the rollout walk drains one replica at "
+                f"a time, so a single replica cannot serve through a "
+                f"swap")
+        self.model = model
+        self.hps = hps
+        self.ckpt_dir = str(ckpt_dir)
+        canaries = (list(canary_requests) if canary_requests
+                    else default_canaries(hps))
+        self.fleet = ServeFleet(model, hps, params, replicas=replicas,
+                                ckpt_id=ckpt_id)
+        self.fleet.warm(canaries[0])
+        self.fleet.start()
+        # template values are ignored — it is the shape manifest the
+        # admission gate validates candidates against
+        template = make_train_state(model, hps, jax.random.key(0))
+        self.controller = RolloutController(
+            self.fleet, model, hps, template, canaries,
+            quarantine_dir=os.path.join(self.ckpt_dir, "quarantine"))
+        self.watcher = self.controller.watch(self.ckpt_dir,
+                                             poll_s=poll_s)
+        self.health_log: List[str] = []
+        self._health_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._feeder: Optional[threading.Thread] = None
+        self._fed = 0
+        self._health_thread = threading.Thread(
+            target=self._health_loop, args=(float(health_period_s),),
+            name="coresident-health", daemon=True)
+        self._health_thread.start()
+
+    # -- health -------------------------------------------------------------
+
+    def sample_health(self) -> str:
+        """One ``/healthz`` verdict through the REAL endpoint payload
+        (``serve.metrics_http.health_payload``), recorded in
+        :attr:`health_log` — the co-resident acceptance reads the log:
+        ok/rolling/scaling only, never degraded."""
+        from sketch_rnn_tpu.serve.metrics_http import health_payload
+        from sketch_rnn_tpu.utils.telemetry import get_telemetry
+
+        status = str(health_payload(get_telemetry(),
+                                    health=self.fleet.health)["status"])
+        with self._health_lock:
+            self.health_log.append(status)
+        return status
+
+    def _health_loop(self, period_s: float) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample_health()
+            except Exception:  # noqa: BLE001 — sampler must outlive
+                pass           # transient fleet-lock contention
+            self._stop.wait(period_s)
+
+    def health_statuses(self) -> List[str]:
+        with self._health_lock:
+            return list(self.health_log)
+
+    # -- load ----------------------------------------------------------------
+
+    def start_loadgen(self, requests: Sequence[Any],
+                      interval_s: float = 0.0) -> None:
+        """Feed ``requests`` to the fleet from a ``coresident-loadgen``
+        thread (``force=True``: the continual-learning corpus must not
+        lose members to shed policy), ``interval_s`` apart — the live
+        traffic the fleet serves while training runs."""
+        if self._feeder is not None:
+            raise RuntimeError("loadgen already running")
+
+        reqs = list(requests)
+
+        def run() -> None:
+            for r in reqs:
+                if self._stop.is_set():
+                    return
+                self.fleet.submit(r, force=True)
+                self._fed += 1
+                if interval_s:
+                    time.sleep(interval_s)
+
+        self._feeder = threading.Thread(target=run,
+                                        name="coresident-loadgen",
+                                        daemon=True)
+        self._feeder.start()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        if self._feeder is not None:
+            self._feeder.join(timeout=timeout)
+        return self.fleet.drain(timeout=timeout)
+
+    def corpus(self) -> List[np.ndarray]:
+        """Completed requests as stroke-3 sequences, uid order — the
+        serve->train return path: feed it to ``stream_batches(corpus,
+        batch_size, max_len)`` and train on what was served."""
+        recs = self.fleet.results
+        return [stroke5_to_stroke3(recs[uid]["result"].strokes5,
+                                   recs[uid]["result"].length)
+                for uid in sorted(recs)]
+
+    # -- lineage -------------------------------------------------------------
+
+    def lineage(self) -> List[Dict[str, Any]]:
+        return self.controller.lineage()
+
+    def serving_summary(self) -> Dict[str, Any]:
+        statuses = self.health_statuses()
+        return {
+            "replicas": self.fleet.n_replicas,
+            "serving_ckpt_id": self.fleet.serving_ckpt_id,
+            "lineage": self.lineage(),
+            # one report per checkpoint the watcher rolled to:
+            # {ok, phase, from, to, swapped, rolled_back, ...}
+            "rollouts": [dict(r) for r in self.watcher.reports],
+            "requests_completed": len(self.fleet.results),
+            "health_samples": len(statuses),
+            "health_degraded": sum(s == "degraded" for s in statuses),
+        }
+
+    def write_manifest(self, out_dir: Optional[str] = None) -> str:
+        """Merge the serving lineage into the run's RUN.json (same
+        run_id as training's manifest, so the two compose): which
+        checkpoint served which admitted-uid window, every rollout
+        event, and the health record."""
+        from sketch_rnn_tpu.utils import runinfo
+
+        return runinfo.write_manifest(
+            out_dir or self.ckpt_dir, kind="train", hps=self.hps,
+            extra={"serving": self.serving_summary()})
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        if self._feeder is not None:
+            self._feeder.join(timeout=timeout)
+            self._feeder = None
+        self._health_thread.join(timeout=timeout)
+        # fleet.close() joins the controller's in-flight walk and stops
+        # the watcher (fleet._rollout wiring, PR 16)
+        self.fleet.close(timeout=timeout)
+
+    def __enter__(self) -> "CoResident":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def coresident_train(hps, train_loader, valid_loader=None,
+                     test_loader=None, scale_factor: float = 1.0,
+                     workdir: Optional[str] = None, seed: int = 0,
+                     replicas: int = 2, num_steps: Optional[int] = None,
+                     resume: bool = True, poll_s: float = 0.25,
+                     loadgen: Optional[Sequence[Any]] = None,
+                     **train_kw):
+    """Run ``train.loop.train`` with a co-resident serving fleet
+    following its checkpoints; returns ``(state, summary)``.
+
+    The fleet starts on the latest checkpoint in ``workdir`` when one
+    exists (the resume path serves what training resumes from),
+    otherwise on the seed initialization — every subsequent checkpoint
+    training saves is rolled out live by the watcher. ``loadgen``
+    (optional) is a request list fed during training. The serving
+    summary (lineage, rollouts, health record) is merged into
+    ``<workdir>/RUN.json`` before the fleet closes.
+    """
+    import jax
+
+    from sketch_rnn_tpu.models.vae import SketchRNN
+    from sketch_rnn_tpu.train.checkpoint import (ckpt_id_of,
+                                                 latest_checkpoint,
+                                                 restore_checkpoint)
+    from sketch_rnn_tpu.train.loop import train
+    from sketch_rnn_tpu.train.state import make_train_state
+
+    if not workdir:
+        raise ValueError("co-resident serving needs a workdir: the "
+                         "fleet follows its checkpoint directory")
+    model = train_kw.pop("model", None) or SketchRNN(hps)
+    params = make_train_state(model, hps, jax.random.key(seed)).params
+    ckpt_id = ""
+    step0 = latest_checkpoint(workdir) if resume else None
+    if step0 is not None:
+        target = make_train_state(model, hps, jax.random.key(seed))
+        restored, _, _ = restore_checkpoint(workdir, target, step=step0)
+        params, ckpt_id = restored.params, ckpt_id_of(step0)
+    co = CoResident(model, hps, params, workdir, replicas=replicas,
+                    ckpt_id=ckpt_id, poll_s=poll_s)
+    try:
+        if loadgen:
+            co.start_loadgen(loadgen)
+        state = train(hps, train_loader, valid_loader, test_loader,
+                      scale_factor=scale_factor, workdir=workdir,
+                      seed=seed, num_steps=num_steps, resume=resume,
+                      model=model, **train_kw)
+        co.drain(timeout=60.0)
+        # let the watcher FINISH rolling to the final checkpoint
+        # before summarizing (the watcher marks a step seen before its
+        # walk completes, so _seen alone is not the done signal — the
+        # fleet's authoritative serving id flipping is)
+        final = latest_checkpoint(workdir)
+        if final is not None:
+            want = ckpt_id_of(final)
+            deadline = time.monotonic() + 30.0
+            while (co.fleet.serving_ckpt_id != want
+                   and time.monotonic() < deadline):
+                time.sleep(min(poll_s, 0.1))
+        summary = co.serving_summary()
+        co.write_manifest(workdir)
+    finally:
+        co.close()
+    return state, summary

@@ -1,0 +1,360 @@
+"""One geometry-run scheduler: the unified dispatch runtime (ISSUE 20).
+
+Every hot host loop in this codebase dispatches fixed-geometry compiled
+programs and pays for three things: how work is GROUPED into runs (the
+bucket-run / chunk / burst formation), how runs are ISSUED (stacked
+scan vs per-item replay, pipelined vs serialized), and how results are
+FETCHED (each ``device_get`` is a host sync that drains the dispatch
+pipeline). Five sites hand-rolled the same answers independently; this
+module owns THE copy of each mechanic and the sites delegate:
+
+- :meth:`GeometryRunScheduler.dispatch_stack` — the bucket-run
+  training scheduler's dispatch decision (``train.loop.dispatch_stack``
+  is a thin delegate; ``scripts/bucket_bench.py`` rides the same one).
+- :meth:`GeometryRunScheduler.geometry_runs` — geometry-boundary run
+  formation for ordered sweeps (the eval sweep's chunker).
+- :meth:`GeometryRunScheduler.bucket_runs` — bucket-grouped fixed-rows
+  run formation for unordered items (the encode burst's grouper).
+- :meth:`GeometryRunScheduler.form_burst` — priority-ordered,
+  cost-capped, group-pure burst formation (the fleet's micro-bursts).
+- :meth:`GeometryRunScheduler.pipeline` — the depth-1 software
+  pipeline (dispatch chunk ``i+1`` before fetching chunk ``i``; zero
+  host syncs between dispatches) the serve engine's chunk loop runs on.
+
+Program identity stays geometry-keyed: :meth:`program` jits a callable
+(optionally with **donated** argnums — the HBM-footprint lever) and
+wraps it in a :class:`~sketch_rnn_tpu.utils.telemetry.JitCompileProbe`,
+so one compile per geometry is an auditable property, never an
+assumption. :meth:`register` adopts a probe a site already built (the
+serve chunk/encode programs carry bespoke geometry keys) into the same
+accounting.
+
+The :class:`DispatchLedger` is the shared accounting surface: realized
+K-amortization (``dispatches_saved = micro_items - dispatches``, the
+number the training rows already log via the ``PaddingLedger`` view)
+and host syncs (every :meth:`fetch`). The serve engine reports both in
+its per-run metrics; the train loop's rows keep their pinned pre-PR
+CSV schema — ``dispatches_saved`` is already a column there, and host
+syncs surface through telemetry counters and GOODPUT/runtime-bench
+records instead of new default columns (the ``PRE_PR_HEADER``
+contract: telemetry may never leak columns into the metrics CSV).
+
+Donation rules (the async-checkpoint snapshot discipline, ISSUE 3/16):
+
+- Donate ONLY buffers the host provably never reads again: the train
+  state (rebound every step; the async checkpointer snapshots BEFORE
+  the donating dispatch consumes it) and the serve loop's carry/prev
+  (opaque device round-trip, rebound every chunk).
+- NEVER donate buffers a later dispatch re-reads: the serve request
+  pool (every chunk of a burst gathers from it) and the ``t``/``done``
+  vectors (outputs of chunk ``i`` are consumed as inputs of chunk
+  ``i+1`` BEFORE the pipelined fetch of chunk ``i`` reads them).
+- A donated buffer reused anyway fails LOUDLY (XLA: "buffer has been
+  deleted or donated") — tests pin that error so a scheduling bug can
+  never silently read stale memory.
+
+Everything here is deterministic scheduling math — run formation,
+dispatch counts, compile counts are pure functions of the work list —
+which is what lets ``scripts/runtime_bench.py`` prove the unified
+scheduler bitwise against the five legacy schedules (the box
+constraint: acceptance never reads a wall clock).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+from typing import Sequence, Tuple
+
+from sketch_rnn_tpu.utils.telemetry import JitCompileProbe
+
+
+class DispatchLedger:
+    """Shared dispatch/host-sync accounting (thread-safe counters).
+
+    ``micro_items`` counts scheduled work units (micro-steps, chunk
+    steps, encode rows), ``dispatches`` the jitted calls that carried
+    them — ``dispatches_saved`` is the realized amortization, the same
+    quantity the training ``PaddingLedger`` derives for its metrics
+    rows. ``host_syncs`` counts device->host fetches (each one drains
+    the dispatch pipeline; the steady-state loops target zero BETWEEN
+    dispatches — the depth-1 pipeline fetches only behind the next
+    issue)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.micro_items = 0
+        self.host_syncs = 0
+
+    def record_run(self, use: int, n_disp: int) -> None:
+        """Account one run: ``use`` work units over ``n_disp`` jitted
+        calls (1 for a stacked dispatch, ``use`` for a replay)."""
+        with self._lock:
+            self.micro_items += int(use)
+            self.dispatches += int(n_disp)
+
+    def record_sync(self, n: int = 1) -> None:
+        with self._lock:
+            self.host_syncs += int(n)
+
+    @property
+    def dispatches_saved(self) -> int:
+        return self.micro_items - self.dispatches
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {"dispatches": self.dispatches,
+                    "micro_items": self.micro_items,
+                    "dispatches_saved": self.micro_items - self.dispatches,
+                    "host_syncs": self.host_syncs}
+
+    def window(self, since: Optional[Dict[str, int]] = None
+               ) -> Dict[str, int]:
+        """Counters since ``since`` (a prior :meth:`snapshot`)."""
+        now = self.snapshot()
+        if since is None:
+            return now
+        return {k: now[k] - since.get(k, 0) for k in now}
+
+
+class _Depth1Pipeline:
+    """At most one dispatch in flight: ``issue`` enqueues the next unit
+    and returns the PREVIOUS unit's handle (None on the first call), so
+    the host's fetch/collect work always overlaps the in-flight device
+    compute — the serve engine's chunk discipline and prefetch.py's
+    output-side mirror. ``drain`` hands back the final in-flight handle
+    (its fetch is the run's one closing sync)."""
+
+    def __init__(self, ledger: DispatchLedger) -> None:
+        self._ledger = ledger
+        self._inflight: Any = None
+
+    def issue(self, dispatch_fn: Callable[[], Any]) -> Any:
+        prev, self._inflight = self._inflight, dispatch_fn()
+        return prev
+
+    def drain(self) -> Any:
+        handle, self._inflight = self._inflight, None
+        return handle
+
+
+class GeometryRunScheduler:
+    """The unified dispatch runtime: program registry + run formation +
+    pipelined issue + the shared :class:`DispatchLedger`.
+
+    One instance per dispatch domain: the process-wide default
+    (:func:`default_scheduler`) serves the training loop, eval sweep,
+    fleet burst formation and encode bursts; each ``ServeEngine`` holds
+    its own (its ledger feeds the per-run serve metrics). All methods
+    are semantics-frozen ports of the five legacy sites —
+    ``scripts/runtime_bench.py`` pins each against its pre-PR schedule.
+    """
+
+    def __init__(self, name: str = "runtime",
+                 ledger: Optional[DispatchLedger] = None) -> None:
+        self.name = str(name)
+        self.ledger = ledger if ledger is not None else DispatchLedger()
+        self._programs: List[weakref.ref] = []
+        self._lock = threading.Lock()
+
+    # -- program registry ---------------------------------------------------
+
+    def program(self, fn: Callable, name: str, key_of=None, label_of=None,
+                donate_argnums=None, **jit_kwargs) -> JitCompileProbe:
+        """Jit ``fn`` (optionally donating ``donate_argnums``) and wrap
+        it in a geometry-keyed :class:`JitCompileProbe` registered with
+        this scheduler — compile counts become auditable through
+        :meth:`compile_count` and the probe's telemetry spans."""
+        import jax
+
+        if donate_argnums is not None:
+            jit_kwargs["donate_argnums"] = donate_argnums
+        return self.register(JitCompileProbe(
+            jax.jit(fn, **jit_kwargs), name,
+            key_of=key_of, label_of=label_of))
+
+    def register(self, probe: JitCompileProbe) -> JitCompileProbe:
+        """Adopt an already-built probe (sites with bespoke geometry
+        keys — the serve chunk/encode programs) into this scheduler's
+        compile accounting. Held by WEAK reference: registration must
+        never extend a program's lifetime (a hot-swap-retired encoder's
+        probes — and the params its programs baked in — stay
+        collectable)."""
+        with self._lock:
+            self._programs.append(weakref.ref(probe))
+        return probe
+
+    def compile_count(self) -> int:
+        """Total compiled executables across live registered programs
+        (one per geometry per program; the never-a-silent-recompile
+        pin)."""
+        with self._lock:
+            self._programs = [r for r in self._programs
+                              if r() is not None]
+            programs = [r() for r in self._programs]
+        return sum(p._cache_size() for p in programs if p is not None)
+
+    # -- run formation ------------------------------------------------------
+
+    def geometry_runs(self, n: int, k_max: int,
+                      geom_of: Optional[Callable[[int], Any]] = None
+                      ) -> Iterator[Tuple[int, int]]:
+        """Chunk an ordered sweep of ``n`` items into runs of up to
+        ``k_max`` that never cross a geometry boundary: yields ``(i,
+        k)`` spans. The eval sweep's chunker (``train.loop._sweep_rows``
+        semantics, frozen): a run extends while ``geom_of`` is constant;
+        ``k_max=1`` (or no ``geom_of`` and ``k_max=1``) degenerates to
+        the per-item schedule."""
+        i = 0
+        while i < n:
+            k = min(k_max, n - i)
+            if k > 1 and geom_of is not None:
+                run, g0 = 1, geom_of(i)
+                while run < k and geom_of(i + run) == g0:
+                    run += 1
+                k = run
+            yield i, k
+            i += k
+
+    def bucket_runs(self, n: int, edge_of: Callable[[int], Any],
+                    rows: int) -> Iterator[Tuple[Any, List[int]]]:
+        """Group ``n`` unordered items by bucket edge and chop each
+        group into fixed-``rows`` runs: yields ``(edge, indices)`` with
+        ``len(indices) <= rows`` (the caller pads short runs to the
+        compiled geometry). The encode burst's grouper
+        (``serve.endpoints.EncodeProgram.encode`` semantics, frozen):
+        edges ascend, each edge's items keep input order."""
+        by_edge: Dict[Any, List[int]] = {}
+        for i in range(n):
+            by_edge.setdefault(edge_of(i), []).append(i)
+        for edge in sorted(by_edge):
+            idxs = by_edge[edge]
+            for lo in range(0, len(idxs), rows):
+                yield edge, idxs[lo:lo + rows]
+
+    def form_burst(self, queues: Iterable, cap: int,
+                   cost_of: Callable[[Any], int],
+                   group_of: Optional[Callable[[Any], Any]] = None
+                   ) -> List[Any]:
+        """Pop a priority-ordered micro-burst: walk ``queues`` (deques,
+        highest priority first), popping heads while the summed
+        ``cost_of`` fits ``cap``; stop at the first head that does not
+        fit, and — when ``group_of`` is given — at the first head whose
+        group differs from the first popped item's (single-tenant
+        bursts). Never skips ahead past a blocked head: priority order
+        is never violated for capacity or purity. The fleet's
+        ``pop_batch`` semantics, frozen."""
+        batch: List[Any] = []
+        used = 0
+        group: Any = _UNSET
+        for q in queues:
+            while q and used < cap:
+                if group is not _UNSET and group_of is not None \
+                        and group_of(q[0]) != group:
+                    return batch
+                cost = cost_of(q[0])
+                if used + cost > cap:
+                    return batch
+                item = q.popleft()
+                if group is _UNSET and group_of is not None:
+                    group = group_of(item)
+                batch.append(item)
+                used += cost
+            if used >= cap:
+                break
+        return batch
+
+    # -- stacked dispatch + remainder replay --------------------------------
+
+    def dispatch_stack(self, single_step, multi_step, state, batch,
+                       step: int, remaining: int, root_key, k: int):
+        """One bucket-run dispatch decision (ISSUE 5 contract, frozen;
+        ``train.loop.dispatch_stack`` and ``scripts/bucket_bench.py``
+        both delegate here so the two cannot drift).
+
+        ``batch`` is a stacked geometry-run prefix with leading axis
+        ``kk <= k``; ``use = min(kk, remaining)`` micro-steps are
+        consumed. A full ``use == k`` stack dispatches ONE compiled
+        (K, B, Tb) scan (``multi_step`` built with
+        ``key_by_global_step=True``: it folds the live ``state.step``
+        into ``root_key``); anything shorter replays per micro-step
+        through ``single_step`` with ``fold_in(root_key, step + i)`` —
+        the identical key either way, so the whole run is step-for-step
+        RNG-identical to K=1. Replay windows report metrics with the
+        scan's semantics (:meth:`replay_window_metrics`).
+
+        Returns ``(state, metrics, use, dispatches)`` and records the
+        run in this scheduler's ledger — ``dispatches_saved`` in every
+        surface derives from the same decision made here.
+        """
+        import jax
+
+        kk = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+        use = min(kk, remaining)
+        if use == k:
+            state, metrics = multi_step(state, batch, root_key)
+            self.ledger.record_run(use, 1)
+            return state, metrics, use, 1
+        per_step = []
+        for i in range(use):
+            b_i = jax.tree_util.tree_map(lambda x: x[i], batch)
+            state, m = single_step(
+                state, b_i, jax.random.fold_in(root_key, step + i))
+            per_step.append(m)
+        self.ledger.record_run(use, use)
+        return state, self.replay_window_metrics(per_step), use, use
+
+    @staticmethod
+    def replay_window_metrics(per_step: Sequence[Dict]) -> Dict:
+        """Fold a replayed window's per-micro-step metric dicts into
+        one row with the K-scan's semantics
+        (``train.step.make_multi_train_step``): MEAN over the window,
+        ``grad_norm_max`` the max, ``lr``/``kl_weight`` the last
+        micro-step's schedule values. Pure device-side tree math on the
+        (lazy) metric refs — no host sync. Shared by every replay path
+        so logged rows cannot drift in meaning between the scan, the
+        run-remainder replay and the fixed-T final remainder."""
+        import jax.numpy as jnp
+
+        sums = None
+        gmax = None
+        for m in per_step:
+            g = m["grad_norm"]
+            gmax = g if gmax is None else jnp.maximum(gmax, g)
+            sums = (dict(m) if sums is None
+                    else {name: sums[name] + m[name] for name in sums})
+        metrics = {name: v / len(per_step) for name, v in sums.items()}
+        metrics["grad_norm_max"] = gmax
+        metrics["lr"] = per_step[-1]["lr"]
+        metrics["kl_weight"] = per_step[-1]["kl_weight"]
+        return metrics
+
+    # -- pipelined issue / fetch --------------------------------------------
+
+    def pipeline(self) -> _Depth1Pipeline:
+        """A fresh depth-1 pipeline bound to this scheduler's ledger."""
+        return _Depth1Pipeline(self.ledger)
+
+    def fetch(self, refs):
+        """Fetch device values to host numpy — THE accounted host sync.
+        Every steady-state loop's sync count flows through here, so the
+        ledger's ``host_syncs`` is exact by construction."""
+        import jax
+
+        self.ledger.record_sync()
+        return jax.device_get(refs)
+
+
+_UNSET = object()  # form_burst's "no group chosen yet" sentinel
+
+_DEFAULT = GeometryRunScheduler("default")
+
+
+def default_scheduler() -> GeometryRunScheduler:
+    """The process-wide scheduler: training loop, eval sweep, fleet
+    burst formation and encode bursts share it (and its ledger); each
+    serve engine holds its own so per-run serve metrics stay
+    per-engine."""
+    return _DEFAULT

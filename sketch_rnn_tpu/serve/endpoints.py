@@ -55,6 +55,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.runtime.scheduler import default_scheduler
 from sketch_rnn_tpu.utils.telemetry import (
     JitCompileProbe,
     critical_path_segments,
@@ -387,6 +388,9 @@ class EncodeProgram:
                                     f"E{a[0].shape[1] - 1},"
                                     f"{self.decode_kernel},"
                                     f"{self.param_dtype})"))
+            # ISSUE 20: edge programs join the unified runtime's
+            # compile accounting alongside the chunk/train programs
+            default_scheduler().register(self._fns[edge])
         return self._fns[edge]
 
     def warm(self) -> None:
@@ -408,7 +412,11 @@ class EncodeProgram:
         Prefixes are grouped by their bucket edge, each group is padded
         to ``rows`` (pad rows are inert — per-row masking), and groups
         larger than ``rows`` run in chunks — so every call dispatches
-        only the (rows, edge) geometries that were compiled once.
+        only the (rows, edge) geometries that were compiled once. The
+        grouping rule itself lives on the unified dispatch runtime
+        (ISSUE 20): :meth:`GeometryRunScheduler.bucket_runs` is the
+        frozen port of the by-edge/fixed-rows loop, and every fetch is
+        an accounted host sync on the shared ledger.
         """
         import jax
 
@@ -423,42 +431,43 @@ class EncodeProgram:
         mu = np.zeros((n, self.hps.z_size), np.float32)
         carry = np.zeros((n, self.model.dec.carry_size), np.float32)
         prev = np.zeros((n, 5), np.float32)
-        by_edge: Dict[int, List[int]] = {}
-        for i, p in enumerate(prefixes):
-            by_edge.setdefault(
-                prefix_edge_of(len(p), self.edges), []).append(i)
-        for edge in sorted(by_edge):
-            idxs = by_edge[edge]
+        sched = default_scheduler()
+        edges_seen: set = set()
+        for edge, chunk in sched.bucket_runs(
+                n, lambda i: prefix_edge_of(len(prefixes[i]),
+                                            self.edges), self.rows):
+            edges_seen.add(edge)
             fn = self._fn(edge)
-            for lo in range(0, len(idxs), self.rows):
-                chunk = idxs[lo:lo + self.rows]
-                group = [prefixes[i] for i in chunk]
-                pad = self.rows - len(group)
-                if pad:
-                    group = group + [np.zeros((1, 3), np.float32)] * pad
-                strokes, lens = pad_prefixes(group, edge)
-                labs = None
-                if self.hps.num_classes > 0:
-                    labs = np.zeros((self.rows,), np.int32)
-                    if labels is not None:
-                        for j, i in enumerate(chunk):
-                            labs[j] = int(labels[i])
-                args = jax.device_put((strokes, lens, labs),
-                                      self.device)
-                if self.param_args:
-                    out = fn(*args, self.params)
-                else:
-                    out = fn(*args)
-                g_mu, g_carry, g_prev = jax.device_get(out)
-                for j, i in enumerate(chunk):
-                    mu[i] = g_mu[j]
-                    carry[i] = g_carry[j]
-                    prev[i] = g_prev[j]
+            group = [prefixes[i] for i in chunk]
+            pad = self.rows - len(group)
+            if pad:
+                group = group + [np.zeros((1, 3), np.float32)] * pad
+            strokes, lens = pad_prefixes(group, edge)
+            labs = None
+            if self.hps.num_classes > 0:
+                labs = np.zeros((self.rows,), np.int32)
+                if labels is not None:
+                    for j, i in enumerate(chunk):
+                        labs[j] = int(labels[i])
+            args = jax.device_put((strokes, lens, labs),
+                                  self.device)
+            if self.param_args:
+                out = fn(*args, self.params)
+            else:
+                out = fn(*args)
+            # one dispatch carried len(chunk) real rows (pad rows are
+            # inert geometry filler, not scheduled work)
+            sched.ledger.record_run(len(chunk), 1)
+            g_mu, g_carry, g_prev = sched.fetch(out)
+            for j, i in enumerate(chunk):
+                mu[i] = g_mu[j]
+                carry[i] = g_carry[j]
+                prev[i] = g_prev[j]
         if tel.enabled:
             tel.emit_span(
                 "encode_phase", "serve", t0, time.perf_counter(),
                 args={"n_prefixes": n,
-                      "edges": sorted(by_edge),
+                      "edges": sorted(edges_seen),
                       **({"replica": self.replica_id}
                          if self.replica_id is not None else {})})
         return mu, carry, prev

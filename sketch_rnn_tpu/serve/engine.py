@@ -57,6 +57,7 @@ from jax import lax
 
 from sketch_rnn_tpu.config import HParams
 from sketch_rnn_tpu.ops import mdn
+from sketch_rnn_tpu.runtime.scheduler import GeometryRunScheduler
 from sketch_rnn_tpu.sample.sampler import END_TOKEN, START_TOKEN
 from sketch_rnn_tpu.utils.faults import fault_point
 from sketch_rnn_tpu.utils.profiling import SpanTimer
@@ -235,11 +236,23 @@ def sample_mixture_rows(mp: mdn.MixtureParams, u: jax.Array,
 
 def make_chunk_step(model, hps: HParams, chunk: int, params,
                     greedy: bool = False, kernel: str = "scan",
-                    param_args: bool = False):
+                    param_args: bool = False, donate: bool = False):
     """Build the jitted fixed-shape K-step decode program.
 
     ``fn(carry, prev, t, done, reset, slot_idx, pool) ->
     (carry, prev, t, done, strokes [K, B, 5])``.
+
+    ``donate=True`` (ISSUE 20) donates the ``carry``/``prev`` input
+    buffers to the program — both are opaque device round-trips the
+    host never reads, rebound to the dispatch's outputs every chunk, so
+    XLA may reuse their memory in place. ONLY those two: ``t``/``done``
+    outputs of chunk ``i`` become chunk ``i+1``'s inputs before the
+    pipelined fetch of chunk ``i`` reads them, and the pool is
+    re-gathered by every chunk of the burst — donating either would
+    hand a later reader deleted buffers. Default off: direct callers
+    (kernel parity tests, ``scripts/bench_kernel.py``'s timing loop)
+    legitimately re-dispatch the same state tuple; only the engine's
+    single-consumer loop opts in.
 
     ``kernel`` selects the chunk program's decode core (ISSUE 17):
     ``"scan"`` is the `lax.scan` step loop below — the bitwise
@@ -389,12 +402,14 @@ def make_chunk_step(model, hps: HParams, chunk: int, params,
         def chunk_fn(carry, prev, t, done, reset, slot_idx, pool):
             return chunk_impl(baked, carry, prev, t, done, reset,
                               slot_idx, pool)
+    if donate:
+        return jax.jit(chunk_fn, donate_argnums=(0, 1))
     return jax.jit(chunk_fn)
 
 
 def make_spec_chunk_step(model, draft_model, hps: HParams, depth: int,
                          params, draft_params, tol: float,
-                         greedy: bool = False):
+                         greedy: bool = False, donate: bool = False):
     """Build the jitted speculative (draft+verify) dispatch program
     (ISSUE 18).
 
@@ -526,6 +541,10 @@ def make_spec_chunk_step(model, draft_model, hps: HParams, depth: int,
             jnp.arange(depth + 1))
         return (fcarry, dcarry), prev, t, done, strokes, acc, drf
 
+    # donate=True: same carry/prev-only donation contract as
+    # make_chunk_step — the (full, draft) carry pair rides argnum 0
+    if donate:
+        return jax.jit(chunk_fn, donate_argnums=(0, 1))
     return jax.jit(chunk_fn)
 
 
@@ -640,6 +659,15 @@ class ServeEngine:
         else:
             self._draft_model = None
             self._draft_params = None
+        # unified dispatch runtime (ISSUE 20): each engine owns its own
+        # GeometryRunScheduler — the chunk probe registers with it, the
+        # run loop rides its depth-1 pipeline, and its DispatchLedger
+        # feeds host_syncs / dispatches_saved into every per-run
+        # metrics block (windowed per run, so concurrent runs on one
+        # engine would still each report their own deltas)
+        self.sched = GeometryRunScheduler(
+            "serve_engine" if replica_id is None
+            else f"serve_engine_r{replica_id}")
         self._bind_params(params)
         self.spans = SpanTimer(category="serve")
 
@@ -685,16 +713,21 @@ class ServeEngine:
         # depth its own geometry too — they sit BEFORE the (kernel,
         # dtype) pair so key[:-2] stays the flavor-independent pool
         # geometry the probe pins compare.
+        # the engine's loop is the single consumer of its own programs,
+        # so carry/prev donation (ISSUE 20) is always safe here — each
+        # dispatch rebinds both names to the outputs and nothing else
+        # ever holds the old buffers
         if self.speculative:
             fn = make_spec_chunk_step(
                 self.model, self._draft_model, self.hps,
                 self.draft_depth, self.params, self._draft_params,
-                self.draft_tol, self.greedy)
+                self.draft_tol, self.greedy, donate=True)
         else:
             fn = make_chunk_step(self.model, self.hps, self.chunk,
                                  self.params, self.greedy,
                                  kernel=self.decode_kernel,
-                                 param_args=self.param_args)
+                                 param_args=self.param_args,
+                                 donate=True)
         # value-paged mode appends params as a TRAILING traced argument
         # (a[7]); the geometry key stays the pool-shape tuple at a[6] —
         # the ISSUE 19 contract that the key must NOT grow a tenant
@@ -713,6 +746,16 @@ class ServeEngine:
                                    if self.speculative else "")
                                 + f"{self.decode_kernel},"
                                 f"{self.param_dtype})"))
+        # ISSUE 20: the chunk program joins the engine scheduler's
+        # compile accounting; a rebind (hot-swap) replaces the retired
+        # probe so compile_count() reflects the LIVE program's
+        # geometries, never a dead executable's (the registry's weak
+        # refs drop the retired probe once nothing else holds it)
+        with self.sched._lock:
+            self.sched._programs = [
+                r for r in self.sched._programs
+                if r() is not None and r()._name != "serve_chunk"]
+        self.sched.register(self._chunk_fn)
 
     def swap_params(self, params, ckpt_id: str = "",
                     param_dtype: Optional[str] = None) -> None:
@@ -991,6 +1034,12 @@ class ServeEngine:
             # silently migrate to) the process default device
             carry, prev, t_dev, done_dev = jax.device_put(
                 (carry, prev, t_dev, done_dev), self.device)
+        # donation hygiene (ISSUE 20): initial_carry aliases its (c, h)
+        # leaves to ONE zeros buffer, and the chunk program donates the
+        # carry — XLA rejects donating the same buffer twice, so split
+        # the leaves into distinct buffers once per run (B x hidden
+        # floats; every later chunk's carry is fresh program outputs)
+        carry = jax.tree_util.tree_map(jnp.copy, carry)
         slot_idx = np.zeros((nslots,), np.int32)
         reset = np.zeros((nslots,), bool)
         # the dispatch index each slot's occupant FIRST runs in: under
@@ -1062,6 +1111,10 @@ class ServeEngine:
                 reset[:] = False
                 cidx = n_disp
                 n_disp += 1
+                # one dispatch carries K chunk steps: the ledger's
+                # dispatches_saved is the realized K-amortization vs a
+                # step-at-a-time schedule (ISSUE 20)
+                self.sched.ledger.record_run(self.chunk, 1)
                 return out, cidx
 
         # Depth-1 software pipelining (the prefetch.py discipline on
@@ -1132,17 +1185,27 @@ class ServeEngine:
         admit_free_slots()
         occupied[:] = [r is not None for r in slot_req]
         n_live = int(occupied.sum())
-        nxt = dispatch() if requests else None
+        # the depth-1 pipeline now lives on the unified dispatch
+        # runtime (ISSUE 20): issue() dispatches the next chunk and
+        # hands back the previous in-flight one, so the dispatch order,
+        # dispatch count and fetch schedule are EXACTLY the legacy
+        # `nxt` juggling's — and every device_get flows through
+        # sched.fetch, making host_syncs exact by construction (zero
+        # between dispatches; one per fetched chunk).
+        pipe = self.sched.pipeline()
+        led0 = self.sched.ledger.snapshot()
+        if requests:
+            pipe.issue(dispatch)
         try:
             while n_live:
-                fut, cidx = nxt
-                nxt = dispatch()   # admissions decided from chunk i-1
+                # admissions decided from chunk i-1 ride dispatch i+1
+                fut, cidx = pipe.issue(dispatch)
                 t_prev = t_host    # chunk cidx-1's t: the row-delta base
                 fault_point(chunk_site)
                 with self.spans.span("fetch"):
                     if self.speculative:
                         t_host, done, strokes, acc, drf = \
-                            jax.device_get(fut)
+                            self.sched.fetch(fut)
                         # done slots / stale occupants draft nothing
                         # (emit gating), so the full [B] sums are exact
                         spec_acc += int(acc.sum())
@@ -1153,7 +1216,7 @@ class ServeEngine:
                             tel.counter("draft_steps_proposed",
                                         int(drf.sum()), cat="serve")
                     else:
-                        t_host, done, strokes = jax.device_get(fut)
+                        t_host, done, strokes = self.sched.fetch(fut)
                 n_chunks += 1
                 t = t_host
                 now = time.perf_counter()
@@ -1333,11 +1396,12 @@ class ServeEngine:
                     admit_free_slots()
                     occupied[:] = [r is not None for r in slot_req]
                     n_live = int(occupied.sum())
-            if nxt is not None:
+            tail = pipe.drain()
+            if tail is not None:
                 # drain the last in-flight (all-frozen) chunk — its steps
                 # served no request, so they land in the idle bucket and
                 # the attributed + idle == dispatched identity stays exact
-                jax.device_get(nxt[0][1])
+                self.sched.fetch(tail[0][1])
                 n_chunks += 1
                 idle_steps += self.chunk
         except BaseException:
@@ -1365,6 +1429,11 @@ class ServeEngine:
             raise
 
         wall = time.perf_counter() - t_start
+        # this run's window of the engine scheduler's shared ledger
+        # (ISSUE 20): dispatches, realized K-amortization and host
+        # syncs — the pipelining pin is host_syncs == fetched chunks
+        # (zero syncs BETWEEN dispatches)
+        led = self.sched.ledger.window(led0)
         if tel.enabled and n_chunks:
             # run-level cost counters for /metrics: attributed ticks
             # per completion above; dispatched/idle close the exact
@@ -1372,6 +1441,12 @@ class ServeEngine:
             tel.counter("device_steps_dispatched",
                         n_chunks * self.chunk, cat="serve")
             tel.counter("device_steps_idle", idle_steps, cat="serve")
+            # unified-runtime counters (ISSUE 20): the scrape-side view
+            # of the same ledger window the metrics block reports
+            tel.counter("dispatches", led["dispatches"], cat="runtime")
+            tel.counter("dispatches_saved", led["dispatches_saved"],
+                        cat="runtime")
+            tel.counter("host_syncs", led["host_syncs"], cat="runtime")
             # speculative headline gauges (ISSUE 18): the /metrics view
             # of this run's acceptance rate and rows-per-ledger-step —
             # same floats as the returned metrics block below
@@ -1392,6 +1467,13 @@ class ServeEngine:
             "decode_steps": int(sum(r.steps for r in results)),
             "device_steps": n_chunks * self.chunk,
             "chunks": n_chunks,
+            # unified-runtime ledger window (ISSUE 20): jitted calls
+            # this run issued, chunk-amortization realized vs a
+            # step-at-a-time schedule, and host syncs (one per fetched
+            # chunk under depth-1 pipelining — never between dispatches)
+            "dispatches": led["dispatches"],
+            "dispatches_saved": led["dispatches_saved"],
+            "host_syncs": led["host_syncs"],
             # cost attribution (ISSUE 11): steps_attributed +
             # steps_idle == device_steps EXACTLY (integers) — the
             # invariant trace_query and the fleet summary reconcile

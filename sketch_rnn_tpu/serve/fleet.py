@@ -117,6 +117,7 @@ from sketch_rnn_tpu.serve.admission import (
 )
 from sketch_rnn_tpu.serve.engine import Request, Result, ServeEngine
 from sketch_rnn_tpu.serve import endpoints as endpoints_mod
+from sketch_rnn_tpu.runtime.scheduler import default_scheduler
 from sketch_rnn_tpu.serve.slo import SLOTracker
 from sketch_rnn_tpu.serve.tenants import PrefixReuseIndex
 from sketch_rnn_tpu.utils.faults import backoff_s, fault_point
@@ -201,25 +202,16 @@ class _Replica:
         request's — the same keep-priority-order rule as the capacity
         stop (skipping ahead to lower-priority same-tenant work would
         violate class priority). Tenant-less fleets are unaffected:
-        every request's tenant is ``""``."""
-        batch: List[Request] = []
-        rows = 0
-        tenant: Optional[str] = None
-        for q in self.queues.values():
-            while q and rows < cap:
-                if tenant is not None and (q[0].tenant or "") != tenant:
-                    return batch
-                cost = endpoints_mod.pool_rows_of(q[0])
-                if rows + cost > cap:
-                    return batch
-                r = q.popleft()
-                if tenant is None:
-                    tenant = r.tenant or ""
-                batch.append(r)
-                rows += cost
-            if rows >= cap:
-                break
-        return batch
+        every request's tenant is ``""``.
+
+        The formation rule itself lives on the unified dispatch
+        runtime (ISSUE 20): :meth:`GeometryRunScheduler.form_burst` is
+        the frozen port of this loop, shared with every other
+        cost-capped grouper."""
+        return default_scheduler().form_burst(
+            self.queues.values(), cap,
+            cost_of=endpoints_mod.pool_rows_of,
+            group_of=lambda r: r.tenant or "")
 
 
 class ServeFleet:

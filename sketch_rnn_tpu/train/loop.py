@@ -92,6 +92,7 @@ from sketch_rnn_tpu.train.watchdog import (
     AnomalyHalt,
     WatchdogMonitor,
 )
+from sketch_rnn_tpu.runtime.scheduler import default_scheduler
 from sketch_rnn_tpu.utils.debug import check_finite, param_count
 from sketch_rnn_tpu.utils.faults import fault_point
 from sketch_rnn_tpu.utils.profiling import GoodputLedger, Throughput
@@ -130,19 +131,16 @@ def dispatch_stack(single_step, multi_step, state, batch, step: int,
     the number of jitted calls issued (1 for a full stack, ``use`` for
     a replay), so ledger accounting cannot drift from the decision
     made here.
+
+    The decision itself now lives on the unified dispatch runtime
+    (ISSUE 20, :meth:`runtime.scheduler.GeometryRunScheduler.
+    dispatch_stack`); this delegate keeps the historical import path
+    for the loop and the bench, and the runtime's shared ledger books
+    the run as a side effect.
     """
-    kk = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
-    use = min(kk, remaining)
-    if use == k:
-        state, metrics = multi_step(state, batch, root_key)
-        return state, metrics, use, 1
-    per_step = []
-    for i in range(use):
-        b_i = jax.tree_util.tree_map(lambda x: x[i], batch)
-        state, m = single_step(
-            state, b_i, jax.random.fold_in(root_key, step + i))
-        per_step.append(m)
-    return state, _replay_window_metrics(per_step), use, use
+    return default_scheduler().dispatch_stack(
+        single_step, multi_step, state, batch, step, remaining,
+        root_key, k)
 
 
 def _replay_window_metrics(per_step) -> Dict:
@@ -152,19 +150,10 @@ def _replay_window_metrics(per_step) -> Dict:
     the last micro-step's schedule values. Pure device-side tree math
     on the (lazy) metric refs — no host sync. Shared by every replay
     path so logged rows cannot drift in meaning between the scan, the
-    run-remainder replay and the fixed-T final remainder."""
-    sums = None
-    gmax = None
-    for m in per_step:
-        g = m["grad_norm"]
-        gmax = g if gmax is None else jnp.maximum(gmax, g)
-        sums = (dict(m) if sums is None
-                else {name: sums[name] + m[name] for name in sums})
-    metrics = {name: v / len(per_step) for name, v in sums.items()}
-    metrics["grad_norm_max"] = gmax
-    metrics["lr"] = per_step[-1]["lr"]
-    metrics["kl_weight"] = per_step[-1]["kl_weight"]
-    return metrics
+    run-remainder replay and the fixed-T final remainder. THE copy
+    lives on the unified runtime (ISSUE 20)."""
+    from sketch_rnn_tpu.runtime.scheduler import GeometryRunScheduler
+    return GeometryRunScheduler.replay_window_metrics(per_step)
 
 
 def _sweep_rows(params, loader: DataLoader, eval_step, mesh, key, multi):
@@ -201,22 +190,21 @@ def _sweep_rows(params, loader: DataLoader, eval_step, mesh, key, multi):
             f"stripe is empty; enlarge the split or reduce host count")
     multi_step, k_max = multi if multi is not None else (None, 1)
     pad_len = getattr(loader, "eval_pad_len", None)
-    i = 0
-    while i < n:
-        k = min(k_max, n - i) if multi_step is not None else 1
-        if k > 1 and pad_len is not None:
-            run, p0 = 1, pad_len(i)
-            while run < k and pad_len(i + run) == p0:
-                run += 1
-            k = run
+    # run formation is the unified runtime's (ISSUE 20): same spans as
+    # the historical inline chunker — geometry-bounded runs of <= k_max
+    # — with the dispatch/host-sync accounting riding the shared ledger
+    sched = default_scheduler()
+    for i, k in sched.geometry_runs(
+            n, k_max if multi_step is not None else 1, geom_of=pad_len):
         if k > 1:
             batches = [loader.get_batch(j) for j in range(i, i + k)]
             stacked = jax.tree_util.tree_map(
                 lambda *xs: np.stack(xs), *batches)
             if mesh is not None:
                 stacked = shard_batch(stacked, mesh, stacked=True)
-            out = jax.device_get(multi_step(params, stacked, key,
-                                            jnp.arange(i, i + k)))
+            sched.ledger.record_run(k, 1)
+            out = sched.fetch(multi_step(params, stacked, key,
+                                         jnp.arange(i, i + k)))
             for j in range(k):
                 yield {m: v[j] for m, v in out.items()}
         else:
@@ -225,10 +213,11 @@ def _sweep_rows(params, loader: DataLoader, eval_step, mesh, key, multi):
                 batch = shard_batch(batch, mesh)
             # eval is deterministic (no dropout, z uses the key) — a fixed
             # fold-in per batch keeps the sweep reproducible
-            yield {m: np.asarray(v) for m, v in dict(
+            sched.ledger.record_run(1, 1)
+            out = sched.fetch(dict(
                 eval_step(params, batch,
-                          jax.random.fold_in(key, i))).items()}
-        i += k
+                          jax.random.fold_in(key, i))))
+            yield {m: np.asarray(v) for m, v in out.items()}
 
 
 def evaluate(params, loader: DataLoader, eval_step,

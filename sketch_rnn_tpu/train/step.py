@@ -105,9 +105,15 @@ def _probe(fn, name: str) -> JitCompileProbe:
     """Wrap a jitted step/eval fn with the per-geometry compile probe
     (ISSUE 8): compile spans + jit-cache hit/miss counters + per-
     executable cost/memory stats when telemetry is on; a passthrough
-    (inner jit cache, bitwise the pre-probe path) when off."""
-    return JitCompileProbe(fn, name, key_of=_probe_batch_key,
-                           label_of=_probe_batch_label)
+    (inner jit cache, bitwise the pre-probe path) when off. Every
+    probe also registers with the unified runtime's default scheduler
+    (ISSUE 20) so train/eval compile counts share one audit surface
+    with the serve programs."""
+    from sketch_rnn_tpu.runtime.scheduler import default_scheduler
+
+    return default_scheduler().register(
+        JitCompileProbe(fn, name, key_of=_probe_batch_key,
+                        label_of=_probe_batch_label))
 
 
 def _vma_check(hps: HParams) -> bool:
@@ -184,7 +190,8 @@ def _make_single_step_core(model, hps: HParams, mesh: Optional[Mesh],
 
 
 def make_train_step(model, hps: HParams,
-                    mesh: Optional[Mesh] = None) -> StepFn:
+                    mesh: Optional[Mesh] = None,
+                    donate: bool = True) -> StepFn:
     """Build the jitted ``(state, batch, key) -> (state, metrics)`` step.
 
     The returned function is the per-bucket compiled-step cache of
@@ -196,10 +203,17 @@ def make_train_step(model, hps: HParams,
     The loss normalizer is ``hps.max_seq_len`` (static, NOT the batch
     T), which is what keeps the masked GMM term exactly
     bucket-independent (ops/mdn.py).
+
+    ``donate=False`` builds the step WITHOUT state donation — the
+    control arm ``scripts/runtime_bench.py`` measures the donated
+    program's peak-bytes reduction against (ISSUE 20). Production
+    callers never pass it: donating the state is the live contract the
+    async checkpointer's snapshot-before-dispatch discipline assumes.
     """
     step_fn = _make_single_step_core(model, hps, mesh, make_optimizer(hps))
+    dn = dict(donate_argnums=0) if donate else {}
     if mesh is None:
-        return _probe(jax.jit(step_fn, donate_argnums=0), "train_step")
+        return _probe(jax.jit(step_fn, **dn), "train_step")
     repl = replicated_sharding(mesh)
     data = batch_sharding(mesh)
     return _probe(jax.jit(
@@ -208,7 +222,7 @@ def make_train_step(model, hps: HParams,
         # data-sharded, key replicated
         in_shardings=(repl, data, repl),
         out_shardings=(repl, repl),
-        donate_argnums=0,
+        **dn,
     ), "train_step")
 
 

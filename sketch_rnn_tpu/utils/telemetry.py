@@ -1135,7 +1135,12 @@ def executable_stats(compiled) -> Dict[str, float]:
     simply omitted). ``peak_bytes`` is the executable's device-memory
     high-water estimate: arguments + outputs + temporaries (XLA's
     ``CompiledMemoryStats``), the number that decides whether a bucket
-    edge or slot count fits in HBM."""
+    edge or slot count fits in HBM. ``alias_bytes`` is the donated /
+    input-output-aliased portion of the arguments (ISSUE 20): a donated
+    program's EFFECTIVE high water is ``peak_bytes - alias_bytes``,
+    because aliased argument buffers are reused as outputs instead of
+    coexisting with them — the quantity ``scripts/runtime_bench.py``
+    measures the donation win on."""
     out: Dict[str, float] = {}
     try:
         ca = compiled.cost_analysis()
@@ -1158,6 +1163,9 @@ def executable_stats(compiled) -> Dict[str, float]:
             out["output_bytes"] = outb
             out["temp_bytes"] = tmp
             out["peak_bytes"] = arg + outb + tmp
+            alias = getattr(ma, "alias_size_in_bytes", None)
+            if alias is not None:
+                out["alias_bytes"] = float(alias or 0)
     except Exception:  # noqa: BLE001
         pass
     return out

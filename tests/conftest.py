@@ -120,7 +120,8 @@ def _no_leaked_fleet_threads():
         return sorted(t.name for t in threading.enumerate()
                       if t.is_alive() and t.name.startswith(
                           ("fleet-replica-", "loadgen", "ckpt-writer",
-                           "host-heartbeat-", "rollout-")))
+                           "host-heartbeat-", "rollout-",
+                           "coresident-")))
 
     deadline = _time.monotonic() + 5.0
     survivors = _runtime_threads()

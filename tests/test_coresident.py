@@ -1,0 +1,236 @@
+"""Co-resident train-and-serve (ISSUE 20 tentpole, phase 2).
+
+The contract: ``coresident_train`` / ``cli train --serve_fleet N``
+trains while a live fleet in the SAME process serves the same model;
+every async checkpoint the loop saves rolls out to the fleet through
+the PR 16 validated/canaried path, ``/healthz`` never reports
+``degraded``, a post-swap request is bitwise a cold fleet started from
+the same checkpoint, the serving lineage lands in RUN.json next to
+training's manifest, and completed requests stream back into
+``stream_batches`` as training data (the continual-learning loop).
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.cli import main
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data.native_batcher import stream_batches
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.runtime.coresident import (CoResident,
+                                               coresident_train,
+                                               stroke5_to_stroke3)
+from sketch_rnn_tpu.serve import Request, ServeFleet
+from sketch_rnn_tpu.train.checkpoint import ckpt_id_of, save_checkpoint
+from sketch_rnn_tpu.train.state import make_train_state
+from sketch_rnn_tpu.train.step import make_train_step
+
+TINY = dict(batch_size=8, max_seq_len=24, enc_rnn_size=12,
+            dec_rnn_size=16, z_size=6, num_mixture=3, hyper_rnn_size=8,
+            hyper_embed_size=4, serve_slots=2, serve_chunk=2)
+
+OK_STATUSES = {"ok", "rolling", "scaling"}
+
+
+def _req(i, z_dim, cap=4):
+    rng = np.random.default_rng(i)
+    return Request(key=jax.random.key(1000 + i),
+                   z=rng.standard_normal(z_dim).astype(np.float32),
+                   temperature=0.8, max_len=cap)
+
+
+def _loader(hps, n=48, seed=0):
+    from sketch_rnn_tpu.data.loader import (DataLoader,
+                                            make_synthetic_strokes)
+
+    seqs, labels = make_synthetic_strokes(
+        n, num_classes=max(hps.num_classes, 1), min_len=3,
+        max_len=hps.max_seq_len - 2, seed=seed)
+    return DataLoader(seqs, hps, labels=labels, augment=False,
+                      seed=seed)
+
+
+@pytest.fixture(scope="module")
+def env():
+    hps = HParams(**TINY)
+    model = SketchRNN(hps)
+    state_old = make_train_state(
+        model, hps, jax.random.key(0))._replace(
+            step=jnp.asarray(10, jnp.int32))
+    state_new = make_train_state(
+        model, hps, jax.random.key(7))._replace(
+            step=jnp.asarray(20, jnp.int32))
+    return dict(hps=hps, model=model, state_old=state_old,
+                state_new=state_new)
+
+
+@pytest.fixture(scope="module")
+def corun(tmp_path_factory):
+    """ONE co-resident training run shared by the assertion tests:
+    6 steps, checkpoints at 3 and 6, a 2-replica fleet serving 6
+    requests throughout."""
+    hps = HParams(**TINY, num_steps=6, save_every=3, log_every=3,
+                  eval_every=10**9)
+    wd = str(tmp_path_factory.mktemp("coresident"))
+    reqs = [_req(i, hps.z_size) for i in range(6)]
+    state, summary = coresident_train(
+        hps, _loader(hps), workdir=wd, seed=0, replicas=2,
+        poll_s=0.05, loadgen=reqs, use_mesh=False)
+    return dict(hps=hps, workdir=wd, state=state, summary=summary)
+
+
+def test_trains_and_rolls_live(corun):
+    """Training completes, BOTH its checkpoints rolled out live, the
+    fleet served every request, and /healthz never said degraded."""
+    assert int(corun["state"].step) == 6
+    s = corun["summary"]
+    rolled = [e for e in s["rollouts"] if e.get("ok")]
+    assert len(rolled) == 2  # steps 3 and 6, oldest first
+    assert s["serving_ckpt_id"] == ckpt_id_of(6)
+    assert s["requests_completed"] == 6
+    assert s["health_samples"] > 0
+    assert s["health_degraded"] == 0
+
+
+def test_lineage_lands_in_run_json(corun):
+    """RUN.json carries the serving lineage next to training's
+    manifest: ordered checkpoint windows ending on the final step."""
+    path = os.path.join(corun["workdir"], "RUN.json")
+    assert os.path.exists(path)
+    doc = json.load(open(path))
+    serving = doc["serving"]
+    lineage = serving["lineage"]
+    assert lineage[-1]["ckpt_id"] == ckpt_id_of(6)
+    assert lineage[-1]["to_uid"] is None  # the open serving window
+    assert [w["ckpt_id"] for w in lineage] == \
+        ["", ckpt_id_of(3), ckpt_id_of(6)]
+    assert serving["replicas"] == 2
+    assert serving["health_degraded"] == 0
+
+
+def test_post_swap_bitwise_cold_fleet(env, tmp_path):
+    """A checkpoint appearing while the fleet serves rolls out live,
+    and a post-swap request is bitwise what a COLD fleet started from
+    that checkpoint computes."""
+    hps, model = env["hps"], env["model"]
+    wd = str(tmp_path)
+    co = CoResident(model, hps, env["state_old"].params, wd,
+                    replicas=2, ckpt_id=ckpt_id_of(10), poll_s=0.05,
+                    health_period_s=0.02)
+    try:
+        save_checkpoint(wd, env["state_new"], 1.0, hps)
+        deadline = time.monotonic() + 30.0
+        while (co.fleet.serving_ckpt_id != ckpt_id_of(20)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert co.fleet.serving_ckpt_id == ckpt_id_of(20)
+        probe = _req(77, hps.z_size, cap=6)
+        co.fleet.submit(dataclasses.replace(probe), force=True)
+        assert co.fleet.drain(timeout=30.0)
+        (rec,) = co.fleet.results.values()
+        live = np.asarray(rec["result"].strokes5)
+        assert rec["result"].ckpt_id == ckpt_id_of(20)
+        statuses = set(co.health_statuses())
+        assert statuses and statuses <= OK_STATUSES
+        lineage = co.lineage()
+        assert lineage[-1]["ckpt_id"] == ckpt_id_of(20)
+    finally:
+        co.close()
+    cold = ServeFleet(model, hps, env["state_new"].params, replicas=2,
+                      ckpt_id=ckpt_id_of(20))
+    try:
+        cold.warm(_req(0, hps.z_size))
+        cold.start()
+        cold.submit(dataclasses.replace(probe), force=True)
+        assert cold.drain(timeout=30.0)
+        (crec,) = cold.results.values()
+        np.testing.assert_array_equal(live,
+                                      np.asarray(crec["result"].strokes5))
+    finally:
+        cold.close()
+
+
+def test_continual_learning_smoke(env):
+    """The loop closes: the fleet's completed-request corpus streams
+    back through ``stream_batches`` and the model trains on what it
+    served."""
+    hps, model = env["hps"], env["model"]
+    co = CoResident(model, hps, env["state_old"].params, "/nonexistent",
+                    replicas=2, poll_s=0.2)
+    try:
+        co.start_loadgen([_req(200 + i, hps.z_size, cap=6)
+                          for i in range(10)])
+        assert co.drain(timeout=60.0)
+        corpus = co.corpus()
+    finally:
+        co.close()
+    assert len(corpus) == 10
+    for s3 in corpus:
+        assert s3.ndim == 2 and s3.shape[1] == 3
+        assert s3[-1, 2] == 1.0  # the final stroke is closed
+    batches = list(stream_batches(iter(corpus), hps.batch_size,
+                                  hps.max_seq_len))
+    assert batches and batches[0]["strokes"].shape == \
+        (hps.batch_size, hps.max_seq_len + 1, 5)
+    state = make_train_state(model, hps, jax.random.key(3))
+    step = make_train_step(model, hps)
+    for i in range(2):
+        state, metrics = step(state, batches[0], jax.random.key(i))
+        assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 2
+
+
+def test_stroke5_to_stroke3_roundtrip_shape():
+    s5 = np.zeros((5, 5), np.float32)
+    s5[:, 0] = np.arange(5)
+    s5[2, 3] = 1.0       # pen lift mid-sketch
+    s5[:, 2] = 1.0
+    s3 = stroke5_to_stroke3(s5, length=4)  # EOS row dropped
+    assert s3.shape == (4, 3)
+    np.testing.assert_array_equal(s3[:, 0], [0, 1, 2, 3])
+    assert s3[2, 2] == 1.0 and s3[1, 2] == 0.0
+    assert s3[-1, 2] == 1.0  # final row closes its stroke
+    # degenerate length never yields an empty sequence
+    assert stroke5_to_stroke3(s5, length=0).shape == (1, 3)
+
+
+def test_cli_serve_fleet_usage_validation(tmp_path, capsys):
+    """Bad co-resident flags fail fast with one actionable line,
+    before any data/model work."""
+    wd = str(tmp_path)
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 "--serve_fleet=1"]) == 2
+    assert "N >= 2" in capsys.readouterr().err
+    assert main(["train", "--synthetic", "--workdir=",
+                 "--serve_fleet=2"]) == 2
+    assert "--workdir" in capsys.readouterr().err
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 "--serve_fleet=2", "--elastic_hosts=2",
+                 f"--rendezvous={wd}"]) == 2
+    assert "--elastic_hosts" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_cli_train_serve_fleet_e2e(tmp_path, capsys):
+    """The full CLI path: train --serve_fleet 2 on synthetic data;
+    lineage in RUN.json, co-resident summary on stdout."""
+    wd = str(tmp_path / "work")
+    hp = ("batch_size=8,max_seq_len=24,enc_rnn_size=12,dec_rnn_size=16,"
+          "z_size=6,num_mixture=3,hyper_rnn_size=8,hyper_embed_size=4,"
+          "serve_slots=2,serve_chunk=2,num_steps=4,save_every=2,"
+          "eval_every=50,log_every=2")
+    assert main(["train", "--synthetic", f"--workdir={wd}",
+                 f"--hparams={hp}", "--serve_fleet=2",
+                 "--serve_poll=0.05"]) == 0
+    out = capsys.readouterr().out
+    assert "co-resident fleet" in out
+    doc = json.load(open(os.path.join(wd, "RUN.json")))
+    assert doc["serving"]["lineage"][-1]["ckpt_id"] == ckpt_id_of(4)
+    assert doc["serving"]["health_degraded"] == 0

@@ -1,0 +1,172 @@
+"""Donation safety (ISSUE 20 satellite): donated buffers fail LOUDLY
+on reuse, never silently; the async-checkpoint snapshot reads
+pre-donation state; scheduling/telemetry stay bitwise-invisible.
+
+Buffer donation (``make_train_step(donate=True)``, the serve chunk
+programs' carry/prev aliasing) is a memory optimization with one
+failure mode worth pinning: a caller holding a stale reference to a
+donated input. XLA's contract is the safe one — the stale array is
+DELETED and any use raises — and these tests pin that the error is the
+loud kind (a raise naming donation), not silent garbage.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketch_rnn_tpu.config import HParams
+from sketch_rnn_tpu.data.loader import DataLoader, make_synthetic_strokes
+from sketch_rnn_tpu.models.vae import SketchRNN
+from sketch_rnn_tpu.serve.engine import Request, ServeEngine
+from sketch_rnn_tpu.train.checkpoint import restore_checkpoint
+from sketch_rnn_tpu.train.loop import train
+from sketch_rnn_tpu.train.state import make_train_state
+from sketch_rnn_tpu.train.step import make_train_step
+from sketch_rnn_tpu.utils import telemetry as tele
+
+TINY = dict(batch_size=4, max_seq_len=16, enc_rnn_size=12,
+            dec_rnn_size=16, z_size=6, num_mixture=3, hyper_rnn_size=8,
+            hyper_embed_size=4, serve_slots=2, serve_chunk=2)
+
+
+def tiny_hps(**kw) -> HParams:
+    return HParams(**{**TINY, **kw})
+
+
+def make_loader(hps, n=16, seed=0):
+    seqs, labels = make_synthetic_strokes(
+        n, num_classes=max(hps.num_classes, 1), min_len=5,
+        max_len=hps.max_seq_len - 2, seed=seed)
+    return DataLoader(seqs, hps, labels=labels, augment=False,
+                      seed=seed)
+
+
+def _assert_trees_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    hps = tiny_hps()
+    model = SketchRNN(hps)
+    loader = make_loader(hps)
+    return hps, model, loader
+
+
+def test_donated_state_reuse_raises_loudly(setup):
+    """The donation contract's failure mode: a stale reference to the
+    donated train state RAISES on any use — reading a leaf and
+    re-dispatching the step both name the deletion/donation. Silent
+    reuse of freed memory is the one outcome that must be
+    impossible."""
+    hps, model, loader = setup
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, donate=True)
+    batch = loader.get_batch(0)
+    stale = state
+    state, _ = step(state, batch, jax.random.key(1))
+    leaf = jax.tree_util.tree_leaves(stale.params)[0]
+    assert leaf.is_deleted()
+    with pytest.raises(RuntimeError, match="deleted"):
+        jnp.sum(leaf).block_until_ready()
+    with pytest.raises(Exception, match="deleted or donated"):
+        step(stale, batch, jax.random.key(2))
+    # the LIVE state keeps stepping fine — donation consumed only the
+    # stale generation
+    state, metrics = step(state, batch, jax.random.key(3))
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_donation_is_bitwise_invisible_to_training(setup):
+    """donate=True is a memory optimization ONLY: three steps with and
+    without donation produce bitwise-identical states and metrics."""
+    hps, model, loader = setup
+    batch = loader.get_batch(0)
+    finals = []
+    for donate in (False, True):
+        state = make_train_state(model, hps, jax.random.key(0))
+        step = make_train_step(model, hps, donate=donate)
+        for i in range(3):
+            state, metrics = step(state, batch, jax.random.key(i))
+        finals.append((jax.device_get(state.params),
+                       float(metrics["loss"])))
+    _assert_trees_equal(finals[0][0], finals[1][0])
+    assert finals[0][1] == finals[1][1]
+
+
+def test_host_snapshot_survives_donation(setup):
+    """The async-checkpoint pattern in miniature: a host snapshot
+    (``device_get``) taken BEFORE the donated dispatch stays readable
+    and equal to the pre-step values after the device buffers are
+    donated away — what the ckpt-writer thread relies on."""
+    hps, model, loader = setup
+    state = make_train_state(model, hps, jax.random.key(0))
+    step = make_train_step(model, hps, donate=True)
+    snapshot = jax.device_get(state.params)
+    reference = jax.tree_util.tree_map(np.array, snapshot)
+    state, _ = step(state, loader.get_batch(0), jax.random.key(1))
+    # the donated device generation is gone; the host snapshot is not
+    assert jax.tree_util.tree_leaves(state.params)[0] is not None
+    _assert_trees_equal(snapshot, reference)
+
+
+def test_async_checkpoint_reads_pre_donation_state(setup, tmp_path):
+    """Loop-level: with the donating train step, the async checkpoint
+    writer snapshots each saved step's state before the next donated
+    dispatch consumes it — async and sync checkpointing restore
+    bitwise-identical states."""
+    hps0, model, _ = setup
+    restored = []
+    for async_ckpt in (True, False):
+        hps = tiny_hps(num_steps=4, save_every=2, eval_every=10**9,
+                       log_every=10**9, async_checkpoint=async_ckpt)
+        wd = str(tmp_path / f"async_{async_ckpt}")
+        train(hps, make_loader(hps), workdir=wd, use_mesh=False)
+        target = make_train_state(SketchRNN(hps), hps,
+                                  jax.random.key(9))
+        per_step = []
+        for step_n in (2, 4):
+            st, _, _ = restore_checkpoint(wd, target, step=step_n)
+            per_step.append(jax.device_get(st.params))
+        restored.append(per_step)
+    for a, b in zip(restored[0], restored[1]):
+        _assert_trees_equal(a, b)
+
+
+def test_serve_strokes_bitwise_invariant_to_telemetry(setup):
+    """Telemetry (and the scheduler ledger feeding it) moves WHEN
+    things are observed, never WHAT is computed: the same requests
+    served with the core disabled and enabled produce bitwise-equal
+    strokes and identical dispatch/host-sync counts."""
+    hps, model, _ = setup
+
+    def serve_once():
+        params = model.init_params(jax.random.key(0))
+        eng = ServeEngine(model, hps, params)
+        rng = np.random.default_rng(5)
+        reqs = [Request(key=jax.random.key(500 + i),
+                        z=rng.standard_normal(hps.z_size)
+                        .astype(np.float32),
+                        temperature=0.7, max_len=4)
+                for i in range(4)]
+        out = eng.run(reqs)
+        strokes = [np.asarray(r.strokes5) for r in
+                   sorted(out["results"], key=lambda r: r.uid)]
+        m = out["metrics"]
+        return strokes, (m["dispatches"], m["host_syncs"])
+
+    base_strokes, base_counts = serve_once()
+    tele.configure(trace_dir=None)
+    try:
+        traced_strokes, traced_counts = serve_once()
+    finally:
+        tele.disable()
+    assert base_counts == traced_counts
+    for a, b in zip(base_strokes, traced_strokes):
+        np.testing.assert_array_equal(a, b)

@@ -1,0 +1,89 @@
+"""Unified dispatch runtime bench wiring (ISSUE 20 satellite: CI).
+
+``test_runtime_smoke`` runs the REAL six-arm matrix at tiny geometry —
+the tier-1 proof that the ``GeometryRunScheduler`` is bitwise the five
+legacy schedules it replaced and that buffer donation aliases the train
+state / serve carry into the compiled programs. The gate tests are
+pure: they pin that ``kind=runtime`` rows are a binary kind (keyed per
+scheduler site, metric 1.0/0.0 from ``ok``) and that a future
+``ok: false`` row actually gates via bench_regress.
+"""
+
+import json
+
+import scripts.bench_regress as bench_regress
+import scripts.runtime_bench as runtime_bench
+from scripts.bench_summary import key_of, metric_of
+
+
+def test_runtime_smoke(capsys):
+    rc = runtime_bench.main(["--smoke"])
+    assert rc == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")]
+    by_site = {r["site"]: r for r in rows}
+    assert set(by_site) == set(runtime_bench.ARMS)
+    assert all(r["ok"] is True and r["kind"] == "runtime"
+               and r["smoke"] is True for r in rows)
+    # the five port pins: each site's unified schedule bitwise legacy
+    assert by_site["train_stack"]["state_bitwise"] is True
+    assert by_site["train_stack"]["metrics_bitwise"] is True
+    assert by_site["train_stack"]["ledger_exact"] is True
+    assert by_site["train_stack"]["no_recompile"] is True
+    assert by_site["eval_sweep"]["spans_bitwise"] is True
+    assert by_site["eval_sweep"]["rows_bitwise"] is True
+    ep = by_site["engine_pipeline"]
+    assert ep["counts_exact"] is True and ep["solo_bitwise"] is True
+    # zero host syncs between dispatches: exactly one sync per chunk
+    assert ep["host_syncs"] == ep["chunks"] == ep["dispatches"]
+    assert ep["dispatches_saved"] > 0
+    assert by_site["fleet_burst"]["configs"] >= 4
+    eb = by_site["encode_burst"]
+    assert eb["schedule_bitwise"] is True and eb["edges"] >= 2
+    # donation machinery: buffers really aliased, effective peak drops
+    don = by_site["donation"]
+    assert don["train_donated_alias_bytes"] > 0
+    assert don["serve_chunk_donated_alias_bytes"] > 0
+    assert don["train_effective_reduction"] > 0
+
+
+def _row(ok, site="train_stack"):
+    return {"kind": "runtime", "site": site, "device_kind": "cpu",
+            "smoke": True, "ok": ok}
+
+
+def test_runtime_rows_key_and_gate_like_binary_kinds(tmp_path, capsys):
+    a = _row(True)
+    assert key_of(a) == key_of(_row(False))
+    assert key_of(a) != key_of(_row(True, site="engine_pipeline"))
+    # never pools with the other binary kinds
+    assert key_of(a) != key_of({"kind": "rollout", "site": "train_stack",
+                                "device_kind": "cpu", "ok": True})
+    assert metric_of(a) == 1.0
+    assert metric_of(_row(False)) == 0.0
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text("".join(json.dumps(_row(True)) + "\n"
+                            for _ in range(4)))
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(_row(False)) + "\n")
+    assert bench_regress.main([f"--fresh={bad}",
+                               f"--history={hist}"]) == 1
+    assert "REGRESS" in capsys.readouterr().out
+
+
+def test_committed_runtime_rows_in_band():
+    """The committed smoke history holds the runtime rows this PR
+    landed and they end in-band (the bench_regress --smoke self-check
+    covers them like every other binary kind)."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_SMOKE_HISTORY.jsonl")) as f:
+        rows = [json.loads(l) for l in f if '"runtime"' in l]
+    rows = [r for r in rows if r.get("kind") == "runtime"]
+    assert len(rows) >= 4
+    assert {r["site"] for r in rows} >= set(runtime_bench.ARMS)
+    last = {}
+    for r in rows:
+        last[r["site"]] = r
+    assert all(r["ok"] is True for r in last.values())
